@@ -1,0 +1,13 @@
+"""Test environment: run all JAX work on a virtual 8-device CPU mesh so
+multi-chip sharding logic is exercised without a TPU pod (SURVEY §4's
+"implication": the reference had no multi-node-without-a-cluster story;
+we fix that here). Must run before jax is first imported."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,77 @@
+"""Comparator semantics (reference src/Merger/CompareFunc.cc:70-113)."""
+
+import struct
+
+import pytest
+
+from uda_tpu.utils import comparators, vint
+from uda_tpu.utils.errors import UdaError
+
+
+def _text(s: bytes) -> bytes:
+    return vint.encode_vlong(len(s)) + s
+
+
+def test_text_skips_vint_prefix():
+    kt = comparators.get_key_type("org.apache.hadoop.io.Text")
+    assert kt.compare(_text(b"apple"), _text(b"banana")) < 0
+    assert kt.compare(_text(b"b"), _text(b"apple" * 100)) > 0
+    assert kt.compare(_text(b"same"), _text(b"same")) == 0
+    # shorter prefix sorts first
+    assert kt.compare(_text(b"ab"), _text(b"abc")) < 0
+
+
+def test_fixed_width_memcmp_semantics():
+    kt = comparators.get_key_type("org.apache.hadoop.io.IntWritable")
+    a = struct.pack(">i", 3)
+    b = struct.pack(">i", 1000)
+    assert kt.compare(a, b) < 0
+    # reference uses memcmp: negative ints (high bit set) sort AFTER
+    # positive — reproduce exactly (CompareFunc.cc:70-78)
+    neg = struct.pack(">i", -5)
+    assert kt.compare(neg, b) > 0
+
+
+def test_numeric_variant_fixes_sign():
+    kt = comparators.get_key_type("uda.tpu.IntNumeric")
+    neg = struct.pack(">i", -5)
+    pos = struct.pack(">i", 3)
+    assert kt.normalize(neg, 4)[0] < kt.normalize(pos, 4)[0]
+
+
+def test_bytes_writable_skips_length():
+    kt = comparators.get_key_type("org.apache.hadoop.io.BytesWritable")
+    a = struct.pack(">i", 2) + b"aa"
+    b = struct.pack(">i", 1) + b"b"
+    assert kt.compare(a, b) < 0
+
+
+def test_unsupported_key_class_raises():
+    with pytest.raises(UdaError):
+        comparators.get_key_type("org.example.Custom")
+
+
+def test_normalize_order_preserving():
+    # for keys whose content fits the width, the (prefix, length) pair
+    # must order exactly like the comparator — including trailing-NUL
+    # pairs like b"a" vs b"a\x00" and b"\x01" vs b"\x01\x00"
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    keys = [b"", b"a", b"a\x00", b"a\x00\x00", b"ab", b"abc", b"b",
+            b"\x01", b"\x01\x00", b"\x00", b"\xff\xff"]
+    W = 8
+    norm = [kt.normalize(k, W) for k in keys]
+    for i in range(len(keys)):
+        for j in range(len(keys)):
+            c_full = comparators.memcmp(keys[i], keys[j])
+            a, b = norm[i], norm[j]
+            c_norm = comparators.memcmp(a[0], b[0]) or (a[1] > b[1]) - (a[1] < b[1])
+            assert c_norm == c_full, (keys[i], keys[j])
+
+
+def test_normalize_overflow_needs_rank():
+    # keys longer than the width with equal prefixes tie on both columns;
+    # ops.sort.overflow_ranks provides the third tiebreak
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    a = kt.normalize(b"prefix__AAAA", 8)
+    b = kt.normalize(b"prefix__BBBB", 8)
+    assert a == b

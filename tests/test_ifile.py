@@ -1,0 +1,95 @@
+"""IFile framing + columnar crack (reference src/Merger/StreamRW.cc)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from uda_tpu.utils import ifile
+from uda_tpu.utils.errors import StorageError
+
+
+def _records(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        klen = int(rng.integers(0, 40))
+        vlen = int(rng.integers(0, 200))
+        out.append((rng.bytes(klen), rng.bytes(vlen)))
+    return out
+
+
+def test_round_trip_stream():
+    recs = _records()
+    buf = ifile.write_records(recs)
+    assert buf.endswith(ifile.EOF_MARKER)
+    got = list(ifile.IFileReader(io.BytesIO(buf)))
+    assert got == recs
+
+
+def test_crack_columnar():
+    recs = _records(200, seed=1)
+    buf = ifile.write_records(recs)
+    batch = ifile.crack(buf)
+    assert batch.num_records == len(recs)
+    for i, (k, v) in enumerate(recs):
+        assert batch.key(i) == k
+        assert batch.value(i) == v
+    assert list(batch.iter_records()) == recs
+
+
+def test_crack_missing_eof():
+    recs = _records(5)
+    buf = ifile.write_records(recs)[: -len(ifile.EOF_MARKER)]
+    with pytest.raises(StorageError):
+        ifile.crack(buf)
+    batch = ifile.crack(buf, expect_eof=False)
+    assert batch.num_records == 5
+
+
+def test_crack_corrupt():
+    with pytest.raises(StorageError):
+        # klen=-2 is invalid (only -1/-1 EOF allowed)
+        ifile.crack(b"\xfe\xfe")
+
+
+def test_batch_concat_and_take():
+    a = ifile.crack(ifile.write_records(_records(10, seed=2)))
+    b = ifile.crack(ifile.write_records(_records(7, seed=3)))
+    cat = ifile.RecordBatch.concat([a, b])
+    assert cat.num_records == 17
+    recs = list(a.iter_records()) + list(b.iter_records())
+    assert list(cat.iter_records()) == recs
+    order = np.arange(17)[::-1]
+    assert list(cat.take(order).iter_records()) == recs[::-1]
+
+
+def test_crc_trailer():
+    out = io.BytesIO()
+    w = ifile.IFileWriter(out, with_crc=True)
+    w.append(b"k", b"v")
+    w.close()
+    raw = out.getvalue()
+    # CRC covers framing + EOF marker; last 4 bytes are the trailer.
+    import zlib
+    assert int.from_bytes(raw[-4:], "big") == zlib.crc32(raw[:-4])
+    # read path verifies the trailer...
+    batch = ifile.crack(raw, verify_crc=True)
+    assert batch.num_records == 1
+    # ...and detects a bit flip
+    flipped = bytearray(raw)
+    flipped[2] ^= 1
+    with pytest.raises(StorageError, match="CRC mismatch"):
+        ifile.crack(bytes(flipped), verify_crc=True)
+    # missing trailer
+    with pytest.raises(StorageError, match="missing CRC"):
+        ifile.crack(ifile.write_records([(b"k", b"v")]), verify_crc=True)
+
+
+def test_truncation_raises_storage_error():
+    # truncation mid-VInt must surface as StorageError (the fallback
+    # contract catches UdaError, not IndexError)
+    with pytest.raises(StorageError):
+        ifile.crack(b"\x8e\x01")  # VInt cut mid-body
+    with pytest.raises(StorageError):
+        list(ifile.IFileReader(io.BytesIO(b"\x01\x01a")))  # no EOF marker

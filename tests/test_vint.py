@@ -1,0 +1,71 @@
+"""VInt/VLong codec conformance (reference src/CommUtils/IOUtility.cc:167-397)."""
+
+import numpy as np
+import pytest
+
+from uda_tpu.utils import vint
+
+
+# Known-good vectors computed from Hadoop WritableUtils.writeVLong semantics.
+KNOWN = [
+    (0, b"\x00"),
+    (1, b"\x01"),
+    (127, b"\x7f"),
+    (-1, b"\xff"),
+    (-112, b"\x90"),
+    (128, b"\x8f\x80"),
+    (255, b"\x8f\xff"),
+    (256, b"\x8e\x01\x00"),
+    (-113, b"\x87\x70"),
+    (-256, b"\x87\xff"),
+    (-257, b"\x86\x01\x00"),
+    (65535, b"\x8e\xff\xff"),
+    (2**31 - 1, b"\x8c\x7f\xff\xff\xff"),
+    (-(2**31), b"\x84\x7f\xff\xff\xff"),
+    (2**63 - 1, b"\x88" + b"\x7f" + b"\xff" * 7),
+    (-(2**63), b"\x80" + b"\x7f" + b"\xff" * 7),
+]
+
+
+@pytest.mark.parametrize("value,encoded", KNOWN)
+def test_known_vectors(value, encoded):
+    assert vint.encode_vlong(value) == encoded
+    got, off = vint.decode_vlong(encoded)
+    assert got == value
+    assert off == len(encoded)
+    assert vint.vlong_size(value) == len(encoded)
+
+
+def test_round_trip_random():
+    rng = np.random.default_rng(0)
+    vals = list(rng.integers(-(2**62), 2**62, size=500))
+    vals += [0, -1, 1, -112, -113, 127, 128, 2**63 - 1, -(2**63)]
+    buf = b"".join(vint.encode_vlong(int(v)) for v in vals)
+    pos = 0
+    for v in vals:
+        got, pos = vint.decode_vlong(buf, pos)
+        assert got == int(v)
+    assert pos == len(buf)
+
+
+def test_decode_vint_size_matches_encoding():
+    for v in (-(2**63), -2**40, -5000, -113, -112, -1, 0, 5, 127, 128, 2**40):
+        enc = vint.encode_vlong(v)
+        first = enc[0] - 256 if enc[0] > 127 else enc[0]
+        assert vint.decode_vint_size(first) == len(enc)
+
+
+def test_truncated_raises():
+    enc = vint.encode_vlong(100000)
+    with pytest.raises(IndexError):
+        vint.decode_vlong(enc[:-1])
+
+
+def test_stream_decode():
+    vals = [1, -1, 300, -300, 2**40, 0, 127, -112]
+    buf = np.frombuffer(b"".join(vint.encode_vlong(v) for v in vals), np.uint8)
+    got, offs = vint.decode_vlong_stream(buf)
+    assert got.tolist() == vals
+    assert offs[0] == 0 and len(offs) == len(vals)
+    got2, _ = vint.decode_vlong_stream(buf, count=3)
+    assert got2.tolist() == vals[:3]

@@ -1,0 +1,25 @@
+"""uda_tpu — a TPU-native shuffle/merge framework.
+
+A ground-up rebuild of the capabilities of Mellanox/Auburn UDA (the Hadoop
+MapReduce shuffle accelerator: RDMA data plane + network-levitated k-way
+merge) designed for TPU hardware:
+
+- XLA collectives (``all_to_all``/``ppermute``) over ICI/DCN replace the
+  ibverbs RDMAClient/RDMAServer queue-pair transport (reference
+  src/DataNet/).
+- Map-output IFile segments are staged into HBM arenas instead of
+  registered, pinned host memory (reference src/MOFServer/IndexInfo.cc).
+- The reduce-side priority-queue merge (reference src/Merger/MergeQueue.h,
+  StreamRW.cc) becomes device-resident sort/merge over fixed-stride
+  normalized key columns, with a host fallback for correctness diffing.
+- The UdaBridge control surface (startNative/doCommand + 6 up-calls,
+  reference src/UdaBridge.cc) is preserved as a Python/C control plane.
+
+Byte-level compatibility: Hadoop zero-compressed VInt/VLong, IFile record
+framing (VInt klen, VInt vlen, key, value, EOF = -1/-1), and RawComparator
+ordering semantics are preserved exactly (see uda_tpu.utils).
+"""
+
+from uda_tpu.version import __version__
+
+__all__ = ["__version__"]

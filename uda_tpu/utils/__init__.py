@@ -1,0 +1,8 @@
+"""L0 runtime: codecs, record streams, comparators, config, logging,
+errors, metrics (the IOUtility/UdaUtil layer of SURVEY §1)."""
+
+from uda_tpu.utils import vint, ifile, comparators, config, errors, metrics
+from uda_tpu.utils.logging import LogLevel, get_logger
+
+__all__ = ["vint", "ifile", "comparators", "config", "errors", "metrics",
+           "LogLevel", "get_logger"]

@@ -1,0 +1,176 @@
+"""Unified configuration registry.
+
+The reference spreads configuration across three channels (SURVEY §5):
+argv options parsed by getopt_long (``-w/-r/-a/-m/-g/-t/-s``, reference
+src/CommUtils/C2JNexus.cc:43-137), positional INIT-message params
+(reference src/Merger/reducer.cc:56-99), and a pull-based ``getConfData``
+up-call for late-bound keys (reference src/UdaBridge.cc:419-438). This
+module unifies all three behind one registry:
+
+- every known flag is declared once with its reference key, type and
+  default (the full inventory from the reference is reproduced below);
+- ``Config.from_argv`` accepts the same short options the reference's
+  ``parse_options`` does;
+- a ``conf_source`` callable can be attached to serve late-bound lookups
+  (the getConfData channel).
+
+TPU-specific knobs (mesh shape, HBM arena sizes, device record widths)
+live in the same registry so there is exactly one way to configure the
+framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+from uda_tpu.utils.errors import ConfigError
+
+__all__ = ["Flag", "Config", "FLAGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    key: str                 # dotted config key (reference JobConf key where one exists)
+    default: Any
+    type: type
+    help: str
+    short: Optional[str] = None  # reference getopt short option, if any
+
+
+# Full flag inventory. Reference keys keep their original names for
+# compatibility with Hadoop-side configs; uda.tpu.* keys are new.
+_FLAG_LIST = [
+    # --- reference argv channel (C2JNexus.cc:43-137) ---
+    Flag("mapred.rdma.wqe.per.conn", 256, int,
+         "in-flight fetch window per peer (reference WQEs per connection)", "w"),
+    Flag("mapred.rdma.cma.port", 9011, int,
+         "control-plane port (reference rdma_cm port)", "r"),
+    Flag("mapred.netmerger.merge.approach", 1, int,
+         "1=online in-memory merge, 2=hybrid LPQ/RPQ merge", "a"),
+    Flag("uda.log.dir", "", str, "private log directory", "g"),
+    Flag("uda.log.level", 4, int, "log severity 0..6 (lsNONE..lsTRACE)", "t"),
+    Flag("mapred.rdma.buf.size", 1024, int,
+         "staging buffer size in KB (reference RDMA buffer size)", "s"),
+    # --- reference INIT/getConfData channel (reducer.cc, UdaPlugin.java) ---
+    Flag("mapred.rdma.buf.size.min", 16, int, "minimum staging buffer KB"),
+    Flag("mapred.rdma.shuffle.total.size", 0, int,
+         "total shuffle memory budget in bytes (0 = derive from percent)"),
+    Flag("mapred.job.shuffle.input.buffer.percent", 0.7, float,
+         "fraction of available memory for shuffle when total.size unset"),
+    Flag("mapred.netmerger.hybrid.lpq.size", 0, int,
+         "segments per LPQ in hybrid merge (0 = sqrt(num_maps))"),
+    Flag("mapred.rdma.num.parallel.lpqs", 0, int,
+         "concurrent LPQs in hybrid merge (0 -> 3)"),
+    Flag("mapred.rdma.compression.buffer.ratio", 0.20, float,
+         "fraction of each buffer pair used for compressed data"),
+    Flag("mapred.uda.log.to.unique.file", "", str,
+         "when set, log to a private file instead of the up-call sink"),
+    Flag("mapred.uda.provider.blocked.threads.per.disk", 1, int,
+         "reader threads per local dir in the supplier data engine"),
+    Flag("mapred.rdma.developer.mode", False, bool,
+         "abort on failure instead of falling back to vanilla"),
+    Flag("mapred.compress.map.output", False, bool, "map outputs are compressed"),
+    Flag("mapred.map.output.compression.codec", "", str,
+         "codec class name (Lzo/Snappy accepted, like reference createInputClient)"),
+    Flag("io.compression.codec.snappy.buffersize", 256 * 1024, int,
+         "snappy block size"),
+    Flag("io.compression.codec.lzo.buffersize", 256 * 1024, int,
+         "lzo block size"),
+    # --- TPU-native knobs (new in this framework) ---
+    Flag("uda.tpu.mesh.shape", "", str,
+         "device mesh as 'dp:N,sh:M' axis list; empty = 1D over all devices"),
+    Flag("uda.tpu.key.width", 16, int,
+         "normalized key bytes carried in device sort columns (multiple of 4)"),
+    Flag("uda.tpu.run.records", 1 << 20, int,
+         "records per HBM-resident sorted run before spilling"),
+    Flag("uda.tpu.arena.slots", 16, int,
+         "buffer-pair slots in the HBM staging arena"),
+    Flag("uda.tpu.exchange.chunk.records", 1 << 18, int,
+         "records per all-to-all exchange round (windowing, replaces the "
+         "reference's 1000-chunk server pool)"),
+    Flag("uda.tpu.use.native", True, bool,
+         "use the C++ native codec/reader library when built"),
+]
+
+FLAGS: Dict[str, Flag] = {f.key: f for f in _FLAG_LIST}
+_SHORT: Dict[str, Flag] = {f.short: f for f in _FLAG_LIST if f.short}
+
+
+def _coerce(flag: Flag, value: Any) -> Any:
+    if isinstance(value, flag.type):
+        return value
+    if flag.type is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    try:
+        return flag.type(value)
+    except (TypeError, ValueError) as e:
+        raise ConfigError(f"bad value {value!r} for {flag.key}: {e}") from e
+
+
+class Config:
+    """Layered config: explicit overrides > conf_source pulls > defaults."""
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None,
+                 conf_source: Optional[Callable[[str, str], str]] = None):
+        self._values: Dict[str, Any] = {}
+        self.conf_source = conf_source
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    def set(self, key: str, value: Any) -> None:
+        flag = FLAGS.get(key)
+        self._values[key] = _coerce(flag, value) if flag else value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._values:
+            return self._values[key]
+        if self.conf_source is not None:
+            flag = FLAGS.get(key)
+            fallback = default if default is not None else (flag.default if flag else "")
+            pulled = self.conf_source(key, str(fallback))
+            if pulled is not None and pulled != "":
+                value = _coerce(flag, pulled) if flag else pulled
+                self._values[key] = value
+                return value
+        if default is not None:
+            return default
+        flag = FLAGS.get(key)
+        if flag is None:
+            raise ConfigError(f"unknown config key {key!r} and no default given")
+        return flag.default
+
+    @classmethod
+    def from_argv(cls, argv: list[str]) -> "Config":
+        """Parse the reference's short-option argv (C2JNexus.cc:43-137).
+
+        Accepts ``["-w","256","-r","9011","-a","1","-m","0","-g",dir,
+        "-t","4","-s","1024"]`` style lists; ``-m`` (standalone mode) is
+        accepted and ignored, like the reference's mostly-vestigial mode
+        flag.
+        """
+        cfg = cls()
+        i = 0
+        while i < len(argv):
+            tok = argv[i]
+            if not tok.startswith("-") or len(tok) != 2:
+                raise ConfigError(f"bad option token {tok!r}")
+            opt = tok[1]
+            if i + 1 >= len(argv):
+                raise ConfigError(f"option -{opt} missing value")
+            val = argv[i + 1]
+            i += 2
+            if opt == "m":
+                continue
+            flag = _SHORT.get(opt)
+            if flag is None:
+                raise ConfigError(f"unknown option -{opt}")
+            cfg.set(flag.key, val)
+        return cfg
+
+    def as_dict(self) -> Dict[str, Any]:
+        out = {f.key: f.default for f in _FLAG_LIST}
+        out.update(self._values)
+        return out

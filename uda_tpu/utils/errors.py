@@ -1,0 +1,81 @@
+"""Typed error hierarchy + fallback signalling.
+
+Equivalent of the reference's ``UdaException`` (backtrace-carrying C++
+exception rethrown into Java, reference src/CommUtils/IOUtility.cc:561-569)
+and the fallback-to-vanilla machinery (any native failure flips the Java
+side back to Hadoop's stock shuffle, reference src/UdaBridge.cc:506-530,
+plugins/shared/.../UdaShuffleConsumerPluginShared.java:205-242).
+
+In the TPU build, ``FallbackSignal`` plays the role of
+``failureInUda``: the bridge catches any ``UdaError`` raised inside the
+engine, reports it through the registered failure up-call, and the caller
+decides whether to fall back to its vanilla path (unless developer mode is
+set, in which case we re-raise — mirroring ``mapred.rdma.developer.mode``).
+"""
+
+from __future__ import annotations
+
+import traceback
+
+__all__ = [
+    "UdaError",
+    "ConfigError",
+    "ProtocolError",
+    "TransportError",
+    "MergeError",
+    "StorageError",
+    "CompressionError",
+    "FallbackSignal",
+]
+
+
+class UdaError(Exception):
+    """Base error. Captures a formatted backtrace at construction, like the
+    reference's UdaException embeds a C++ backtrace in its message
+    (IOUtility.cc:561-569, print_backtrace :479-498)."""
+
+    def __init__(self, message: str):
+        self.backtrace = "".join(traceback.format_stack()[:-1])
+        super().__init__(message)
+
+
+class ConfigError(UdaError):
+    """Bad or missing configuration (reference parse_options failures,
+    src/CommUtils/C2JNexus.cc:43-137)."""
+
+
+class ProtocolError(UdaError):
+    """Malformed control-plane command (reference parse_hadoop_cmd,
+    src/CommUtils/C2JNexus.cc:141-207)."""
+
+
+class TransportError(UdaError):
+    """Exchange/collective-plane failure (reference RDMA WC errors and
+    connect failures, src/DataNet/RDMAClient.cc:215-356)."""
+
+
+class MergeError(UdaError):
+    """Merge-engine invariant violation (reference merge-thread failures,
+    src/Merger/MergeManager.cc)."""
+
+
+class StorageError(UdaError):
+    """Segment IO failure (reference AIOHandler/DataEngine read errors,
+    src/MOFServer/IndexInfo.cc:304-376)."""
+
+
+class CompressionError(UdaError):
+    """Codec failure (reference DecompressorWrapper paths,
+    src/Merger/DecompressorWrapper.cc)."""
+
+
+class FallbackSignal(Exception):
+    """Raised to the embedding application to request fallback-to-vanilla.
+
+    Wraps the originating ``UdaError``. Matches the contract of
+    ``UdaBridge_exceptionInNativeThread`` -> Java ``failureInUda``
+    (reference src/UdaBridge.cc:506-530)."""
+
+    def __init__(self, cause: UdaError):
+        self.cause = cause
+        super().__init__(f"uda_tpu failure, fallback requested: {cause}")

@@ -1,0 +1,238 @@
+"""IFile record streams: Hadoop map-output segment format.
+
+Byte-exact implementation of the record framing the reference reads and
+writes (reference src/Merger/StreamRW.cc): each record is
+``VInt(keyLen) VInt(valLen) key value``; end-of-stream is the marker pair
+``(-1, -1)`` (two 0xFF bytes), detected by the reference's ``nextKV``
+(StreamRW.cc:334-449) and appended by ``write_kv_to_stream``
+(StreamRW.cc:151-225).
+
+Two access styles:
+
+- streaming reader/writer (``IFileReader``/``IFileWriter``) matching the
+  reference's record-at-a-time iterators;
+- bulk *columnar cracking* (``crack``): one pass converts a whole segment
+  buffer into offset/length arrays over the raw bytes — the host-side
+  preparation step for staging records into device-resident columns.
+  This replaces the reference's per-record VInt parse in the merge hot
+  loop with a single vectorizable pass (natively accelerated by
+  uda_tpu/native when built).
+
+Checksum note: Hadoop's IFile wraps streams in IFileOutputStream (CRC32
+trailer). The reference's native merger consumes the *decompressed,
+checksum-stripped* record stream handed over by the Java side, so the
+framing here deliberately matches that inner stream, not the on-disk
+CRC-wrapped one. An optional CRC32 trailer is supported for our own
+spill files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import zlib
+from typing import BinaryIO, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from uda_tpu.utils import vint
+from uda_tpu.utils.errors import StorageError
+
+__all__ = ["IFileWriter", "IFileReader", "RecordBatch", "crack", "write_records"]
+
+EOF_MARKER = b"\xff\xff"  # VInt(-1) VInt(-1)
+
+
+class IFileWriter:
+    """Sequential record writer with EOF marker on close.
+
+    Mirrors ``write_kv_to_stream`` framing (reference StreamRW.cc:151-225).
+    """
+
+    def __init__(self, out: BinaryIO, with_crc: bool = False):
+        self._out = out
+        self._crc = zlib.crc32(b"") if with_crc else None
+        self.records = 0
+        self.bytes_written = 0
+        self._closed = False
+
+    def append(self, key: bytes, value: bytes) -> None:
+        rec = (vint.encode_vlong(len(key)) + vint.encode_vlong(len(value))
+               + key + value)
+        self._out.write(rec)
+        if self._crc is not None:
+            self._crc = zlib.crc32(rec, self._crc)
+        self.records += 1
+        self.bytes_written += len(rec)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._out.write(EOF_MARKER)
+        self.bytes_written += len(EOF_MARKER)
+        if self._crc is not None:
+            self._crc = zlib.crc32(EOF_MARKER, self._crc)
+            self._out.write(self._crc.to_bytes(4, "big"))
+            self.bytes_written += 4
+        self._closed = True
+
+    def __enter__(self) -> "IFileWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class IFileReader:
+    """Record-at-a-time reader (reference BaseSegment::nextKV semantics,
+    StreamRW.cc:334-449): yields (key, value) until the EOF marker."""
+
+    def __init__(self, src: BinaryIO):
+        self._buf = src.read()
+        self._pos = 0
+
+    def __iter__(self) -> Iterator[Tuple[bytes, bytes]]:
+        buf = self._buf
+        pos = self._pos
+        while True:
+            try:
+                klen, pos = vint.decode_vlong(buf, pos)
+                vlen, pos = vint.decode_vlong(buf, pos)
+            except IndexError as e:
+                raise StorageError(f"truncated IFile stream at offset {pos}: {e}") from e
+            if klen == -1 and vlen == -1:
+                return
+            if klen < 0 or vlen < 0:
+                raise StorageError(f"corrupt IFile record lengths {klen}/{vlen}")
+            key = buf[pos:pos + klen]
+            pos += klen
+            val = buf[pos:pos + vlen]
+            pos += vlen
+            if len(key) != klen or len(val) != vlen:
+                raise StorageError("truncated IFile record")
+            yield bytes(key), bytes(val)
+
+
+@dataclasses.dataclass
+class RecordBatch:
+    """Columnar view of one segment: raw bytes + per-record offsets.
+
+    ``data`` holds the segment bytes; keys/values are addressed by
+    (offset, length) int64 arrays. This is the host-side currency between
+    the supplier, the staging arena and the device packing step.
+    """
+
+    data: np.ndarray        # uint8, the full segment buffer (records are
+                            # addressed by offset; any EOF marker / CRC
+                            # trailer bytes at the tail are never addressed)
+    key_off: np.ndarray     # int64 [n]
+    key_len: np.ndarray     # int64 [n]
+    val_off: np.ndarray     # int64 [n]
+    val_len: np.ndarray     # int64 [n]
+
+    @property
+    def num_records(self) -> int:
+        return int(self.key_off.shape[0])
+
+    def key(self, i: int) -> bytes:
+        o, n = int(self.key_off[i]), int(self.key_len[i])
+        return self.data[o:o + n].tobytes()
+
+    def value(self, i: int) -> bytes:
+        o, n = int(self.val_off[i]), int(self.val_len[i])
+        return self.data[o:o + n].tobytes()
+
+    def iter_records(self) -> Iterator[Tuple[bytes, bytes]]:
+        for i in range(self.num_records):
+            yield self.key(i), self.value(i)
+
+    def take(self, order: np.ndarray) -> "RecordBatch":
+        """Reorder records (used to materialize a device-computed sort
+        permutation back into record order)."""
+        return RecordBatch(self.data, self.key_off[order], self.key_len[order],
+                           self.val_off[order], self.val_len[order])
+
+    @staticmethod
+    def concat(batches: list["RecordBatch"]) -> "RecordBatch":
+        """Concatenate batches into one (rebases offsets into one buffer)."""
+        if not batches:
+            return RecordBatch(np.zeros(0, np.uint8), *([np.zeros(0, np.int64)] * 4))
+        datas, kos, kls, vos, vls = [], [], [], [], []
+        base = 0
+        for b in batches:
+            datas.append(b.data)
+            kos.append(b.key_off + base)
+            kls.append(b.key_len)
+            vos.append(b.val_off + base)
+            vls.append(b.val_len)
+            base += len(b.data)
+        return RecordBatch(np.concatenate(datas), np.concatenate(kos),
+                           np.concatenate(kls), np.concatenate(vos),
+                           np.concatenate(vls))
+
+
+def crack(buf: bytes | np.ndarray, expect_eof: bool = True,
+          verify_crc: bool = False) -> RecordBatch:
+    """One-pass columnar crack of an IFile segment buffer.
+
+    Replaces per-record parsing in the merge hot loop (reference
+    StreamRW.cc:334-449) with a single host pass producing offset/length
+    columns. With ``verify_crc`` the 4 bytes after the EOF marker are
+    checked as a big-endian CRC32 of everything before them (the trailer
+    ``IFileWriter(with_crc=True)`` writes). The native library
+    (uda_tpu.native.lib) overrides this with a C++ implementation when
+    available; this is the pure-Python reference.
+    """
+    arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf
+    mem = memoryview(arr)
+    n = len(arr)
+    key_off, key_len, val_off, val_len = [], [], [], []
+    pos = 0
+    saw_eof = False
+    while pos < n:
+        try:
+            klen, p = vint.decode_vlong(mem, pos)
+            vlen, p = vint.decode_vlong(mem, p)
+        except IndexError as e:
+            raise StorageError(f"truncated IFile segment at offset {pos}: {e}") from e
+        if klen == -1 and vlen == -1:
+            saw_eof = True
+            pos = p
+            break
+        if klen < 0 or vlen < 0 or p + klen + vlen > n:
+            raise StorageError(f"corrupt IFile segment at offset {pos}")
+        key_off.append(p)
+        key_len.append(klen)
+        val_off.append(p + klen)
+        val_len.append(vlen)
+        pos = p + klen + vlen
+    if expect_eof and not saw_eof:
+        raise StorageError("IFile segment missing EOF marker")
+    if verify_crc:
+        if not saw_eof or pos + 4 > n:
+            raise StorageError("IFile segment missing CRC trailer")
+        want = int.from_bytes(mem[pos:pos + 4], "big")
+        got = zlib.crc32(mem[:pos])
+        if want != got:
+            raise StorageError(f"IFile CRC mismatch: trailer {want:#010x}, "
+                               f"computed {got:#010x}")
+    return RecordBatch(
+        arr,
+        np.asarray(key_off, dtype=np.int64),
+        np.asarray(key_len, dtype=np.int64),
+        np.asarray(val_off, dtype=np.int64),
+        np.asarray(val_len, dtype=np.int64),
+    )
+
+
+def write_records(records: Iterable[Tuple[bytes, bytes]],
+                  out: Optional[BinaryIO] = None) -> bytes:
+    """Serialize records into IFile framing; returns the bytes when no
+    stream is given."""
+    own = out is None
+    stream = out or io.BytesIO()
+    w = IFileWriter(stream)
+    for k, v in records:
+        w.append(k, v)
+    w.close()
+    return stream.getvalue() if own else b""

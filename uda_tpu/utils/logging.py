@@ -1,0 +1,123 @@
+"""Dual-mode log facility.
+
+Equivalent of the reference logger (reference src/CommUtils/IOUtility.cc:
+406-557): severity enum lsNONE..lsTRACE, either routed to the embedding
+application through a registered up-call (the ``logToJava`` path,
+UdaBridge.cc:440-452) or written to a private per-role log file
+(``mapred.uda.log.to.unique.file``). Log level can be re-synced at runtime
+(the reference re-reads log4j's level once per second,
+plugins/shared/.../UdaPlugin.java:99-143; here ``set_level`` is just
+called directly by the bridge's SET_LOG_LEVEL command).
+
+Every message carries a ``(file:line)`` suffix like the reference
+(IOUtility.cc:514-536).
+"""
+
+from __future__ import annotations
+
+import enum
+import inspect
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["LogLevel", "Logger", "get_logger", "log"]
+
+
+class LogLevel(enum.IntEnum):
+    # Mirrors the severity enum in reference src/include/IOUtility.h
+    NONE = 0
+    FATAL = 1
+    ERROR = 2
+    WARN = 3
+    INFO = 4
+    DEBUG = 5
+    TRACE = 6
+
+
+class Logger:
+    """Process-wide logger with an optional up-call sink.
+
+    ``sink`` receives ``(level, message)``; when unset, messages go to a
+    file (if ``open_file`` was called) or stderr.
+    """
+
+    def __init__(self) -> None:
+        self.level = LogLevel.INFO
+        self.sink: Optional[Callable[[int, str], None]] = None
+        self._file = None
+        self._lock = threading.Lock()
+
+    def set_level(self, level: int) -> None:
+        self.level = LogLevel(max(0, min(6, int(level))))
+
+    def set_sink(self, sink: Optional[Callable[[int, str], None]]) -> None:
+        self.sink = sink
+
+    def open_file(self, path: str) -> None:
+        """Private log file mode (reference startLogNetMerger/MOFSupplier,
+        IOUtility.cc:406-466)."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with self._lock:
+            if self._file:
+                self._file.close()
+            self._file = open(path, "a", buffering=1)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+
+    def log(self, level: LogLevel, msg: str) -> None:
+        if level > self.level or self.level == LogLevel.NONE:
+            return
+        # attribute to the first frame outside this module, whatever the
+        # call depth (direct .log(), level helpers, or module-level log())
+        caller = inspect.currentframe()
+        this_file = __file__
+        while caller is not None and caller.f_code.co_filename == this_file:
+            caller = caller.f_back
+        where = ""
+        if caller:
+            where = f" ({os.path.basename(caller.f_code.co_filename)}:{caller.f_lineno})"
+        text = f"{msg}{where}"
+        if self.sink is not None:
+            self.sink(int(level), text)
+            return
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        line = f"{stamp} {level.name:5s} uda_tpu: {text}\n"
+        with self._lock:
+            out = self._file or sys.stderr
+            out.write(line)
+
+    def fatal(self, msg: str) -> None:
+        self.log(LogLevel.FATAL, msg)
+
+    def error(self, msg: str) -> None:
+        self.log(LogLevel.ERROR, msg)
+
+    def warn(self, msg: str) -> None:
+        self.log(LogLevel.WARN, msg)
+
+    def info(self, msg: str) -> None:
+        self.log(LogLevel.INFO, msg)
+
+    def debug(self, msg: str) -> None:
+        self.log(LogLevel.DEBUG, msg)
+
+    def trace(self, msg: str) -> None:
+        self.log(LogLevel.TRACE, msg)
+
+
+_LOGGER = Logger()
+
+
+def get_logger() -> Logger:
+    return _LOGGER
+
+
+def log(level: LogLevel, msg: str) -> None:
+    _LOGGER.log(level, msg)

@@ -1,0 +1,68 @@
+"""Per-phase timers and counters.
+
+Equivalent of the reference's per-task counters (``total_wait_mem_time``,
+``total_fetch_time``, ``total_merge_time``, reference
+src/Merger/reducer.h:80-90, accumulated in StreamRW.cc:555-569) and the
+AIO on-air counters (src/CommUtils/AIOHandler.cc:129-141). The reference
+had no dedicated tracer (SURVEY §5); here we add a lightweight span/trace
+export so profiles can be correlated with device profiles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+__all__ = ["Metrics", "metrics"]
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.spans: list[dict] = []
+        self.record_spans = False
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] += value
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.counters[name + "_time"] += dt
+                if self.record_spans:
+                    self.spans.append({"name": name, "ts": t0, "dur": dt,
+                                       "tid": threading.get_ident()})
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.counters)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.spans.clear()
+
+    def export_chrome_trace(self, path: str) -> None:
+        """Write spans in Chrome trace-event format (load in perfetto)."""
+        with self._lock:
+            events = [
+                {"name": s["name"], "ph": "X", "pid": 0, "tid": s["tid"],
+                 "ts": s["ts"] * 1e6, "dur": s["dur"] * 1e6}
+                for s in self.spans
+            ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+
+metrics = Metrics()

@@ -1,0 +1,8 @@
+"""Version of the uda_tpu framework.
+
+The reference UDA is version 3.4.1-0 (release:1), autoconf package
+``libuda`` 3.1 (reference src/configure.ac:20). We restart at 0.x for the
+TPU-native rebuild.
+"""
+
+__version__ = "0.1.0"
