@@ -1,0 +1,64 @@
+"""Shared test helpers: synthetic map-output generation.
+
+Builds the on-disk layout the supplier serves (``<root>/<job>/<map>/
+file.out[.index]``) the way a Hadoop mapper would: per-map records
+partitioned by reducer, each partition sorted and IFile-framed, index
+triples pointing into the concatenated MOF.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Callable
+
+import numpy as np
+
+from uda_tpu.mofserver.index import write_index_file
+from uda_tpu.utils.ifile import IFileWriter
+
+
+def default_partitioner(key: bytes, num_reducers: int) -> int:
+    import zlib
+    return zlib.crc32(key) % num_reducers
+
+
+def make_mof_tree(root: str, job_id: str, num_maps: int, num_reducers: int,
+                  records_per_map: int, seed: int = 0,
+                  key_bytes: int = 10, val_bytes: int = 30,
+                  partitioner: Callable[[bytes, int], int] = default_partitioner,
+                  sort_key=None) -> dict[int, list[tuple[bytes, bytes]]]:
+    """Write a full MOF tree; returns expected records per reducer
+    (unsorted)."""
+    rng = np.random.default_rng(seed)
+    expected: dict[int, list[tuple[bytes, bytes]]] = {r: [] for r in range(num_reducers)}
+    sort_key = sort_key or (lambda kv: kv[0])
+    for m in range(num_maps):
+        map_id = f"attempt_{job_id}_m_{m:06d}_0"
+        parts: dict[int, list[tuple[bytes, bytes]]] = {r: [] for r in range(num_reducers)}
+        for _ in range(records_per_map):
+            k = rng.bytes(key_bytes)
+            v = rng.bytes(val_bytes)
+            r = partitioner(k, num_reducers)
+            parts[r].append((k, v))
+            expected[r].append((k, v))
+        d = os.path.join(root, job_id, map_id)
+        os.makedirs(d, exist_ok=True)
+        mof = io.BytesIO()
+        triples = []
+        for r in range(num_reducers):
+            start = mof.tell()
+            w = IFileWriter(mof)
+            for k, v in sorted(parts[r], key=sort_key):
+                w.append(k, v)
+            w.close()
+            length = mof.tell() - start
+            triples.append((start, length, length))
+        with open(os.path.join(d, "file.out"), "wb") as f:
+            f.write(mof.getvalue())
+        write_index_file(os.path.join(d, "file.out.index"), triples)
+    return expected
+
+
+def map_ids(job_id: str, num_maps: int) -> list[str]:
+    return [f"attempt_{job_id}_m_{m:06d}_0" for m in range(num_maps)]

@@ -1,0 +1,170 @@
+"""End-to-end single-host slice: MOFs on disk -> fetch -> device merge ->
+framed IFile emission (SURVEY §7.3's minimum slice), online and hybrid."""
+
+import functools
+import io
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.merger import LocalFetchClient, MergeManager
+from uda_tpu.merger.arena import BufferArena
+from uda_tpu.merger.hybrid import num_lpqs_for
+from uda_tpu.mofserver import DataEngine, DirIndexResolver
+from uda_tpu.utils import comparators
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import MergeError
+from uda_tpu.utils.ifile import IFileReader
+
+
+def _run_merge(tmp_path, cfg=None, num_maps=6, num_reducers=2,
+               records_per_map=80, job="jobA", seed=1):
+    expected = make_mof_tree(str(tmp_path), job, num_maps, num_reducers,
+                             records_per_map, seed=seed)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    results = {}
+    try:
+        for r in range(num_reducers):
+            mm = MergeManager(LocalFetchClient(engine), kt, cfg)
+            blocks = []
+            # consumer gets a memoryview valid only during the call: copy
+            total = mm.run(job, map_ids(job, num_maps), r,
+                           lambda b: blocks.append(bytes(b)))
+            stream = b"".join(blocks)
+            assert total == len(stream)
+            results[r] = list(IFileReader(io.BytesIO(stream)))
+    finally:
+        engine.stop()
+    return expected, results
+
+
+def _check_sorted_equal(expected, got, kt):
+    want = sorted(expected, key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert [k for k, _ in got] == [k for k, _ in want]
+    assert sorted(v for _, v in got) == sorted(v for _, v in want)
+
+
+def test_online_merge_end_to_end(tmp_path):
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    expected, results = _run_merge(tmp_path)
+    for r, got in results.items():
+        assert len(got) == len(expected[r])
+        _check_sorted_equal(expected[r], got, kt)
+
+
+def test_online_merge_small_chunks_split_records(tmp_path):
+    # chunk smaller than a record forces the carry/join path
+    # (reference switch_mem/join, StreamRW.cc:542-590)
+    cfg = Config({"mapred.rdma.buf.size": 1})  # 1 KB chunks... still big
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    expected, results = _run_merge(tmp_path, cfg, num_maps=2,
+                                   records_per_map=30, job="jobB", seed=2)
+    for r, got in results.items():
+        assert len(got) == len(expected[r])
+        _check_sorted_equal(expected[r], got, kt)
+
+
+def test_hybrid_merge_end_to_end(tmp_path):
+    cfg = Config({"mapred.netmerger.merge.approach": 2,
+                  "mapred.netmerger.hybrid.lpq.size": 2,
+                  "uda.tpu.spill.dirs": str(tmp_path / "spill")})
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    expected, results = _run_merge(tmp_path, cfg, num_maps=7, job="jobC",
+                                   seed=3)
+    for r, got in results.items():
+        assert len(got) == len(expected[r])
+        _check_sorted_equal(expected[r], got, kt)
+    # spill files are deleted after the RPQ phase (~SuperSegment)
+    spill = tmp_path / "spill"
+    assert not spill.exists() or not any(spill.iterdir())
+
+
+def test_hybrid_empty_spill_dirs_falls_back_to_tmp(tmp_path):
+    # regression: explicit '' must mean "system tmp", not crash
+    cfg = Config({"mapred.netmerger.merge.approach": 2,
+                  "uda.tpu.spill.dirs": ""})
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    expected, results = _run_merge(tmp_path, cfg, num_maps=4, job="jobE",
+                                   seed=5)
+    for r, got in results.items():
+        _check_sorted_equal(expected[r], got, kt)
+
+
+def test_emitter_block_size_and_eof():
+    from uda_tpu.merger.emitter import FramedEmitter
+    recs = [(bytes([i]) * 4, b"v" * 50) for i in range(100)]
+    em = FramedEmitter(block_size=256)
+    blocks = []
+    total = em.emit(iter(recs), lambda b: blocks.append(bytes(b)))
+    assert all(len(b) <= 256 for b in blocks)
+    assert total == sum(len(b) for b in blocks)
+    stream = b"".join(blocks)
+    got = list(IFileReader(io.BytesIO(stream)))
+    assert got == recs
+    # oversized single record still emits (split across blocks)
+    big = [(b"k", b"x" * 2000)]
+    blocks2 = []
+    em.emit(iter(big), lambda b: blocks2.append(bytes(b)))
+    got2 = list(IFileReader(io.BytesIO(b"".join(blocks2))))
+    assert got2 == big
+
+
+def test_iter_file_records_streaming(tmp_path):
+    from uda_tpu.utils.ifile import iter_file_records, write_records
+    recs = [(np.random.default_rng(i).bytes(10),
+             np.random.default_rng(i + 1000).bytes(200)) for i in range(300)]
+    # include a value that ends with the EOF marker bytes (must not be
+    # mistaken for end of stream)
+    recs[7] = (b"trap", b"data\xff\xff")
+    path = str(tmp_path / "run.ifile")
+    with open(path, "wb") as f:
+        f.write(write_records(recs))
+    got = list(iter_file_records(path, buffer_size=97))
+    assert got == recs
+
+
+def test_num_lpqs():
+    assert num_lpqs_for(16, 0) == 4          # sqrt rule (reducer.cc:278)
+    assert num_lpqs_for(100, 10) == 10       # explicit lpq size
+    assert num_lpqs_for(1, 0) == 1
+
+
+def test_progress_reports(tmp_path):
+    make_mof_tree(str(tmp_path), "jobD", 45, 1, 5, seed=4)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    seen = []
+    try:
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes",
+                          progress=lambda done, total: seen.append((done, total)))
+        mm.run("jobD", map_ids("jobD", 45), 0, lambda b: None)
+    finally:
+        engine.stop()
+    # every PROGRESS_INTERVAL segments + final (MergeManager.cc:44)
+    assert (20, 45) in seen and (40, 45) in seen and (45, 45) in seen
+
+
+def test_arena_backpressure():
+    arena = BufferArena(2, 1024)
+    a = arena.acquire()
+    b = arena.acquire()
+    assert arena.try_acquire() is None
+    with pytest.raises(MergeError):
+        arena.acquire(timeout=0.05)
+    arena.release(a)
+    c = arena.acquire()
+    assert c is a
+    arena.release(b)
+    arena.release(c)
+    assert arena.free_slots == 2
+
+
+def test_arena_slot_write_overflow():
+    arena = BufferArena(1, 16)
+    slot = arena.acquire()
+    slot.write(b"x" * 16)
+    with pytest.raises(MergeError):
+        slot.write(b"y" * 17)
+    arena.release(slot)

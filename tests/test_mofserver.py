@@ -1,0 +1,126 @@
+"""Supplier side: index files, resolver cache, data engine chunk serving
+(reference src/MOFServer/)."""
+
+import os
+import threading
+
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.mofserver import (DataEngine, DirIndexResolver, ShuffleRequest,
+                               read_index_file, write_index_file)
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import StorageError
+from uda_tpu.utils.ifile import crack
+
+
+def test_index_file_round_trip(tmp_path):
+    path = str(tmp_path / "file.out.index")
+    triples = [(0, 100, 100), (100, 250, 250), (350, 0, 2)]
+    write_index_file(path, triples)
+    recs = read_index_file(path, "/data/file.out")
+    assert [(r.start_offset, r.raw_length, r.part_length) for r in recs] == triples
+    assert all(r.path == "/data/file.out" for r in recs)
+
+
+def test_index_file_corrupt(tmp_path):
+    path = str(tmp_path / "bad.index")
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 23)  # not a multiple of 24
+    with pytest.raises(StorageError):
+        read_index_file(path, "x")
+
+
+def test_resolver_caches_lookup(tmp_path):
+    make_mof_tree(str(tmp_path), "job1", num_maps=1, num_reducers=2,
+                  records_per_map=10)
+    calls = []
+    inner = DirIndexResolver(str(tmp_path))
+    orig = inner._lookup
+
+    def counting(job, mapid):
+        calls.append(mapid)
+        return orig(job, mapid)
+
+    inner._lookup = counting
+    mid = map_ids("job1", 1)[0]
+    a = inner.resolve("job1", mid, 0)
+    b = inner.resolve("job1", mid, 1)
+    assert len(calls) == 1  # first-fetch-only up-call (IndexInfo.cc:237-251)
+    assert a.start_offset == 0 and b.start_offset > 0
+    with pytest.raises(StorageError):
+        inner.resolve("job1", mid, 5)
+
+
+def test_data_engine_serves_partitions(tmp_path):
+    expected = make_mof_tree(str(tmp_path), "job2", num_maps=3, num_reducers=2,
+                             records_per_map=50)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    try:
+        for r in range(2):
+            got = []
+            for mid in map_ids("job2", 3):
+                res = engine.fetch(ShuffleRequest("job2", mid, r, 0, 1 << 20))
+                assert res.is_last
+                got += list(crack(res.data).iter_records())
+            assert sorted(got) == sorted(expected[r])
+    finally:
+        engine.stop()
+
+
+def test_data_engine_chunked_reads(tmp_path):
+    make_mof_tree(str(tmp_path), "job3", num_maps=1, num_reducers=1,
+                  records_per_map=100, val_bytes=100)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    try:
+        mid = map_ids("job3", 1)[0]
+        # fetch in small chunks and reassemble
+        chunks = []
+        offset = 0
+        while True:
+            res = engine.fetch(ShuffleRequest("job3", mid, 0, offset, 512))
+            chunks.append(res.data)
+            offset += len(res.data)
+            if res.is_last:
+                break
+        assert offset == res.raw_length
+        batch = crack(b"".join(chunks))
+        assert batch.num_records == 100
+    finally:
+        engine.stop()
+
+
+def test_data_engine_bad_offset(tmp_path):
+    make_mof_tree(str(tmp_path), "job4", num_maps=1, num_reducers=1,
+                  records_per_map=5)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    try:
+        mid = map_ids("job4", 1)[0]
+        with pytest.raises(StorageError):
+            engine.fetch(ShuffleRequest("job4", mid, 0, 10**9, 512))
+    finally:
+        engine.stop()
+
+
+def test_data_engine_concurrent(tmp_path):
+    make_mof_tree(str(tmp_path), "job5", num_maps=8, num_reducers=4,
+                  records_per_map=40)
+    cfg = Config({"mapred.uda.provider.blocked.threads.per.disk": 4})
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    errors = []
+
+    def worker(r):
+        try:
+            for mid in map_ids("job5", 8):
+                res = engine.fetch(ShuffleRequest("job5", mid, r, 0, 1 << 20))
+                crack(res.data)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    engine.stop()
+    assert not errors

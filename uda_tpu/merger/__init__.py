@@ -1,0 +1,9 @@
+"""Reduce-side merge engine (the Merger/ layer of SURVEY §1): staging
+arena, streaming segments, merge manager, hybrid LPQ/RPQ merge."""
+
+from uda_tpu.merger.arena import BufferArena, BufferSlot, SlotState
+from uda_tpu.merger.merge_manager import MergeManager
+from uda_tpu.merger.segment import InputClient, LocalFetchClient, Segment
+
+__all__ = ["BufferArena", "BufferSlot", "SlotState", "MergeManager",
+           "InputClient", "LocalFetchClient", "Segment"]

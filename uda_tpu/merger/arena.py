@@ -1,0 +1,120 @@
+"""Staging buffer arena: the mem_desc slot state machine.
+
+Equivalent of the reference's registered-memory pools (client buffer
+pairs, reference src/DataNet/RDMAClient.cc:437-496 ``split_mem_pool_to_
+pairs``; per-buffer state machine ``mem_desc_t`` {INIT, FETCH_READY,
+MERGE_READY, BUSY} with cyclic start/end for the compression path,
+reference src/Merger/MergeQueue.h:37-115).
+
+On TPU there is no RDMA registration: the arena manages *host* staging
+buffers with the same bounded-slot backpressure the reference got from
+its fixed pool (wait-for-mem condition, accumulated in the
+``total_wait_mem_time`` counter, reference reducer.h:80-90). It backs
+(a) the 2-slot framed-emission double buffer (uda_tpu.merger.emitter —
+the reference's 2 x 1 MB KV staging pool) and (b) H2D staging in the
+exchange path. Fetch-side memory is bounded elsewhere, by the fetch
+window (see uda_tpu.mofserver.data_engine docstring).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Optional
+
+import numpy as np
+
+from uda_tpu.utils.errors import MergeError
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["SlotState", "BufferSlot", "BufferArena"]
+
+
+class SlotState(enum.Enum):
+    # reference MergeQueue.h:44-49
+    INIT = 0
+    FETCH_READY = 1   # being filled by a fetch
+    MERGE_READY = 2   # filled, ready for the merger
+    BUSY = 3          # being consumed by the merger
+
+
+class BufferSlot:
+    """One staging buffer with its state + fill bookkeeping."""
+
+    __slots__ = ("buf", "state", "length", "owner")
+
+    def __init__(self, size: int):
+        self.buf = np.empty(size, np.uint8)
+        self.state = SlotState.INIT
+        self.length = 0       # valid bytes
+        self.owner = None     # segment currently holding the slot
+
+    @property
+    def size(self) -> int:
+        return int(self.buf.shape[0])
+
+    def write(self, data: bytes, offset: int = 0) -> None:
+        end = offset + len(data)
+        if end > self.size:
+            raise MergeError(f"slot overflow: {end} > {self.size}")
+        self.buf[offset:end] = np.frombuffer(data, np.uint8)
+        self.length = end
+
+    def view(self) -> np.ndarray:
+        return self.buf[: self.length]
+
+
+class BufferArena:
+    """Fixed population of slots with blocking acquire (backpressure).
+
+    ``acquire`` blocks until a slot is free, accumulating the wait in the
+    ``wait_mem_time`` metric (reference total_wait_mem_time,
+    reducer.h:84). Slots are sized once at construction like the
+    reference page-aligns and validates its buffer size at INIT
+    (reducer.cc:100-133).
+    """
+
+    def __init__(self, num_slots: int, slot_size: int):
+        if num_slots <= 0 or slot_size <= 0:
+            raise MergeError("arena needs positive slot count and size")
+        self.slot_size = slot_size
+        self._free: list[BufferSlot] = [BufferSlot(slot_size)
+                                        for _ in range(num_slots)]
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.num_slots = num_slots
+
+    def acquire(self, owner=None, timeout: Optional[float] = None) -> BufferSlot:
+        with metrics.timer("wait_mem"):
+            with self._cv:
+                while not self._free:
+                    if not self._cv.wait(timeout=timeout):
+                        raise MergeError("timed out waiting for a staging slot")
+                slot = self._free.pop()
+        slot.state = SlotState.FETCH_READY
+        slot.length = 0
+        slot.owner = owner
+        return slot
+
+    def try_acquire(self, owner=None) -> Optional[BufferSlot]:
+        with self._cv:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+        slot.state = SlotState.FETCH_READY
+        slot.length = 0
+        slot.owner = owner
+        return slot
+
+    def release(self, slot: BufferSlot) -> None:
+        slot.state = SlotState.INIT
+        slot.owner = None
+        slot.length = 0
+        with self._cv:
+            self._free.append(slot)
+            self._cv.notify()
+
+    @property
+    def free_slots(self) -> int:
+        with self._lock:
+            return len(self._free)

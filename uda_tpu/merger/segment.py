@@ -1,0 +1,144 @@
+"""Segments: streaming views of one map-output partition.
+
+Equivalent of the reference's Segment/BaseSegment (reference
+src/Merger/StreamRW.cc:334-590): a segment pulls its partition's bytes
+chunk by chunk through an InputClient, handling records that break across
+chunk boundaries. The reference does this with double-buffered RDMA
+fetches and a cond-wait ``switch_mem`` that ``join``s the split record
+into ``temp_kv`` (StreamRW.cc:462-590); here the same contract is a
+*carry buffer*: each chunk is columnar-cracked up to its last complete
+record and the partial tail is prepended to the next chunk.
+
+``InputClient`` is the transport abstraction of reference
+src/Merger/InputClient.h:30-56 (``start_fetch_req``/``comp_fetch_req``):
+implementations are LocalFetchClient (single host, over the DataEngine)
+and the mesh exchange client (uda_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Optional
+
+from uda_tpu.mofserver.data_engine import DataEngine, FetchResult, ShuffleRequest
+from uda_tpu.utils.errors import MergeError
+from uda_tpu.utils.ifile import RecordBatch, crack_partial
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["InputClient", "LocalFetchClient", "Segment"]
+
+
+class InputClient(abc.ABC):
+    """Transport abstraction (reference InputClient.h:30-56)."""
+
+    @abc.abstractmethod
+    def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
+        """Async fetch; ``on_complete(FetchResult | Exception)``."""
+
+    def stop(self) -> None:
+        pass
+
+
+class LocalFetchClient(InputClient):
+    """Single-host client: fetches straight from a DataEngine (the
+    minimum end-to-end slice of SURVEY §7.3)."""
+
+    def __init__(self, engine: DataEngine):
+        self.engine = engine
+
+    def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
+        fut = self.engine.submit(req)
+
+        def _done(f):
+            err = f.exception()
+            on_complete(err if err is not None else f.result())
+
+        fut.add_done_callback(_done)
+
+
+class Segment:
+    """One partition's record stream, fetched chunk-wise with a carry
+    buffer for records split across chunk boundaries.
+
+    Drives ``chunk_size``-byte fetches at increasing offsets until
+    ``raw_length`` bytes have arrived (the reference's send_request /
+    switch_mem loop, StreamRW.cc:462-590). Completed chunks are cracked
+    into RecordBatches immediately so bytes can be packed/shipped to
+    device while later chunks are still in flight.
+    """
+
+    def __init__(self, client: InputClient, job_id: str, map_id: str,
+                 reduce_id: int, chunk_size: int):
+        self.client = client
+        self.job_id = job_id
+        self.map_id = map_id
+        self.reduce_id = reduce_id
+        self.chunk_size = chunk_size
+        self.batches: list[RecordBatch] = []
+        self.raw_length: Optional[int] = None
+        self._carry = b""
+        self._next_offset = 0
+        self._done = threading.Event()
+        self._error: Optional[Exception] = None
+        self._lock = threading.Lock()
+
+    # -- fetch driving ------------------------------------------------------
+
+    def start(self) -> None:
+        self._issue(0)
+
+    def _issue(self, offset: int) -> None:
+        req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
+                             offset, self.chunk_size)
+        self.client.start_fetch(req, self._on_complete)
+
+    def _on_complete(self, result) -> None:
+        if isinstance(result, Exception):
+            self._error = result
+            self._done.set()
+            return
+        try:
+            self._ingest(result)
+        except Exception as e:  # crack errors -> surfaced to the waiter
+            self._error = e
+            self._done.set()
+
+    def _ingest(self, res: FetchResult) -> None:
+        with self._lock:
+            self.raw_length = res.raw_length
+            data = self._carry + res.data
+            last = res.is_last
+            # crack up to the last complete record; keep the partial tail
+            batch, consumed, _ = crack_partial(data, expect_eof=last)
+            if batch.num_records:
+                self.batches.append(batch)
+            self._carry = data[consumed:] if not last else b""
+            self._next_offset = res.offset + len(res.data)
+            metrics.add("fetched_bytes", len(res.data))
+        if last:
+            self._done.set()
+        else:
+            self._issue(self._next_offset)
+
+    # -- consumption --------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        if not self._done.wait(timeout=timeout):
+            raise MergeError(f"segment {self.map_id} fetch timed out")
+        if self._error is not None:
+            raise self._error
+
+    @property
+    def ready(self) -> bool:
+        return self._done.is_set() and self._error is None
+
+    def record_batch(self) -> RecordBatch:
+        """All records of the partition as one batch (fetch must be done)."""
+        self.wait()
+        with self._lock:
+            if len(self.batches) == 1:
+                return self.batches[0]
+            return RecordBatch.concat(self.batches)
+
+

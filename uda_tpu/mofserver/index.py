@@ -1,0 +1,124 @@
+"""Index records and map-output path resolution.
+
+Equivalent of the reference's supplier-side index layer (reference
+src/MOFServer/IndexInfo.h:98-121 ``index_record_t`` {offset, rawLength,
+partLength, path} and ``partition_table_t``; resolution via the
+``getPathUda`` up-call into Java's IndexCache, reference
+src/MOFServer/IndexInfo.cc:237-251, plugins mlx-2.x UdaPluginSH.java:
+107-144).
+
+File formats:
+
+- a *MOF* (map output file, ``file.out``) is the concatenation of one
+  IFile segment per reduce partition;
+- its *index* (``file.out.index``) is one (start_offset, raw_length,
+  part_length) triple of 8-byte big-endian longs per partition — the
+  Hadoop spill-index record layout. ``raw_length`` is the uncompressed
+  record-bytes length, ``part_length`` the on-disk segment length
+  (they differ when compression or the CRC trailer is on).
+
+``IndexResolver`` is the pluggable getPath equivalent: the embedding
+application (bridge) registers a callback; the default resolver reads
+``<dir>/<map_id>/file.out[.index]`` like the reference's LocalDirAllocator
+layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import threading
+from typing import Callable, Dict, Optional, Sequence
+
+from uda_tpu.utils.errors import StorageError
+
+__all__ = ["IndexRecord", "write_index_file", "read_index_file",
+           "IndexResolver", "DirIndexResolver"]
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexRecord:
+    """One reduce partition of one map output (reference index_record_t,
+    IndexInfo.h:98-104)."""
+
+    start_offset: int
+    raw_length: int
+    part_length: int
+    path: str  # MOF data file path
+
+
+def write_index_file(path: str, triples: Sequence[tuple[int, int, int]]) -> None:
+    """Write a spill index: (start, raw_len, part_len) 8-byte BE triples."""
+    with open(path, "wb") as f:
+        for start, raw, part in triples:
+            f.write(struct.pack(">qqq", start, raw, part))
+
+
+def read_index_file(path: str, mof_path: str) -> list[IndexRecord]:
+    """Read a spill index into IndexRecords pointing at ``mof_path``."""
+    size = os.path.getsize(path)
+    if size % 24 != 0:
+        raise StorageError(f"index file {path} length {size} not a "
+                           "multiple of 24")
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    for i in range(size // 24):
+        start, raw, part = struct.unpack_from(">qqq", data, i * 24)
+        if start < 0 or raw < 0 or part < 0:
+            raise StorageError(f"negative field in index record {i} of {path}")
+        out.append(IndexRecord(start, raw, part, mof_path))
+    return out
+
+
+class IndexResolver:
+    """(job_id, map_id, reduce_id) -> IndexRecord, with a per-(job,map)
+    cache like the reference's first-fetch-only up-call (IndexInfo.cc:
+    237-251: the path is resolved once and cached in the partition
+    table)."""
+
+    def __init__(self, lookup: Callable[[str, str], list[IndexRecord]]):
+        self._lookup = lookup
+        self._cache: Dict[tuple[str, str], list[IndexRecord]] = {}
+        self._lock = threading.Lock()
+
+    def resolve(self, job_id: str, map_id: str, reduce_id: int) -> IndexRecord:
+        key = (job_id, map_id)
+        with self._lock:
+            records = self._cache.get(key)
+        if records is None:
+            records = self._lookup(job_id, map_id)
+            with self._lock:
+                self._cache[key] = records
+        if not 0 <= reduce_id < len(records):
+            raise StorageError(
+                f"reduce {reduce_id} out of range for {map_id} "
+                f"({len(records)} partitions)")
+        return records[reduce_id]
+
+    def invalidate(self, job_id: str) -> None:
+        with self._lock:
+            for key in [k for k in self._cache if k[0] == job_id]:
+                del self._cache[key]
+
+
+class DirIndexResolver(IndexResolver):
+    """Default layout resolver: ``<root>/<job>/<map_id>/file.out[.index]``
+    (the reference's usercache/appcache layout shape, UdaPluginSH.java:
+    107-144, without the YARN user indirection)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        super().__init__(self._from_dir)
+
+    def map_dir(self, job_id: str, map_id: str) -> str:
+        return os.path.join(self.root, job_id, map_id)
+
+    def _from_dir(self, job_id: str, map_id: str) -> list[IndexRecord]:
+        d = self.map_dir(job_id, map_id)
+        mof = os.path.join(d, "file.out")
+        idx = os.path.join(d, "file.out.index")
+        if not os.path.exists(idx):
+            raise StorageError(f"no index file for {job_id}/{map_id} at {idx}")
+        return read_index_file(idx, mof)

@@ -1,0 +1,6 @@
+"""Device ops: packing, sort, merge (the MergeQueue/StreamRW layer of
+SURVEY §1, rebuilt as whole-run device sorts over packed key columns)."""
+
+from uda_tpu.ops import packing, sort, merge
+
+__all__ = ["packing", "sort", "merge"]

@@ -70,13 +70,14 @@ class KeyType:
     def normalize(self, serialized: bytes, width: int) -> tuple[bytes, int]:
         """Returns ``(padded_prefix, content_length)``.
 
-        The device sort key is the pair: compare the zero-padded prefix
-        bytewise, then the content length. For keys whose content fits in
-        ``width`` this pair orders exactly like ``compare`` (zero-padding
-        alone would collapse e.g. b"a" and b"a\\x00"; the length column
-        restores the shorter-is-smaller memcmp rule). Keys longer than
-        ``width`` with equal prefixes additionally need the overflow-rank
-        tiebreak (uda_tpu.ops.sort.overflow_ranks).
+        The full device sort key is (prefix bytes, overflow rank,
+        content length) — see uda_tpu.ops.sort._as_columns for why rank
+        precedes length. For keys whose content fits in ``width`` the
+        (prefix, length) columns order exactly like ``compare``
+        (zero-padding alone would collapse e.g. b"a" and b"a\\x00"; the
+        length column restores the shorter-is-smaller memcmp rule); keys
+        longer than ``width`` with equal prefixes are ordered by the rank
+        column (uda_tpu.ops.packing.overflow_ranks).
         """
         c = self.content(serialized)
         if len(c) >= width:

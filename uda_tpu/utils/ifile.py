@@ -38,7 +38,8 @@ import numpy as np
 from uda_tpu.utils import vint
 from uda_tpu.utils.errors import StorageError
 
-__all__ = ["IFileWriter", "IFileReader", "RecordBatch", "crack", "write_records"]
+__all__ = ["IFileWriter", "IFileReader", "RecordBatch", "crack",
+           "crack_partial", "iter_file_records", "write_records"]
 
 EOF_MARKER = b"\xff\xff"  # VInt(-1) VInt(-1)
 
@@ -223,6 +224,82 @@ def crack(buf: bytes | np.ndarray, expect_eof: bool = True,
         np.asarray(val_off, dtype=np.int64),
         np.asarray(val_len, dtype=np.int64),
     )
+
+
+def crack_partial(data: bytes, expect_eof: bool = False
+                  ) -> Tuple[RecordBatch, int, bool]:
+    """Crack the longest prefix of complete records; returns ``(batch,
+    bytes_consumed, saw_eof)``.
+
+    The incremental sibling of ``crack`` for chunked streams: a record
+    split across a chunk boundary is left unconsumed so the caller can
+    carry its bytes into the next chunk (the reference's temp_kv join
+    across buffers, StreamRW.cc:542-590). With ``expect_eof`` the buffer
+    must be a complete segment and everything is consumed.
+    """
+    if expect_eof:
+        batch = crack(data, expect_eof=True)
+        return batch, len(data), True
+    arr = np.frombuffer(data, np.uint8) if not isinstance(data, np.ndarray) else data
+    mem = memoryview(arr)
+    n = len(arr)
+    key_off, key_len, val_off, val_len = [], [], [], []
+    pos = 0
+    saw_eof = False
+    while pos < n:
+        start = pos
+        try:
+            klen, p = vint.decode_vlong(mem, pos)
+            vlen, p = vint.decode_vlong(mem, p)
+        except IndexError:
+            pos = start
+            break
+        if klen == -1 and vlen == -1:
+            pos = p
+            saw_eof = True
+            break
+        if klen < 0 or vlen < 0:
+            raise StorageError(f"corrupt record framing at offset {start}")
+        if p + klen + vlen > n:
+            pos = start
+            break
+        key_off.append(p)
+        key_len.append(klen)
+        val_off.append(p + klen)
+        val_len.append(vlen)
+        pos = p + klen + vlen
+    batch = RecordBatch(
+        arr,
+        np.asarray(key_off, dtype=np.int64),
+        np.asarray(key_len, dtype=np.int64),
+        np.asarray(val_off, dtype=np.int64),
+        np.asarray(val_len, dtype=np.int64),
+    )
+    return batch, pos, saw_eof
+
+
+def iter_file_records(path: str, buffer_size: int = 1 << 20
+                      ) -> Iterator[Tuple[bytes, bytes]]:
+    """Stream records from an IFile on disk with bounded memory.
+
+    Reads ``buffer_size`` chunks, cracks complete records, carries the
+    partial tail — the file-backed analogue of the reference's
+    SuperSegment cursor (StreamRW.cc:813-861), used by the RPQ phase so
+    spill files never need to be memory-resident.
+    """
+    carry = b""
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(buffer_size)
+            if not chunk:
+                raise StorageError(f"IFile {path} missing EOF marker")
+            data = carry + chunk
+            batch, consumed, saw_eof = crack_partial(data)
+            for i in range(batch.num_records):
+                yield batch.key(i), batch.value(i)
+            if saw_eof:
+                return
+            carry = data[consumed:]
 
 
 def write_records(records: Iterable[Tuple[bytes, bytes]],
