@@ -1,0 +1,68 @@
+"""Benchmark: single-chip TeraSort shuffle+merge throughput.
+
+Measures the flagship path of BASELINE.json config 2 — HBM-resident
+TeraSort records, device shuffle+merge (stable lexicographic sort of
+100-byte records by their 10-byte keys) — on whatever accelerator is
+ambient (the driver runs this on one real TPU chip).
+
+Protocol: data is TeraGen'd ON DEVICE (the deployment stages records
+into HBM once; the host never holds record bytes), a warmup iteration
+compiles, then ``ITERS`` timed iterations each sort a FRESH dataset
+(different PRNG seed — no result can be cached) and are validated for
+sort order on device.
+
+Baseline: the reference's data plane tops out at FDR InfiniBand line
+rate, 56 Gb/s ~= 6.8 GB/s per node (BASELINE.md: "beat FDR-InfiniBand
+UDA shuffle+merge wall-clock"; the reference repo publishes no absolute
+figures, SURVEY §6). vs_baseline = achieved GB/s / 6.8.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+BASELINE_GBPS = 6.8  # FDR IB line rate, the reference data plane ceiling
+LOG2_RECORDS = 24    # 16M records x 100 B = 1.6 GB of records in HBM
+ITERS = 5
+
+
+def main() -> None:
+    from uda_tpu.models import terasort
+
+    n = 1 << LOG2_RECORDS
+    gb = n * terasort.RECORD_BYTES / 1e9
+
+    # warmup/compile on a throwaway dataset
+    words = terasort.teragen(jax.random.key(999), n)
+    out = terasort.single_chip_sort(words)
+    jax.block_until_ready(out)
+    terasort.validate_sorted(out, words)
+
+    times = []
+    for i in range(ITERS):
+        words = terasort.teragen(jax.random.key(i), n)
+        jax.block_until_ready(words)
+        t0 = time.perf_counter()
+        out = terasort.single_chip_sort(words)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+        terasort.validate_sorted(out, words)
+        del words, out
+
+    best = min(times)
+    gbps = gb / best
+    print(json.dumps({
+        "metric": "terasort_singlechip_shuffle_merge_gbps",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,9 +5,20 @@ we fix that here). Must run before jax is first imported."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the ambient environment selects the axon TPU
+# backend (JAX_PLATFORMS=axon): unit tests exercise sharding on 8
+# virtual devices, not the single real chip. The axon sitecustomize
+# imports jax at interpreter startup, so setting env vars here is too
+# late for the env-var path — update jax.config post-import instead
+# (backends are created lazily, so this still wins as long as no array
+# has touched a device yet).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,145 @@
+"""Multi-chip exchange on the 8-device CPU mesh (the multi-node-without-
+a-cluster capability the reference never had, SURVEY §4.5)."""
+
+import numpy as np
+import pytest
+
+from uda_tpu.parallel import (distributed_sort_step, exchange_record_batches,
+                              exchange_round, make_mesh, prepare_layout,
+                              sample_splitters, shuffle_exchange,
+                              uniform_splitters)
+from uda_tpu.utils.errors import TransportError
+from uda_tpu.utils.ifile import RecordBatch, crack, write_records
+
+AXIS = "shuffle"
+
+
+def _mesh():
+    return make_mesh(8, AXIS)
+
+
+def _random_words(n, w, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2**32, size=(n, w), dtype=np.uint32)
+
+
+def test_prepare_layout_counts():
+    mesh = _mesh()
+    n, p = 64 * 8, 8
+    words = _random_words(n, 4)
+    dest = (words[:, 0] % p).astype(np.int32)
+    layout = prepare_layout(words, dest, mesh, AXIS)
+    counts = np.asarray(layout.counts)
+    assert counts.shape == (p, p)
+    # row i = histogram of dest among device i's shard
+    shard = n // p
+    for i in range(p):
+        want = np.bincount(dest[i * shard:(i + 1) * shard], minlength=p)
+        assert counts[i].tolist() == want.tolist()
+
+
+def test_single_round_exchange_regroups():
+    mesh = _mesh()
+    p, shard = 8, 32
+    words = _random_words(p * shard, 3, seed=1)
+    dest = (words[:, 1] % p).astype(np.int32)
+    layout = prepare_layout(words, dest, mesh, AXIS)
+    cap = int(layout.counts.max())
+    recv, recv_counts = exchange_round(layout, cap, 0)
+    recv = np.asarray(recv).reshape(p, p, cap, 3)   # [dst, src, slot, w]
+    recv_counts = np.asarray(recv_counts).reshape(p, p)
+    got = {d: [] for d in range(p)}
+    for d in range(p):
+        for s in range(p):
+            for i in range(recv_counts[d, s]):
+                got[d].append(tuple(recv[d, s, i]))
+    for d in range(p):
+        want = sorted(map(tuple, words[dest == d]))
+        assert sorted(got[d]) == want
+
+
+def test_multi_round_skew_all_to_one():
+    mesh = _mesh()
+    p, shard = 8, 16
+    words = _random_words(p * shard, 2, seed=2)
+    dest = np.zeros(p * shard, np.int32)  # extreme skew: everything to 0
+    results, layout = shuffle_exchange(words, dest, mesh, AXIS, capacity=4)
+    assert len(results) == 4  # 16 per bucket / capacity 4
+    collected = []
+    for recv, counts in results:
+        recv = np.asarray(recv).reshape(p, p, 4, 2)
+        counts = np.asarray(counts).reshape(p, p)
+        for s in range(p):
+            for i in range(counts[0, s]):
+                collected.append(tuple(recv[0, s, i]))
+        # nothing lands on devices != 0
+        assert counts[1:].sum() == 0
+    assert sorted(collected) == sorted(map(tuple, words))
+
+
+def test_shuffle_exchange_max_rounds_guard():
+    mesh = _mesh()
+    words = _random_words(64, 2, seed=3)
+    dest = np.zeros(64, np.int32)
+    with pytest.raises(TransportError):
+        shuffle_exchange(words, dest, mesh, AXIS, capacity=1, max_rounds=2)
+
+
+def test_distributed_sort_step_total_order():
+    mesh = _mesh()
+    p = 8
+    n = p * 128
+    words = _random_words(n, 5, seed=4)  # 3 key words + 2 payload words
+    splitters = uniform_splitters(p)
+    res = distributed_sort_step(words, splitters, mesh, AXIS,
+                                capacity=n // p, num_keys=3)
+    res.check()
+    out = np.asarray(res.words).reshape(p, -1, 5)
+    nvalid = np.asarray(res.valid_counts).reshape(-1)
+    rows = [out[d, :nvalid[d]] for d in range(p)]
+    got = np.concatenate(rows)
+    assert got.shape[0] == n
+    # global total order on the 3 key words
+    keys = [tuple(r[:3]) for r in got]
+    assert keys == sorted(keys)
+    # the full multiset of records survived
+    assert sorted(map(tuple, got)) == sorted(map(tuple, words))
+
+
+def test_distributed_sort_step_overflow_detected():
+    mesh = _mesh()
+    p = 8
+    words = _random_words(p * 64, 2, seed=5)
+    words[:, 0] = 0  # all keys in partition 0 -> massive skew
+    res = distributed_sort_step(words, uniform_splitters(p), mesh, AXIS,
+                                capacity=8, num_keys=1)
+    with pytest.raises(TransportError):
+        res.check()
+
+
+def test_sample_splitters_balance():
+    rng = np.random.default_rng(6)
+    # skewed distribution: half the mass near zero
+    w0 = np.concatenate([rng.integers(0, 1000, 5000),
+                         rng.integers(0, 2**32, 5000)]).astype(np.uint32)
+    spl = sample_splitters(w0, 8)
+    assert spl.shape == (7,)
+    assert (np.sort(spl) == spl).all()
+    dest = np.searchsorted(spl, w0, side="right")
+    counts = np.bincount(dest, minlength=8)
+    assert counts.max() < 0.35 * w0.size  # vs 0.625 with uniform splitters
+
+
+def test_exchange_record_batches_host():
+    def batch(recs):
+        return crack(write_records(recs))
+
+    by_dest = [
+        [batch([(b"a", b"1")]), batch([(b"b", b"2")])],
+        [batch([(b"c", b"3")]), batch([])],
+    ]
+    out = exchange_record_batches(by_dest)
+    assert [list(b.iter_records()) for b in out] == [
+        [(b"a", b"1"), (b"c", b"3")],
+        [(b"b", b"2")],
+    ]

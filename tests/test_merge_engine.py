@@ -126,6 +126,106 @@ def test_iter_file_records_streaming(tmp_path):
     assert got == recs
 
 
+def test_emitter_consumer_exception_releases_slots():
+    from uda_tpu.merger.emitter import FramedEmitter
+    em = FramedEmitter(block_size=64)
+
+    def boom(_):
+        raise RuntimeError("downstream broke")
+
+    recs = [(bytes([i]), b"v" * 40) for i in range(20)]
+    with pytest.raises(RuntimeError):
+        em.emit(iter(recs), boom)
+    # arena fully recovered: the next emit on the same emitter works
+    blocks = []
+    em.emit(iter(recs), lambda b: blocks.append(bytes(b)))
+    got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+    assert got == recs
+
+
+def test_empty_partition_zero_raw_length(tmp_path):
+    # a foreign writer may index an empty partition as raw_length=0 (no
+    # records, no EOF marker); the fetch must yield zero records, not fail
+    import os
+
+    from uda_tpu.mofserver.index import write_index_file
+
+    d = tmp_path / "jobZ" / "attempt_jobZ_m_000000_0"
+    os.makedirs(d)
+    with open(d / "file.out", "wb") as f:
+        f.write(b"")
+    write_index_file(str(d / "file.out.index"), [(0, 0, 0)])
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    try:
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes")
+        blocks = []
+        total = mm.run("jobZ", ["attempt_jobZ_m_000000_0"], 0,
+                       lambda b: blocks.append(bytes(b)))
+        got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+        assert got == []
+        assert total == 2  # just the EOF marker
+    finally:
+        engine.stop()
+
+
+def test_sliding_window_bounds_concurrency(tmp_path):
+    # in-flight segments never exceed the window, and all complete
+    import threading
+
+    from uda_tpu.merger.segment import InputClient
+
+    make_mof_tree(str(tmp_path), "jobW", num_maps=20, num_reducers=1,
+                  records_per_map=5, seed=9)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    peak = [0]
+    active = [0]
+    lock = threading.Lock()
+
+    class Counting(LocalFetchClient):
+        def start_fetch(self, req, on_complete):
+            if req.offset == 0:
+                with lock:
+                    active[0] += 1
+                    peak[0] = max(peak[0], active[0])
+
+            def wrapped(res):
+                if not isinstance(res, Exception) and res.is_last:
+                    with lock:
+                        active[0] -= 1
+                on_complete(res)
+
+            super().start_fetch(req, wrapped)
+
+    cfg = Config({"mapred.rdma.wqe.per.conn": 4})
+    try:
+        mm = MergeManager(Counting(engine), "uda.tpu.RawBytes", cfg)
+        segs = mm.fetch_all("jobW", map_ids("jobW", 20), 0)
+        assert all(s.ready for s in segs)
+        assert peak[0] <= 4
+    finally:
+        engine.stop()
+
+
+def test_hybrid_spill_cleanup_on_failure(tmp_path):
+    # a failing LPQ must not orphan completed groups' spill files
+    make_mof_tree(str(tmp_path), "jobF", num_maps=4, num_reducers=1,
+                  records_per_map=10, seed=11)
+    spill = tmp_path / "spill"
+    cfg = Config({"mapred.netmerger.merge.approach": 2,
+                  "mapred.netmerger.hybrid.lpq.size": 1,
+                  "mapred.rdma.num.parallel.lpqs": 1,
+                  "uda.tpu.spill.dirs": str(spill)})
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    try:
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes", cfg)
+        maps = map_ids("jobF", 4) + ["attempt_jobF_m_000099_0"]  # missing
+        with pytest.raises(Exception):
+            mm.run("jobF", maps, 0, lambda b: None)
+    finally:
+        engine.stop()
+    assert not spill.exists() or not any(spill.iterdir())
+
+
 def test_num_lpqs():
     assert num_lpqs_for(16, 0) == 4          # sqrt rule (reducer.cc:278)
     assert num_lpqs_for(100, 10) == 10       # explicit lpq size

@@ -160,6 +160,20 @@ def test_overflow_keys_rank_before_length():
     assert got == sorted(k for k, _ in recs)
 
 
+def test_overflow_text_keys_rank_by_content_not_serialized():
+    # regression: overflow ranks must compare comparator CONTENT, not the
+    # serialized key — Text's VInt length prefix must not dominate
+    kt = comparators.get_key_type("org.apache.hadoop.io.Text")
+    contents = [b"0123456789012345Z",   # len 17, shorter VInt prefix
+                b"0123456789012345AB",  # len 18 — must sort FIRST (A < Z)
+                b"0123456789012345"]
+    recs = [(vint.encode_vlong(len(c)) + c, b"v") for c in contents]
+    batch = _batch(recs)
+    order = merge.sorted_batch_order(batch, kt, width=16)
+    got = [kt.content(batch.key(int(i))) for i in order]
+    assert got == sorted(contents)
+
+
 def test_overflow_equal_full_keys_stable():
     kt = _raw()
     recs = [(b"prefix__prefix__XX", bytes([i])) for i in range(5)]

@@ -1,0 +1,65 @@
+"""TeraSort workload: single-chip and distributed (BASELINE configs 2/5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from uda_tpu.models import terasort
+from uda_tpu.parallel.mesh import make_mesh
+
+
+def test_teragen_shape_and_pad():
+    words = np.asarray(terasort.teragen(jax.random.key(0), 1024))
+    assert words.shape == (1024, terasort.RECORD_WORDS)
+    assert words.dtype == np.uint32
+    # key pad bytes are zero (fixed-width memcmp contract)
+    assert (words[:, 2] & 0xFFFF).max() == 0
+
+
+def test_single_chip_sort_total_order():
+    words = np.asarray(terasort.teragen(jax.random.key(1), 4096))
+    out = np.asarray(terasort.single_chip_sort(words))
+    keys = [tuple(r[:3]) for r in out]
+    assert keys == sorted(keys)
+    assert sorted(map(tuple, out)) == sorted(map(tuple, words))
+    terasort.validate_sorted(out, words)
+
+
+def test_validate_sorted_catches_violation():
+    words = np.asarray(terasort.teragen(jax.random.key(2), 256))
+    out = np.asarray(terasort.single_chip_sort(words))
+    bad = out[::-1].copy()
+    with pytest.raises(AssertionError):
+        terasort.validate_sorted(bad)
+
+
+def test_validate_sorted_catches_corruption():
+    words = np.asarray(terasort.teragen(jax.random.key(3), 256))
+    out = np.asarray(terasort.single_chip_sort(words)).copy()
+    out[10, 5] ^= 1  # flip one payload bit
+    with pytest.raises(AssertionError):
+        terasort.validate_sorted(out, words)
+
+
+def test_distributed_terasort_8dev():
+    mesh = make_mesh(8)
+    words = np.asarray(terasort.teragen(jax.random.key(4), 8 * 256))
+    res = terasort.distributed_terasort(words, mesh)
+    res.check()
+    out = np.asarray(res.words).reshape(8, -1, terasort.RECORD_WORDS)
+    nvalid = np.asarray(res.valid_counts).reshape(-1)
+    rows = np.concatenate([out[d, :nvalid[d]] for d in range(8)])
+    assert rows.shape[0] == words.shape[0]
+    keys = [tuple(r[:3]) for r in rows]
+    assert keys == sorted(keys)
+    terasort.validate_sorted(rows, words)
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)  # must be jittable
+    assert out.shape == args[0].shape
+    g.dryrun_multichip(8)
+    g.dryrun_multichip(4)

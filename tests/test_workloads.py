@@ -1,0 +1,79 @@
+"""Benchmark-ladder workloads end-to-end (BASELINE configs 1, 3, 4)."""
+
+import collections
+import re
+import struct
+
+import numpy as np
+import pytest
+
+from uda_tpu.models import grep, inverted_index, secondary_sort, wordcount
+from uda_tpu.models.pipeline import MapReduceJob, grouped_reduce
+from uda_tpu.utils.config import Config
+
+TEXT = (b"the quick brown fox jumps over the lazy dog\n"
+        b"the dog barks and the fox runs away over the hill\n"
+        b"pack my box with five dozen liquor jugs\n") * 7
+
+
+def test_wordcount_matches_direct_count(tmp_path):
+    got = wordcount.run_wordcount(TEXT, num_maps=3, num_reducers=2,
+                                  work_dir=str(tmp_path))
+    want = collections.Counter(
+        m.group(0).lower() for m in re.finditer(rb"[A-Za-z0-9]+", TEXT))
+    assert got == dict(want)
+
+
+def test_wordcount_single_map_single_reduce(tmp_path):
+    got = wordcount.run_wordcount(b"a b a", num_maps=1, num_reducers=1,
+                                  work_dir=str(tmp_path))
+    assert got == {b"a": 2, b"b": 1}
+
+
+def test_secondary_sort_grouping_and_order(tmp_path):
+    outputs = secondary_sort.run_secondary_sort(
+        num_groups=10, per_group=30, num_maps=3, num_reducers=2,
+        work_dir=str(tmp_path))
+    # run_secondary_sort asserts order+partitioning internally; verify
+    # record conservation here
+    total = sum(len(recs) for recs in outputs.values())
+    assert total == 10 * 30
+
+
+def test_inverted_index_zipf_skew(tmp_path):
+    index = inverted_index.run_inverted_index(
+        num_docs=20, words_per_doc=60, num_maps=4, num_reducers=4,
+        seed=1, work_dir=str(tmp_path))
+    # zipf: the hottest term dominates (skew actually present)
+    sizes = sorted((len(v) for v in index.values()), reverse=True)
+    assert sizes[0] > 5 * sizes[len(sizes) // 2]
+
+
+def test_grep_counts_descending(tmp_path):
+    result = grep.run_grep(TEXT, rb"[a-z]*o[a-z]*", num_maps=2,
+                           work_dir=str(tmp_path))
+    counts = [c for _, c in result]
+    assert counts == sorted(counts, reverse=True)
+    want = collections.Counter()
+    for line in TEXT.splitlines():
+        for m in re.finditer(rb"[a-z]*o[a-z]*", line):
+            want[m.group(0)] += 1
+    assert dict(result) == dict(want)
+
+
+def test_grouped_reduce_contract():
+    records = [(b"a", b"1"), (b"a", b"2"), (b"b", b"3")]
+    out = list(grouped_reduce(iter(records),
+                              lambda k, vs: [(k, b"".join(vs))]))
+    assert out == [(b"a", b"12"), (b"b", b"3")]
+    assert list(grouped_reduce(iter([]), lambda k, vs: [(k, b"")])) == []
+
+
+def test_pipeline_hybrid_mode(tmp_path):
+    cfg = Config({"mapred.netmerger.merge.approach": 2,
+                  "uda.tpu.spill.dirs": str(tmp_path / "spill")})
+    got = wordcount.run_wordcount(TEXT, num_maps=5, num_reducers=2,
+                                  config=cfg, work_dir=str(tmp_path / "w"))
+    want = collections.Counter(
+        m.group(0).lower() for m in re.finditer(rb"[A-Za-z0-9]+", TEXT))
+    assert got == dict(want)

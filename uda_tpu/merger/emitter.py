@@ -47,10 +47,10 @@ class FramedEmitter:
         out = io.BytesIO()
         writer = IFileWriter(out)
         total = 0
-        prev_slot = None  # released one call late: true double-buffering
+        held: list = []  # acquired slots not yet released (<= 2)
 
         def flush() -> None:
-            nonlocal total, prev_slot
+            nonlocal total
             block = out.getvalue()
             out.seek(0)
             out.truncate()
@@ -59,23 +59,27 @@ class FramedEmitter:
             for start in range(0, len(block), self.block_size):
                 piece = block[start:start + self.block_size]
                 slot = self.arena.acquire()
+                held.append(slot)
                 slot.write(piece)
-                if prev_slot is not None:
-                    self.arena.release(prev_slot)
-                prev_slot = slot
+                if len(held) > 1:  # release one call late: double-buffer
+                    self.arena.release(held.pop(0))
                 with metrics.timer("emit"):
                     consumer(slot.view().data.toreadonly())
                 total += len(piece)
 
-        for key, value in records:
-            writer.append(key, value)
-            if out.tell() >= self.block_size:
+        try:
+            for key, value in records:
+                writer.append(key, value)
+                if out.tell() >= self.block_size:
+                    flush()
+            writer.close()  # EOF marker
+            if out.tell():
                 flush()
-        writer.close()  # EOF marker
-        if out.tell():
-            flush()
-        if prev_slot is not None:
-            self.arena.release(prev_slot)
+        finally:
+            # a consumer exception must not strand slots: the arena is
+            # task-lifetime (a leaked slot deadlocks the next emit)
+            for slot in held:
+                self.arena.release(slot)
         metrics.add("emitted_bytes", total)
         return total
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import math
 import os
 import tempfile
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
 
@@ -82,6 +83,13 @@ def run_hybrid(mm, job_id: str, map_ids: Sequence[str], reduce_id: int,
     log.info(f"hybrid merge: {num_maps} maps -> {len(groups)} LPQs of <= "
              f"{group}, {parallel} parallel")
 
+    # every spill path is registered BEFORE its file is opened so a
+    # failing LPQ (disk full, fetch error) can't orphan the completed
+    # groups' multi-GB spill files — the reference leaned on ~SuperSegment
+    # dtors for this (StreamRW.cc:824-830)
+    spill_paths: list[str] = []
+    paths_lock = threading.Lock()
+
     def spill_one(idx_group) -> SuperSegment:
         idx, g = idx_group
         segments = mm.fetch_all(job_id, g, reduce_id)
@@ -89,6 +97,8 @@ def run_hybrid(mm, job_id: str, map_ids: Sequence[str], reduce_id: int,
         d = spill_dirs[idx % len(spill_dirs)]
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"uda.{job_id}.r{reduce_id}.lpq-{idx:03d}")
+        with paths_lock:
+            spill_paths.append(path)
         with metrics.timer("lpq_spill"):
             with open(path, "wb") as f:
                 w = IFileWriter(f)
@@ -97,10 +107,18 @@ def run_hybrid(mm, job_id: str, map_ids: Sequence[str], reduce_id: int,
                 w.close()
         return SuperSegment(path)
 
-    with metrics.timer("lpq_phase"):
-        with ThreadPoolExecutor(max_workers=parallel,
-                                thread_name_prefix="uda-lpq") as pool:
-            supers = list(pool.map(spill_one, enumerate(groups)))
+    try:
+        with metrics.timer("lpq_phase"):
+            with ThreadPoolExecutor(max_workers=parallel,
+                                    thread_name_prefix="uda-lpq") as pool:
+                supers = list(pool.map(spill_one, enumerate(groups)))
+    except BaseException:
+        for p in spill_paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        raise
 
     # RPQ: bounded-memory streaming merge of the sorted spill files —
     # each SuperSegment contributes a buffered file cursor, so peak RAM

@@ -71,28 +71,40 @@ class MergeManager:
 
     def fetch_all(self, job_id: str, map_ids: Sequence[str],
                   reduce_id: int) -> list[Segment]:
-        """Fetch every map's partition, randomized order, bounded window.
+        """Fetch every map's partition, randomized order, sliding window.
 
-        Returns segments in the *original* map order (merge stability and
+        The window refills as individual segments complete (true
+        credit-flow semantics: in-flight count stays at ``window`` until
+        the tail, rather than draining at batch boundaries). Returns
+        segments in the *original* map order (merge stability and
         reproducibility do not depend on fetch completion order).
         """
         segs = [Segment(self.client, job_id, m, reduce_id, self.chunk_size)
                 for m in map_ids]
         order = list(range(len(segs)))
         random.Random(self.seed).shuffle(order)  # MergeManager.cc:58-63
+        credits = threading.Semaphore(self.window)
+        done_lock = threading.Lock()
         done = 0
+
+        def on_done(_seg) -> None:
+            nonlocal done
+            credits.release()
+            with done_lock:
+                done += 1
+                d = done
+            if self.progress and d % PROGRESS_INTERVAL == 0:
+                self.progress(d, len(segs))
+
         with metrics.timer("fetch"):
-            for begin in range(0, len(order), self.window):
+            for i in order:
+                credits.acquire()
                 if self._stop.is_set():
                     raise MergeError("merge manager stopped during fetch")
-                batch_idx = order[begin:begin + self.window]
-                for i in batch_idx:
-                    segs[i].start()
-                for i in batch_idx:
-                    segs[i].wait()
-                    done += 1
-                    if self.progress and done % PROGRESS_INTERVAL == 0:
-                        self.progress(done, len(segs))
+                segs[i].on_done = on_done
+                segs[i].start()
+            for s in segs:
+                s.wait()
         if self.progress:
             self.progress(len(segs), len(segs))
         return segs

@@ -77,11 +77,17 @@ class Segment:
         self.chunk_size = chunk_size
         self.batches: list[RecordBatch] = []
         self.raw_length: Optional[int] = None
+        self.on_done = None  # callback fired once when fetch finishes
         self._carry = b""
         self._next_offset = 0
         self._done = threading.Event()
         self._error: Optional[Exception] = None
         self._lock = threading.Lock()
+
+    def _notify_done(self) -> None:
+        cb = self.on_done
+        if cb is not None:
+            cb(self)
 
     # -- fetch driving ------------------------------------------------------
 
@@ -97,18 +103,28 @@ class Segment:
         if isinstance(result, Exception):
             self._error = result
             self._done.set()
+            self._notify_done()
             return
         try:
             self._ingest(result)
         except Exception as e:  # crack errors -> surfaced to the waiter
             self._error = e
             self._done.set()
+            self._notify_done()
 
     def _ingest(self, res: FetchResult) -> None:
         with self._lock:
             self.raw_length = res.raw_length
             data = self._carry + res.data
             last = res.is_last
+            if last and not data:
+                # legitimately empty partition (raw_length == 0: a byte
+                # range with no records and no EOF marker, as foreign
+                # writers may produce for empty reducers)
+                self._carry = b""
+                self._done.set()
+                self._notify_done()
+                return
             # crack up to the last complete record; keep the partial tail
             batch, consumed, _ = crack_partial(data, expect_eof=last)
             if batch.num_records:
@@ -118,6 +134,7 @@ class Segment:
             metrics.add("fetched_bytes", len(res.data))
         if last:
             self._done.set()
+            self._notify_done()
         else:
             self._issue(self._next_offset)
 

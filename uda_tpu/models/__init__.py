@@ -1,0 +1,8 @@
+"""Workloads (the benchmark ladder of BASELINE.json): TeraSort,
+WordCount, SecondarySort, InvertedIndex, Grep."""
+
+from uda_tpu.models import (grep, inverted_index, pipeline, secondary_sort,
+                            terasort, wordcount)
+
+__all__ = ["grep", "inverted_index", "pipeline", "secondary_sort",
+           "terasort", "wordcount"]

@@ -1,0 +1,83 @@
+"""Grep (BASELINE config 4b): Hadoop's distributed grep.
+
+Two chained jobs like Hadoop's Grep example: (1) match lines against a
+regex and count matches per matched string; (2) swap (count, match) and
+sort descending by count — the second job's single-reducer sort runs
+through the engine with the numeric-order key variant (sign-flip
+normalization, uda.tpu.LongNumeric-style) on a descending key encoding.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from typing import Iterable, Optional
+
+from uda_tpu.models.pipeline import MapReduceJob, Record
+from uda_tpu.models.wordcount import parse_text_key, text_key
+from uda_tpu.utils.config import Config
+
+__all__ = ["run_grep"]
+
+
+def _count_mapper_factory(pattern: bytes):
+    rx = re.compile(pattern)
+
+    def _mapper(split: bytes) -> Iterable[Record]:
+        for line in split.splitlines():
+            for m in rx.finditer(line):
+                yield text_key(m.group(0)), struct.pack(">q", 1)
+
+    return _mapper
+
+
+def _sum_reducer(key: bytes, values: list[bytes]) -> Iterable[Record]:
+    yield key, struct.pack(">q", sum(struct.unpack(">q", v)[0] for v in values))
+
+
+def _swap_mapper(split) -> Iterable[Record]:
+    for match_key, count_val in split:
+        (count,) = struct.unpack(">q", count_val)
+        # descending numeric order == ascending memcmp of ~count (big-endian)
+        yield struct.pack(">Q", (1 << 64) - 1 - count), match_key
+
+    return
+
+
+def _identity_reducer(key: bytes, values: list[bytes]) -> Iterable[Record]:
+    for v in values:
+        yield key, v
+
+
+def run_grep(text: bytes, pattern: bytes, num_maps: int = 4,
+             num_reducers: int = 2, config: Optional[Config] = None,
+             work_dir: Optional[str] = None) -> list[tuple[bytes, int]]:
+    """Returns [(match, count)] sorted by count descending (ties by
+    arrival, like Hadoop's grep-sort)."""
+    n = len(text)
+    step = max(1, n // num_maps)
+    splits = []
+    start = 0
+    while start < n:
+        end = min(n, start + step)
+        while end < n and text[end:end + 1] != b"\n":
+            end += 1
+        splits.append(text[start:end])
+        start = end + 1
+    job1 = MapReduceJob("grep1", _count_mapper_factory(pattern), _sum_reducer,
+                        key_type="org.apache.hadoop.io.Text",
+                        num_reducers=num_reducers, config=config,
+                        work_dir=work_dir)
+    counts: list[Record] = []
+    for recs in job1.run(splits).values():
+        counts.extend(recs)
+
+    job2 = MapReduceJob("grep2", _swap_mapper, _identity_reducer,
+                        key_type="uda.tpu.RawBytes", num_reducers=1,
+                        config=config, work_dir=work_dir)
+    outputs = job2.run([counts])
+    result = []
+    for k, v in outputs[0]:
+        (inv,) = struct.unpack(">Q", k)
+        result.append((parse_text_key(v), (1 << 64) - 1 - inv))
+    return result

@@ -1,0 +1,129 @@
+"""MapReduce pipeline driver: the workload harness over the framework.
+
+Runs a complete map -> shuffle -> merge -> reduce job through the same
+components a Hadoop deployment would use (MOFWriter supplier layout,
+DataEngine chunk serving, MergeManager device merge, framed emission),
+so every workload in uda_tpu.models is an end-to-end exercise of the
+engine — the role the reference's cluster regression workloads played
+(reference scripts/regression/namesConf.sh:20-35: TeraSort, sort,
+wordcount, TestDFSIO, pi).
+
+The reduce side consumes the merged stream through ``grouped_reduce``,
+which implements Hadoop's grouping contract: consecutive equal keys form
+one reduce call (valid because the merged stream is comparator-sorted).
+"""
+
+from __future__ import annotations
+
+import functools
+import io
+import os
+import tempfile
+from typing import Callable, Iterable, Iterator, Optional, Sequence, Tuple
+
+from uda_tpu.merger import LocalFetchClient, MergeManager
+from uda_tpu.mofserver import DataEngine, DirIndexResolver
+from uda_tpu.mofserver.writer import MOFWriter
+from uda_tpu.utils.comparators import KeyType, get_key_type
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.ifile import IFileReader
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["MapReduceJob", "grouped_reduce", "hash_partitioner"]
+
+Record = Tuple[bytes, bytes]
+
+
+def hash_partitioner(key: bytes, num_reducers: int) -> int:
+    """Default partitioner (Hadoop HashPartitioner shape)."""
+    import zlib
+    return zlib.crc32(key) % num_reducers
+
+
+def grouped_reduce(records: Iterable[Record],
+                   reducer: Callable[[bytes, list[bytes]], Iterable[Record]],
+                   key_content: Callable[[bytes], bytes] = lambda k: k
+                   ) -> Iterator[Record]:
+    """Group consecutive equal keys (by comparator content) and apply the
+    reducer — Hadoop's reduce() contract over a sorted stream."""
+    cur_key: Optional[bytes] = None
+    cur_content: Optional[bytes] = None
+    values: list[bytes] = []
+    for k, v in records:
+        c = key_content(k)
+        if cur_content is not None and c != cur_content:
+            yield from reducer(cur_key, values)
+            values = []
+        cur_key, cur_content = k, c
+        values.append(v)
+    if cur_content is not None:
+        yield from reducer(cur_key, values)
+
+
+class MapReduceJob:
+    """One job: map inputs to records, shuffle+merge, reduce.
+
+    ``mapper(input) -> iterable of (key, value)`` serialized records;
+    ``reducer(key, values) -> iterable of (key, value)`` outputs.
+    """
+
+    def __init__(self, job_id: str,
+                 mapper: Callable[[object], Iterable[Record]],
+                 reducer: Callable[[bytes, list[bytes]], Iterable[Record]],
+                 key_type: KeyType | str = "uda.tpu.RawBytes",
+                 num_reducers: int = 2,
+                 partitioner: Callable[[bytes, int], int] = hash_partitioner,
+                 config: Optional[Config] = None,
+                 work_dir: Optional[str] = None):
+        self.job_id = job_id
+        self.mapper = mapper
+        self.reducer = reducer
+        self.key_type = (get_key_type(key_type) if isinstance(key_type, str)
+                         else key_type)
+        self.num_reducers = num_reducers
+        self.partitioner = partitioner
+        self.cfg = config or Config()
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix=f"uda_{job_id}_")
+
+    # -- map phase ----------------------------------------------------------
+
+    def run_maps(self, inputs: Sequence[object]) -> MOFWriter:
+        """Run the mapper over each input split; write sorted partitioned
+        MOFs (what Hadoop's map-side sort+spill produces)."""
+        writer = MOFWriter(self.work_dir, self.job_id)
+        cmp = self.key_type.compare
+        sort_key = functools.cmp_to_key(cmp)
+        with metrics.timer("map_phase"):
+            for m, split in enumerate(inputs):
+                parts: list[list[Record]] = [[] for _ in range(self.num_reducers)]
+                for k, v in self.mapper(split):
+                    parts[self.partitioner(k, self.num_reducers)].append((k, v))
+                for p in parts:
+                    p.sort(key=lambda kv: sort_key(kv[0]))
+                writer.write(f"attempt_{self.job_id}_m_{m:06d}_0", parts)
+        return writer
+
+    # -- reduce phase -------------------------------------------------------
+
+    def run_reduces(self, writer: MOFWriter) -> dict[int, list[Record]]:
+        """Shuffle+merge each partition through the engine, apply the
+        reducer over the grouped sorted stream."""
+        engine = DataEngine(DirIndexResolver(self.work_dir), self.cfg)
+        outputs: dict[int, list[Record]] = {}
+        try:
+            for r in range(self.num_reducers):
+                mm = MergeManager(LocalFetchClient(engine), self.key_type,
+                                  self.cfg)
+                blocks: list[bytes] = []
+                mm.run(self.job_id, writer.map_ids, r,
+                       lambda b: blocks.append(bytes(b)))
+                merged = IFileReader(io.BytesIO(b"".join(blocks)))
+                with metrics.timer("reduce_phase"):
+                    outputs[r] = list(grouped_reduce(
+                        merged, self.reducer, self.key_type.content))
+        finally:
+            engine.stop()
+        return outputs
+
+    def run(self, inputs: Sequence[object]) -> dict[int, list[Record]]:
+        return self.run_reduces(self.run_maps(inputs))

@@ -1,0 +1,127 @@
+"""TeraSort: the flagship workload (BASELINE.json configs 2 and 5).
+
+The reference's headline benchmark is TeraSort on a Hadoop+UDA cluster
+(reference scripts/regression/executeTerasort.sh, analizeTerasort.sh):
+10-byte keys, 90-byte values, shuffle+merge dominated. Here the whole
+shuffle+merge is device-resident:
+
+- records live as uint32[n, 26] rows: columns 0-2 the big-endian packed
+  key (10 bytes + 2 constant pad bytes), columns 3-25 the 90-byte value
+  (last 2 bytes pad);
+- single-chip "merge": one stable lexicographic sort over the 3 key
+  columns (uda_tpu.ops.sort semantics, fixed-width keys need no
+  length/rank columns);
+- multi-chip: the fused partition -> all_to_all -> local-sort step
+  (uda_tpu.parallel.distributed), whose concatenated shards are the
+  globally sorted dataset.
+
+TeraGen-equivalent data is generated ON DEVICE (jax PRNG) — the host
+never touches record bytes, mirroring how the real deployment stages
+records into HBM once and keeps them there.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from uda_tpu.parallel.distributed import (DistributedSortResult,
+                                          distributed_sort_step,
+                                          uniform_splitters)
+from uda_tpu.parallel.mesh import SHUFFLE_AXIS
+
+__all__ = ["KEY_WORDS", "RECORD_WORDS", "RECORD_BYTES", "teragen",
+           "single_chip_sort", "distributed_terasort", "validate_sorted"]
+
+KEY_WORDS = 3        # 10 key bytes -> 3 BE words (2 pad bytes, constant 0)
+VALUE_WORDS = 23     # 90 value bytes -> 23 words (2 pad bytes)
+RECORD_WORDS = KEY_WORDS + VALUE_WORDS
+RECORD_BYTES = 100   # logical TeraSort record size
+
+
+@partial(jax.jit, static_argnames=("n",))
+def teragen(key: jax.Array, n: int) -> jax.Array:
+    """Generate n TeraSort-shaped records on device.
+
+    Keys are uniform random (the TeraGen keyspace); the 2 pad bytes of
+    word 2 are zeroed so fixed-width memcmp order == 3-word lexicographic
+    order. Values carry random payload bits.
+    """
+    kk, kv = jax.random.split(key)
+    keys = jax.random.bits(kk, (n, KEY_WORDS), dtype=jnp.uint32)
+    keys = keys.at[:, 2].set(keys[:, 2] & jnp.uint32(0xFFFF0000))
+    vals = jax.random.bits(kv, (n, VALUE_WORDS), dtype=jnp.uint32)
+    return jnp.concatenate([keys, vals], axis=1)
+
+
+@jax.jit
+def single_chip_sort(words: jax.Array) -> jax.Array:
+    """The single-chip shuffle+merge: stable lexicographic sort of whole
+    records by their 3 key words (the device replacement of the
+    reference's k-way PQ merge, src/Merger/MergeQueue.h:276-427)."""
+    n = words.shape[0]
+    iota = lax.iota(jnp.int32, n)
+    ops = tuple(words[:, i] for i in range(KEY_WORDS)) + (iota,)
+    perm = lax.sort(ops, num_keys=KEY_WORDS, is_stable=True)[-1]
+    return jnp.take(words, perm, axis=0)
+
+
+def distributed_terasort(words, mesh: Mesh, axis: str = SHUFFLE_AXIS,
+                         capacity: Optional[int] = None
+                         ) -> DistributedSortResult:
+    """Multi-chip TeraSort step over the mesh (BASELINE config 5 shape).
+
+    ``capacity`` defaults to 2x the balanced per-(src,dst) share —
+    uniform keys stay far under it; heavy skew should use
+    parallel.exchange.shuffle_exchange's multi-round path instead.
+    """
+    p = int(np.prod(list(mesh.shape.values())))
+    n = int(words.shape[0])
+    if capacity is None:
+        capacity = max(1, (2 * n) // (p * p))
+    return distributed_sort_step(words, uniform_splitters(p), mesh, axis,
+                                 capacity=capacity, num_keys=KEY_WORDS)
+
+
+@jax.jit
+def _order_violations(words: jax.Array) -> jax.Array:
+    """Count adjacent out-of-order key pairs on device (0 == sorted)."""
+    a = words[:-1, :KEY_WORDS]
+    b = words[1:, :KEY_WORDS]
+    gt = ((a[:, 0] > b[:, 0])
+          | ((a[:, 0] == b[:, 0]) & (a[:, 1] > b[:, 1]))
+          | ((a[:, 0] == b[:, 0]) & (a[:, 1] == b[:, 1])
+             & (a[:, 2] > b[:, 2])))
+    return jnp.sum(gt.astype(jnp.int32))
+
+
+@jax.jit
+def _checksum(words: jax.Array) -> jax.Array:
+    """Order-independent multiset fingerprint (sum of per-record mixes)."""
+    x = words.astype(jnp.uint32)
+    mix = x * jnp.uint32(2654435761)
+    rec = jnp.sum(mix, axis=1) ^ jnp.uint32(0x9E3779B9)
+    return jnp.sum(rec.astype(jnp.uint32)), jnp.sum(x)
+
+
+def validate_sorted(sorted_words, input_words=None,
+                    valid_count: Optional[int] = None) -> None:
+    """Sort-validity gate (the TeraSort validity check of the reference's
+    regression harness, scripts/regression/terasortAnallizer.sh):
+    order violations == 0, and when the input is given, the record
+    multiset is preserved (device checksum)."""
+    sw = sorted_words if valid_count is None else sorted_words[:valid_count]
+    violations = int(_order_violations(sw))
+    if violations:
+        raise AssertionError(f"{violations} adjacent order violations")
+    if input_words is not None:
+        a = _checksum(sw)
+        b = _checksum(input_words)
+        if not all(bool(x == y) for x, y in zip(a, b)):
+            raise AssertionError("record multiset changed during sort")

@@ -1,0 +1,61 @@
+"""Map-output writing: the producer side of the MOF contract.
+
+The reference consumes MOFs written by Hadoop mappers (``file.out`` +
+``file.out.index`` under the per-attempt work dir, resolved via
+IndexCache — reference plugins mlx-2.x UdaPluginSH.java:107-144). This
+framework also has to *produce* them (its map phase, tests, and the
+regression workloads), so the writer lives in the supplier package: one
+IFile segment per reduce partition, concatenated, with the (start,
+raw_length, part_length) index triples alongside.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Callable, Iterable, Sequence, Tuple
+
+from uda_tpu.mofserver.index import write_index_file
+from uda_tpu.utils.ifile import IFileWriter
+
+__all__ = ["MOFWriter", "write_map_output"]
+
+
+def write_map_output(map_dir: str,
+                     partitions: Sequence[Iterable[Tuple[bytes, bytes]]]
+                     ) -> list[tuple[int, int, int]]:
+    """Write one map attempt's output: ``partitions[r]`` is the (already
+    sorted) record stream for reducer r. Returns the index triples."""
+    os.makedirs(map_dir, exist_ok=True)
+    mof = io.BytesIO()
+    triples = []
+    for records in partitions:
+        start = mof.tell()
+        w = IFileWriter(mof)
+        for k, v in records:
+            w.append(k, v)
+        w.close()
+        length = mof.tell() - start
+        triples.append((start, length, length))
+    with open(os.path.join(map_dir, "file.out"), "wb") as f:
+        f.write(mof.getvalue())
+    write_index_file(os.path.join(map_dir, "file.out.index"), triples)
+    return triples
+
+
+class MOFWriter:
+    """Job-scoped writer over the DirIndexResolver layout
+    (``<root>/<job>/<map_id>/file.out[.index]``)."""
+
+    def __init__(self, root: str, job_id: str):
+        self.root = root
+        self.job_id = job_id
+        self.map_ids: list[str] = []
+
+    def map_dir(self, map_id: str) -> str:
+        return os.path.join(self.root, self.job_id, map_id)
+
+    def write(self, map_id: str,
+              partitions: Sequence[Iterable[Tuple[bytes, bytes]]]) -> None:
+        write_map_output(self.map_dir(map_id), partitions)
+        self.map_ids.append(map_id)

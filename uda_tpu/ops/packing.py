@@ -77,6 +77,39 @@ def content_spans(batch: RecordBatch, kt: KeyType) -> Tuple[np.ndarray, np.ndarr
     return off, ln
 
 
+def _gather_padded(data: np.ndarray, off: np.ndarray, take: np.ndarray,
+                   width: int) -> np.ndarray:
+    """Vectorized gather of [n, width] bytes: data[off+j] for j < take,
+    zero-padded past each row's take."""
+    j = np.arange(width, dtype=np.int64)
+    idx = off[:, None] + j[None, :]
+    mask = j[None, :] < take[:, None]
+    idx = np.where(mask, idx, 0)
+    return np.where(mask, data[idx], 0).astype(np.uint8)
+
+
+def _bytes_to_words(raw: np.ndarray) -> np.ndarray:
+    """[n, 4k] uint8 -> big-endian uint32 [n, k]: the ONE place the lane
+    layout is defined (memcmp byte order == ascending word order)."""
+    n, nbytes = raw.shape
+    w = raw.reshape(n, nbytes // 4, 4)
+    return ((w[:, :, 0].astype(np.uint32) << 24)
+            | (w[:, :, 1].astype(np.uint32) << 16)
+            | (w[:, :, 2].astype(np.uint32) << 8)
+            | w[:, :, 3].astype(np.uint32))
+
+
+def _words_to_bytes(words: np.ndarray) -> np.ndarray:
+    """Inverse of _bytes_to_words: uint32 [n, k] -> uint8 [n, 4k]."""
+    n, k = words.shape
+    raw = np.empty((n, k * 4), np.uint8)
+    raw[:, 0::4] = (words >> 24) & 0xFF
+    raw[:, 1::4] = (words >> 16) & 0xFF
+    raw[:, 2::4] = (words >> 8) & 0xFF
+    raw[:, 3::4] = words & 0xFF
+    return raw
+
+
 def pack_keys(batch: RecordBatch, kt: KeyType, width: int) -> PackedKeys:
     """Pack normalized key prefixes into big-endian uint32 lane columns."""
     if width % 4 != 0 or width <= 0:
@@ -86,33 +119,24 @@ def pack_keys(batch: RecordBatch, kt: KeyType, width: int) -> PackedKeys:
         return PackedKeys(np.zeros((0, width // 4), np.uint32),
                           np.zeros(0, np.int32), np.zeros(0, np.int32))
     off, ln = content_spans(batch, kt)
-    take = np.minimum(ln, width)
-    # gather [n, width] bytes: data[off + j] where j < take, else 0 pad
-    j = np.arange(width, dtype=np.int64)
-    idx = off[:, None] + j[None, :]
-    mask = j[None, :] < take[:, None]
-    idx = np.where(mask, idx, 0)
-    raw = np.where(mask, batch.data[idx], 0).astype(np.uint8)
+    raw = _gather_padded(batch.data, off, np.minimum(ln, width), width)
     if kt.name in ("int_numeric", "long_numeric"):
         raw[:, 0] ^= 0x80  # sign-bit flip: memcmp order == numeric order
-    words = raw.reshape(n, width // 4, 4)
-    words = (
-        (words[:, :, 0].astype(np.uint32) << 24)
-        | (words[:, :, 1].astype(np.uint32) << 16)
-        | (words[:, :, 2].astype(np.uint32) << 8)
-        | words[:, :, 3].astype(np.uint32)
-    )
-    ranks = overflow_ranks(batch, raw, ln, width)
+    words = _bytes_to_words(raw)
+    ranks = overflow_ranks(batch, raw, off, ln, width)
     return PackedKeys(words, ln.astype(np.int32), ranks)
 
 
 def overflow_ranks(batch: RecordBatch, prefixes: np.ndarray,
-                   content_len: np.ndarray, width: int) -> np.ndarray:
+                   content_off: np.ndarray, content_len: np.ndarray,
+                   width: int) -> np.ndarray:
     """Third sort column: orders keys whose content exceeds ``width`` and
     whose carried prefixes collide.
 
-    Host-side: group the (rare) overflowing keys by prefix, sort each
-    group's full content bytes, assign dense ranks. Keys that fit the
+    Host-side: group the (rare) overflowing keys by prefix, order each
+    group by its full *content* bytes — NOT the serialized key, whose
+    length prefix (Text VInt / BytesWritable length field) would
+    dominate the comparison — and assign dense ranks. Keys that fit the
     width keep rank 0 — the (prefix, length) pair already orders them
     exactly (see comparators.KeyType.normalize).
     """
@@ -121,19 +145,24 @@ def overflow_ranks(batch: RecordBatch, prefixes: np.ndarray,
     over = np.nonzero(content_len > width)[0]
     if over.size == 0:
         return ranks
+
+    def content(i: int) -> bytes:
+        o, l = int(content_off[i]), int(content_len[i])
+        return batch.data[o:o + l].tobytes()
+
     groups: dict[bytes, list[int]] = {}
     for i in over.tolist():
         groups.setdefault(prefixes[i].tobytes(), []).append(i)
     for members in groups.values():
         if len(members) < 2:
             continue
-        full = sorted(members, key=lambda i: (batch.key(i), i))
-        # dense rank by full key bytes (equal keys share a rank so the
-        # stable sort preserves arrival order among them)
+        full = sorted(members, key=lambda i: (content(i), i))
+        # dense rank by full content bytes (equal contents share a rank
+        # so the stable sort preserves arrival order among them)
         r = 0
         prev = None
         for i in full:
-            kb = batch.key(i)
+            kb = content(i)
             if prev is not None and kb != prev:
                 r += 1
             ranks[i] = r
@@ -148,32 +177,19 @@ def pack_fixed_payload(batch: RecordBatch, stride: int) -> np.ndarray:
     Raises if any value exceeds ``stride``; shorter values are zero-padded
     (their true length travels in the batch's ``val_len`` column).
     """
-    n = batch.num_records
     if np.any(batch.val_len > stride):
         raise MergeError(f"value exceeds fixed stride {stride}")
     wstride = (stride + 3) // 4 * 4
-    j = np.arange(wstride, dtype=np.int64)
-    idx = batch.val_off[:, None] + j[None, :]
-    mask = j[None, :] < batch.val_len[:, None]
-    idx = np.where(mask, idx, 0)
-    raw = np.where(mask, batch.data[idx], 0).astype(np.uint8)
-    words = raw.reshape(n, wstride // 4, 4)
-    return ((words[:, :, 0].astype(np.uint32) << 24)
-            | (words[:, :, 1].astype(np.uint32) << 16)
-            | (words[:, :, 2].astype(np.uint32) << 8)
-            | words[:, :, 3].astype(np.uint32))
+    raw = _gather_padded(batch.data, batch.val_off, batch.val_len, wstride)
+    return _bytes_to_words(raw)
 
 
 def unpack_fixed_payload(words: np.ndarray, lengths: Optional[np.ndarray],
                          stride: int) -> list[bytes]:
     """Inverse of pack_fixed_payload (host side, for emission)."""
     words = np.asarray(words, dtype=np.uint32)
-    n, w = words.shape
-    raw = np.empty((n, w * 4), np.uint8)
-    raw[:, 0::4] = (words >> 24) & 0xFF
-    raw[:, 1::4] = (words >> 16) & 0xFF
-    raw[:, 2::4] = (words >> 8) & 0xFF
-    raw[:, 3::4] = words & 0xFF
+    raw = _words_to_bytes(words)
+    n = raw.shape[0]
     if lengths is None:
         return [raw[i, :stride].tobytes() for i in range(n)]
     return [raw[i, : int(lengths[i])].tobytes() for i in range(n)]

@@ -1,0 +1,19 @@
+"""Multi-chip data plane (the DataNet/ layer of SURVEY §1, rebuilt as
+mesh collectives): mesh helpers, windowed all-to-all exchange, fused
+distributed sort step."""
+
+from uda_tpu.parallel.distributed import (DistributedSortResult,
+                                          distributed_sort_step,
+                                          sample_splitters,
+                                          uniform_splitters)
+from uda_tpu.parallel.exchange import (ShuffleLayout, exchange_record_batches,
+                                       exchange_round, prepare_layout,
+                                       shuffle_exchange)
+from uda_tpu.parallel.mesh import (SHUFFLE_AXIS, make_mesh, mesh_from_config,
+                                   shard_spec)
+
+__all__ = ["DistributedSortResult", "distributed_sort_step",
+           "sample_splitters", "uniform_splitters", "ShuffleLayout",
+           "exchange_record_batches", "exchange_round", "prepare_layout",
+           "shuffle_exchange", "SHUFFLE_AXIS", "make_mesh",
+           "mesh_from_config", "shard_spec"]

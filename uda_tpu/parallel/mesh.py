@@ -1,0 +1,65 @@
+"""Device mesh construction.
+
+The TPU equivalent of the reference's connection topology: where the
+reference built one RDMA QP per (reducer, supplier-host) pair lazily
+(reference src/DataNet/RDMAClient.cc:498-527), the TPU framework lays
+all devices out in a ``jax.sharding.Mesh`` once and lets XLA route
+collectives over ICI/DCN. The shuffle data plane uses one named axis
+(default ``"shuffle"``); multi-axis meshes (e.g. ``dp x shuffle`` for
+several concurrent jobs, or an ICI x DCN split for multi-pod) compose by
+naming which axis carries the exchange.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import ConfigError
+
+__all__ = ["make_mesh", "mesh_from_config", "shard_spec", "SHUFFLE_AXIS"]
+
+SHUFFLE_AXIS = "shuffle"
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              axis: str = SHUFFLE_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1D mesh over ``num_devices`` (default: all local devices)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ConfigError(
+                f"requested {num_devices} devices, have {len(devs)}")
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def mesh_from_config(cfg: Config) -> Mesh:
+    """Mesh from the ``uda.tpu.mesh.shape`` flag: ``'axis:N,axis2:M'``;
+    empty = 1D over all devices."""
+    spec = str(cfg.get("uda.tpu.mesh.shape")).strip()
+    if not spec:
+        return make_mesh()
+    names, sizes = [], []
+    for part in spec.split(","):
+        name, _, size = part.partition(":")
+        if not size.isdigit():
+            raise ConfigError(f"bad mesh spec segment {part!r}")
+        names.append(name.strip())
+        sizes.append(int(size))
+    devs = jax.devices()
+    need = int(np.prod(sizes))
+    if need > len(devs):
+        raise ConfigError(f"mesh {spec} needs {need} devices, have {len(devs)}")
+    arr = np.asarray(devs[:need]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def shard_spec(mesh: Mesh, axis: str = SHUFFLE_AXIS) -> NamedSharding:
+    """Row-sharded NamedSharding along the shuffle axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
