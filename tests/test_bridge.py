@@ -1,0 +1,166 @@
+"""Bridge surface: command protocol, role dispatch, up-calls, fallback
+(reference src/UdaBridge.cc, src/CommUtils/C2JNexus.cc)."""
+
+import functools
+import io
+import threading
+
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.bridge import Cmd, UdaBridge, form_cmd, parse_cmd
+from uda_tpu.mofserver import DirIndexResolver
+from uda_tpu.utils import comparators
+from uda_tpu.utils.errors import ProtocolError
+from uda_tpu.utils.ifile import IFileReader
+from uda_tpu.utils.logging import get_logger
+
+
+def teardown_function(_fn):
+    get_logger().set_sink(None)
+
+
+def test_protocol_round_trip():
+    cmd = form_cmd(Cmd.FETCH, ["host1", "job_1", "attempt_x", "3"])
+    assert cmd == "4:4:host1:job_1:attempt_x:3"
+    header, params = parse_cmd(cmd)
+    assert header == Cmd.FETCH
+    assert params == ["host1", "job_1", "attempt_x", "3"]
+    assert parse_cmd("0:2")[0] == Cmd.FINAL
+
+
+def test_protocol_errors():
+    with pytest.raises(ProtocolError):
+        parse_cmd("nonsense")
+    with pytest.raises(ProtocolError):
+        parse_cmd("2:4:only_one")        # count mismatch
+    with pytest.raises(ProtocolError):
+        parse_cmd("0:99")                # unknown header
+    with pytest.raises(ProtocolError):
+        form_cmd(Cmd.INIT, ["has:colon"])
+
+
+class Harness:
+    """Embedder double: collects up-calls like UdaPluginRT would."""
+
+    def __init__(self, root):
+        self.root = root
+        self.blocks = []
+        self.fetch_over = threading.Event()
+        self.failures = []
+        self.conf = {}
+        self.logs = []
+        self._resolver = DirIndexResolver(root)
+
+    def data_from_uda(self, data, length):
+        self.blocks.append(bytes(data[:length]))
+
+    def fetch_over_message(self):
+        self.fetch_over.set()
+
+    def get_path_uda(self, job_id, map_id, reduce_id):
+        return self._resolver.resolve(job_id, map_id, reduce_id)
+
+    def get_conf_data(self, name, default):
+        return self.conf.get(name, "")
+
+    def log_to(self, level, message):
+        self.logs.append((level, message))
+
+    def failure_in_uda(self, error):
+        self.failures.append(error)
+        self.fetch_over.set()
+
+
+def _drive_reduce(tmp_path, job, num_maps=4, reducers=2, init_extra=None):
+    expected = make_mof_tree(str(tmp_path), job, num_maps, reducers, 40,
+                             seed=13)
+    results = {}
+    for r in range(reducers):
+        harness = Harness(str(tmp_path))
+        bridge = UdaBridge()
+        bridge.start(True, ["-w", "8", "-s", "64"], harness)
+        bridge.do_command(form_cmd(
+            Cmd.INIT, [job, str(r), str(num_maps), "uda.tpu.RawBytes"]
+            + (init_extra or [])))
+        for mid in map_ids(job, num_maps):
+            bridge.do_command(form_cmd(Cmd.FETCH, ["localhost", job, mid, str(r)]))
+        bridge.do_command(form_cmd(Cmd.FINAL, []))
+        assert harness.fetch_over.wait(timeout=30)
+        bridge.reduce_exit()
+        assert not harness.failures, harness.failures
+        results[r] = list(IFileReader(io.BytesIO(b"".join(harness.blocks))))
+    return expected, results
+
+
+def test_reduce_role_end_to_end_via_upcall_resolution(tmp_path):
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    expected, results = _drive_reduce(tmp_path, "jobB1")
+    for r, got in results.items():
+        want = sorted(expected[r], key=functools.cmp_to_key(
+            lambda a, b: kt.compare(a[0], b[0])))
+        assert [k for k, _ in got] == [k for k, _ in want]
+
+
+def test_reduce_role_with_local_dirs_param(tmp_path):
+    # INIT's trailing params are local dirs -> DirIndexResolver path
+    expected, results = _drive_reduce(tmp_path, "jobB2",
+                                      init_extra=[str(tmp_path).replace(":", "")])
+    assert sum(len(v) for v in results.values()) == sum(
+        len(v) for v in expected.values())
+
+
+def test_supplier_role_serves_and_exits(tmp_path):
+    make_mof_tree(str(tmp_path), "jobB3", 2, 1, 10, seed=14)
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(False, ["-w", "8"], harness)
+    from uda_tpu.mofserver import ShuffleRequest
+
+    engine = bridge.data_engine()
+    res = engine.fetch(ShuffleRequest("jobB3", map_ids("jobB3", 2)[0], 0,
+                                      0, 1 << 20))
+    assert res.is_last and len(res.data) > 0
+    bridge.do_command(form_cmd(Cmd.JOB_OVER, ["jobB3"]))
+    bridge.do_command(form_cmd(Cmd.EXIT, []))
+
+
+def test_failure_triggers_fallback_upcall(tmp_path):
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    bridge.do_command(form_cmd(
+        Cmd.INIT, ["jobNope", "0", "1", "uda.tpu.RawBytes"]))
+    bridge.do_command(form_cmd(Cmd.FETCH,
+                               ["h", "jobNope", "attempt_missing", "0"]))
+    bridge.do_command(form_cmd(Cmd.FINAL, []))
+    assert harness.fetch_over.wait(timeout=30)
+    assert harness.failures  # failure_in_uda fired
+    assert bridge.failed
+    # bridge is inert afterwards (Java fell back to vanilla)
+    bridge.do_command(form_cmd(Cmd.FINAL, []))  # no raise, no effect
+
+
+def test_developer_mode_reraises(tmp_path):
+    harness = Harness(str(tmp_path))
+    harness.conf["mapred.rdma.developer.mode"] = "true"
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    with pytest.raises(Exception):
+        bridge.do_command("garbage-not-a-command")
+
+
+def test_unexpected_role_command_fails_softly(tmp_path):
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    bridge.do_command(form_cmd(Cmd.NEW_MAP, []))  # supplier-only cmd
+    assert bridge.failed and harness.failures
+
+
+def test_log_upcall_sink(tmp_path):
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, ["-t", "6"], harness)
+    get_logger().info("hello bridge")
+    assert any("hello bridge" in m for _, m in harness.logs)

@@ -1,0 +1,200 @@
+"""Native codec/reader parity vs the pure-Python reference
+(SURVEY §2 [native] rows; Python side is the semantic oracle)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from uda_tpu import native
+from uda_tpu.utils import ifile, vint
+from uda_tpu.utils.errors import StorageError
+
+pytestmark = pytest.mark.skipif(
+    not (native.available() or native.build()),
+    reason="native library not built and build failed")
+
+
+def _records(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.bytes(int(rng.integers(0, 50))),
+             rng.bytes(int(rng.integers(0, 300)))) for _ in range(n)]
+
+
+def test_crack_parity_with_python():
+    recs = _records()
+    buf = ifile.write_records(recs)
+    py = ifile.crack(buf)
+    nat = native.crack_native(buf)
+    assert nat.num_records == py.num_records
+    for arr_py, arr_nat in [(py.key_off, nat.key_off), (py.key_len, nat.key_len),
+                            (py.val_off, nat.val_off), (py.val_len, nat.val_len)]:
+        assert arr_py.tolist() == arr_nat.tolist()
+    assert list(nat.iter_records()) == recs
+
+
+def test_crack_partial_parity():
+    recs = _records(50, seed=1)
+    buf = ifile.write_records(recs)
+    for cut in [0, 1, 7, len(buf) // 2, len(buf) - 3, len(buf)]:
+        py_b, py_c, py_e = ifile.crack_partial(buf[:cut])
+        na_b, na_c, na_e = native.crack_partial_native(buf[:cut])
+        assert (py_b.num_records, py_c, py_e) == (na_b.num_records, na_c, na_e), cut
+        assert list(py_b.iter_records()) == list(na_b.iter_records())
+
+
+def test_crack_native_errors():
+    with pytest.raises(StorageError):
+        native.crack_native(b"\xfe\xfe")  # klen=-2: corrupt
+    with pytest.raises(StorageError):
+        native.crack_native(ifile.write_records([(b"k", b"v")])[:-2])
+
+
+def test_write_records_parity():
+    recs = _records(120, seed=5)
+    buf = ifile.write_records(recs)
+    batch = ifile.crack(buf)
+    assert native.write_records_native(batch) == buf
+    # no-EOF variant reframes just the records
+    assert native.write_records_native(batch, write_eof=False) \
+        == buf[:-len(ifile.EOF_MARKER)]
+
+
+def test_bridge_malformed_param_falls_back():
+    # regression: a ValueError inside a well-formed command must flow
+    # through failure_in_uda, not escape the bridge
+    from uda_tpu.bridge import Cmd, UdaBridge, form_cmd
+
+    failures = []
+
+    class H:
+        def failure_in_uda(self, e):
+            failures.append(e)
+
+        def get_conf_data(self, n, d):
+            return ""
+
+    b = UdaBridge()
+    b.start(True, [], H())
+    b.do_command(form_cmd(Cmd.INIT, ["job", "not_an_int", "4",
+                                     "uda.tpu.RawBytes"]))
+    assert failures and b.failed
+
+
+def test_pallas_tile_power_of_two_guard():
+    from uda_tpu.ops import pallas_merge
+
+    a = np.zeros((4, 4), np.uint32)
+    with pytest.raises(ValueError):
+        pallas_merge.merge_sorted_pair(a, a, 2, tile=384)
+
+
+def test_decode_vlongs_parity():
+    vals = [0, 1, -1, 127, -112, 128, -113, 2**40, -(2**40), 2**63 - 1,
+            -(2**63)]
+    buf = b"".join(vint.encode_vlong(v) for v in vals)
+    got = native.decode_vlongs_native(buf)
+    assert got.tolist() == vals
+    with pytest.raises(IndexError):
+        native.decode_vlongs_native(buf[:-1], count=len(vals))
+
+
+def test_value_ending_in_eof_marker_bytes():
+    # the trap case: a record VALUE containing/ending with 0xFFFF must not
+    # terminate the native scan
+    recs = [(b"k1", b"data\xff\xff"), (b"k2", b"\xff\xff"), (b"k3", b"x")]
+    buf = ifile.write_records(recs)
+    nat = native.crack_native(buf)
+    assert list(nat.iter_records()) == recs
+
+
+def test_read_pool(tmp_path):
+    data = np.random.default_rng(0).bytes(1 << 20)
+    path = str(tmp_path / "blob")
+    with open(path, "wb") as f:
+        f.write(data)
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        with native.ReadPool(threads=3) as pool:
+            tags = {}
+            for i in range(16):
+                off = i * (1 << 16)
+                tags[pool.submit(fd, off, 1 << 16)] = off
+            got = {}
+            while len(got) < 16:
+                for tag, buf in pool.poll(min_events=1, timeout=5.0):
+                    got[tag] = buf
+            for tag, off in tags.items():
+                assert got[tag].tobytes() == data[off:off + (1 << 16)]
+    finally:
+        os.close(fd)
+
+
+def test_use_native_flag_gates_codec(tmp_path):
+    # regression: uda.tpu.use.native=false must disable the native codec
+    # dispatch in ifile, not only the DataEngine reader
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.utils import ifile
+    from uda_tpu.utils.config import Config
+
+    try:
+        DataEngine(DirIndexResolver(str(tmp_path)),
+                   Config({"uda.tpu.use.native": False})).stop()
+        assert ifile._native_mod() is None
+    finally:
+        ifile.set_native_enabled(True)
+    assert ifile._native_mod() is not None
+
+
+def test_bridge_reduce_exit_stops_owned_engine(tmp_path):
+    from tests.helpers import make_mof_tree, map_ids
+    from uda_tpu.bridge import Cmd, UdaBridge, form_cmd
+    from uda_tpu.mofserver import DirIndexResolver
+    from uda_tpu.utils.errors import StorageError
+    import threading
+
+    make_mof_tree(str(tmp_path), "jobN", 1, 1, 5)
+
+    class H:
+        def __init__(self):
+            self.done = threading.Event()
+            self._r = DirIndexResolver(str(tmp_path))
+
+        def data_from_uda(self, d, n): pass
+
+        def fetch_over_message(self): self.done.set()
+
+        def get_path_uda(self, j, m, r): return self._r.resolve(j, m, r)
+
+        def get_conf_data(self, n, d): return ""
+
+        def failure_in_uda(self, e): self.done.set()
+
+    h = H()
+    b = UdaBridge()
+    b.start(True, [], h)
+    b.do_command(form_cmd(Cmd.INIT, ["jobN", "0", "1", "uda.tpu.RawBytes"]))
+    b.do_command(form_cmd(Cmd.FETCH, ["h", "jobN", map_ids("jobN", 1)[0], "0"]))
+    b.do_command(form_cmd(Cmd.FINAL, []))
+    assert h.done.wait(30)
+    engine = b._owned_engine
+    assert engine is not None
+    b.reduce_exit()
+    assert b._owned_engine is None
+    with pytest.raises(StorageError):
+        from uda_tpu.mofserver import ShuffleRequest
+        engine.fetch(ShuffleRequest("jobN", "x", 0, 0, 10))
+
+
+def test_read_pool_short_read_at_eof(tmp_path):
+    path = str(tmp_path / "small")
+    with open(path, "wb") as f:
+        f.write(b"hello")
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        with native.ReadPool(threads=1) as pool:
+            tag = pool.submit(fd, 0, 100)
+            [(t, buf)] = pool.poll()
+            assert t == tag and buf.tobytes() == b"hello"
+    finally:
+        os.close(fd)

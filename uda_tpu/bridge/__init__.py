@@ -1,0 +1,7 @@
+"""Control surface (the UdaBridge/C2JNexus layer of SURVEY §1 L4/L3):
+command protocol, role dispatch, up-call registry, fallback contract."""
+
+from uda_tpu.bridge.bridge import UdaBridge, UdaCallable
+from uda_tpu.bridge.protocol import Cmd, form_cmd, parse_cmd
+
+__all__ = ["UdaBridge", "UdaCallable", "Cmd", "form_cmd", "parse_cmd"]

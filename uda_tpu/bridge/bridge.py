@@ -1,0 +1,294 @@
+"""The UdaBridge control surface.
+
+Re-creation of the reference's JNI bridge contract (reference
+src/UdaBridge.cc) as an embeddable Python API with the same shape:
+
+- down-calls: ``start(is_net_merger, argv, callable)`` (startNative,
+  UdaBridge.cc:187-263), ``do_command(cmd)`` (doCommandNative :266-295),
+  ``reduce_exit()`` (reduceExitMsgNative :299-314), ``set_log_level``
+  (:318-333);
+- up-calls on the registered ``UdaCallable``: ``fetch_over_message``,
+  ``data_from_uda``, ``get_path_uda``, ``get_conf_data``, ``log_to``
+  and ``failure_in_uda`` — the 6 cached callback methods of
+  UdaBridge.cc:138-170, 516-522;
+- role dispatch: NetMerger (reduce side, MergeManager_main +
+  reduce_downcall_handler, reference src/Merger/NetMergerMain.cc:44-88)
+  vs MOFSupplier (server side, MOFSupplier_main + mof_downcall_handler,
+  reference src/MOFServer/MOFSupplierMain.cc:37-143), selected by the
+  ``is_net_merger`` flag exactly like UdaBridge.cc:217-238;
+- the fallback contract: any engine failure is reported through
+  ``failure_in_uda`` and the bridge goes inert, unless
+  ``mapred.rdma.developer.mode`` is set, in which case it re-raises
+  (reference UdaBridge.cc:506-530, UdaShuffleConsumerPluginShared.java:
+  205-242).
+
+A JNI-loadable C shim over this class (libuda replacement for running
+under an actual Hadoop JVM) is planned for a later round; the command
+protocol and up-call semantics here are the compatibility layer it will
+bind to.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Protocol, Sequence
+
+from uda_tpu.bridge.protocol import Cmd, form_cmd, parse_cmd
+from uda_tpu.merger import LocalFetchClient, MergeManager
+from uda_tpu.merger.segment import InputClient
+from uda_tpu.mofserver import DataEngine, IndexRecord, IndexResolver
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import FallbackSignal, ProtocolError, UdaError
+from uda_tpu.utils.logging import LogLevel, get_logger
+
+__all__ = ["UdaCallable", "UdaBridge"]
+
+log = get_logger()
+
+
+class UdaCallable(Protocol):
+    """The up-call interface the embedder registers (the reference's
+    UdaCallable/UdaPluginRT/UdaPluginSH surface, UdaBridge.java:85-145).
+    All methods are optional; missing ones are no-ops (except
+    get_path_uda, required on the supplier side when no local root is
+    configured)."""
+
+    def fetch_over_message(self) -> None: ...
+
+    def data_from_uda(self, data: memoryview, length: int) -> None: ...
+
+    def get_path_uda(self, job_id: str, map_id: str,
+                     reduce_id: int) -> IndexRecord: ...
+
+    def get_conf_data(self, name: str, default: str) -> str: ...
+
+    def log_to(self, level: int, message: str) -> None: ...
+
+    def failure_in_uda(self, error: Exception) -> None: ...
+
+
+class _UpcallIndexResolver(IndexResolver):
+    """Supplier index resolution through the get_path_uda up-call — the
+    reference's first-fetch Java IndexCache round trip (IndexInfo.cc:
+    237-251, UdaPluginSH.java:107-144), cached per (job, map, reduce)."""
+
+    def __init__(self, callable_obj):
+        self._callable = callable_obj
+        self._cache: dict[tuple, IndexRecord] = {}
+        self._lock = threading.Lock()
+
+    def resolve(self, job_id: str, map_id: str, reduce_id: int) -> IndexRecord:
+        key = (job_id, map_id, reduce_id)
+        with self._lock:
+            rec = self._cache.get(key)
+        if rec is None:
+            rec = self._callable.get_path_uda(job_id, map_id, reduce_id)
+            with self._lock:
+                self._cache[key] = rec
+        return rec
+
+    def invalidate(self, job_id: str) -> None:
+        with self._lock:
+            for key in [k for k in self._cache if k[0] == job_id]:
+                del self._cache[key]
+
+
+class UdaBridge:
+    """One bridge instance per role process (the reference allows one
+    reduce task per NetMerger process, reducer.h:137)."""
+
+    def __init__(self) -> None:
+        self.callable: Optional[UdaCallable] = None
+        self.is_net_merger = False
+        self.cfg = Config()
+        self.started = False
+        self._failed = False
+        # reduce side
+        self._mm: Optional[MergeManager] = None
+        self._client: Optional[InputClient] = None
+        self._job_id: Optional[str] = None
+        self._reduce_id: Optional[int] = None
+        self._key_class = "uda.tpu.RawBytes"
+        self._pending_maps: list[str] = []
+        self._merge_thread: Optional[threading.Thread] = None
+        # supplier side
+        self._engine: Optional[DataEngine] = None
+        self._resolver: Optional[IndexResolver] = None
+        self._owned_engine: Optional[DataEngine] = None
+
+    # -- down-calls ---------------------------------------------------------
+
+    def start(self, is_net_merger: bool, argv: Sequence[str],
+              callable_obj: Optional[UdaCallable] = None) -> None:
+        """startNative: parse argv (the reference's getopt channel), wire
+        the conf pull channel, pick the role (UdaBridge.cc:187-263)."""
+        self.callable = callable_obj
+        self.is_net_merger = is_net_merger
+        self.cfg = Config.from_argv(list(argv))
+        if callable_obj is not None and hasattr(callable_obj, "get_conf_data"):
+            self.cfg.conf_source = callable_obj.get_conf_data
+        if callable_obj is not None and hasattr(callable_obj, "log_to"):
+            get_logger().set_sink(callable_obj.log_to)
+        get_logger().set_level(self.cfg.get("uda.log.level"))
+        if not is_net_merger:
+            # MOFSupplier_main: the data engine serves fetches; paths
+            # resolve through the up-call (the IndexCache round trip)
+            self._resolver = _UpcallIndexResolver(self.callable)
+            self._engine = DataEngine(self._resolver, self.cfg)
+        self.started = True
+        log.info(f"uda_tpu bridge started as "
+                 f"{'NetMerger' if is_net_merger else 'MOFSupplier'}")
+
+    def data_engine(self) -> DataEngine:
+        """The supplier's engine (for in-process reduce-side clients —
+        the single-host wiring where both roles share a process)."""
+        if self._engine is None:
+            raise UdaError("bridge not started as MOFSupplier")
+        return self._engine
+
+    def do_command(self, cmd: str) -> None:
+        """doCommandNative: dispatch by role (UdaBridge.cc:266-295)."""
+        if not self.started:
+            raise UdaError("bridge not started")
+        if self._failed:
+            return  # inert after failure (Java has fallen back to vanilla)
+        try:
+            header, params = parse_cmd(cmd)
+            if self.is_net_merger:
+                self._reduce_downcall(header, params)
+            else:
+                self._mof_downcall(header, params)
+        except Exception as e:  # noqa: BLE001 - ANY engine failure must
+            # flow through the fallback contract (e.g. a ValueError from
+            # a malformed INIT param), not escape into the embedder
+            self._fail(e)
+
+    def reduce_exit(self) -> None:
+        """reduceExitMsgNative: synchronous teardown of the reduce task
+        (UdaBridge.cc:299-314, finalize_reduce_task reducer.cc:354-410)."""
+        t = self._merge_thread
+        if t is not None:
+            t.join()
+        if self._mm is not None:
+            self._mm.stop()
+            self._mm = None
+        if self._owned_engine is not None:
+            self._owned_engine.stop()
+            self._owned_engine = None
+        self._merge_thread = None
+
+    def set_log_level(self, level: int) -> None:
+        """setLogLevelNative (UdaBridge.cc:318-333)."""
+        get_logger().set_level(level)
+
+    # -- reduce side (reduce_downcall_handler, reducer.cc:144-217) ----------
+
+    def _reduce_downcall(self, header: Cmd, params: list[str]) -> None:
+        if header == Cmd.INIT:
+            # reference INIT carries 10 fixed params + local dirs
+            # (reducer.cc:56-133); we take: job_id, reduce_id, num_maps,
+            # key_class, then optional local dirs
+            if len(params) < 4:
+                raise ProtocolError(f"INIT needs >= 4 params, got {len(params)}")
+            self._job_id, rid, _num_maps, self._key_class = params[:4]
+            self._reduce_id = int(rid)
+            self._pending_maps = []
+            client = self._make_client(params[4:])
+            self._mm = MergeManager(client, self._key_class, self.cfg)
+        elif header == Cmd.FETCH:
+            # reference FETCH: host:jobid:attemptid:partition
+            # (UdaPlugin.java:322-334); host is vestigial on TPU (the
+            # exchange is mesh-global)
+            if len(params) < 4:
+                raise ProtocolError("FETCH needs 4 params")
+            _host, job_id, map_attempt, _partition = params[:4]
+            self._pending_maps.append(map_attempt)
+        elif header == Cmd.FINAL:
+            if self._mm is None:
+                raise UdaError("FINAL before INIT")
+            maps = list(self._pending_maps)
+            self._merge_thread = threading.Thread(
+                target=self._merge_main, args=(maps,), daemon=True,
+                name="uda-merge-thread")
+            self._merge_thread.start()
+        elif header == Cmd.EXIT:
+            self.reduce_exit()
+        else:
+            raise ProtocolError(f"unexpected command {header.name} for "
+                                "NetMerger role")
+
+    def _make_client(self, local_dirs: list[str]) -> InputClient:
+        """createInputClient: plain or decompressing transport by codec
+        class (reference reducer.cc:412-450)."""
+        if self._client is not None:
+            return self._client
+        if local_dirs:
+            from uda_tpu.mofserver import DirIndexResolver
+            engine = DataEngine(DirIndexResolver(local_dirs[0]), self.cfg)
+        else:
+            engine = DataEngine(_UpcallIndexResolver(self.callable), self.cfg)
+        self._owned_engine = engine
+        client: InputClient = LocalFetchClient(engine)
+        if self.cfg.get("mapred.compress.map.output"):
+            from uda_tpu.compress import DecompressingClient, get_codec
+            codec = get_codec(
+                self.cfg.get("mapred.map.output.compression.codec") or "zlib")
+            client = DecompressingClient(client, codec)
+        return client
+
+    def set_input_client(self, client: InputClient) -> None:
+        """Inject a transport (e.g. the mesh exchange client) — the
+        createInputClient factory seam (reducer.cc:412-450)."""
+        self._client = client
+
+    def _merge_main(self, maps: list[str]) -> None:
+        """The merge thread: fetch -> merge -> stream dataFromUda blocks
+        -> fetchOverMessage (merge_thread_main, MergeManager.cc:291-314)."""
+        try:
+            def consumer(block: memoryview) -> None:
+                cb = getattr(self.callable, "data_from_uda", None)
+                if cb is not None:
+                    cb(block, len(block))
+
+            self._mm.run(self._job_id, maps, self._reduce_id, consumer)
+            cb = getattr(self.callable, "fetch_over_message", None)
+            if cb is not None:
+                cb()
+        except Exception as e:  # noqa: BLE001 - the fallback boundary
+            self._fail(e)
+
+    # -- supplier side (mof_downcall_handler, MOFSupplierMain.cc:37-81) -----
+
+    def _mof_downcall(self, header: Cmd, params: list[str]) -> None:
+        if header == Cmd.NEW_MAP:
+            pass  # map registration is implicit (resolution is pull-based)
+        elif header == Cmd.JOB_OVER:
+            if params and self._resolver is not None:
+                self._resolver.invalidate(params[0])
+        elif header == Cmd.INIT:
+            pass
+        elif header == Cmd.EXIT:
+            if self._engine is not None:
+                self._engine.stop()
+                self._engine = None
+        else:
+            raise ProtocolError(f"unexpected command {header.name} for "
+                                "MOFSupplier role")
+
+    # -- failure contract ---------------------------------------------------
+
+    def _fail(self, error: Exception) -> None:
+        """exceptionInNativeThread -> failureInUda -> inert bridge
+        (UdaBridge.cc:506-530); developer mode re-raises instead
+        (UdaShuffleConsumerPluginShared.java:210-217)."""
+        if self.cfg.get("mapred.rdma.developer.mode"):
+            raise error
+        self._failed = True
+        log.error(f"engine failure, requesting fallback: {error}")
+        cb = getattr(self.callable, "failure_in_uda", None)
+        if cb is not None:
+            cb(error)
+
+    @property
+    def failed(self) -> bool:
+        return self._failed

@@ -1,0 +1,62 @@
+"""The ":"-delimited control command protocol.
+
+Byte-compatible reimplementation of the reference's hadoop_cmd wire
+format (reference src/CommUtils/C2JNexus.cc:141-207 ``parse_hadoop_cmd``
+and plugins/shared/.../UdaPlugin.java:562-587 ``UdaCmd.formCmd``):
+commands are ``"<param_count>:<header>:<p1>:<p2>:..."`` where header is
+the command enum and param_count counts the params AFTER the header.
+The command enum mirrors reference src/include/C2JNexus.h:36-47.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from uda_tpu.utils.errors import ProtocolError
+
+__all__ = ["Cmd", "form_cmd", "parse_cmd"]
+
+
+class Cmd(enum.IntEnum):
+    # reference C2JNexus.h:36-47
+    EXIT = 0
+    NEW_MAP = 1
+    FINAL = 2
+    RESULT = 3
+    FETCH = 4
+    FETCH_OVER = 5
+    JOB_OVER = 6
+    INIT = 7
+    MORE = 8
+    RT_LAUNCHED = 9
+
+
+def form_cmd(header: Cmd, params: list[str]) -> str:
+    """UdaCmd.formCmd (UdaPlugin.java:562-587)."""
+    for p in params:
+        if ":" in p:
+            raise ProtocolError(f"param {p!r} contains the delimiter")
+    return ":".join([str(len(params)), str(int(header))] + list(params))
+
+
+def parse_cmd(cmd: str) -> tuple[Cmd, list[str]]:
+    """parse_hadoop_cmd (C2JNexus.cc:141-207): returns (header, params).
+
+    Like the reference, the declared count must match the actual params
+    (the reference walks exactly ``count`` tokens and errors on
+    truncation).
+    """
+    parts = cmd.split(":")
+    if len(parts) < 2:
+        raise ProtocolError(f"malformed command {cmd!r}")
+    try:
+        count = int(parts[0])
+        header = Cmd(int(parts[1]))
+    except ValueError as e:
+        raise ProtocolError(f"malformed command {cmd!r}: {e}") from e
+    params = parts[2:]
+    if count != len(params):
+        raise ProtocolError(
+            f"command {header.name} declares {count} params, got "
+            f"{len(params)}")
+    return header, params

@@ -87,10 +87,17 @@ class MapReduceJob:
 
     # -- map phase ----------------------------------------------------------
 
+    def _codec(self):
+        if not self.cfg.get("mapred.compress.map.output"):
+            return None
+        from uda_tpu.compress import get_codec
+        return get_codec(self.cfg.get("mapred.map.output.compression.codec")
+                         or "zlib")
+
     def run_maps(self, inputs: Sequence[object]) -> MOFWriter:
         """Run the mapper over each input split; write sorted partitioned
         MOFs (what Hadoop's map-side sort+spill produces)."""
-        writer = MOFWriter(self.work_dir, self.job_id)
+        writer = MOFWriter(self.work_dir, self.job_id, codec=self._codec())
         cmp = self.key_type.compare
         sort_key = functools.cmp_to_key(cmp)
         with metrics.timer("map_phase"):
@@ -109,11 +116,15 @@ class MapReduceJob:
         """Shuffle+merge each partition through the engine, apply the
         reducer over the grouped sorted stream."""
         engine = DataEngine(DirIndexResolver(self.work_dir), self.cfg)
+        codec = self._codec()
         outputs: dict[int, list[Record]] = {}
         try:
             for r in range(self.num_reducers):
-                mm = MergeManager(LocalFetchClient(engine), self.key_type,
-                                  self.cfg)
+                client: object = LocalFetchClient(engine)
+                if codec is not None:
+                    from uda_tpu.compress import DecompressingClient
+                    client = DecompressingClient(client, codec)
+                mm = MergeManager(client, self.key_type, self.cfg)
                 blocks: list[bytes] = []
                 mm.run(self.job_id, writer.map_ids, r,
                        lambda b: blocks.append(bytes(b)))

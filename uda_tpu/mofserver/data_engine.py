@@ -58,17 +58,26 @@ class ShuffleRequest:
 
 @dataclasses.dataclass
 class FetchResult:
-    """Reply payload (reference ACK fields, RDMAServer.cc:597-607)."""
+    """Reply payload (reference ACK fields, RDMAServer.cc:597-607).
+
+    ``raw_length`` is the partition's uncompressed record-byte size and
+    ``part_length`` its on-disk size (they differ under compression,
+    matching Hadoop's spill-index semantics); ``last`` is set by the
+    producer in whatever domain it serves (DataEngine: on-disk bytes;
+    DecompressingClient: uncompressed stream).
+    """
 
     data: bytes
-    raw_length: int      # total record bytes of the partition
+    raw_length: int      # total uncompressed record bytes of the partition
     part_length: int     # total on-disk bytes of the partition
     offset: int          # echo of the request offset
     path: str
+    last: bool           # required: a defaulted value silently truncated
+                         # multi-chunk streams once; producers must decide
 
     @property
     def is_last(self) -> bool:
-        return self.offset + len(self.data) >= self.raw_length
+        return self.last
 
 
 class _FdCache:
@@ -114,6 +123,52 @@ class _FdCache:
             self._fds.clear()
 
 
+class _NativeReads:
+    """Routes blocking reads through the native ReadPool: a router thread
+    drains the pool's completion queue (the io_getevents analogue) and
+    wakes the submitting thread by tag. Submit and waiter registration
+    are atomic under the same lock the router needs to deliver, so a
+    completion can never beat its waiter's registration."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._lock = threading.Lock()
+        self._waiters: dict[int, list] = {}   # tag -> [Event, data|None]
+        self._stop = False
+        self._router = threading.Thread(target=self._route, daemon=True,
+                                        name="uda-native-router")
+        self._router.start()
+
+    def _route(self) -> None:
+        while not self._stop:
+            events = self.pool.poll(min_events=1, timeout=0.2)
+            with self._lock:
+                for tag, result in events:
+                    w = self._waiters.pop(tag, None)
+                    if w is not None:
+                        w[1] = result
+                        w[0].set()
+
+    def read(self, fd: int, offset: int, length: int) -> bytes:
+        waiter = [threading.Event(), None]
+        with self._lock:
+            tag = self.pool.submit(fd, offset, length)
+            self._waiters[tag] = waiter
+        if not waiter[0].wait(timeout=60.0):
+            with self._lock:
+                self._waiters.pop(tag, None)  # don't leak the entry
+            raise StorageError("native read timed out")
+        result = waiter[1]
+        if isinstance(result, Exception):
+            raise result
+        return result.tobytes()
+
+    def close(self) -> None:
+        self._stop = True
+        self._router.join(timeout=2.0)
+        self.pool.close()
+
+
 class DataEngine:
     """Threaded chunk server over local map-output files."""
 
@@ -128,6 +183,22 @@ class DataEngine:
                                         thread_name_prefix="uda-data-engine")
         self._fds = _FdCache()
         self._stopped = False
+        # native read path (the AIOHandler-equivalent worker pool,
+        # uda_tpu/native/reader.cc), flag-gated with graceful fallback.
+        # The flag also gates the process-wide native IFile codec — but
+        # only when EXPLICITLY set, so a default-config engine never
+        # silently reconfigures other jobs in the process.
+        if cfg.is_set("uda.tpu.use.native"):
+            from uda_tpu.utils.ifile import set_native_enabled
+            set_native_enabled(bool(cfg.get("uda.tpu.use.native")))
+        self._native = None
+        if cfg.get("uda.tpu.use.native"):
+            try:
+                from uda_tpu import native
+                if native.available() or native.build():
+                    self._native = _NativeReads(native.ReadPool(threads))
+            except Exception as e:  # pragma: no cover - best effort
+                log.warn(f"native reader unavailable, using os.pread: {e}")
 
     def submit(self, req: ShuffleRequest) -> Future:
         """Async fetch; the Future resolves to a FetchResult. Never
@@ -143,15 +214,20 @@ class DataEngine:
     def _serve(self, req: ShuffleRequest) -> FetchResult:
         with metrics.timer("supplier_read"):
             rec = self.resolver.resolve(req.job_id, req.map_id, req.reduce_id)
-            if req.offset < 0 or req.offset >= max(rec.raw_length, 1):
+            served = rec.part_length  # the on-disk domain
+            if req.offset < 0 or req.offset >= max(served, 1):
                 raise StorageError(
-                    f"offset {req.offset} outside partition (raw "
-                    f"{rec.raw_length}) for {req.map_id}/{req.reduce_id}")
+                    f"offset {req.offset} outside partition (on-disk "
+                    f"{served}) for {req.map_id}/{req.reduce_id}")
             want = min(req.chunk_size or self.chunk_size_default,
-                       rec.raw_length - req.offset)
+                       served - req.offset)
             fd = self._fds.acquire(rec.path)
             try:
-                data = os.pread(fd, want, rec.start_offset + req.offset)
+                if self._native is not None:
+                    data = self._native.read(fd, rec.start_offset + req.offset,
+                                             want)
+                else:
+                    data = os.pread(fd, want, rec.start_offset + req.offset)
             finally:
                 self._fds.release(rec.path)
             if len(data) != want:
@@ -160,11 +236,14 @@ class DataEngine:
                     f"{rec.start_offset + req.offset}")
             metrics.add("supplier_bytes", len(data))
             return FetchResult(data, rec.raw_length, rec.part_length,
-                               req.offset, rec.path)
+                               req.offset, rec.path,
+                               last=req.offset + len(data) >= served)
 
     def stop(self) -> None:
         self._stopped = True
         self._pool.shutdown(wait=True)
+        if self._native is not None:
+            self._native.close()
         self._fds.close_all()
 
     def __enter__(self) -> "DataEngine":

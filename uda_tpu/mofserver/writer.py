@@ -22,21 +22,34 @@ __all__ = ["MOFWriter", "write_map_output"]
 
 
 def write_map_output(map_dir: str,
-                     partitions: Sequence[Iterable[Tuple[bytes, bytes]]]
-                     ) -> list[tuple[int, int, int]]:
+                     partitions: Sequence[Iterable[Tuple[bytes, bytes]]],
+                     codec=None) -> list[tuple[int, int, int]]:
     """Write one map attempt's output: ``partitions[r]`` is the (already
-    sorted) record stream for reducer r. Returns the index triples."""
+    sorted) record stream for reducer r. Returns the index triples.
+
+    With ``codec`` (a uda_tpu.compress.Codec) each partition's IFile
+    bytes are block-compressed; the index triple then carries
+    (start, raw_length=uncompressed, part_length=on-disk) like Hadoop's
+    spill index for compressed map outputs.
+    """
     os.makedirs(map_dir, exist_ok=True)
     mof = io.BytesIO()
     triples = []
     for records in partitions:
-        start = mof.tell()
-        w = IFileWriter(mof)
+        seg = io.BytesIO()
+        w = IFileWriter(seg)
         for k, v in records:
             w.append(k, v)
         w.close()
-        length = mof.tell() - start
-        triples.append((start, length, length))
+        raw = seg.getvalue()
+        start = mof.tell()
+        if codec is not None:
+            from uda_tpu.compress import compress_block_stream
+            blob = compress_block_stream(raw, codec)
+        else:
+            blob = raw
+        mof.write(blob)
+        triples.append((start, len(raw), len(blob)))
     with open(os.path.join(map_dir, "file.out"), "wb") as f:
         f.write(mof.getvalue())
     write_index_file(os.path.join(map_dir, "file.out.index"), triples)
@@ -47,9 +60,10 @@ class MOFWriter:
     """Job-scoped writer over the DirIndexResolver layout
     (``<root>/<job>/<map_id>/file.out[.index]``)."""
 
-    def __init__(self, root: str, job_id: str):
+    def __init__(self, root: str, job_id: str, codec=None):
         self.root = root
         self.job_id = job_id
+        self.codec = codec
         self.map_ids: list[str] = []
 
     def map_dir(self, map_id: str) -> str:
@@ -57,5 +71,5 @@ class MOFWriter:
 
     def write(self, map_id: str,
               partitions: Sequence[Iterable[Tuple[bytes, bytes]]]) -> None:
-        write_map_output(self.map_dir(map_id), partitions)
+        write_map_output(self.map_dir(map_id), partitions, self.codec)
         self.map_ids.append(map_id)

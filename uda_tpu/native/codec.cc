@@ -1,0 +1,149 @@
+// Native IFile/VInt codec: the host-staging hot path.
+//
+// C++ equivalent of the reference's StreamUtility VInt/VLong codec and
+// record framing walk (reference src/CommUtils/IOUtility.cc:167-397,
+// src/Merger/StreamRW.cc:334-449), exposed through a C ABI consumed via
+// ctypes (uda_tpu/native/__init__.py). One pass converts an IFile
+// segment buffer into columnar (offset, length) arrays — the same
+// contract as uda_tpu.utils.ifile.crack/crack_partial, which remain the
+// pure-Python reference implementation these functions are parity-tested
+// against (tests/test_native.py).
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+// Hadoop zero-compressed VLong decode. Returns bytes consumed, 0 on
+// truncation. Mirrors decodeVIntSize/readVLong semantics
+// (IOUtility.cc:228-397).
+inline int decode_vlong(const uint8_t* buf, int64_t len, int64_t pos,
+                        int64_t* out) {
+  if (pos >= len) return 0;
+  int8_t first = static_cast<int8_t>(buf[pos]);
+  if (first >= -112) {
+    *out = first;
+    return 1;
+  }
+  int size = (first >= -120) ? (-111 - first) : (-119 - first);
+  if (pos + size > len) return 0;
+  uint64_t v = 0;
+  for (int i = 1; i < size; ++i) {
+    v = (v << 8) | buf[pos + i];
+  }
+  *out = (first < -120) ? static_cast<int64_t>(~v) : static_cast<int64_t>(v);
+  return size;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Error codes (negative returns)
+enum : int64_t {
+  UDA_ERR_CORRUPT = -1,     // negative length that isn't the EOF marker
+  UDA_ERR_OVERFLOW = -2,    // more records than max_records
+};
+
+// Scan consecutive VLongs; returns count decoded (stops at truncation).
+int64_t uda_decode_vlongs(const uint8_t* buf, int64_t len, int64_t* out,
+                          int64_t max) {
+  int64_t pos = 0, n = 0;
+  while (pos < len && n < max) {
+    int used = decode_vlong(buf, len, pos, &out[n]);
+    if (used == 0) break;
+    pos += used;
+    ++n;
+  }
+  return n;
+}
+
+// One-pass columnar crack of an IFile segment (the native twin of
+// ifile.crack_partial). Writes up to max_records (key_off, key_len,
+// val_off, val_len) rows. Returns the record count or a UDA_ERR_* code;
+// *consumed = bytes consumed (complete records + EOF marker),
+// *saw_eof = 1 if the (-1,-1) marker was reached.
+int64_t uda_crack(const uint8_t* buf, int64_t len,
+                  int64_t* key_off, int64_t* key_len,
+                  int64_t* val_off, int64_t* val_len,
+                  int64_t max_records, int64_t* consumed, int32_t* saw_eof) {
+  int64_t pos = 0, n = 0;
+  *saw_eof = 0;
+  while (pos < len) {
+    int64_t start = pos;
+    int64_t klen, vlen;
+    int used = decode_vlong(buf, len, pos, &klen);
+    if (used == 0) { pos = start; break; }
+    int64_t p = pos + used;
+    used = decode_vlong(buf, len, p, &vlen);
+    if (used == 0) { pos = start; break; }
+    p += used;
+    if (klen == -1 && vlen == -1) {
+      pos = p;
+      *saw_eof = 1;
+      break;
+    }
+    if (klen < 0 || vlen < 0) return UDA_ERR_CORRUPT;
+    if (p + klen + vlen > len) { pos = start; break; }
+    if (n >= max_records) return UDA_ERR_OVERFLOW;
+    key_off[n] = p;
+    key_len[n] = klen;
+    val_off[n] = p + klen;
+    val_len[n] = vlen;
+    pos = p + klen + vlen;
+    ++n;
+  }
+  *consumed = pos;
+  return n;
+}
+
+// Serialize records into IFile framing (VInt klen, VInt vlen, key, val).
+// Returns bytes written or -1 if out_cap is too small. Appends the EOF
+// marker when write_eof != 0.
+static inline int encode_vlong(int64_t v, uint8_t* out) {
+  if (v >= -112 && v <= 127) {
+    out[0] = static_cast<uint8_t>(v);
+    return 1;
+  }
+  int tag = -112;
+  uint64_t u = static_cast<uint64_t>(v);
+  if (v < 0) {
+    u = ~u;
+    tag = -120;
+  }
+  int body = 0;
+  for (uint64_t t = u; t; t >>= 8) ++body;
+  out[0] = static_cast<uint8_t>(tag - body);
+  for (int i = 0; i < body; ++i) {
+    out[1 + i] = static_cast<uint8_t>(u >> (8 * (body - 1 - i)));
+  }
+  return body + 1;
+}
+
+int64_t uda_write_records(const uint8_t* data,
+                          const int64_t* key_off, const int64_t* key_len,
+                          const int64_t* val_off, const int64_t* val_len,
+                          int64_t n, uint8_t* out, int64_t out_cap,
+                          int32_t write_eof) {
+  int64_t pos = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t need = key_len[i] + val_len[i] + 20;
+    if (pos + need > out_cap) return -1;
+    pos += encode_vlong(key_len[i], out + pos);
+    pos += encode_vlong(val_len[i], out + pos);
+    const uint8_t* k = data + key_off[i];
+    for (int64_t j = 0; j < key_len[i]; ++j) out[pos + j] = k[j];
+    pos += key_len[i];
+    const uint8_t* v = data + val_off[i];
+    for (int64_t j = 0; j < val_len[i]; ++j) out[pos + j] = v[j];
+    pos += val_len[i];
+  }
+  if (write_eof) {
+    if (pos + 2 > out_cap) return -1;
+    out[pos++] = 0xFF;
+    out[pos++] = 0xFF;
+  }
+  return pos;
+}
+
+}  // extern "C"

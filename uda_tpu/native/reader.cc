@@ -1,0 +1,139 @@
+// Native reader pool: async segment reads with batch completion.
+//
+// C++ equivalent of the reference's AIOHandler (libaio wrapper with a
+// completion thread, reference src/CommUtils/AIOHandler.cc:80-235) and
+// of the per-disk thread-pool reader in the orphaned AsyncIO/ directory
+// (reference src/AsyncIO/AsyncReaderManager.cc:16-50, AsyncReaderThread.cc
+// :36-86 — compiled but never wired; here the capability IS wired, into
+// uda_tpu.mofserver.data_engine). Plain pread worker threads + a
+// completion queue drained by uda_pool_get_events (the io_getevents
+// analogue, same min_nr/timeout shape as AIOHandler.cc:152-235).
+
+#include <chrono>
+#include <cstdint>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <errno.h>
+#include <unistd.h>
+
+namespace {
+
+struct Job {
+  int fd;
+  int64_t offset;
+  int64_t len;
+  uint8_t* dst;
+  uint64_t tag;
+};
+
+struct Event {
+  uint64_t tag;
+  int64_t result;  // bytes read, or -errno
+};
+
+struct Pool {
+  std::vector<std::thread> workers;
+  std::deque<Job> jobs;
+  std::deque<Event> events;
+  std::mutex mu;
+  std::condition_variable job_cv;
+  std::condition_variable event_cv;
+  bool stopping = false;
+
+  void worker() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        job_cv.wait(lk, [&] { return stopping || !jobs.empty(); });
+        if (stopping && jobs.empty()) return;
+        job = jobs.front();
+        jobs.pop_front();
+      }
+      int64_t done = 0;
+      int64_t result = 0;
+      while (done < job.len) {
+        ssize_t r = pread(job.fd, job.dst + done,
+                          static_cast<size_t>(job.len - done),
+                          static_cast<off_t>(job.offset + done));
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          result = -static_cast<int64_t>(errno);
+          break;
+        }
+        if (r == 0) break;  // EOF
+        done += r;
+      }
+      if (result == 0) result = done;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        events.push_back(Event{job.tag, result});
+      }
+      event_cv.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* uda_pool_create(int threads) {
+  if (threads < 1) threads = 1;
+  Pool* p = new Pool();
+  for (int i = 0; i < threads; ++i) {
+    p->workers.emplace_back([p] { p->worker(); });
+  }
+  return p;
+}
+
+void uda_pool_destroy(void* pool) {
+  Pool* p = static_cast<Pool*>(pool);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stopping = true;
+  }
+  p->job_cv.notify_all();
+  for (auto& t : p->workers) t.join();
+  delete p;
+}
+
+int uda_pool_submit(void* pool, int fd, int64_t offset, int64_t len,
+                    uint8_t* dst, uint64_t tag) {
+  Pool* p = static_cast<Pool*>(pool);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    if (p->stopping) return -1;
+    p->jobs.push_back(Job{fd, offset, len, dst, tag});
+  }
+  p->job_cv.notify_one();
+  return 0;
+}
+
+// Drain completions: blocks until >= min_events are available or the
+// timeout (seconds) elapses; returns the number written to out_*.
+int uda_pool_get_events(void* pool, uint64_t* out_tags, int64_t* out_results,
+                        int max_events, int min_events, double timeout_s) {
+  Pool* p = static_cast<Pool*>(pool);
+  std::unique_lock<std::mutex> lk(p->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                      std::chrono::duration<double>(timeout_s));
+  p->event_cv.wait_until(lk, deadline, [&] {
+    return static_cast<int>(p->events.size()) >= min_events || p->stopping;
+  });
+  int n = 0;
+  while (n < max_events && !p->events.empty()) {
+    out_tags[n] = p->events.front().tag;
+    out_results[n] = p->events.front().result;
+    p->events.pop_front();
+    ++n;
+  }
+  return n;
+}
+
+}  // extern "C"

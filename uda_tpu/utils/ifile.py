@@ -39,9 +39,32 @@ from uda_tpu.utils import vint
 from uda_tpu.utils.errors import StorageError
 
 __all__ = ["IFileWriter", "IFileReader", "RecordBatch", "crack",
-           "crack_partial", "iter_file_records", "write_records"]
+           "crack_partial", "iter_file_records", "write_records",
+           "set_native_enabled"]
 
 EOF_MARKER = b"\xff\xff"  # VInt(-1) VInt(-1)
+
+# native codec dispatch: the C++ library (uda_tpu/native) takes over the
+# bulk scan for buffers past this size; the Python implementation below
+# remains the semantic reference it is parity-tested against
+_NATIVE_THRESHOLD = 4096
+_native_enabled = True
+
+
+def set_native_enabled(enabled: bool) -> None:
+    """Toggle the native codec (the ``uda.tpu.use.native`` flag's hook)."""
+    global _native_enabled
+    _native_enabled = enabled
+
+
+def _native_mod():
+    if not _native_enabled:
+        return None
+    try:
+        from uda_tpu import native
+    except ImportError:
+        return None
+    return native if native.available() else None
 
 
 class IFileWriter:
@@ -185,6 +208,16 @@ def crack(buf: bytes | np.ndarray, expect_eof: bool = True,
     available; this is the pure-Python reference.
     """
     arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf
+    nat = _native_mod() if len(arr) >= _NATIVE_THRESHOLD else None
+    if nat is not None:
+        batch, consumed, saw = nat.crack_partial_native(arr)
+        if expect_eof and not saw:
+            raise StorageError("IFile segment missing EOF marker")
+        if not saw and consumed != len(arr):
+            raise StorageError(f"truncated IFile segment at offset {consumed}")
+        if verify_crc:
+            _check_crc_trailer(arr, consumed, saw)
+        return batch
     mem = memoryview(arr)
     n = len(arr)
     key_off, key_len, val_off, val_len = [], [], [], []
@@ -210,13 +243,7 @@ def crack(buf: bytes | np.ndarray, expect_eof: bool = True,
     if expect_eof and not saw_eof:
         raise StorageError("IFile segment missing EOF marker")
     if verify_crc:
-        if not saw_eof or pos + 4 > n:
-            raise StorageError("IFile segment missing CRC trailer")
-        want = int.from_bytes(mem[pos:pos + 4], "big")
-        got = zlib.crc32(mem[:pos])
-        if want != got:
-            raise StorageError(f"IFile CRC mismatch: trailer {want:#010x}, "
-                               f"computed {got:#010x}")
+        _check_crc_trailer(arr, pos, saw_eof)
     return RecordBatch(
         arr,
         np.asarray(key_off, dtype=np.int64),
@@ -224,6 +251,19 @@ def crack(buf: bytes | np.ndarray, expect_eof: bool = True,
         np.asarray(val_off, dtype=np.int64),
         np.asarray(val_len, dtype=np.int64),
     )
+
+
+def _check_crc_trailer(arr: np.ndarray, pos: int, saw_eof: bool) -> None:
+    """Verify the 4-byte big-endian CRC32 trailer after the EOF marker."""
+    n = len(arr)
+    if not saw_eof or pos + 4 > n:
+        raise StorageError("IFile segment missing CRC trailer")
+    mem = memoryview(arr)
+    want = int.from_bytes(mem[pos:pos + 4], "big")
+    got = zlib.crc32(mem[:pos])
+    if want != got:
+        raise StorageError(f"IFile CRC mismatch: trailer {want:#010x}, "
+                           f"computed {got:#010x}")
 
 
 def crack_partial(data: bytes, expect_eof: bool = False
@@ -241,6 +281,9 @@ def crack_partial(data: bytes, expect_eof: bool = False
         batch = crack(data, expect_eof=True)
         return batch, len(data), True
     arr = np.frombuffer(data, np.uint8) if not isinstance(data, np.ndarray) else data
+    nat = _native_mod() if len(arr) >= _NATIVE_THRESHOLD else None
+    if nat is not None:
+        return nat.crack_partial_native(arr)
     mem = memoryview(arr)
     n = len(arr)
     key_off, key_len, val_off, val_len = [], [], [], []
