@@ -22,3 +22,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent compile cache: CPU compiles are fast, but caching keeps
+# repeated full-suite runs cheap and exercises the same code path the
+# TPU entry points rely on.
+from uda_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.enable()

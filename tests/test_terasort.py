@@ -25,6 +25,41 @@ def test_single_chip_sort_total_order():
     terasort.validate_sorted(out, words)
 
 
+def test_single_chip_sort_gather_path_matches_carry():
+    # the bounded-compile accelerator path must produce byte-identical
+    # output to the operand-carry path (stability included: duplicate
+    # keys keep arrival order in both)
+    words = np.asarray(terasort.teragen(jax.random.key(7), 2048)).copy()
+    words[100:300, :3] = words[700:900, :3]  # inject duplicate keys
+    a = np.asarray(terasort.single_chip_sort(words, path="carry"))
+    b = np.asarray(terasort.single_chip_sort(words, path="gather"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_bench_step_both_paths_validate():
+    for path in ("carry", "gather"):
+        viol, ck_in, ck_out = terasort.bench_step(
+            jax.random.key(5), 4096, 2, path=path)
+        assert int(viol) == 0, path
+        assert np.uint32(ck_in) == np.uint32(ck_out), path
+
+
+def test_distributed_terasort_gather_payload_path():
+    from uda_tpu.parallel.distributed import (distributed_sort_step,
+                                              uniform_splitters)
+
+    mesh = make_mesh(4)
+    words = np.asarray(terasort.teragen(jax.random.key(6), 4 * 256))
+    res = distributed_sort_step(words, uniform_splitters(4), mesh,
+                                "shuffle", capacity=256, num_keys=3,
+                                payload_path="gather")
+    res.check()
+    out = np.asarray(res.words).reshape(4, -1, terasort.RECORD_WORDS)
+    nvalid = np.asarray(res.valid_counts).reshape(-1)
+    rows = np.concatenate([out[d, :nvalid[d]] for d in range(4)])
+    terasort.validate_sorted(rows, words)
+
+
 def test_validate_sorted_catches_violation():
     words = np.asarray(terasort.teragen(jax.random.key(2), 256))
     out = np.asarray(terasort.single_chip_sort(words))
@@ -37,6 +72,17 @@ def test_validate_sorted_catches_corruption():
     words = np.asarray(terasort.teragen(jax.random.key(3), 256))
     out = np.asarray(terasort.single_chip_sort(words)).copy()
     out[10, 5] ^= 1  # flip one payload bit
+    with pytest.raises(AssertionError):
+        terasort.validate_sorted(out, words)
+
+
+def test_validate_sorted_catches_column_swap():
+    # distinct per-column multipliers in the checksum: swapping two
+    # value columns in every row (a plausible gather-path indexing bug)
+    # must fail even though row sums with a single multiplier would not
+    words = np.asarray(terasort.teragen(jax.random.key(8), 256))
+    out = np.asarray(terasort.single_chip_sort(words)).copy()
+    out[:, [5, 7]] = out[:, [7, 5]]
     with pytest.raises(AssertionError):
         terasort.validate_sorted(out, words)
 

@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from uda_tpu.ops.sort import resolve_sort_path
 from uda_tpu.parallel.distributed import (DistributedSortResult,
                                           distributed_sort_step,
                                           uniform_splitters)
@@ -60,21 +61,38 @@ def teragen(key: jax.Array, n: int) -> jax.Array:
     return jnp.concatenate([keys, vals], axis=1)
 
 
-@jax.jit
-def single_chip_sort(words: jax.Array) -> jax.Array:
+def _sort_record_cols(cols: tuple, path: str) -> tuple:
+    """Stable lexicographic sort of SoA record columns by the first
+    KEY_WORDS columns — the single source of truth for the carry/gather
+    strategy switch (see bench_step for the trade-off)."""
+    if path == "carry":
+        return lax.sort(cols, num_keys=KEY_WORDS, is_stable=True)
+    iota = lax.iota(jnp.int32, cols[0].shape[0])
+    *sk, perm = lax.sort((*cols[:KEY_WORDS], iota),
+                         num_keys=KEY_WORDS, is_stable=True)
+    return (*sk, *(jnp.take(c, perm, axis=0) for c in cols[KEY_WORDS:]))
+
+
+@partial(jax.jit, static_argnames=("path",))
+def _single_chip_sort(words: jax.Array, path: str) -> jax.Array:
+    cols = tuple(words[:, i] for i in range(words.shape[1]))
+    return jnp.stack(_sort_record_cols(cols, path), axis=1)
+
+
+def single_chip_sort(words: jax.Array, path: str = "auto") -> jax.Array:
     """The single-chip shuffle+merge: stable lexicographic sort of whole
     records by their 3 key words (the device replacement of the
     reference's k-way PQ merge, src/Merger/MergeQueue.h:276-427).
 
-    The 23 value words ride through the sort network as extra operands
-    instead of being gathered by the output permutation afterwards: on
-    TPU a row gather of [n, 26] runs at ~2.3 GB/s while the
-    operand-carried sort sustains ~12 GB/s (the gather's random HBM
-    access pattern is the bottleneck, not the compare-exchange work).
+    Payload-movement strategy (see bench_step for the full trade-off):
+    "carry" rides the 23 value words through the sort network (~12 GB/s
+    at runtime but superlinear-in-operands compile time on TPU
+    remote-compile backends), "gather" computes the permutation with a
+    4-operand sort and applies it with per-column gathers (bounded
+    compile, gather-bound runtime). "auto" resolves per the ambient
+    backend at call time (resolve_sort_path).
     """
-    cols = tuple(words[:, i] for i in range(words.shape[1]))
-    out = lax.sort(cols, num_keys=KEY_WORDS, is_stable=True)
-    return jnp.stack(out, axis=1)
+    return _single_chip_sort(words, resolve_sort_path(path))
 
 
 def distributed_terasort(words, mesh: Mesh, axis: str = SHUFFLE_AXIS,
@@ -113,28 +131,47 @@ def _violations_cols(k0, k1, k2) -> jax.Array:
     return jnp.sum(gt.astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("n", "k"))
-def bench_step(seed: jax.Array, n: int, k: int):
+@partial(jax.jit, static_argnames=("n", "k", "path"))
+def bench_step(seed: jax.Array, n: int, k: int, path: str = "carry"):
     """Sustained-throughput benchmark kernel: k independent
     teragen->sort->validate rounds inside ONE device program (one host
     dispatch), so per-call host/RPC latency amortizes away and the
     result reflects device shuffle+merge throughput.
 
-    Everything stays in column (SoA) form — the payload rides the sort
-    network as operands and validation consumes the sorted columns
-    directly, with no [n, 26] row materialization.
+    Everything stays in column (SoA) form — on TPU, XLA lane-pads the
+    minor dimension of an [n, 26] row matrix to 128 words (5x HBM
+    footprint and bandwidth), so device-resident records are 26 separate
+    [n] columns and nothing ever materializes rows.
+
+    Two device strategies for moving the 23 value columns:
+
+    - ``path="carry"``: the payload rides the sort network as extra
+      ``lax.sort`` operands. Fastest at runtime (~12 GB/s measured;
+      streaming compare-exchange), but XLA's variadic-sort compile time
+      grows superlinearly in operand count — on remote-compile backends
+      the 26-operand program can take a very long time to compile ONCE
+      (it persists in the uda_tpu compile cache afterwards).
+    - ``path="gather"``: a 4-operand sort (3 key words + iota) computes
+      the permutation, then per-column gathers apply it. Compiles in
+      ~1 min cold; runtime is gather-bound (TPU random gathers are
+      element-at-a-time, ~2.4 GB/s).
+
+    bench.py probes which path is compilable within its time budget and
+    picks the fastest (see bench.py --probe).
 
     Returns (total order violations, input checksum, output checksum):
     consuming the sorted output in-graph keeps XLA from eliminating any
     round, and the caller asserts violations == 0 and checksum equality.
     """
+    if path not in ("carry", "gather"):
+        raise ValueError(f"unknown bench path {path!r}")
 
     def body(i, acc):
         viol, ck_in, ck_out = acc
         w = teragen(jax.random.fold_in(seed, i), n)
         cols = tuple(w[:, c] for c in range(RECORD_WORDS))
         ck_in = ck_in + _checksum_cols(cols)
-        out = lax.sort(cols, num_keys=KEY_WORDS, is_stable=True)
+        out = _sort_record_cols(cols, path)
         ck_out = ck_out + _checksum_cols(out)
         viol = viol + _violations_cols(out[0], out[1], out[2])
         return (viol, ck_in, ck_out)
@@ -157,14 +194,12 @@ def _order_violations(words: jax.Array) -> jax.Array:
 
 @jax.jit
 def _checksum(words: jax.Array) -> jax.Array:
-    """Order-independent multiset fingerprint: per-record mix (couples
-    the words WITHIN a row, so torn records change the sum) summed over
-    records (so permutations don't). One formula, shared by
-    validate_sorted and bench_step."""
-    x = words.astype(jnp.uint32)
-    mix = x * jnp.uint32(2654435761)
-    rec = jnp.sum(mix, axis=1) ^ jnp.uint32(0x9E3779B9)
-    return jnp.sum(rec.astype(jnp.uint32))
+    """Order-independent multiset fingerprint over row-matrix records —
+    the same formula as _checksum_cols (a DISTINCT odd multiplier per
+    column couples a word to its column position, so torn records and
+    column swaps change the sum; the outer sum over records is
+    permutation-invariant), so validate_sorted and bench_step agree."""
+    return _checksum_cols(tuple(words[:, c] for c in range(words.shape[1])))
 
 
 def validate_sorted(sorted_words, input_words=None,

@@ -36,7 +36,22 @@ from jax import lax
 from uda_tpu.ops.packing import PackedKeys
 
 __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
-           "concat_packed"]
+           "concat_packed", "resolve_sort_path"]
+
+
+def resolve_sort_path(path: str) -> str:
+    """Resolve a payload-movement strategy name. "auto" picks
+    operand-carry on CPU (compile is cheap there) and permutation+gather
+    on accelerators — XLA's variadic-sort compile time grows
+    superlinearly in operand count, and on TPU remote-compile backends a
+    wide carry sort can take hours to compile. Resolution happens
+    EAGERLY, never inside a jitted trace: a trace-time choice would be
+    baked into the jit cache and survive a later platform switch."""
+    if path == "auto":
+        path = "carry" if jax.default_backend() == "cpu" else "gather"
+    if path not in ("carry", "gather"):
+        raise ValueError(f"unknown sort path {path!r}")
+    return path
 
 
 @partial(jax.jit, static_argnames=("num_key_words",))

@@ -79,8 +79,10 @@ class DistributedSortResult:
                 "shuffle_exchange's multi-round path")
 
 
-@partial(jax.jit, static_argnames=("mesh", "axis", "capacity", "num_keys"))
-def _sort_step(words, splitters, mesh, axis, capacity, num_keys):
+@partial(jax.jit, static_argnames=("mesh", "axis", "capacity", "num_keys",
+                                   "payload_path"))
+def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
+               payload_path="carry"):
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
              out_specs=(P(axis), P(axis), P(axis)))
     def _go(w, spl):
@@ -108,18 +110,32 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys):
                                      split_axis=0, concat_axis=0,
                                      tiled=False).reshape(p)
         flat = recv.reshape(p * capacity, wcols)
-        # 4. local sort: invalid rows forced past every real key; all
-        # record columns ride the sort network (operand-carry beats a
-        # row gather ~5x on TPU, see uda_tpu.ops.sort.sort_records_fixed)
+        # 4. local sort: invalid rows forced past every real key.
+        # payload_path="carry": all record columns ride the sort network
+        # (fastest runtime, but XLA variadic-sort compile time grows
+        # superlinearly in operand count — prohibitive on TPU
+        # remote-compile backends). "gather": a narrow sort computes the
+        # permutation, per-column gathers apply it (bounded compile).
         row = jnp.arange(p * capacity, dtype=jnp.int32)
         valid = (row % capacity) < jnp.take(recv_counts, row // capacity)
         keycols = tuple(jnp.where(valid, flat[:, i], _INVALID)
                         for i in range(num_keys))
-        payload = tuple(flat[:, i] for i in range(wcols))
-        sorted_ops = lax.sort(
-            (*keycols, jnp.where(valid, 0, 1), *payload),
-            num_keys=num_keys + 1, is_stable=True)
-        out = jnp.stack(sorted_ops[num_keys + 1:], axis=1)
+        if payload_path == "carry":
+            payload = tuple(flat[:, i] for i in range(wcols))
+            sorted_ops = lax.sort(
+                (*keycols, jnp.where(valid, 0, 1), *payload),
+                num_keys=num_keys + 1, is_stable=True)
+            out = jnp.stack(sorted_ops[num_keys + 1:], axis=1)
+        else:
+            # permutation from a narrow sort, applied per column ([n]
+            # gathers keep the SoA/no-lane-padding rationale of
+            # terasort.bench_step; a row gather on the [n, W] matrix
+            # would touch the 5x lane-padded layout)
+            *_, perm = lax.sort(
+                (*keycols, jnp.where(valid, 0, 1), row),
+                num_keys=num_keys + 1, is_stable=True)
+            out = jnp.stack(tuple(jnp.take(flat[:, i], perm, axis=0)
+                                  for i in range(wcols)), axis=1)
         nvalid = jnp.sum(recv_counts)
         return out, nvalid[None], overflow[None]
 
@@ -128,18 +144,25 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys):
 
 
 def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
-                          capacity: int, num_keys: int
+                          capacity: int, num_keys: int,
+                          payload_path: str = "auto"
                           ) -> DistributedSortResult:
     """Run the fused partition/exchange/sort step.
 
     ``words``: uint32[N, W] records (rows sharded over ``axis``; the
     first ``num_keys`` columns are the big-endian key words).
     ``capacity``: per-(src, dst) records per round — the credit window.
+    ``payload_path``: how the local sort moves value columns ("auto":
+    operand-carry on CPU meshes, permutation+gather on accelerators
+    where wide variadic sorts compile pathologically slowly).
     """
+    from uda_tpu.ops.sort import resolve_sort_path
+
+    payload_path = resolve_sort_path(payload_path)
     spec = NamedSharding(mesh, P(axis))
     words = jax.device_put(words, spec)
     splitters = jax.device_put(jnp.asarray(splitters, dtype=jnp.uint32),
                                NamedSharding(mesh, P()))
     out, nvalid, overflow = _sort_step(words, splitters, mesh, axis,
-                                       capacity, num_keys)
+                                       capacity, num_keys, payload_path)
     return DistributedSortResult(out, nvalid, overflow)
