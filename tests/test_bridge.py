@@ -164,3 +164,68 @@ def test_log_upcall_sink(tmp_path):
     bridge.start(True, ["-t", "6"], harness)
     get_logger().info("hello bridge")
     assert any("hello bridge" in m for _, m in harness.logs)
+
+
+def test_bridge_malformed_param_falls_back():
+    # regression: a ValueError inside a well-formed command must flow
+    # through failure_in_uda, not escape the bridge
+    failures = []
+
+    class H:
+        def failure_in_uda(self, e):
+            failures.append(e)
+
+        def get_conf_data(self, n, d):
+            return ""
+
+    b = UdaBridge()
+    b.start(True, [], H())
+    b.do_command(form_cmd(Cmd.INIT, ["job", "not_an_int", "4",
+                                     "uda.tpu.RawBytes"]))
+    assert failures and b.failed
+
+
+def test_developer_mode_merge_thread_failure_surfaces(tmp_path):
+    # a failure on the BACKGROUND merge thread in developer mode must
+    # not die silently in Thread.run: failure_in_uda still wakes
+    # waiters, and the stored error re-raises on the next synchronous
+    # call (here: reduce_exit)
+    harness = Harness(str(tmp_path))
+    harness.conf["mapred.rdma.developer.mode"] = "true"
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    bridge.do_command(form_cmd(
+        Cmd.INIT, ["jobDevM", "0", "1", "uda.tpu.RawBytes"]))
+    bridge.do_command(form_cmd(Cmd.FETCH,
+                               ["h", "jobDevM", "attempt_missing", "0"]))
+    bridge.do_command(form_cmd(Cmd.FINAL, []))
+    assert harness.fetch_over.wait(timeout=30)  # waiter woke, no hang
+    assert harness.failures
+    with pytest.raises(Exception):
+        bridge.reduce_exit()
+    # error was consumed by the re-raise; bridge is clean again
+    bridge.reduce_exit()
+
+
+def test_reinit_stops_previous_engine(tmp_path):
+    # a second INIT on the same bridge (new reduce attempt) must tear
+    # down the previous task's engine instead of leaking its threads
+    make_mof_tree(str(tmp_path), "jobRe", 1, 1, 5)
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    bridge.do_command(form_cmd(
+        Cmd.INIT, ["jobRe", "0", "1", "uda.tpu.RawBytes"]))
+    first_engine = bridge._owned_engine
+    assert first_engine is not None
+    bridge.do_command(form_cmd(
+        Cmd.INIT, ["jobRe", "0", "1", "uda.tpu.RawBytes"]))
+    assert not harness.failures
+    assert bridge._owned_engine is not None
+    assert bridge._owned_engine is not first_engine
+    from uda_tpu.mofserver import ShuffleRequest
+    from uda_tpu.utils.errors import StorageError
+
+    with pytest.raises(StorageError):
+        first_engine.fetch(ShuffleRequest("jobRe", "x", 0, 0, 10))
+    bridge.reduce_exit()

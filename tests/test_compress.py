@@ -110,3 +110,18 @@ def test_compressed_wordcount_via_config(tmp_path):
     want = collections.Counter(
         m.group(0).lower() for m in re.finditer(rb"[A-Za-z0-9]+", text))
     assert got == dict(want)
+
+
+def test_zlib_rejects_wrong_length_header():
+    # a corrupt uncompressed_len in a block header must fail AT the
+    # block for every codec, zlib included
+    import zlib as _zlib
+
+    from uda_tpu.compress import get_codec
+    from uda_tpu.utils.errors import CompressionError
+
+    codec = get_codec("zlib")
+    comp = _zlib.compress(b"x" * 100)
+    assert codec.decompress(comp, 100) == b"x" * 100
+    with pytest.raises(CompressionError):
+        codec.decompress(comp, 99)

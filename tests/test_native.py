@@ -60,35 +60,6 @@ def test_write_records_parity():
         == buf[:-len(ifile.EOF_MARKER)]
 
 
-def test_bridge_malformed_param_falls_back():
-    # regression: a ValueError inside a well-formed command must flow
-    # through failure_in_uda, not escape the bridge
-    from uda_tpu.bridge import Cmd, UdaBridge, form_cmd
-
-    failures = []
-
-    class H:
-        def failure_in_uda(self, e):
-            failures.append(e)
-
-        def get_conf_data(self, n, d):
-            return ""
-
-    b = UdaBridge()
-    b.start(True, [], H())
-    b.do_command(form_cmd(Cmd.INIT, ["job", "not_an_int", "4",
-                                     "uda.tpu.RawBytes"]))
-    assert failures and b.failed
-
-
-def test_pallas_tile_power_of_two_guard():
-    from uda_tpu.ops import pallas_merge
-
-    a = np.zeros((4, 4), np.uint32)
-    with pytest.raises(ValueError):
-        pallas_merge.merge_sorted_pair(a, a, 2, tile=384)
-
-
 def test_decode_vlongs_parity():
     vals = [0, 1, -1, 127, -112, 128, -113, 2**40, -(2**40), 2**63 - 1,
             -(2**63)]

@@ -74,3 +74,9 @@ def test_merge_splits_diagonals():
     b2 = np.asarray([[5], [5]], np.uint32)
     s2 = np.asarray(pallas_merge.merge_splits(a2, b2, 2, 1))
     assert s2.tolist() == [0, 2]
+
+
+def test_pallas_tile_power_of_two_guard():
+    a = np.zeros((4, 4), np.uint32)
+    with pytest.raises(ValueError):
+        pallas_merge.merge_sorted_pair(a, a, 2, tile=384)

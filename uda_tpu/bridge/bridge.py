@@ -103,6 +103,7 @@ class UdaBridge:
         self.cfg = Config()
         self.started = False
         self._failed = False
+        self._dev_error: Optional[Exception] = None
         # reduce side
         self._mm: Optional[MergeManager] = None
         self._client: Optional[InputClient] = None
@@ -150,6 +151,9 @@ class UdaBridge:
         """doCommandNative: dispatch by role (UdaBridge.cc:266-295)."""
         if not self.started:
             raise UdaError("bridge not started")
+        if self._dev_error is not None:
+            raise self._dev_error  # developer mode: surface the stored
+            # background failure loudly on the next synchronous call
         if self._failed:
             return  # inert after failure (Java has fallen back to vanilla)
         try:
@@ -176,6 +180,11 @@ class UdaBridge:
             self._owned_engine.stop()
             self._owned_engine = None
         self._merge_thread = None
+        if self._dev_error is not None:
+            # developer mode: a failure that happened on the merge thread
+            # must not vanish with the thread — teardown re-raises it
+            err, self._dev_error = self._dev_error, None
+            raise err
 
     def set_log_level(self, level: int) -> None:
         """setLogLevelNative (UdaBridge.cc:318-333)."""
@@ -190,6 +199,11 @@ class UdaBridge:
             # key_class, then optional local dirs
             if len(params) < 4:
                 raise ProtocolError(f"INIT needs >= 4 params, got {len(params)}")
+            if self._mm is not None or self._owned_engine is not None:
+                # re-INIT (a second reduce attempt on the same bridge):
+                # tear down the previous task first — the prior engine's
+                # thread pool / fd cache must not leak until process exit
+                self.reduce_exit()
             self._job_id, rid, _num_maps, self._key_class = params[:4]
             self._reduce_id = int(rid)
             self._pending_maps = []
@@ -255,7 +269,7 @@ class UdaBridge:
             if cb is not None:
                 cb()
         except Exception as e:  # noqa: BLE001 - the fallback boundary
-            self._fail(e)
+            self._fail(e, in_thread=True)
 
     # -- supplier side (mof_downcall_handler, MOFSupplierMain.cc:37-81) -----
 
@@ -277,14 +291,30 @@ class UdaBridge:
 
     # -- failure contract ---------------------------------------------------
 
-    def _fail(self, error: Exception) -> None:
+    def _fail(self, error: Exception, in_thread: bool = False) -> None:
         """exceptionInNativeThread -> failureInUda -> inert bridge
-        (UdaBridge.cc:506-530); developer mode re-raises instead
-        (UdaShuffleConsumerPluginShared.java:210-217)."""
+        (UdaBridge.cc:506-530); developer mode fails loudly instead of
+        falling back (UdaShuffleConsumerPluginShared.java:210-217).
+
+        Developer mode on a BACKGROUND thread cannot usefully re-raise
+        (the exception would die in Thread.run and the embedder — which
+        gets no failure_in_uda in developer mode — would block on
+        fetch_over forever): the error is stored and re-raised by the
+        next synchronous call (do_command / reduce_exit), and
+        failure_in_uda still fires so waiters wake; the embedder must
+        not treat it as a fallback request in developer mode (the
+        reference aborts the process outright there, :210-217 — an
+        embedded library cannot)."""
         if self.cfg.get("mapred.rdma.developer.mode"):
-            raise error
-        self._failed = True
-        log.error(f"engine failure, requesting fallback: {error}")
+            if not in_thread:
+                raise error
+            self._failed = True
+            self._dev_error = error
+            log.error(f"merge-thread failure (developer mode, will "
+                      f"re-raise on next call): {error}")
+        else:
+            self._failed = True
+            log.error(f"engine failure, requesting fallback: {error}")
         cb = getattr(self.callable, "failure_in_uda", None)
         if cb is not None:
             cb(error)

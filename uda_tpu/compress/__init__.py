@@ -52,8 +52,17 @@ class Codec:
 
 
 def _zlib_codec() -> Codec:
-    return Codec("zlib", lambda b: zlib.compress(b, 6),
-                 lambda b, n: zlib.decompress(b))
+    def decompress(data: bytes, uncompressed_len: int) -> bytes:
+        out = zlib.decompress(data)
+        # enforce the block header's length claim like the snappy codec
+        # does — a corrupt header must fail at the block, not surface
+        # later as a confusing record-framing error
+        if len(out) != uncompressed_len:
+            raise CompressionError(
+                f"zlib length mismatch: {len(out)} != {uncompressed_len}")
+        return out
+
+    return Codec("zlib", lambda b: zlib.compress(b, 6), decompress)
 
 
 _snappy_lock = threading.Lock()

@@ -166,6 +166,13 @@ class _NativeReads:
     def close(self) -> None:
         self._stop = True
         self._router.join(timeout=2.0)
+        if self._router.is_alive():
+            # the router may still be inside pool.poll; destroying the
+            # native pool under it would be a use-after-free of the
+            # whole process — leaking the pool is the safe failure mode
+            log.warn("native read router did not exit in 2s; "
+                     "leaking the native pool instead of freeing it")
+            return
         self.pool.close()
 
 
