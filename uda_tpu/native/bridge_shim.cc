@@ -1,0 +1,394 @@
+// libuda_tpu_bridge.so — the native embedding surface of the uda_tpu
+// bridge (the role the reference's libuda.so JNI layer plays,
+// reference src/UdaBridge.cc).
+//
+// The reference exposes 4 native down-calls (startNative, doCommandNative,
+// reduceExitMsgNative, setLogLevelNative; UdaBridge.cc:187-333) and 6
+// up-calls into the host runtime (fetchOverMessage, dataFromUda,
+// getPathUda, getConfData, logToJava, failureInUda; UdaBridge.cc:138-170,
+// 516-522).  This shim re-creates that contract as a plain C ABI so any
+// native host — a C++ service, a JVM through JNA/FFI, or a test driver —
+// can embed the TPU engine:
+//
+//   down-calls:  uda_bridge_start / uda_bridge_do_command /
+//                uda_bridge_reduce_exit / uda_bridge_set_log_level
+//   up-calls:    the function pointers of uda_callbacks_t
+//
+// Internally the shim embeds CPython and drives uda_tpu.bridge.UdaBridge;
+// the up-call glue is a C-defined Python type whose methods forward to
+// the registered C function pointers (the inverse of the reference's
+// cached jmethodID table, UdaBridge.cc:110-174).  GIL discipline mirrors
+// the reference's JNI attach/detach rules (UdaUtil.cc:26-95): every
+// down-call takes the GIL; every up-call RELEASES it around the C
+// callback so a host callback may re-enter the bridge without
+// deadlocking.
+//
+// Error contract: down-calls return 0 on success, -1 on Python-level
+// failure (the exception text is routed to the log_to callback when
+// registered, else stderr) — the fallback-to-vanilla signal of the
+// reference (UdaBridge.cc:506-530) additionally arrives through the
+// failure_in_uda up-call exactly as in the Python API.
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+extern "C" {
+
+typedef struct uda_index_record {
+  char path[4096];
+  long long start_offset;
+  long long raw_length;
+  long long part_length;
+} uda_index_record_t;
+
+typedef struct uda_callbacks {
+  void *ctx;
+  void (*fetch_over_message)(void *ctx);
+  void (*data_from_uda)(void *ctx, const char *data, long long len);
+  // return 0 and fill *rec on success, nonzero on failure
+  int (*get_path_uda)(void *ctx, const char *job_id, const char *map_id,
+                      int reduce_id, uda_index_record_t *rec);
+  // copy the value (or dflt) into out (cap bytes incl. NUL)
+  void (*get_conf_data)(void *ctx, const char *name, const char *dflt,
+                        char *out, int cap);
+  void (*log_to)(void *ctx, int level, const char *message);
+  void (*failure_in_uda)(void *ctx, const char *what);
+} uda_callbacks_t;
+
+}  // extern "C"
+
+namespace {
+
+std::mutex g_mu;
+uda_callbacks_t g_cbs;           // copied at start()
+bool g_have_cbs = false;
+PyObject *g_bridge = nullptr;    // uda_tpu.bridge.UdaBridge instance
+
+void report_error(const char *where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  PyObject *s = value ? PyObject_Str(value) : nullptr;
+  const char *msg = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  char buf[1024];
+  snprintf(buf, sizeof buf, "uda_tpu bridge shim: %s failed: %s", where,
+           msg ? msg : "?");
+  if (g_have_cbs && g_cbs.log_to) {
+    Py_BEGIN_ALLOW_THREADS
+    g_cbs.log_to(g_cbs.ctx, /*lsERROR=*/2, buf);
+    Py_END_ALLOW_THREADS
+  } else {
+    fprintf(stderr, "%s\n", buf);
+  }
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  PyErr_Clear();
+}
+
+// ---- the up-call forwarder: a C-defined Python type ----------------------
+// Instances satisfy the UdaCallable protocol (uda_tpu/bridge/bridge.py);
+// each method releases the GIL around the C callback.
+
+struct Forwarder {
+  PyObject_HEAD
+};
+
+PyObject *fw_fetch_over_message(PyObject *, PyObject *) {
+  if (g_cbs.fetch_over_message) {
+    Py_BEGIN_ALLOW_THREADS
+    g_cbs.fetch_over_message(g_cbs.ctx);
+    Py_END_ALLOW_THREADS
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject *fw_data_from_uda(PyObject *, PyObject *args) {
+  Py_buffer view;
+  long long length = 0;
+  if (!PyArg_ParseTuple(args, "y*L", &view, &length)) return nullptr;
+  if (g_cbs.data_from_uda) {
+    const char *data = static_cast<const char *>(view.buf);
+    long long n = length < (long long)view.len ? length : (long long)view.len;
+    Py_BEGIN_ALLOW_THREADS
+    g_cbs.data_from_uda(g_cbs.ctx, data, n);
+    Py_END_ALLOW_THREADS
+  }
+  PyBuffer_Release(&view);
+  Py_RETURN_NONE;
+}
+
+PyObject *fw_get_path_uda(PyObject *, PyObject *args) {
+  const char *job = nullptr, *map = nullptr;
+  int reduce_id = 0;
+  if (!PyArg_ParseTuple(args, "ssi", &job, &map, &reduce_id)) return nullptr;
+  if (!g_cbs.get_path_uda) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "no get_path_uda callback registered");
+    return nullptr;
+  }
+  uda_index_record_t rec;
+  memset(&rec, 0, sizeof rec);
+  int rc = 1;
+  Py_BEGIN_ALLOW_THREADS
+  rc = g_cbs.get_path_uda(g_cbs.ctx, job, map, reduce_id, &rec);
+  Py_END_ALLOW_THREADS
+  if (rc != 0) {
+    PyErr_Format(PyExc_RuntimeError, "get_path_uda callback failed (%d)", rc);
+    return nullptr;
+  }
+  // Build an uda_tpu IndexRecord (the IndexRecordBridge fields of the
+  // reference, plugins/shared/.../IndexRecordBridge.java); positional
+  // order is (start_offset, raw_length, part_length, path)
+  PyObject *mod = PyImport_ImportModule("uda_tpu.mofserver");
+  if (!mod) return nullptr;
+  PyObject *cls = PyObject_GetAttrString(mod, "IndexRecord");
+  Py_DECREF(mod);
+  if (!cls) return nullptr;
+  PyObject *out = PyObject_CallFunction(cls, "LLLs", rec.start_offset,
+                                        rec.raw_length, rec.part_length,
+                                        rec.path);
+  Py_DECREF(cls);
+  return out;
+}
+
+PyObject *fw_get_conf_data(PyObject *, PyObject *args) {
+  const char *name = nullptr, *dflt = nullptr;
+  if (!PyArg_ParseTuple(args, "ss", &name, &dflt)) return nullptr;
+  if (!g_cbs.get_conf_data) return PyUnicode_FromString(dflt ? dflt : "");
+  char buf[4096];
+  buf[0] = '\0';
+  Py_BEGIN_ALLOW_THREADS
+  g_cbs.get_conf_data(g_cbs.ctx, name, dflt ? dflt : "", buf, sizeof buf);
+  Py_END_ALLOW_THREADS
+  buf[sizeof buf - 1] = '\0';
+  return PyUnicode_FromString(buf);
+}
+
+PyObject *fw_log_to(PyObject *, PyObject *args) {
+  int level = 0;
+  const char *msg = nullptr;
+  if (!PyArg_ParseTuple(args, "is", &level, &msg)) return nullptr;
+  if (g_cbs.log_to) {
+    Py_BEGIN_ALLOW_THREADS
+    g_cbs.log_to(g_cbs.ctx, level, msg);
+    Py_END_ALLOW_THREADS
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject *fw_failure_in_uda(PyObject *, PyObject *args) {
+  PyObject *err = nullptr;
+  if (!PyArg_ParseTuple(args, "O", &err)) return nullptr;
+  if (g_cbs.failure_in_uda) {
+    PyObject *s = PyObject_Str(err);
+    const char *what = s ? PyUnicode_AsUTF8(s) : "?";
+    Py_BEGIN_ALLOW_THREADS
+    g_cbs.failure_in_uda(g_cbs.ctx, what ? what : "?");
+    Py_END_ALLOW_THREADS
+    Py_XDECREF(s);
+  }
+  Py_RETURN_NONE;
+}
+
+PyMethodDef fw_methods[] = {
+    {"fetch_over_message", fw_fetch_over_message, METH_NOARGS, nullptr},
+    {"data_from_uda", fw_data_from_uda, METH_VARARGS, nullptr},
+    {"get_path_uda", fw_get_path_uda, METH_VARARGS, nullptr},
+    {"get_conf_data", fw_get_conf_data, METH_VARARGS, nullptr},
+    {"log_to", fw_log_to, METH_VARARGS, nullptr},
+    {"failure_in_uda", fw_failure_in_uda, METH_VARARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject fw_type = {
+    PyVarObject_HEAD_INIT(nullptr, 0) /* name */ "uda_tpu_shim.Forwarder",
+    sizeof(Forwarder),
+};
+
+// ---- lifecycle -----------------------------------------------------------
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Embedders configure the interpreter through an env hook (e.g.
+    // forcing the CPU backend in tests): exec'd once, before any
+    // uda_tpu import.
+    const char *boot = getenv("UDA_TPU_PY_BOOTSTRAP");
+    bool ok = true;
+    if (boot && *boot) {
+      if (PyRun_SimpleString(boot) != 0) {
+        fprintf(stderr, "uda_tpu bridge shim: bootstrap failed\n");
+        ok = false;
+      }
+    }
+    // Py_Initialize leaves this thread holding the GIL; release it or
+    // every Python thread the bridge spawns (merge thread, engine
+    // workers) deadlocks the moment the embedder blocks in C. All
+    // entry points re-acquire via PyGILState_Ensure.
+    PyEval_SaveThread();
+    return ok;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// start the bridge in the given role (reference startNative,
+// UdaBridge.cc:187-263). argv uses the reference's short-option channel
+// ("-w", "8", ...). Callbacks may be NULL (then only local-dir
+// resolution works). Returns 0 on success.
+int uda_bridge_start(int is_net_merger, int argc, const char **argv,
+                     const uda_callbacks_t *cbs) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!ensure_python()) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *mod = nullptr, *cls = nullptr, *lst = nullptr, *fwd = nullptr,
+           *res = nullptr;
+  do {
+    if (g_bridge) {
+      // restart: stop the previous bridge's threads BEFORE touching
+      // g_cbs — the old merge thread reads g_cbs concurrently (with the
+      // GIL released around its up-calls), so swapping callbacks under
+      // a live bridge would hand old data to the new embedder's ctx or
+      // call through a half-written pointer. reduce_exit joins the
+      // merge thread and stops the engine (bridge.py reduce_exit).
+      PyObject *r = PyObject_CallMethod(g_bridge, "reduce_exit", nullptr);
+      if (!r) report_error("uda_bridge_start (stopping previous bridge)");
+      Py_XDECREF(r);
+      Py_CLEAR(g_bridge);
+    }
+    if (cbs) {
+      g_cbs = *cbs;
+      g_have_cbs = true;
+    } else {
+      memset(&g_cbs, 0, sizeof g_cbs);
+      g_have_cbs = false;
+    }
+    if (fw_type.tp_methods == nullptr) {
+      fw_type.tp_methods = fw_methods;
+      fw_type.tp_flags = Py_TPFLAGS_DEFAULT;
+      fw_type.tp_new = PyType_GenericNew;
+      if (PyType_Ready(&fw_type) != 0) break;
+    }
+    mod = PyImport_ImportModule("uda_tpu.bridge");
+    if (!mod) break;
+    cls = PyObject_GetAttrString(mod, "UdaBridge");
+    if (!cls) break;
+    g_bridge = PyObject_CallNoArgs(cls);  // previous cleared above
+    if (!g_bridge) break;
+    lst = PyList_New(argc);
+    if (!lst) break;
+    for (int i = 0; i < argc; i++)
+      PyList_SET_ITEM(lst, i, PyUnicode_FromString(argv[i] ? argv[i] : ""));
+    fwd = g_have_cbs ? PyObject_CallNoArgs((PyObject *)&fw_type) : Py_NewRef(Py_None);
+    if (!fwd) break;
+    res = PyObject_CallMethod(g_bridge, "start", "iOO",
+                              is_net_merger ? 1 : 0, lst, fwd);
+    if (!res) break;
+    rc = 0;
+  } while (false);
+  if (rc != 0) report_error("uda_bridge_start");
+  Py_XDECREF(res);
+  Py_XDECREF(fwd);
+  Py_XDECREF(lst);
+  Py_XDECREF(cls);
+  Py_XDECREF(mod);
+  PyGILState_Release(st);
+  return rc;
+}
+
+// 0 when the interpreter is live (calling PyGILState_Ensure on an
+// uninitialized runtime is a fatal abort, not a soft error — every
+// entry point that can run before start() must check first)
+static int not_started(const char *where) {
+  if (Py_IsInitialized()) return 0;
+  fprintf(stderr, "uda_tpu bridge shim: %s before uda_bridge_start\n", where);
+  return 1;
+}
+
+// doCommandNative (UdaBridge.cc:266-295): "count:header:params..." strings
+int uda_bridge_do_command(const char *cmd) {
+  if (not_started("do_command")) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  if (g_bridge) {
+    PyObject *res = PyObject_CallMethod(g_bridge, "do_command", "s", cmd);
+    if (res) {
+      rc = 0;
+      Py_DECREF(res);
+    } else {
+      report_error("uda_bridge_do_command");
+    }
+  } else {
+    fprintf(stderr, "uda_tpu bridge shim: do_command before start\n");
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+// reduceExitMsgNative (UdaBridge.cc:299-314): synchronous teardown
+int uda_bridge_reduce_exit(void) {
+  if (not_started("reduce_exit")) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  if (g_bridge) {
+    PyObject *res = PyObject_CallMethod(g_bridge, "reduce_exit", nullptr);
+    if (res) {
+      rc = 0;
+      Py_DECREF(res);
+    } else {
+      report_error("uda_bridge_reduce_exit");
+    }
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+// setLogLevelNative (UdaBridge.cc:318-333)
+int uda_bridge_set_log_level(int level) {
+  if (not_started("set_log_level")) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  if (g_bridge) {
+    PyObject *res =
+        PyObject_CallMethod(g_bridge, "set_log_level", "i", level);
+    if (res) {
+      rc = 0;
+      Py_DECREF(res);
+    } else {
+      report_error("uda_bridge_set_log_level");
+    }
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+// 1 after a failure was signalled (the Java-side fallback latch,
+// UdaShuffleConsumerPluginShared.java:162-177)
+int uda_bridge_failed(void) {
+  if (not_started("failed")) return 0;
+  PyGILState_STATE st = PyGILState_Ensure();
+  int failed = 0;
+  if (g_bridge) {
+    PyObject *v = PyObject_GetAttrString(g_bridge, "failed");
+    if (v) {
+      failed = PyObject_IsTrue(v) == 1 ? 1 : 0;
+      Py_DECREF(v);
+    } else {
+      PyErr_Clear();
+    }
+  }
+  PyGILState_Release(st);
+  return failed;
+}
+
+}  // extern "C"

@@ -1,0 +1,235 @@
+// Standalone driver for libuda_tpu_bridge.so — the native-embedder
+// analogue of the reference's JNI mechanism tests (reference
+// tests/jni*/README: prove callback registration, data hand-off and
+// packaged-class dispatch through the bridge in isolation).
+//
+// Usage: bridge_shim_test <mof_root> <job_id> <num_maps> <reduce_id> [upcall]
+//   <mof_root> must hold the <job>/<map>/file.out[.index] tree the
+//   uda_tpu MOF writer produces (tests/helpers.make_mof_tree).
+//   With "upcall", INIT carries no local dir and index resolution runs
+//   through the get_path_uda C callback (the reference's IndexCache
+//   round trip, src/MOFServer/IndexInfo.cc:237-251): this driver parses
+//   file.out.index itself (24-byte big-endian triples).
+//
+// Drives the full reduce flow over the C ABI: start -> INIT -> FETCH xN
+// -> FINAL -> wait fetch_over -> reduce_exit, collecting dataFromUda
+// bytes, then prints "MERGED <bytes> RECORDS <n>" for the harness to
+// assert on. Exits nonzero on any failure (including failure_in_uda).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef struct uda_index_record {
+  char path[4096];
+  long long start_offset;
+  long long raw_length;
+  long long part_length;
+} uda_index_record_t;
+
+typedef struct uda_callbacks {
+  void *ctx;
+  void (*fetch_over_message)(void *ctx);
+  void (*data_from_uda)(void *ctx, const char *data, long long len);
+  int (*get_path_uda)(void *ctx, const char *job_id, const char *map_id,
+                      int reduce_id, uda_index_record_t *rec);
+  void (*get_conf_data)(void *ctx, const char *name, const char *dflt,
+                        char *out, int cap);
+  void (*log_to)(void *ctx, int level, const char *message);
+  void (*failure_in_uda)(void *ctx, const char *what);
+} uda_callbacks_t;
+
+int uda_bridge_start(int is_net_merger, int argc, const char **argv,
+                     const uda_callbacks_t *cbs);
+int uda_bridge_do_command(const char *cmd);
+int uda_bridge_reduce_exit(void);
+int uda_bridge_set_log_level(int level);
+int uda_bridge_failed(void);
+}
+
+namespace {
+
+struct Host {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  bool failed = false;
+  std::string merged;
+  std::string failure;
+  std::string root;  // for the get_path_uda upcall mode
+  std::atomic<int> path_upcalls{0};
+};
+
+// read one 8-byte big-endian long
+long long be64(const unsigned char *p) {
+  long long v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+
+int on_get_path(void *ctx, const char *job, const char *map, int reduce_id,
+                uda_index_record_t *rec) {
+  Host *h = static_cast<Host *>(ctx);
+  h->path_upcalls.fetch_add(1);
+  std::string dir = h->root + "/" + job + "/" + map;
+  std::string idx = dir + "/file.out.index";
+  FILE *f = fopen(idx.c_str(), "rb");
+  if (!f) return 1;
+  unsigned char triple[24];
+  if (fseek(f, 24L * reduce_id, SEEK_SET) != 0 ||
+      fread(triple, 1, 24, f) != 24) {
+    fclose(f);
+    return 2;
+  }
+  fclose(f);
+  snprintf(rec->path, sizeof rec->path, "%s/file.out", dir.c_str());
+  rec->start_offset = be64(triple);
+  rec->raw_length = be64(triple + 8);
+  rec->part_length = be64(triple + 16);
+  return 0;
+}
+
+void on_fetch_over(void *ctx) {
+  Host *h = static_cast<Host *>(ctx);
+  std::lock_guard<std::mutex> lk(h->mu);
+  h->done = true;
+  h->cv.notify_all();
+}
+
+void on_data(void *ctx, const char *data, long long len) {
+  Host *h = static_cast<Host *>(ctx);
+  std::lock_guard<std::mutex> lk(h->mu);
+  h->merged.append(data, (size_t)len);
+}
+
+void on_conf(void *, const char *, const char *dflt, char *out, int cap) {
+  snprintf(out, (size_t)cap, "%s", dflt ? dflt : "");
+}
+
+void on_log(void *, int level, const char *msg) {
+  if (level <= 2) fprintf(stderr, "[bridge:%d] %s\n", level, msg);
+}
+
+void on_failure(void *ctx, const char *what) {
+  Host *h = static_cast<Host *>(ctx);
+  std::lock_guard<std::mutex> lk(h->mu);
+  h->failed = true;
+  h->failure = what ? what : "?";
+  h->done = true;
+  h->cv.notify_all();
+}
+
+// count IFile records: VInt klen, VInt vlen, key, value; EOF = (-1,-1)
+// (byte-level contract of uda_tpu.utils.ifile / reference
+// src/CommUtils/IOUtility.cc:167-332)
+long decode_vint(const unsigned char *p, size_t n, size_t *used) {
+  if (n == 0) return *used = 0, 0;
+  signed char first = (signed char)p[0];
+  if (first >= -112) return *used = 1, (long)first;
+  int len = first >= -120 ? -112 - first : -120 - first;
+  bool neg = first < -120;
+  if ((size_t)len + 1 > n) return *used = 0, 0;
+  long v = 0;
+  for (int i = 0; i < len; i++) v = (v << 8) | p[1 + i];
+  *used = (size_t)len + 1;
+  return neg ? ~v : v;
+}
+
+int count_records(const std::string &buf) {
+  const unsigned char *p = (const unsigned char *)buf.data();
+  size_t n = buf.size(), pos = 0;
+  int records = 0;
+  while (pos < n) {
+    size_t u1 = 0, u2 = 0;
+    long klen = decode_vint(p + pos, n - pos, &u1);
+    if (!u1) return -1;
+    long vlen = decode_vint(p + pos + u1, n - pos - u1, &u2);
+    if (!u2) return -1;
+    pos += u1 + u2;
+    if (klen == -1 && vlen == -1) continue;  // EOF marker between blocks
+    if (klen < 0 || vlen < 0 || pos + (size_t)(klen + vlen) > n) return -1;
+    pos += (size_t)(klen + vlen);
+    records++;
+  }
+  return records;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s <mof_root> <job_id> <num_maps> <reduce_id>\n",
+            argv[0]);
+    return 2;
+  }
+  const std::string root = argv[1], job = argv[2];
+  const int num_maps = atoi(argv[3]);
+  const std::string reduce_id = argv[4];
+  const bool upcall = argc > 5 && strcmp(argv[5], "upcall") == 0;
+
+  Host host;
+  host.root = root;
+  uda_callbacks_t cbs;
+  memset(&cbs, 0, sizeof cbs);
+  cbs.ctx = &host;
+  cbs.fetch_over_message = on_fetch_over;
+  cbs.data_from_uda = on_data;
+  cbs.get_path_uda = on_get_path;
+  cbs.get_conf_data = on_conf;
+  cbs.log_to = on_log;
+  cbs.failure_in_uda = on_failure;
+
+  const char *args[] = {"-w", "8", "-s", "64"};
+  if (uda_bridge_start(1, 4, args, &cbs) != 0) return 3;
+
+  // INIT: job, reduce, num_maps, key_class, then optionally a local dir
+  // (DirIndexResolver); without it resolution uses the get_path_uda
+  // up-call
+  std::string init = upcall
+      ? "4:7:" + job + ":" + reduce_id + ":" + std::to_string(num_maps) +
+            ":uda.tpu.RawBytes"
+      : "5:7:" + job + ":" + reduce_id + ":" + std::to_string(num_maps) +
+            ":uda.tpu.RawBytes:" + root;
+  if (uda_bridge_do_command(init.c_str()) != 0) return 4;
+  for (int m = 0; m < num_maps; m++) {
+    char map_id[256];
+    // map-attempt naming of tests/helpers.map_ids
+    snprintf(map_id, sizeof map_id, "attempt_%s_m_%06d_0", job.c_str(), m);
+    std::string fetch = std::string("4:4:localhost:") + job + ":" + map_id +
+                        ":" + reduce_id;
+    if (uda_bridge_do_command(fetch.c_str()) != 0) return 5;
+  }
+  if (uda_bridge_do_command("0:2") != 0) return 6;  // FINAL
+
+  {
+    std::unique_lock<std::mutex> lk(host.mu);
+    if (!host.cv.wait_for(lk, std::chrono::seconds(60),
+                          [&] { return host.done; })) {
+      fprintf(stderr, "timeout waiting for fetch_over\n");
+      return 7;
+    }
+  }
+  if (host.failed || uda_bridge_failed()) {
+    fprintf(stderr, "bridge failure: %s\n", host.failure.c_str());
+    return 8;
+  }
+  if (uda_bridge_reduce_exit() != 0) return 9;
+
+  if (upcall && host.path_upcalls.load() == 0) {
+    fprintf(stderr, "upcall mode but get_path_uda was never invoked\n");
+    return 11;
+  }
+  int records = count_records(host.merged);
+  if (records < 0) {
+    fprintf(stderr, "merged stream is not valid IFile framing\n");
+    return 10;
+  }
+  printf("MERGED %zu RECORDS %d\n", host.merged.size(), records);
+  return 0;
+}
