@@ -58,13 +58,24 @@ PATHS = (("carry", "gather")
          else ("gather",))
 
 
+def _enable_cache() -> None:
+    # The probe-warms-cache contract must hold on EVERY backend, CPU
+    # included (compile_cache skips CPU unless explicitly opted in):
+    # without it, a minutes-long carry compile in the probe would be
+    # repaid in the main process, outside the probe's timeout guard.
+    os.environ.setdefault("UDA_TPU_COMPILE_CACHE",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+    from uda_tpu.utils import compile_cache
+
+    compile_cache.enable()
+
+
 def _compile_and_check(path: str) -> None:
     """Compile + smoke-run bench_step for `path` at the real benchmark
     shape (executables are shape-specialized, so probing a smaller n
     would warm the wrong cache entry)."""
-    from uda_tpu.utils import compile_cache
-
-    compile_cache.enable()
+    _enable_cache()
     import jax
 
     from uda_tpu.models import terasort
@@ -112,9 +123,7 @@ def main() -> None:
     if chosen is None:
         raise SystemExit("no bench path compiled within budget")
 
-    from uda_tpu.utils import compile_cache
-
-    compile_cache.enable()
+    _enable_cache()
     import jax
     import numpy as np
 

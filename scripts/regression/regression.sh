@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Flow driver for the regression harness — the retry/restart wrapper role
+# of the reference's performBM*.sh (reference
+# scripts/regression_for_limited_permissions_cluster/executeTerasort.sh:
+# 22-80: run, check, retry on transient failure, collect results).
+#
+# Usage: regression.sh [--size small|medium|large] [--retries N] [args...]
+# Extra args pass through to run_regression.py.
+
+set -u
+HERE="$(cd "$(dirname "$0")" && pwd)"
+RETRIES=1
+ARGS=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --retries) RETRIES="$2"; shift 2 ;;
+    *) ARGS+=("$1"); shift ;;
+  esac
+done
+
+PYTHON="${PYTHON:-python3}"
+attempt=0
+while :; do
+  attempt=$((attempt + 1))
+  echo "== regression attempt ${attempt} =="
+  "${PYTHON}" "${HERE}/run_regression.py" "${ARGS[@]+"${ARGS[@]}"}"
+  rc=$?
+  if [[ ${rc} -eq 0 ]]; then
+    echo "== regression PASSED (attempt ${attempt}) =="
+    exit 0
+  fi
+  if [[ ${rc} -eq 2 ]]; then
+    echo "== usage error (not retryable) ==" >&2
+    exit 2
+  fi
+  if [[ ${attempt} -gt ${RETRIES} ]]; then
+    echo "== regression FAILED after ${attempt} attempts ==" >&2
+    exit 1
+  fi
+  echo "== retrying... ==" >&2
+done

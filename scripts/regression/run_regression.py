@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""Regression harness: the workload ladder as a repeatable gate.
+
+The rebuild of the reference's cluster regression system (reference
+scripts/regression/: executeTerasort.sh + terasortAnallizer.sh run the
+job, check sort validity, and emit timing tables; mr-dstatExcel.sh folds
+dstat resource CSVs into the report; performBM*.sh drives the flow with
+retries). Here the same roles are played in one place:
+
+- every workload of the BASELINE ladder runs end-to-end through the
+  engine (MOF writer -> DataEngine -> MergeManager -> reduce) with its
+  validity gate enforced — correctness is "job success + output
+  validity" exactly like the reference's regression defined it;
+- wall-clock per workload plus a /proc-based resource sample (user/sys
+  CPU seconds, max RSS) replace the dstat CSVs;
+- results land as one JSON file and a markdown table; a nonzero exit
+  means the gate failed (CI semantics the reference's cases/uda.cases
+  wrapper provided).
+
+Usage:
+  python scripts/regression/run_regression.py [--size small|medium|large]
+      [--workloads wordcount,terasort,...] [--reps N] [--out DIR]
+      [--platform cpu|ambient]
+
+Defaults run everything at small size on CPU (laptop/CI friendly);
+--platform ambient keeps whatever backend the environment provides (the
+single real TPU chip under the driver).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _add_repo_to_path() -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+_add_repo_to_path()
+
+SIZES = {
+    # per-workload scale knobs: (small, medium, large)
+    "wordcount_bytes": (1 << 16, 1 << 20, 1 << 24),
+    "terasort_records": (1 << 12, 1 << 16, 1 << 20),
+    "secsort_groups": (10, 60, 300),
+    "invidx_docs": (20, 120, 600),
+    "grep_bytes": (1 << 16, 1 << 20, 1 << 24),
+    "dist_records_per_dev": (256, 2048, 16384),
+}
+
+
+def _size(name: str, size: str) -> int:
+    return SIZES[name][{"small": 0, "medium": 1, "large": 2}[size]]
+
+
+class Sampler:
+    """getrusage-based stand-in for the reference's dstat collection."""
+
+    def __enter__(self):
+        self.r0 = resource.getrusage(resource.RUSAGE_SELF)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        r1 = resource.getrusage(resource.RUSAGE_SELF)
+        self.wall = time.perf_counter() - self.t0
+        self.user = r1.ru_utime - self.r0.ru_utime
+        self.sys = r1.ru_stime - self.r0.ru_stime
+        self.max_rss_mb = r1.ru_maxrss / 1024.0
+
+    def row(self) -> dict:
+        return {"wall_s": round(self.wall, 3), "cpu_user_s": round(self.user, 3),
+                "cpu_sys_s": round(self.sys, 3),
+                "max_rss_mb": round(self.max_rss_mb, 1)}
+
+
+# -- workloads (each: run + validity gate; raises on failure) ---------------
+
+def wl_wordcount(size: str, work_dir: str) -> dict:
+    import numpy as np
+
+    from uda_tpu.models.wordcount import run_wordcount
+
+    n = _size("wordcount_bytes", size)
+    rng = np.random.default_rng(1)
+    vocab = [b"w%04d" % i for i in range(500)]
+    words, total = [], 0
+    while total < n:
+        w = vocab[int(rng.integers(0, len(vocab)))]
+        words.append(w)
+        total += len(w) + 1
+    text = b" ".join(words)
+    counts = run_wordcount(text, num_maps=4, num_reducers=3,
+                           work_dir=work_dir)
+    # validity: exact recount
+    want: dict[bytes, int] = {}
+    for w in words:
+        want[w] = want.get(w, 0) + 1
+    assert counts == want, "wordcount mismatch"
+    return {"input_bytes": len(text), "distinct_words": len(want)}
+
+
+def wl_terasort(size: str, work_dir: str) -> dict:
+    import jax
+    import numpy as np
+
+    from uda_tpu.models import terasort
+
+    n = _size("terasort_records", size)
+    words = terasort.teragen(jax.random.key(42), n)
+    out = terasort.single_chip_sort(words)
+    terasort.validate_sorted(out, words)  # the terasortAnallizer gate
+    return {"records": n, "bytes": n * terasort.RECORD_BYTES}
+
+
+def wl_distributed_terasort(size: str, work_dir: str) -> dict:
+    import jax
+    import numpy as np
+
+    from uda_tpu.models import terasort
+    from uda_tpu.parallel.mesh import make_mesh
+
+    ndev = len(jax.devices())
+    per = _size("dist_records_per_dev", size)
+    n = ndev * per
+    mesh = make_mesh(ndev)
+    words = np.asarray(jax.device_get(terasort.teragen(jax.random.key(7), n)))
+    res = terasort.distributed_terasort(words, mesh)
+    res.check()
+    out = np.asarray(res.words).reshape(ndev, -1, terasort.RECORD_WORDS)
+    nvalid = np.asarray(res.valid_counts).reshape(-1)
+    rows = np.concatenate([out[d, :nvalid[d]] for d in range(ndev)])
+    assert rows.shape[0] == n
+    terasort.validate_sorted(rows, words)
+    return {"devices": ndev, "records": n}
+
+
+def wl_secondary_sort(size: str, work_dir: str) -> dict:
+    from uda_tpu.models.secondary_sort import run_secondary_sort
+
+    g = _size("secsort_groups", size)
+    run_secondary_sort(num_groups=g, per_group=40, work_dir=work_dir)
+    return {"groups": g}
+
+
+def wl_inverted_index(size: str, work_dir: str) -> dict:
+    from uda_tpu.models.inverted_index import run_inverted_index
+
+    d = _size("invidx_docs", size)
+    idx = run_inverted_index(num_docs=d, words_per_doc=80, work_dir=work_dir)
+    return {"docs": d, "terms": len(idx)}
+
+
+def wl_grep(size: str, work_dir: str) -> dict:
+    import numpy as np
+
+    from uda_tpu.models.grep import run_grep
+
+    n = _size("grep_bytes", size)
+    rng = np.random.default_rng(3)
+    lines = []
+    total = 0
+    while total < n:
+        tok = b"needle%d" % int(rng.integers(0, 20)) \
+            if rng.random() < 0.3 else b"hay%06d" % int(rng.integers(0, 9999))
+        lines.append(tok)
+        total += len(tok) + 1
+    text = b"\n".join(lines)
+    result = run_grep(text, rb"needle\d+", work_dir=work_dir)
+    counts = [c for _, c in result]
+    assert counts == sorted(counts, reverse=True), "grep sort order broken"
+    assert sum(counts) == sum(1 for t in lines if t.startswith(b"needle"))
+    return {"input_bytes": len(text), "matches": sum(counts)}
+
+
+def wl_compressed_shuffle(size: str, work_dir: str) -> dict:
+    # the compression-path regression: same wordcount, zlib-block MOFs
+    import numpy as np
+
+    from uda_tpu.models.wordcount import run_wordcount
+    from uda_tpu.utils.config import Config
+
+    n = max(1 << 14, _size("wordcount_bytes", size) // 4)
+    rng = np.random.default_rng(5)
+    text = b" ".join(b"z%03d" % int(rng.integers(0, 99)) for _ in range(n // 5))
+    cfg = Config({"mapred.compress.map.output": True,
+                  "mapred.map.output.compression.codec": "zlib"})
+    counts = run_wordcount(text, num_maps=3, num_reducers=2, config=cfg,
+                           work_dir=work_dir)
+    want: dict[bytes, int] = {}
+    for w in text.split(b" "):
+        want[w] = want.get(w, 0) + 1
+    assert counts == want, "compressed wordcount mismatch"
+    return {"input_bytes": len(text)}
+
+
+WORKLOADS = {
+    "wordcount": wl_wordcount,
+    "terasort": wl_terasort,
+    "distributed_terasort": wl_distributed_terasort,
+    "secondary_sort": wl_secondary_sort,
+    "inverted_index": wl_inverted_index,
+    "grep": wl_grep,
+    "compressed_shuffle": wl_compressed_shuffle,
+}
+
+
+def _setup_platform(platform: str) -> None:
+    if platform == "cpu":
+        # must precede any jax device use; the ambient environment may
+        # force an accelerator backend (see tests/conftest.py). Append
+        # rather than setdefault: an already-exported XLA_FLAGS must not
+        # silently drop the virtual-device flag (it would degrade the
+        # distributed workload to one device while still passing).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from uda_tpu.utils import compile_cache
+
+    compile_cache.enable()
+
+
+def _run_single(name: str, size: str, platform: str, out_dir: str,
+                rep: int) -> int:
+    """Child-process mode: run ONE workload and print its result row as
+    JSON. Isolation makes ru_maxrss a true per-workload peak (it is a
+    process-lifetime high-water mark) and keeps a crashing workload from
+    taking the harness down."""
+    _setup_platform(platform)
+    work_dir = tempfile.mkdtemp(prefix=f"uda_reg_{name}_", dir=out_dir)
+    status, detail, err = "PASS", {}, ""
+    with Sampler() as s:
+        try:
+            detail = WORKLOADS[name](size, work_dir)
+        except Exception as e:  # noqa: BLE001 - the gate boundary
+            status, err = "FAIL", f"{type(e).__name__}: {e}"
+    row = {"workload": name, "rep": rep, "size": size, "status": status,
+           **s.row(), "detail": detail, "error": err}
+    print("RESULT " + json.dumps(row))
+    return 0 if status == "PASS" else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", choices=("small", "medium", "large"),
+                    default="small")
+    ap.add_argument("--workloads", default=",".join(WORKLOADS))
+    ap.add_argument("--reps", type=int, default=1)
+    ap.add_argument("--out", default="")
+    ap.add_argument("--platform", choices=("cpu", "ambient"), default="cpu")
+    ap.add_argument("--single", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--rep", type=int, default=0, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.single:
+        return _run_single(args.single, args.size, args.platform,
+                           args.out or tempfile.gettempdir(), args.rep)
+
+    names = [w.strip() for w in args.workloads.split(",") if w.strip()]
+    unknown = [w for w in names if w not in WORKLOADS]
+    if unknown:
+        print(f"unknown workloads: {unknown}", file=sys.stderr)
+        return 2
+
+    out_dir = args.out or os.path.join(
+        tempfile.gettempdir(), f"uda_regression_{int(time.time())}")
+    os.makedirs(out_dir, exist_ok=True)
+
+    rows = []
+    failed = []
+    for name in names:
+        for rep in range(args.reps):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--single", name, "--size", args.size,
+                   "--platform", args.platform, "--out", out_dir,
+                   "--rep", str(rep)]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  check=False)
+            row = None
+            for line in proc.stdout.splitlines():
+                if line.startswith("RESULT "):
+                    row = json.loads(line[len("RESULT "):])
+            if row is None:  # crashed before reporting
+                row = {"workload": name, "rep": rep, "size": args.size,
+                       "status": "FAIL", "wall_s": 0.0, "cpu_user_s": 0.0,
+                       "cpu_sys_s": 0.0, "max_rss_mb": 0.0, "detail": {},
+                       "error": f"worker died rc={proc.returncode}: "
+                                f"{proc.stderr[-300:]}"}
+            rows.append(row)
+            if row["status"] == "FAIL":
+                failed.append(name)
+            print(f"{row['status']:4s} {name:22s} rep{rep} "
+                  f"{row['wall_s']:8.2f}s  "
+                  f"cpu {row['cpu_user_s'] + row['cpu_sys_s']:7.2f}s  "
+                  f"rss {row['max_rss_mb']:7.1f}MB  {row['error']}")
+
+    report = {"size": args.size, "platform": args.platform,
+              "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
+              "results": rows, "failed": sorted(set(failed))}
+    with open(os.path.join(out_dir, "results.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    with open(os.path.join(out_dir, "results.md"), "w") as f:
+        f.write(f"# uda_tpu regression — {args.size} ({report['timestamp']})\n\n")
+        f.write("| workload | rep | status | wall s | cpu s | rss MB |\n")
+        f.write("|---|---|---|---|---|---|\n")
+        for r in rows:
+            f.write(f"| {r['workload']} | {r['rep']} | {r['status']} | "
+                    f"{r['wall_s']} | {r['cpu_user_s'] + r['cpu_sys_s']:.2f} "
+                    f"| {r['max_rss_mb']} |\n")
+    print(f"\nreport: {out_dir}/results.json")
+    if failed:
+        print(f"FAILED: {sorted(set(failed))}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,9 +23,8 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# persistent compile cache: CPU compiles are fast, but caching keeps
-# repeated full-suite runs cheap and exercises the same code path the
-# TPU entry points rely on.
+# exercise the cache wiring the TPU entry points rely on (a no-op on
+# CPU unless UDA_TPU_COMPILE_CACHE is set — see compile_cache.enable)
 from uda_tpu.utils import compile_cache  # noqa: E402
 
 compile_cache.enable()

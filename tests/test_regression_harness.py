@@ -1,0 +1,35 @@
+"""The regression harness itself (scripts/regression) — the CI-gate
+contract of the reference's cases/ wrapper (reference cases/uda.cases,
+runRegression_2.sh): exit 0 + report on pass, nonzero on failure."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "regression", "run_regression.py")
+
+
+def _run(tmp_path, workloads):
+    return subprocess.run(
+        [sys.executable, SCRIPT, "--size", "small", "--out", str(tmp_path),
+         "--workloads", workloads],
+        capture_output=True, text=True, timeout=300, check=False,
+        cwd=REPO)
+
+
+def test_harness_pass_produces_report(tmp_path):
+    proc = _run(tmp_path, "secondary_sort,compressed_shuffle")
+    assert proc.returncode == 0, proc.stderr[-800:]
+    report = json.load(open(os.path.join(tmp_path, "results.json")))
+    assert report["failed"] == []
+    assert {r["workload"] for r in report["results"]} == {
+        "secondary_sort", "compressed_shuffle"}
+    assert all(r["status"] == "PASS" for r in report["results"])
+    assert os.path.exists(os.path.join(tmp_path, "results.md"))
+
+
+def test_harness_unknown_workload_errors(tmp_path):
+    proc = _run(tmp_path, "not_a_workload")
+    assert proc.returncode == 2

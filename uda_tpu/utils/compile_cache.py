@@ -35,6 +35,12 @@ def enable() -> bool:
 
     Returns True when the cache is active. Honors
     ``UDA_TPU_COMPILE_CACHE=`` (empty) as an explicit opt-out.
+
+    CPU backends are excluded by default (set ``UDA_TPU_COMPILE_CACHE``
+    to opt in): CPU compiles are fast, and XLA:CPU AOT cache entries pin
+    the compile machine's feature set — reloading them on a host with a
+    different detected feature set risks SIGILL. The cache's purpose is
+    accelerator backends, where a cold remote compile costs minutes.
     """
     global _enabled
     if _enabled:
@@ -44,6 +50,18 @@ def enable() -> bool:
         return False
     import jax
 
+    # Detect a CPU-only configuration WITHOUT instantiating a backend:
+    # calling jax.default_backend() here would lock in platform
+    # selection and break callers (dryrun_multichip) that re-force CPU
+    # after enable(). jax.config.jax_platforms covers the ambient
+    # setups this repo runs under (sitecustomize sets it); JAX_PLATFORMS
+    # covers plain environments. An unset value (auto-detect) enables
+    # the cache — the accelerator case is the one that matters.
+    platforms = (jax.config.jax_platforms
+                 or os.environ.get("JAX_PLATFORMS", ""))
+    if (platforms.strip().lower() == "cpu"
+            and "UDA_TPU_COMPILE_CACHE" not in os.environ):
+        return False
     os.makedirs(d, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", d)
     # Cache everything that took real compile time; the remote-compile
