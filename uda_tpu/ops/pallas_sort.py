@@ -56,7 +56,9 @@ __all__ = ["ROWS", "sort_lanes", "rows_to_lanes", "lanes_to_rows",
 
 ROWS = 32               # sublane-padded row count of the lanes layout
 TB_ROW_DEFAULT = 31     # default tie-break row (last)
-_INF = jnp.uint32(0xFFFFFFFF)
+_INF = np.uint32(0xFFFFFFFF)  # numpy scalar: kernels bake it in as a
+                              # literal (a traced jnp constant would be
+                              # rejected by pallas_call as a capture)
 _LANE = 128             # TPU lane width: DMA lane offsets must be multiples
 
 
@@ -296,7 +298,7 @@ def _merge_pass(x, splits, run_len: int, tile: int, num_keys: int,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n // tile,),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec((rows, tile), lambda t, s: (0, t)),
             scratch_shapes=[
                 pltpu.VMEM((rows, tile + _LANE), jnp.uint32),
