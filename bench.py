@@ -41,21 +41,33 @@ import sys
 import time
 
 BASELINE_GBPS = 6.8  # FDR IB line rate, the reference data plane ceiling
-LOG2_RECORDS = 23    # 8M records x 100 B = 0.8 GB resident per round
+# 8M records x 100 B = 0.8 GB resident per round (override for smoke
+# tests of the bench plumbing itself)
+LOG2_RECORDS = int(os.environ.get("UDA_TPU_BENCH_LOG2", 23))
 ROUNDS_PER_DISPATCH = 4   # amortizes the ~75 ms dispatch+readback cost
 DISPATCHES = 2
+# lanes-path sort tile; clamped so smoke-sized runs (UDA_TPU_BENCH_LOG2)
+# still satisfy sort_lanes' n % tile == 0 contract
+LANES_TILE = min(1024, 1 << LOG2_RECORDS)
+# run the Pallas kernels in interpret mode (CPU smoke runs of the lanes
+# path; useless on TPU and at full size)
+INTERPRET = os.environ.get("UDA_TPU_BENCH_INTERPRET") == "1"
 # cold-compile budget per candidate path, seconds (warm = cache hit,
 # returns in seconds regardless)
 PROBE_TIMEOUT = float(os.environ.get("UDA_TPU_BENCH_PROBE_TIMEOUT", 600))
+# Path order: "lanes" (the Pallas bitonic pipeline) first — it is the
+# fast path AND the bounded-compile path (two Mosaic kernels regardless
+# of n), so it is also the safe cold-compile bet. "gather" is the
+# always-compilable XLA fallback.
 # IMPORTANT: "carry" is opt-in. On remote-compile backends the 26-operand
 # sort compile (a) can run for hours and (b) keeps running SERVER-SIDE
 # after the client is killed, serializing every later compile in the
 # session behind it — one failed carry probe poisons the whole service.
 # Opt in with UDA_TPU_BENCH_TRY_CARRY=1 only where compiles are local
 # (CPU) or known-fast.
-PATHS = (("carry", "gather")
+PATHS = (("lanes", "carry", "gather")
          if os.environ.get("UDA_TPU_BENCH_TRY_CARRY") == "1"
-         else ("gather",))
+         else ("lanes", "gather"))
 
 
 def _enable_cache() -> None:
@@ -66,6 +78,15 @@ def _enable_cache() -> None:
     os.environ.setdefault("UDA_TPU_COMPILE_CACHE",
                           os.path.join(os.path.dirname(
                               os.path.abspath(__file__)), ".jax_cache"))
+    # Honor an explicit JAX_PLATFORMS: the TPU deployment's sitecustomize
+    # force-selects its backend via jax.config, which silently overrides
+    # the env var — without this, a CPU smoke run of bench.py (and its
+    # probe subprocesses) would hang waiting on the TPU relay.
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms and platforms != "axon":
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
     from uda_tpu.utils import compile_cache
 
     compile_cache.enable()
@@ -82,7 +103,7 @@ def _compile_and_check(path: str) -> None:
 
     viol, ck_in, ck_out = terasort.bench_step(
         jax.random.key(999), 1 << LOG2_RECORDS, ROUNDS_PER_DISPATCH,
-        path=path)
+        path=path, tile=LANES_TILE, interpret=INTERPRET)
     assert int(viol) == 0
 
 
@@ -137,7 +158,8 @@ def main() -> None:
     # compute, so all timing synchronizes through a scalar readback)
     viol, ck_in, ck_out = terasort.bench_step(jax.random.key(999), n,
                                               ROUNDS_PER_DISPATCH,
-                                              path=chosen)
+                                              path=chosen, tile=LANES_TILE,
+                                              interpret=INTERPRET)
     assert int(viol) == 0
 
     best = float("inf")
@@ -145,7 +167,9 @@ def main() -> None:
         t0 = time.perf_counter()
         viol, ck_in, ck_out = terasort.bench_step(jax.random.key(i), n,
                                                   ROUNDS_PER_DISPATCH,
-                                                  path=chosen)
+                                                  path=chosen,
+                                                  tile=LANES_TILE,
+                                                  interpret=INTERPRET)
         ok = (int(viol) == 0, np.uint32(ck_in) == np.uint32(ck_out))
         dt = time.perf_counter() - t0
         assert all(ok), f"validation failed: {ok}"

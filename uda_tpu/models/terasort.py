@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from uda_tpu.ops import pallas_sort
 from uda_tpu.ops.sort import resolve_sort_path
 from uda_tpu.parallel.distributed import (DistributedSortResult,
                                           distributed_sort_step,
@@ -38,7 +39,8 @@ from uda_tpu.parallel.distributed import (DistributedSortResult,
 from uda_tpu.parallel.mesh import SHUFFLE_AXIS
 
 __all__ = ["KEY_WORDS", "RECORD_WORDS", "RECORD_BYTES", "teragen",
-           "single_chip_sort", "distributed_terasort", "validate_sorted"]
+           "teragen_lanes", "single_chip_sort", "distributed_terasort",
+           "validate_sorted"]
 
 KEY_WORDS = 3        # 10 key bytes -> 3 BE words (2 pad bytes, constant 0)
 VALUE_WORDS = 23     # 90 value bytes -> 23 words (2 pad bytes)
@@ -59,6 +61,21 @@ def teragen(key: jax.Array, n: int) -> jax.Array:
     keys = keys.at[:, 2].set(keys[:, 2] & jnp.uint32(0xFFFF0000))
     vals = jax.random.bits(kv, (n, VALUE_WORDS), dtype=jnp.uint32)
     return jnp.concatenate([keys, vals], axis=1)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def teragen_lanes(key: jax.Array, n: int) -> jax.Array:
+    """Generate n TeraSort-shaped records directly in the lanes layout
+    (uint32[pallas_sort.ROWS, n]): rows 0-2 the big-endian key words
+    (pad bytes of row 2 zeroed), rows 3-25 the value words, rows 26-31
+    zero (row 31 becomes the sort's stability tie-break). Generating in
+    lanes form means the flagship path never pays a transpose."""
+    kk, kv = jax.random.split(key)
+    keys = jax.random.bits(kk, (KEY_WORDS, n), dtype=jnp.uint32)
+    keys = keys.at[2].set(keys[2] & jnp.uint32(0xFFFF0000))
+    vals = jax.random.bits(kv, (VALUE_WORDS, n), dtype=jnp.uint32)
+    pad = jnp.zeros((pallas_sort.ROWS - RECORD_WORDS, n), jnp.uint32)
+    return jnp.concatenate([keys, vals, pad], axis=0)
 
 
 def _sort_record_cols(cols: tuple, path: str) -> tuple:
@@ -131,26 +148,32 @@ def _violations_cols(k0, k1, k2) -> jax.Array:
     return jnp.sum(gt.astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("n", "k", "path"))
-def bench_step(seed: jax.Array, n: int, k: int, path: str = "carry"):
+@partial(jax.jit, static_argnames=("n", "k", "path", "tile", "interpret"))
+def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
+               tile: int = 1024, interpret: bool = False):
     """Sustained-throughput benchmark kernel: k independent
     teragen->sort->validate rounds inside ONE device program (one host
     dispatch), so per-call host/RPC latency amortizes away and the
     result reflects device shuffle+merge throughput.
 
-    Everything stays in column (SoA) form — on TPU, XLA lane-pads the
-    minor dimension of an [n, 26] row matrix to 128 words (5x HBM
-    footprint and bandwidth), so device-resident records are 26 separate
-    [n] columns and nothing ever materializes rows.
+    Nothing ever materializes an [n, 26] row matrix — on TPU, XLA
+    lane-pads the minor dimension to 128 words (5x HBM footprint and
+    bandwidth). Records are either 26 separate [n] columns (SoA) or the
+    [32, n] lanes layout.
 
-    Two device strategies for moving the 23 value columns:
+    Three device strategies:
 
-    - ``path="carry"``: the payload rides the sort network as extra
-      ``lax.sort`` operands. Fastest at runtime (~12 GB/s measured;
-      streaming compare-exchange), but XLA's variadic-sort compile time
-      grows superlinearly in operand count — on remote-compile backends
-      the 26-operand program can take a very long time to compile ONCE
-      (it persists in the uda_tpu compile cache afterwards).
+    - ``path="lanes"`` (flagship): records live in the lanes layout and
+      the full sort runs in the Pallas bitonic pipeline
+      (pallas_sort.sort_lanes). Payload rides every compare-exchange as
+      lane moves of the 32-row tile — streaming HBM access, no gathers
+      — and compile cost is BOUNDED (two Mosaic kernels total,
+      regardless of n and record width).
+    - ``path="carry"``: the payload rides the ``lax.sort`` network as
+      extra operands. Fast at runtime (~12 GB/s measured) but XLA's
+      variadic-sort compile time grows superlinearly in operand count —
+      on remote-compile backends the 26-operand program can take hours
+      to compile ONCE (it persists in the compile cache afterwards).
     - ``path="gather"``: a 4-operand sort (3 key words + iota) computes
       the permutation, then per-column gathers apply it. Compiles in
       ~1 min cold; runtime is gather-bound (TPU random gathers are
@@ -163,10 +186,22 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "carry"):
     consuming the sorted output in-graph keeps XLA from eliminating any
     round, and the caller asserts violations == 0 and checksum equality.
     """
-    if path not in ("carry", "gather"):
+    if path not in ("lanes", "carry", "gather"):
         raise ValueError(f"unknown bench path {path!r}")
 
-    def body(i, acc):
+    def body_lanes(i, acc):
+        viol, ck_in, ck_out = acc
+        x = teragen_lanes(jax.random.fold_in(seed, i), n)
+        ck_in = ck_in + _checksum_cols(tuple(x[r]
+                                             for r in range(RECORD_WORDS)))
+        out = pallas_sort.sort_lanes(x, num_keys=KEY_WORDS, tile=tile,
+                                     interpret=interpret)
+        ck_out = ck_out + _checksum_cols(tuple(out[r]
+                                               for r in range(RECORD_WORDS)))
+        viol = viol + _violations_cols(out[0], out[1], out[2])
+        return (viol, ck_in, ck_out)
+
+    def body_cols(i, acc):
         viol, ck_in, ck_out = acc
         w = teragen(jax.random.fold_in(seed, i), n)
         cols = tuple(w[:, c] for c in range(RECORD_WORDS))
@@ -177,6 +212,7 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "carry"):
         return (viol, ck_in, ck_out)
 
     zero = jnp.uint32(0)
+    body = body_lanes if path == "lanes" else body_cols
     return lax.fori_loop(0, k, body, (jnp.int32(0), zero, zero))
 
 

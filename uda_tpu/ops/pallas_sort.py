@@ -161,29 +161,41 @@ def _tile_sort(x, tile: int, num_keys: int, tb_row: int, alternate: bool,
     )(x)
 
 
-def _pass_splits(x, run_len: int, tile: int, num_keys: int, tb_row: int,
-                 final: bool):
-    """Merge-path diagonals for one pass, in XLA.
+def _pass_splits(x, run_len, final, tile: int, num_keys: int, tb_row: int):
+    """Merge-path windows for one pass, in XLA.
 
-    Returns int32[num_tiles, 2]: per output tile, (i0, d_eff) where
-    d_eff is the pair-local diagonal in ASCENDING rank space — for
-    descending-output tiles the tile's ranks are
-    [2L - d_local - T, 2L - d_local), counted from the top — and i0 is
-    the number of A-run records among the first d_eff merged records.
-    B is the stored-DESCENDING run read through its logical ascending
-    view B'[m] = B[L-1-m]; ties go to A (arrival order) which the
-    strict tie-break ordering decides naturally."""
+    ``run_len`` (= L) and ``final`` may be TRACED scalars: every
+    pass-dependent quantity is computed here and handed to the kernel as
+    data, so ONE compiled kernel serves every pass (and the pass loop
+    can be a ``lax.fori_loop``) — the whole pipeline costs two Mosaic
+    kernel compiles regardless of n.
+
+    Rank bookkeeping: per output tile, d_eff is the pair-local diagonal
+    in ASCENDING rank space — for descending-output tiles the tile's
+    ranks are [2L - d_local - T, 2L - d_local), counted from the top —
+    and i0 is the number of A-run records among the first d_eff merged
+    records (vectorized merge-path binary search). B is the
+    stored-DESCENDING run read through its logical ascending view
+    B'[m] = B[L-1-m]; ties go to A (arrival order) which the strict
+    tie-break ordering decides naturally.
+
+    Returns int32[num_tiles, 8] rows
+    (a_align, roll_a, thr_a, b_align, roll_b, thr_b, out_asc, 0):
+    per side an aligned superwindow start, the cyclic roll that places
+    the wanted first record at lane 0, and the invalid-lane threshold
+    (A: lanes >= thr_a are past the run end; B: lanes < thr_b are below
+    B'[j0]); see _merge_pass_kernel for how they are applied.
+    """
     rows, n = x.shape
-    L = run_len
+    L = jnp.asarray(run_len, jnp.int32)
+    final = jnp.asarray(final, jnp.bool_)
     num_tiles = n // tile
+    win = tile + _LANE
     t = jnp.arange(num_tiles, dtype=jnp.int32)
     pair = (t * tile) // (2 * L)
     d_local = t * tile - pair * 2 * L
-    if final:
-        d_eff = d_local
-    else:
-        out_asc = (pair % 2) == 0
-        d_eff = jnp.where(out_asc, d_local, 2 * L - (d_local + tile))
+    out_asc = final | ((pair % 2) == 0)
+    d_eff = jnp.where(out_asc, d_local, 2 * L - (d_local + tile))
     a_base = pair * 2 * L
     b_base = a_base + L
     key_rows_idx = list(range(num_keys)) + [tb_row]
@@ -206,15 +218,34 @@ def _pass_splits(x, run_len: int, tile: int, num_keys: int, tb_row: int,
         hi = jnp.where(ok, hi, mid - 1)
         return lo, hi
 
-    bits = max(2, int(np.log2(max(2, int(L)))) + 2)
-    lo, hi = lax.fori_loop(0, bits, body, (lo, hi))
-    return jnp.stack([lo.astype(jnp.int32), d_eff.astype(jnp.int32)], axis=1)
+    bits = max(2, int(np.log2(n)) + 2)    # covers any L <= n/2
+    i0, _ = lax.fori_loop(0, bits, body, (lo, hi))
+    j0 = d_eff - i0
+
+    # ---- A: records [i0, i0+tile) of the ascending run ----
+    a_start = a_base + i0
+    a_align = jnp.minimum((a_start // _LANE) * _LANE, n - win)
+    roll_a = a_start - a_align
+    thr_a = L - i0                        # lanes >= thr_a: past run end
+    # ---- B: stored lanes holding B'[j0+tile-1] ... B'[j0] ----
+    # unclamped start b_base + L - j0 - tile undershoots b_base by
+    # inv = max(0, j0 + tile - L); read from the clamped start and roll
+    # RIGHT by inv so position r holds B'[j0 + tile - 1 - r] for r>=inv
+    # and the first inv lanes are masked (+inf front)
+    inv = jnp.maximum(0, j0 + tile - L)
+    b_clamp = b_base + jnp.maximum(0, L - j0 - tile)
+    b_align = jnp.minimum((b_clamp // _LANE) * _LANE, n - win)
+    roll_b = inv - (b_clamp - b_align)
+    cols = [a_align, roll_a, thr_a, b_align, roll_b, inv,
+            out_asc.astype(jnp.int32), jnp.zeros_like(a_align)]
+    return jnp.stack([c.astype(jnp.int32) for c in cols], axis=1)
 
 
 def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
-                       *, tile, run_len, n, num_keys, tb_row, final):
+                       *, tile, num_keys, tb_row):
     """One output tile of one merge pass (see _pass_splits for the rank
-    bookkeeping).
+    bookkeeping; every pass-dependent scalar arrives via splits_ref, so
+    this kernel compiles once and serves all log2(n/tile) passes).
 
     Window construction: each side DMAs a lane-aligned superwindow of
     tile+128 lanes (align floor-clamped so it never leaves the array),
@@ -228,30 +259,19 @@ def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
     always land in the discarded half of the merge: smallest-T taken
     for ascending output, largest-T (positions [T, 2T) of the
     descending-direction network) for descending output."""
-    L = run_len
     rows = a_buf.shape[0]
     t = pl.program_id(0)
-    pair = (t * tile) // (2 * L)
-    i0 = splits_ref[t, 0]
-    d_eff = splits_ref[t, 1]
-    j0 = d_eff - i0
-    a_base = pair * 2 * L
-    b_base = a_base + L
+    a_align = splits_ref[t, 0]
+    roll_a = splits_ref[t, 1]
+    thr_a = splits_ref[t, 2]
+    b_align = splits_ref[t, 3]
+    roll_b = splits_ref[t, 4]
+    thr_b = splits_ref[t, 5]
+    out_asc = splits_ref[t, 6] != 0
     win = tile + _LANE
 
-    # ---- A: records [i0, i0+tile) of the ascending run ----
-    a_start = a_base + i0
-    a_align = jnp.minimum((a_start // _LANE) * _LANE, n - win)
     cp_a = pltpu.make_async_copy(x_hbm.at[:, pl.ds(a_align, win)], a_buf,
                                  sem_a)
-    # ---- B: stored lanes holding B'[j0+tile-1] ... B'[j0] ----
-    # unclamped start b_base + L - j0 - tile undershoots b_base by
-    # inv = max(0, j0 + tile - L); read from the clamped start and roll
-    # RIGHT by inv so position r holds B'[j0 + tile - 1 - r] for r>=inv
-    # and the first inv lanes are masked (+inf front)
-    inv = jnp.maximum(0, j0 + tile - L)
-    b_clamp = b_base + jnp.maximum(0, L - j0 - tile)
-    b_align = jnp.minimum((b_clamp // _LANE) * _LANE, n - win)
     cp_b = pltpu.make_async_copy(x_hbm.at[:, pl.ds(b_align, win)], b_buf,
                                  sem_b)
     cp_a.start()
@@ -263,22 +283,18 @@ def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
     rowi = lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
     is_key_row = (rowi < num_keys) | (rowi == tb_row)
 
-    a_rows = pltpu.roll(a_buf[...], -(a_start - a_align), 1)[:, :tile]
-    a_invalid = (i0 + r_idx) >= L          # tail lanes past the run end
+    a_rows = pltpu.roll(a_buf[...], -roll_a, 1)[:, :tile]
+    a_invalid = r_idx >= thr_a             # tail lanes past the run end
     a_rows = jnp.where(is_key_row & a_invalid,
                        jnp.broadcast_to(_INF, a_rows.shape), a_rows)
 
-    b_rows = pltpu.roll(b_buf[...], inv - (b_clamp - b_align), 1)[:, :tile]
-    b_invalid = r_idx < inv                # front lanes below B'[j0]
+    b_rows = pltpu.roll(b_buf[...], roll_b, 1)[:, :tile]
+    b_invalid = r_idx < thr_b              # front lanes below B'[j0]
     b_rows = jnp.where(is_key_row & b_invalid,
                        jnp.broadcast_to(_INF, b_rows.shape), b_rows)
 
     seq = jnp.concatenate([a_rows, b_rows], axis=1)
     key_rows_idx = list(range(num_keys)) + [tb_row]
-    if final:
-        out_asc = jnp.bool_(True)
-    else:
-        out_asc = (pair % 2) == 0
     asc_mask = jnp.broadcast_to(out_asc, (1, 2 * tile))
     j = tile
     while j >= 1:
@@ -287,14 +303,13 @@ def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
     o_ref[...] = jnp.where(out_asc, seq[:, :tile], seq[:, tile:])
 
 
-@partial(jax.jit, static_argnames=("run_len", "tile", "num_keys", "tb_row",
-                                   "final", "interpret"))
-def _merge_pass(x, splits, run_len: int, tile: int, num_keys: int,
-                tb_row: int, final: bool, interpret: bool = False):
+@partial(jax.jit, static_argnames=("tile", "num_keys", "tb_row", "interpret"))
+def _merge_pass(x, splits, tile: int, num_keys: int, tb_row: int,
+                interpret: bool = False):
     rows, n = x.shape
     return pl.pallas_call(
-        partial(_merge_pass_kernel, tile=tile, run_len=run_len, n=n,
-                num_keys=num_keys, tb_row=tb_row, final=final),
+        partial(_merge_pass_kernel, tile=tile, num_keys=num_keys,
+                tb_row=tb_row),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n // tile,),
@@ -337,11 +352,18 @@ def sort_lanes(x, num_keys: int, tb_row: int = TB_ROW_DEFAULT,
     levels = int(np.log2(n // tile))
     x = _tile_sort(x, tile, num_keys, tb_row, alternate=levels > 0,
                    interpret=interpret)
-    L = tile
-    for lvl in range(levels):
+    if levels == 0:
+        return x
+
+    # One fori_loop body serving every pass: run_len/final are traced,
+    # so the program holds exactly ONE merge pallas_call (and one tile
+    # sort) no matter how many passes run — compile cost is bounded in
+    # n, the property the operand-carry lax.sort path lacks.
+    def body(lvl, x):
+        run_len = jnp.int32(tile) << lvl
         final = lvl == levels - 1
-        splits = _pass_splits(x, L, tile, num_keys, tb_row, final)
-        x = _merge_pass(x, splits, L, tile, num_keys, tb_row, final,
-                        interpret=interpret)
-        L *= 2
-    return x
+        splits = _pass_splits(x, run_len, final, tile, num_keys, tb_row)
+        return _merge_pass(x, splits, tile, num_keys, tb_row,
+                           interpret=interpret)
+
+    return lax.fori_loop(0, levels, body, x)
