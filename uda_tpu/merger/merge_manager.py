@@ -70,7 +70,9 @@ class MergeManager:
     # -- fetch phase --------------------------------------------------------
 
     def fetch_all(self, job_id: str, map_ids: Sequence[str],
-                  reduce_id: int) -> list[Segment]:
+                  reduce_id: int,
+                  on_segment: Optional[Callable[[int, Segment], None]] = None
+                  ) -> list[Segment]:
         """Fetch every map's partition, randomized order, sliding window.
 
         The window refills as individual segments complete (true
@@ -78,21 +80,37 @@ class MergeManager:
         the tail, rather than draining at batch boundaries). Returns
         segments in the *original* map order (merge stability and
         reproducibility do not depend on fetch completion order).
+
+        ``on_segment(index, segment)`` fires on each successful segment
+        completion, from the transport's completion thread — the hook
+        the overlapped merge uses to stage runs while later fetches are
+        still in flight.
         """
         segs = [Segment(self.client, job_id, m, reduce_id, self.chunk_size)
                 for m in map_ids]
+        index_of = {id(s): i for i, s in enumerate(segs)}
         order = list(range(len(segs)))
         random.Random(self.seed).shuffle(order)  # MergeManager.cc:58-63
         credits = threading.Semaphore(self.window)
         done_lock = threading.Lock()
         done = 0
+        all_notified = threading.Event()  # ALL on_done callbacks returned
+        cb_errors: list[Exception] = []
 
-        def on_done(_seg) -> None:
+        def on_done(seg) -> None:
             nonlocal done
             credits.release()
-            with done_lock:
-                done += 1
-                d = done
+            try:
+                if on_segment is not None and seg.ready:
+                    on_segment(index_of[id(seg)], seg)
+            except Exception as e:  # surfaced after the waits below
+                cb_errors.append(e)
+            finally:
+                with done_lock:
+                    done += 1
+                    d = done
+                if d == len(segs):
+                    all_notified.set()
             if self.progress and d % PROGRESS_INTERVAL == 0:
                 self.progress(d, len(segs))
 
@@ -105,6 +123,14 @@ class MergeManager:
                 segs[i].start()
             for s in segs:
                 s.wait()
+            # a segment's _done fires BEFORE its on_done callback runs:
+            # wait for the callbacks too, or a caller could finalize its
+            # on_segment consumer (e.g. the overlapped merger) while the
+            # last completion is still being delivered
+            if segs:
+                all_notified.wait()
+        if cb_errors:
+            raise cb_errors[0]
         if self.progress:
             self.progress(len(segs), len(segs))
         return segs
@@ -129,14 +155,34 @@ class MergeManager:
 
     def run(self, job_id: str, map_ids: Sequence[str], reduce_id: int,
             consumer: Callable[[memoryview], None]) -> int:
-        """The full online merge: fetch -> merge -> emit (reference
-        merge_online, MergeManager.cc:184-193)."""
+        """The full online merge: fetch overlapped with device merge ->
+        emit (reference merge_online, MergeManager.cc:184-193; the
+        overlap restores the network-levitated property — see
+        uda_tpu.merger.overlap)."""
         approach = self.cfg.get("mapred.netmerger.merge.approach")
         if approach == 2:
             from uda_tpu.merger.hybrid import run_hybrid
             return run_hybrid(self, job_id, map_ids, reduce_id, consumer)
-        segments = self.fetch_all(job_id, map_ids, reduce_id)
-        merged = self.merge_segments(segments)
+        if not self.cfg.get("uda.tpu.merge.overlap"):
+            segments = self.fetch_all(job_id, map_ids, reduce_id)
+            merged = self.merge_segments(segments)
+            return self.emit_framed(merged, consumer)
+
+        from uda_tpu.merger.overlap import OverlappedMerger
+
+        om = OverlappedMerger(self.key_type, self.key_width)
+        self._active_overlap = om  # observability (tests/diagnostics)
+        try:
+            # feed the Segment itself: record_batch() (a full concat of
+            # the segment's chunks) then runs on the merge thread, not
+            # on the transport's completion thread
+            segments = self.fetch_all(job_id, map_ids, reduce_id,
+                                      on_segment=om.feed)
+        except Exception:
+            om.abort()
+            raise
+        with metrics.timer("merge"):
+            merged = om.finish([s.record_batch() for s in segments])
         return self.emit_framed(merged, consumer)
 
     def stop(self) -> None:

@@ -1,0 +1,271 @@
+"""Overlapped fetch/merge: the network-levitated property itself.
+
+The reference's entire reason to exist is that the merge runs WHILE
+fetches stream in (reference src/Merger/MergeManager.cc:47-182: arriving
+MOFs join the k-way heap; src/Merger/StreamRW.cc:462-590: the merge loop
+re-issues each segment's next chunk), so by the time the last map output
+lands, almost all comparison work is already done. The TPU-native shape
+of that property is NOT a record-at-a-time heap (which cannot use the
+VPU) but a **log-structured run forest**:
+
+- as each segment's fetch completes it is packed (host, vectorized) and
+  staged to the device as a sorted run, while later fetches are still
+  in flight;
+- runs merge pairwise on device with the O(n) Pallas merge-path kernel
+  (uda_tpu.ops.pallas_merge.merge_sorted_pair) under a binary-counter
+  policy: each run is padded to a power-of-two capacity and two runs of
+  equal capacity merge immediately into one of twice the capacity —
+  every record therefore moves through at most log2(k) merges, total
+  work O(n log k), and only O(log) distinct kernel shapes ever compile
+  (pallas_call executables are shape-specialized; unconstrained segment
+  sizes would compile a fresh kernel per (na, nb) pair);
+- ``finish()`` merges the O(log k) leftover runs, largest-capacity
+  last, and gathers the final byte permutation on host.
+
+Stability contract (identical to ops.merge.merge_batches): the device
+rows carry (key words, content length, segment index, row index) as the
+composite sort key, so equal comparator keys order by original (segment,
+row) arrival — independent of fetch COMPLETION order, which under a
+randomized fetch schedule is nondeterministic.
+
+Overflow fallback: keys whose content exceeds the carried width compare
+by overflow *rank*, which is only meaningful computed across ALL records
+(ops.packing.overflow_ranks). Rather than serialize rank computation,
+the forest detects oversize keys at staging and ``finish()`` falls back
+to the global device re-sort (merge_batches) — correctness never
+depends on the fast path applying. TeraSort-shaped keys (10 B <= width)
+always stay on the fast path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from uda_tpu.ops import merge as merge_ops
+from uda_tpu.ops import packing
+from uda_tpu.ops.pallas_merge import merge_sorted_pair
+from uda_tpu.utils.comparators import KeyType
+from uda_tpu.utils.errors import MergeError
+from uda_tpu.utils.ifile import RecordBatch
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["OverlappedMerger", "MIN_RUN_CAPACITY"]
+
+log = get_logger()
+
+MIN_RUN_CAPACITY = 512  # smallest padded run (= default merge tile)
+
+_PAD_WORD = np.uint32(0xFFFFFFFF)
+
+
+def _next_pow2(n: int) -> int:
+    p = MIN_RUN_CAPACITY
+    while p < n:
+        p *= 2
+    return p
+
+
+class _Run:
+    """One sorted run of the forest.
+
+    Rows are uint32[cap, C] with C = key words + 3: the composite key
+    (words..., content length, segment index, row index). Device
+    (pallas-engine) runs are padded to a power-of-two capacity with
+    all-0xFFFFFFFF rows, which sort strictly after every real row (a
+    real row's length column is a content length < 2^31), so valid rows
+    stay a prefix through any merge; host runs are exact-sized.
+
+    ``bucket`` is the binary-counter size class: staging assigns
+    next_pow2(valid), each merge doubles it — so every record passes
+    through at most log2(k) merges regardless of engine.
+    """
+
+    __slots__ = ("rows", "valid", "bucket")
+
+    def __init__(self, rows, valid: int, bucket: int):
+        self.rows = rows
+        self.valid = valid
+        self.bucket = bucket
+
+    @property
+    def capacity(self) -> int:
+        return int(self.rows.shape[0])
+
+
+class OverlappedMerger:
+    """Consumes completed segments during the fetch phase; produces the
+    final permutation over the concatenated batches.
+
+    ``engine`` selects the pairwise merge backend: "pallas" (the device
+    merge-path kernel; the real TPU path), "host" (vectorized numpy
+    lexsort merge — the correctness twin, and the fast choice where the
+    only accelerator is the XLA CPU backend, whose interpret-mode Pallas
+    emulation compiles an unrolled grid per shape), or "auto" (host on
+    CPU, pallas elsewhere).
+    """
+
+    def __init__(self, key_type: KeyType, width: int, engine: str = "auto"):
+        self.key_type = key_type
+        self.width = width
+        if engine == "auto":
+            engine = "host" if jax.default_backend() == "cpu" else "pallas"
+        if engine not in ("host", "pallas"):
+            raise MergeError(f"unknown overlap merge engine {engine!r}")
+        self.engine = engine
+        # off-TPU, a forced pallas engine runs in interpret mode
+        self.interpret = jax.default_backend() == "cpu"
+        self._q: "queue.Queue" = queue.Queue()
+        self._forest: dict[int, _Run] = {}   # capacity -> run
+        self._overflow = False
+        self._error: Optional[Exception] = None
+        self._merges = 0
+        self._staged = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="uda-overlap-merge")
+        self._thread.start()
+
+    # -- producer side (fetch completion callbacks, any thread) -------------
+
+    def feed(self, seg_index: int, source) -> None:
+        """Stage one completed segment's records (non-blocking; safe to
+        call from a transport completion thread). ``source`` is either a
+        RecordBatch or an object with a ``record_batch()`` method (a
+        Segment) — materialization happens on the merge thread."""
+        self._q.put((seg_index, source))
+
+    # -- merge thread --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._error is not None:
+                continue  # drain; finish() will surface the error
+            try:
+                self._stage(*item)
+            except Exception as e:  # surfaced at finish()
+                self._error = e
+
+    def _stage(self, seg_index: int, source) -> None:
+        if self._overflow:
+            return  # fast path already disabled; finish() re-sorts all
+        batch = (source if isinstance(source, RecordBatch)
+                 else source.record_batch())
+        if batch.num_records == 0:
+            return
+        with metrics.timer("overlap_pack"):
+            packed = packing.pack_keys(batch, self.key_type, self.width)
+        if int(np.max(packed.key_lens, initial=0)) > self.width:
+            # rank-bearing keys: cross-run rank consistency needs the
+            # global view; disable the fast path (see module docstring)
+            self._overflow = True
+            return
+        n = batch.num_records
+        kw = packed.key_words.shape[1]
+        # device runs pad to a power-of-two capacity (bounded set of
+        # kernel shapes); host runs stay exact-sized
+        cap = _next_pow2(n) if self.engine == "pallas" else n
+        rows = np.full((cap, kw + 3), _PAD_WORD, np.uint32)
+        rows[:n, :kw] = packed.key_words
+        rows[:n, kw] = packed.key_lens.astype(np.uint32)
+        rows[:n, kw + 1] = np.uint32(seg_index)
+        rows[:n, kw + 2] = np.arange(n, dtype=np.uint32)
+        # per-segment sort on host key order (vectorized lexsort over the
+        # composite; row index column is already arrival order)
+        order = np.lexsort(tuple(rows[:n, c] for c in range(kw, -1, -1)))
+        rows[:n] = rows[:n][order]
+        self._staged += 1
+        with metrics.timer("overlap_stage"):
+            if self.engine == "pallas":
+                rows = jax.device_put(rows)
+            self._insert(_Run(rows, n, _next_pow2(n)))
+
+    def _insert(self, run: _Run) -> None:
+        # binary-counter carry: equal size classes merge immediately
+        while run.bucket in self._forest:
+            other = self._forest.pop(run.bucket)
+            run = self._merge(other, run)
+        self._forest[run.bucket] = run
+
+    def _merge(self, a: _Run, b: _Run) -> _Run:
+        bucket = 2 * max(a.bucket, b.bucket)
+        with metrics.timer("overlap_device_merge"):
+            if self.engine == "host":
+                rows = np.concatenate([a.rows[:a.valid], b.rows[:b.valid]])
+                order = np.lexsort(tuple(
+                    rows[:, c] for c in range(rows.shape[1] - 1, -1, -1)))
+                merged = rows[order]
+            else:
+                # every column is part of the composite key (words, len,
+                # seg, row) — rows are totally ordered, so the kernel's
+                # internal tie-break never decides anything
+                merged = merge_sorted_pair(a.rows, b.rows,
+                                           num_keys=int(a.rows.shape[1]),
+                                           interpret=self.interpret)
+        self._merges += 1
+        return _Run(merged, a.valid + b.valid, bucket)
+
+    # -- consumer side -------------------------------------------------------
+
+    @property
+    def stats(self) -> dict:
+        """Counters for observability/tests: merges that have completed
+        and segments staged so far (both monotone)."""
+        return {"device_merges": self._merges, "staged_runs": self._staged,
+                "pending": self._q.qsize(), "overflow": self._overflow}
+
+    def finish(self, batches: Sequence[RecordBatch]) -> RecordBatch:
+        """Drain, merge the leftover forest, and materialize the sorted
+        batch. ``batches`` must be ALL segments' batches in original
+        segment-index order (the indices fed to :meth:`feed`)."""
+        self._q.put(None)
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+        if self._overflow:
+            log.warn("overlap fast path disabled (oversize keys); "
+                     "falling back to global device re-sort")
+            return merge_ops.merge_batches(batches, self.key_type,
+                                           self.width)
+        cat = RecordBatch.concat(list(batches))
+        if not self._forest:
+            return cat  # nothing staged (all segments empty)
+        # merge leftovers smallest-first; on the pallas engine, pad the
+        # smaller run up to the larger capacity first (padding rows sort
+        # last, so the validity prefix is preserved) — capacities stay
+        # powers of two, so kernel shapes stay in the O(log) compiled set
+        runs = [self._forest[c] for c in sorted(self._forest)]
+        acc = runs[0]
+        for nxt in runs[1:]:
+            if self.engine == "pallas" and acc.capacity < nxt.capacity:
+                pad = np.full((nxt.capacity - acc.capacity,
+                               int(acc.rows.shape[1])), _PAD_WORD, np.uint32)
+                acc = _Run(jnp.concatenate(
+                    [acc.rows, jax.device_put(pad)], axis=0), acc.valid,
+                    acc.bucket)
+            acc = self._merge(acc, nxt)
+        rows = np.asarray(acc.rows)[:acc.valid]
+        kw = rows.shape[1] - 3
+        seg_col = rows[:, kw + 1].astype(np.int64)
+        row_col = rows[:, kw + 2].astype(np.int64)
+        sizes = np.asarray([b.num_records for b in batches], np.int64)
+        offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+        perm = offsets[seg_col] + row_col
+        if perm.shape[0] != cat.num_records:
+            raise MergeError(
+                f"overlap merge lost records: {perm.shape[0]} of "
+                f"{cat.num_records} (segments fed != segments finished?)")
+        return cat.take(perm)
+
+    def abort(self) -> None:
+        """Stop the merge thread without producing output."""
+        self._q.put(None)
+        self._thread.join(timeout=5.0)

@@ -106,13 +106,27 @@ class Segment:
             self._notify_done()
             return
         try:
-            self._ingest(result)
+            last = self._ingest(result)
         except Exception as e:  # crack errors -> surfaced to the waiter
             self._error = e
             self._done.set()
             self._notify_done()
+            return
+        # notify exactly once, outside _ingest's try scope: an exception
+        # thrown by the on_done callback itself must NOT re-enter the
+        # error path above and fire on_done a second time (double credit
+        # release / double progress count)
+        if last:
+            self._done.set()
+            self._notify_done()
+        else:
+            self._issue(self._next_offset)
 
-    def _ingest(self, res: FetchResult) -> None:
+    def _ingest(self, res: FetchResult) -> bool:
+        """Absorb one chunk; returns True when the segment is complete.
+        Never calls callbacks and never touches them under self._lock —
+        the completion callback may call record_batch(), which takes the
+        same (non-reentrant) lock on this same thread."""
         with self._lock:
             self.raw_length = res.raw_length
             data = self._carry + res.data
@@ -122,21 +136,15 @@ class Segment:
                 # range with no records and no EOF marker, as foreign
                 # writers may produce for empty reducers)
                 self._carry = b""
-                self._done.set()
-                self._notify_done()
-                return
-            # crack up to the last complete record; keep the partial tail
-            batch, consumed, _ = crack_partial(data, expect_eof=last)
-            if batch.num_records:
-                self.batches.append(batch)
-            self._carry = data[consumed:] if not last else b""
-            self._next_offset = res.offset + len(res.data)
-            metrics.add("fetched_bytes", len(res.data))
-        if last:
-            self._done.set()
-            self._notify_done()
-        else:
-            self._issue(self._next_offset)
+            else:
+                # crack up to the last complete record; keep the tail
+                batch, consumed, _ = crack_partial(data, expect_eof=last)
+                if batch.num_records:
+                    self.batches.append(batch)
+                self._carry = data[consumed:] if not last else b""
+                self._next_offset = res.offset + len(res.data)
+                metrics.add("fetched_bytes", len(res.data))
+        return last
 
     # -- consumption --------------------------------------------------------
 

@@ -155,22 +155,12 @@ def _merge_tile_kernel(splits_ref, a_hbm, brev_hbm, out_ref, scratch_a,
     out_ref[...] = merged[:tile, :cols]
 
 
-def merge_sorted_pair(a, b, num_keys: int, tile: int = 512,
-                      interpret: bool = False):
-    """Merge two key-sorted row matrices into one (stable: A's rows
-    precede B's on equal keys). ``a``/``b``: uint32[n, W] with key words
-    in the leading ``num_keys`` columns. Row counts are padded up to the
-    tile internally; the output has a.shape[0]+b.shape[0] rows."""
-    if tile <= 0 or (tile & (tile - 1)) != 0:
-        raise ValueError(f"tile must be a power of two, got {tile} "
-                         "(the bitonic merge network requires it)")
-    a = jnp.asarray(a, jnp.uint32)
-    b = jnp.asarray(b, jnp.uint32)
+@partial(jax.jit, static_argnames=("num_keys", "tile", "interpret"))
+def _merge_sorted_pair_jit(a, b, num_keys: int, tile: int, interpret: bool):
+    """Shape-specialized core: jit so repeat calls at the same (na, nb)
+    hit the executable cache instead of re-tracing the pallas_call
+    (the overlapped merger calls this many times per job)."""
     na, nb, cols = a.shape[0], b.shape[0], a.shape[1]
-    if na == 0:
-        return b
-    if nb == 0:
-        return a
     total = na + nb
     num_tiles = (total + tile - 1) // tile
     padded = num_tiles * tile
@@ -204,3 +194,21 @@ def merge_sorted_pair(a, b, num_keys: int, tile: int = 512,
         interpret=interpret,
     )(splits, a, brev)
     return out[:total]
+
+
+def merge_sorted_pair(a, b, num_keys: int, tile: int = 512,
+                      interpret: bool = False):
+    """Merge two key-sorted row matrices into one (stable: A's rows
+    precede B's on equal keys). ``a``/``b``: uint32[n, W] with key words
+    in the leading ``num_keys`` columns. Row counts are padded up to the
+    tile internally; the output has a.shape[0]+b.shape[0] rows."""
+    if tile <= 0 or (tile & (tile - 1)) != 0:
+        raise ValueError(f"tile must be a power of two, got {tile} "
+                         "(the bitonic merge network requires it)")
+    a = jnp.asarray(a, jnp.uint32)
+    b = jnp.asarray(b, jnp.uint32)
+    if a.shape[0] == 0:
+        return b
+    if b.shape[0] == 0:
+        return a
+    return _merge_sorted_pair_jit(a, b, num_keys, tile, interpret)

@@ -91,6 +91,9 @@ _FLAG_LIST = [
          "reference's 1000-chunk server pool)"),
     Flag("uda.tpu.use.native", True, bool,
          "use the C++ native codec/reader library when built"),
+    Flag("uda.tpu.merge.overlap", True, bool,
+         "overlap device merge with fetching (the network-levitated "
+         "property); off = merge once after all fetches complete"),
     Flag("uda.tpu.spill.dirs", "", str,
          "comma-separated local dirs for LPQ spill files (round-robin, "
          "like the reference's local-dir rotation); empty = system tmp"),
