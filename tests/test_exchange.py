@@ -112,9 +112,54 @@ def test_distributed_sort_step_overflow_detected():
     words = _random_words(p * 64, 2, seed=5)
     words[:, 0] = 0  # all keys in partition 0 -> massive skew
     res = distributed_sort_step(words, uniform_splitters(p), mesh, AXIS,
-                                capacity=8, num_keys=1)
+                                capacity=8, num_keys=1, multiround="never")
     with pytest.raises(TransportError):
         res.check()
+
+
+def test_distributed_sort_auto_multiround_completes_skew():
+    # same massive skew, default policy: the multi-round backlog path
+    # must drain it completely with capacity << bucket size
+    mesh = _mesh()
+    p = 8
+    n = p * 64
+    words = _random_words(n, 3, seed=15)
+    words[:, 0] = 0  # every record to partition 0
+    res = distributed_sort_step(words, uniform_splitters(p), mesh, AXIS,
+                                capacity=8, num_keys=1)
+    res.check()
+    out = np.asarray(res.words).reshape(p, -1, 3)
+    nvalid = np.asarray(res.valid_counts).reshape(-1)
+    assert nvalid[0] == n and nvalid[1:].sum() == 0
+    got = out[0, :n]
+    assert sorted(map(tuple, got)) == sorted(map(tuple, words))
+    keys = got[:, 0].tolist()
+    assert keys == sorted(keys)
+
+
+def test_multiround_matches_fused_exactly():
+    # on non-overflowing data, "always" must produce the same per-shard
+    # valid rows as the fused single-round program (incl. duplicate-key
+    # (src, arrival) stability)
+    mesh = _mesh()
+    p = 8
+    n = p * 64
+    words = _random_words(n, 4, seed=16)
+    words[: n // 2, 0] = words[n // 2:, 0]  # duplicate first key words
+    spl = uniform_splitters(p)
+    fused = distributed_sort_step(words, spl, mesh, AXIS, capacity=n // p,
+                                  num_keys=2, multiround="never")
+    fused.check()
+    multi = distributed_sort_step(words, spl, mesh, AXIS, capacity=16,
+                                  num_keys=2, multiround="always")
+    multi.check()
+    fw = np.asarray(fused.words).reshape(p, -1, 4)
+    mw = np.asarray(multi.words).reshape(p, -1, 4)
+    fv = np.asarray(fused.valid_counts).reshape(-1)
+    mv = np.asarray(multi.valid_counts).reshape(-1)
+    assert fv.tolist() == mv.tolist()
+    for d in range(p):
+        np.testing.assert_array_equal(fw[d, :fv[d]], mw[d, :mv[d]])
 
 
 def test_sample_splitters_balance():

@@ -237,12 +237,19 @@ class OverlappedMerger:
                                            self.width)
         cat = RecordBatch.concat(list(batches))
         if not self._forest:
-            return cat  # nothing staged (all segments empty)
+            if cat.num_records:
+                # records exist but nothing was ever staged: the caller
+                # skipped feed() — returning cat here would silently
+                # emit UNSORTED data as the merge result
+                raise MergeError(
+                    f"overlap merge fed 0 of {cat.num_records} records")
+            return cat  # all segments legitimately empty
         # merge leftovers smallest-first; on the pallas engine, pad the
         # smaller run up to the larger capacity first (padding rows sort
         # last, so the validity prefix is preserved) — capacities stay
         # powers of two, so kernel shapes stay in the O(log) compiled set
         runs = [self._forest[c] for c in sorted(self._forest)]
+        self._forest = {}  # release device-resident runs when done
         acc = runs[0]
         for nxt in runs[1:]:
             if self.engine == "pallas" and acc.capacity < nxt.capacity:

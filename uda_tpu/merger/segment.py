@@ -159,11 +159,15 @@ class Segment:
         return self._done.is_set() and self._error is None
 
     def record_batch(self) -> RecordBatch:
-        """All records of the partition as one batch (fetch must be done)."""
+        """All records of the partition as one batch (fetch must be
+        done). The concat is cached: callers on different threads (the
+        overlap staging thread, then the finish pass) pay for it once."""
         self.wait()
         with self._lock:
             if len(self.batches) == 1:
                 return self.batches[0]
-            return RecordBatch.concat(self.batches)
+            cat = RecordBatch.concat(self.batches)
+            self.batches = [cat]
+            return cat
 
 
