@@ -1,0 +1,64 @@
+"""Microbench: native bulk framing vs the per-record Python writer.
+
+The emit/spill hot path (reference src/Merger/StreamRW.cc:151-225
+``write_kv_to_stream``, a C++ loop) must not degrade to per-record
+Python at TeraSort scale. Measures both FramedEmitter paths over the
+same sorted batch and prints the speedup.
+
+Run: python scripts/bench_emit.py [num_records]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    sys.path.insert(0, ".")
+    from uda_tpu import native
+    from uda_tpu.merger.emitter import FramedEmitter
+    from uda_tpu.utils.ifile import crack, write_records
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    rng = np.random.default_rng(0)
+    print(f"# building {n} records (10B keys / 90B values)...",
+          file=sys.stderr)
+    keys = rng.bytes(10 * n)
+    vals = rng.bytes(90 * n)
+    recs = [(keys[i * 10:(i + 1) * 10], vals[i * 90:(i + 1) * 90])
+            for i in range(n)]
+    batch = crack(write_records(recs))
+    block = 1 << 20
+    sink = {"bytes": 0}
+
+    def consumer(view) -> None:
+        sink["bytes"] += len(view)
+
+    def timed(fn) -> float:
+        best = float("inf")
+        for _ in range(3):
+            sink["bytes"] = 0
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    em = FramedEmitter(block)
+    t_py = timed(lambda: em.emit(iter(recs), consumer))
+    py_gbps = sink["bytes"] / t_py / 1e9
+    if not native.build():
+        print(f"python emit: {py_gbps:.2f} GB/s (native library not "
+              "built; no comparison)")
+        return
+    t_nat = timed(lambda: em.emit_batch(batch, consumer))
+    nat_gbps = sink["bytes"] / t_nat / 1e9
+    print(f"python per-record emit: {t_py:.3f}s ({py_gbps:.2f} GB/s)")
+    print(f"native bulk emit:       {t_nat:.3f}s ({nat_gbps:.2f} GB/s)")
+    print(f"speedup: {t_py / t_nat:.1f}x")
+
+
+if __name__ == "__main__":
+    main()

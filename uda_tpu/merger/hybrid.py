@@ -28,9 +28,9 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
 
+from uda_tpu import native
 from uda_tpu.ops import merge as merge_ops
-from uda_tpu.utils.errors import MergeError
-from uda_tpu.utils.ifile import IFileWriter, iter_file_records
+from uda_tpu.utils.ifile import iter_file_records
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -101,10 +101,12 @@ def run_hybrid(mm, job_id: str, map_ids: Sequence[str], reduce_id: int,
             spill_paths.append(path)
         with metrics.timer("lpq_spill"):
             with open(path, "wb") as f:
-                w = IFileWriter(f)
-                for k, v in merged.iter_records():
-                    w.append(k, v)
-                w.close()
+                # native bulk framing in bounded chunks replaces the
+                # per-record Python append loop (the hybrid write hot
+                # spot) while keeping the spill STREAMED — peak RAM is
+                # one chunk, not the multi-GB spill
+                for piece in native.iter_framed_chunks(merged):
+                    f.write(piece)
         return SuperSegment(path)
 
     try:

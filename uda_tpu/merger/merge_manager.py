@@ -149,9 +149,10 @@ class MergeManager:
         """Stream the sorted batch to ``consumer`` in IFile-framed blocks
         of at most the staging-buffer size (the dataFromUda contract:
         each call hands one filled KV block whose memory is only valid
-        during the call, reference UdaPlugin.java:368-402). Returns total
-        bytes emitted."""
-        return self.emitter.emit(merged.iter_records(), consumer)
+        during the call, reference UdaPlugin.java:368-402). Framing runs
+        through the native bulk framer when built (emit_batch). Returns
+        total bytes emitted."""
+        return self.emitter.emit_batch(merged, consumer)
 
     def run(self, job_id: str, map_ids: Sequence[str], reduce_id: int,
             consumer: Callable[[memoryview], None]) -> int:

@@ -111,32 +111,10 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
                                      split_axis=0, concat_axis=0,
                                      tiled=False).reshape(p)
         flat = recv.reshape(p * capacity, wcols)
-        # 4. local sort: invalid rows forced past every real key.
-        # payload_path="carry": all record columns ride the sort network
-        # (fastest runtime, but XLA variadic-sort compile time grows
-        # superlinearly in operand count — prohibitive on TPU
-        # remote-compile backends). "gather": a narrow sort computes the
-        # permutation, per-column gathers apply it (bounded compile).
+        # 4. local sort: invalid rows forced past every real key
         row = jnp.arange(p * capacity, dtype=jnp.int32)
         valid = (row % capacity) < jnp.take(recv_counts, row // capacity)
-        keycols = tuple(jnp.where(valid, flat[:, i], _INVALID)
-                        for i in range(num_keys))
-        if payload_path == "carry":
-            payload = tuple(flat[:, i] for i in range(wcols))
-            sorted_ops = lax.sort(
-                (*keycols, jnp.where(valid, 0, 1), *payload),
-                num_keys=num_keys + 1, is_stable=True)
-            out = jnp.stack(sorted_ops[num_keys + 1:], axis=1)
-        else:
-            # permutation from a narrow sort, applied per column ([n]
-            # gathers keep the SoA/no-lane-padding rationale of
-            # terasort.bench_step; a row gather on the [n, W] matrix
-            # would touch the 5x lane-padded layout)
-            *_, perm = lax.sort(
-                (*keycols, jnp.where(valid, 0, 1), row),
-                num_keys=num_keys + 1, is_stable=True)
-            out = jnp.stack(tuple(jnp.take(flat[:, i], perm, axis=0)
-                                  for i in range(wcols)), axis=1)
+        out = _sort_valid_rows(flat, valid, num_keys, payload_path)
         nvalid = jnp.sum(recv_counts)
         return out, nvalid[None], overflow[None]
 
@@ -200,30 +178,20 @@ def _round_scatter(words, dest, pos, acc, colbase, r, mesh, axis, capacity):
     so ONE compiled program serves every round.
     """
 
+    from uda_tpu.parallel.exchange import window_round_body
+
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
              out_specs=P(axis))
     def _go(w, d, q, acc, cb, rr):
         p = lax.psum(1, axis)
-        wcols = w.shape[1]
         lo = rr[0] * capacity
-        in_round = (q >= lo) & (q < lo + capacity)
-        slot = jnp.where(in_round, q - lo, capacity)
-        send = jnp.zeros((p, capacity + 1, wcols), w.dtype)
-        send = send.at[d, slot].set(w, mode="drop")
-        send_counts = jnp.bincount(
-            jnp.where(in_round, d, p), length=p + 1)[:p].astype(jnp.int32)
-        recv = lax.all_to_all(send[:, :capacity], axis, split_axis=0,
-                              concat_axis=0, tiled=False)
-        recv_counts = lax.all_to_all(send_counts[:, None], axis,
-                                     split_axis=0, concat_axis=0,
-                                     tiled=False).reshape(p)
-        flat = recv.reshape(p * capacity, wcols)
+        flat, recv_counts = window_round_body(w, d, q, lo, axis, capacity)
         row = jnp.arange(p * capacity, dtype=jnp.int32)
         peer = row // capacity
-        slot_r = row % capacity
-        valid = slot_r < jnp.take(recv_counts, peer)
-        idx = jnp.where(valid, jnp.take(cb[0], peer) + lo + slot_r,
+        slot = row % capacity
+        valid = slot < jnp.take(recv_counts, peer)
+        idx = jnp.where(valid, jnp.take(cb[0], peer) + lo + slot,
                         acc.shape[0])
         return acc.at[idx].set(flat, mode="drop")
 

@@ -38,8 +38,8 @@ from uda_tpu.utils.errors import TransportError
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.metrics import metrics
 
-__all__ = ["ShuffleLayout", "prepare_layout", "exchange_round",
-           "shuffle_exchange", "exchange_record_batches"]
+__all__ = ["ShuffleLayout", "prepare_layout", "window_round_body",
+           "exchange_round", "shuffle_exchange", "exchange_record_batches"]
 
 
 @dataclasses.dataclass
@@ -95,28 +95,43 @@ def prepare_layout(words: jax.Array, dest: jax.Array, mesh: Mesh,
     return ShuffleLayout(sw, sd, pos, np.asarray(counts), mesh, axis)
 
 
-@partial(jax.jit, static_argnames=("capacity", "axis", "mesh", "round_index"))
-def _round_impl(words, dest, pos, mesh, axis, capacity, round_index):
-    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
-             out_specs=(P(axis), P(axis)))
-    def _go(w, d, q):
-        p = lax.psum(1, axis)
-        wcols = w.shape[1]
-        lo = round_index * capacity
-        in_round = (q >= lo) & (q < lo + capacity)
-        slot = jnp.where(in_round, q - lo, capacity)  # overflow -> dropped row
-        send = jnp.zeros((p, capacity + 1, wcols), w.dtype)
-        send = send.at[d, slot].set(w, mode="drop")
-        send_counts = jnp.bincount(
-            jnp.where(in_round, d, p), length=p + 1)[:p].astype(jnp.int32)
-        recv = lax.all_to_all(send[:, :capacity], axis, split_axis=0,
-                              concat_axis=0, tiled=False)
-        recv_counts = lax.all_to_all(send_counts[:, None], axis,
-                                     split_axis=0, concat_axis=0,
-                                     tiled=False)
-        return recv.reshape(p * capacity, wcols), recv_counts.reshape(1, p)
+def window_round_body(w, d, q, lo, axis: str, capacity: int):
+    """One windowed exchange round, for use INSIDE a shard_map body (the
+    single definition of the round wire protocol — exchange_round and
+    the multiround scatter in uda_tpu.parallel.distributed both build on
+    it). ``lo`` (the window base, round * capacity) may be traced.
 
-    return _go(words, dest, pos)
+    Returns ``(flat, recv_counts)``: the local [P*capacity, W] delivery
+    (row block i = peer i's contribution) and per-peer valid counts [P].
+    """
+    p = lax.psum(1, axis)
+    wcols = w.shape[1]
+    in_round = (q >= lo) & (q < lo + capacity)
+    slot = jnp.where(in_round, q - lo, capacity)  # overflow -> dropped row
+    send = jnp.zeros((p, capacity + 1, wcols), w.dtype)
+    send = send.at[d, slot].set(w, mode="drop")
+    send_counts = jnp.bincount(
+        jnp.where(in_round, d, p), length=p + 1)[:p].astype(jnp.int32)
+    recv = lax.all_to_all(send[:, :capacity], axis, split_axis=0,
+                          concat_axis=0, tiled=False)
+    recv_counts = lax.all_to_all(send_counts[:, None], axis,
+                                 split_axis=0, concat_axis=0,
+                                 tiled=False).reshape(p)
+    return recv.reshape(p * capacity, wcols), recv_counts
+
+
+@partial(jax.jit, static_argnames=("capacity", "axis", "mesh"))
+def _round_impl(words, dest, pos, round_index, mesh, axis, capacity):
+    # round_index is TRACED: one compiled program serves every round
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis), P(axis), P(axis), P()),
+             out_specs=(P(axis), P(axis)))
+    def _go(w, d, q, r):
+        flat, recv_counts = window_round_body(w, d, q, r[0] * capacity,
+                                              axis, capacity)
+        return flat, recv_counts.reshape(1, -1)
+
+    return _go(words, dest, pos, round_index)
 
 
 def exchange_round(layout: ShuffleLayout, capacity: int, round_index: int):
@@ -126,8 +141,9 @@ def exchange_round(layout: ShuffleLayout, capacity: int, round_index: int):
     from each peer (``recv_words`` row-block i = peer i's contribution,
     of which ``recv_counts[i]`` rows are valid).
     """
-    return _round_impl(layout.words, layout.dest, layout.pos, layout.mesh,
-                       layout.axis, capacity, round_index)
+    return _round_impl(layout.words, layout.dest, layout.pos,
+                       jnp.asarray([round_index], jnp.int32),
+                       layout.mesh, layout.axis, capacity)
 
 
 def shuffle_exchange(words, dest, mesh: Mesh, axis: str,

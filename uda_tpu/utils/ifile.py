@@ -40,7 +40,7 @@ from uda_tpu.utils.errors import StorageError
 
 __all__ = ["IFileWriter", "IFileReader", "RecordBatch", "crack",
            "crack_partial", "iter_file_records", "write_records",
-           "set_native_enabled"]
+           "set_native_enabled", "native_enabled"]
 
 EOF_MARKER = b"\xff\xff"  # VInt(-1) VInt(-1)
 
@@ -55,6 +55,12 @@ def set_native_enabled(enabled: bool) -> None:
     """Toggle the native codec (the ``uda.tpu.use.native`` flag's hook)."""
     global _native_enabled
     _native_enabled = enabled
+
+
+def native_enabled() -> bool:
+    """Whether native dispatch is allowed (the kill switch state; says
+    nothing about whether the library is built)."""
+    return _native_enabled
 
 
 def _native_mod():
