@@ -80,6 +80,35 @@ class DistributedSortResult:
                 "shuffle_exchange's multi-round path")
 
 
+def _sort_valid_rows(flat, valid, num_keys, payload_path):
+    """Stable local sort of ``flat``'s rows by the first ``num_keys``
+    columns, with ``valid``-masked rows forced past every real key (the
+    shared tail of the fused step and the multi-round accumulator sort).
+
+    payload_path="carry": all record columns ride the sort network
+    (fastest runtime, but XLA variadic-sort compile time grows
+    superlinearly in operand count — prohibitive on TPU remote-compile
+    backends). "gather": a narrow sort computes the permutation and
+    per-column gathers apply it (bounded compile; [n] gathers keep the
+    SoA/no-lane-padding rationale of terasort.bench_step — a row gather
+    on the [n, W] matrix would touch the lane-padded layout)."""
+    n, wcols = flat.shape
+    keycols = tuple(jnp.where(valid, flat[:, i], _INVALID)
+                    for i in range(num_keys))
+    invalid_last = jnp.where(valid, 0, 1)
+    if payload_path == "carry":
+        payload = tuple(flat[:, i] for i in range(wcols))
+        sorted_ops = lax.sort(
+            (*keycols, invalid_last, *payload),
+            num_keys=num_keys + 1, is_stable=True)
+        return jnp.stack(sorted_ops[num_keys + 1:], axis=1)
+    row = jnp.arange(n, dtype=jnp.int32)
+    *_, perm = lax.sort((*keycols, invalid_last, row),
+                        num_keys=num_keys + 1, is_stable=True)
+    return jnp.stack(tuple(jnp.take(flat[:, i], perm, axis=0)
+                           for i in range(wcols)), axis=1)
+
+
 @partial(jax.jit, static_argnames=("mesh", "axis", "capacity", "num_keys",
                                    "payload_path"))
 def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
@@ -209,21 +238,8 @@ def _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path):
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
              out_specs=P(axis))
     def _go(a, nv):
-        n, wcols = a.shape
-        row = jnp.arange(n, dtype=jnp.int32)
-        valid = row < nv[0]
-        keycols = tuple(jnp.where(valid, a[:, i], _INVALID)
-                        for i in range(num_keys))
-        if payload_path == "carry":
-            payload = tuple(a[:, i] for i in range(wcols))
-            sorted_ops = lax.sort(
-                (*keycols, jnp.where(valid, 0, 1), *payload),
-                num_keys=num_keys + 1, is_stable=True)
-            return jnp.stack(sorted_ops[num_keys + 1:], axis=1)
-        *_, perm = lax.sort((*keycols, jnp.where(valid, 0, 1), row),
-                            num_keys=num_keys + 1, is_stable=True)
-        return jnp.stack(tuple(jnp.take(a[:, i], perm, axis=0)
-                               for i in range(wcols)), axis=1)
+        row = jnp.arange(a.shape[0], dtype=jnp.int32)
+        return _sort_valid_rows(a, row < nv[0], num_keys, payload_path)
 
     return _go(acc, nvalid)
 
