@@ -108,22 +108,44 @@ def _compile_and_check(path: str) -> None:
 
 
 def _probe(path: str, timeout: float) -> bool:
-    """Compile `path` in a subprocess under a wall-clock cap."""
+    """Compile `path` in a subprocess under a wall-clock cap.
+
+    Failures must stay diagnosable after the fact: the subprocess runs
+    with JAX_TRACEBACK_FILTERING=off and its FULL stderr persists to
+    .bench_probe_<path>.log next to this file (the last-3-lines tail of
+    a filtered JAX traceback is boilerplate, useless for debugging)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_TRACEBACK_FILTERING="off")
+    log = os.path.join(here, f".bench_probe_{path}.log")
     t0 = time.perf_counter()
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--probe", path],
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-        timeout=None if timeout <= 0 else timeout,
-        capture_output=True, text=True, check=False,
-    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe", path],
+            cwd=here, env=env,
+            timeout=None if timeout <= 0 else timeout,
+            capture_output=True, text=True, check=False,
+        )
+        ok, stderr = proc.returncode == 0, proc.stderr or ""
+        verdict = "ok" if ok else "failed"
+    except subprocess.TimeoutExpired as e:
+        ok = False
+        err = e.stderr  # whatever the subprocess wrote before the kill
+        stderr = (err.decode(errors="replace")
+                  if isinstance(err, bytes) else err) or ""
+        verdict = f"compile exceeded {timeout:.0f}s budget"
     dt = time.perf_counter() - t0
-    ok = proc.returncode == 0
-    print(f"# probe {path}: {'ok' if ok else 'failed'} in {dt:.0f}s",
-          file=sys.stderr)
-    if not ok:
-        tail = (proc.stderr or "").strip().splitlines()[-3:]
-        for line in tail:
+    print(f"# probe {path}: {verdict} in {dt:.0f}s", file=sys.stderr)
+    if ok:
+        # drop any stale failure log so post-hoc diagnosis never reads
+        # a traceback that predates the code that fixed it
+        if os.path.exists(log):
+            os.remove(log)
+    else:
+        with open(log, "w") as f:
+            f.write(stderr)
+        for line in stderr.strip().splitlines()[-10:]:
             print(f"#   {line}", file=sys.stderr)
+        print(f"#   full stderr: {log}", file=sys.stderr)
     return ok
 
 
@@ -134,13 +156,9 @@ def main() -> None:
 
     chosen = None
     for path in PATHS:
-        try:
-            if _probe(path, PROBE_TIMEOUT):
-                chosen = path
-                break
-        except subprocess.TimeoutExpired:
-            print(f"# probe {path}: compile exceeded {PROBE_TIMEOUT:.0f}s "
-                  "budget, falling back", file=sys.stderr)
+        if _probe(path, PROBE_TIMEOUT):
+            chosen = path
+            break
     if chosen is None:
         raise SystemExit("no bench path compiled within budget")
 

@@ -156,7 +156,10 @@ def _tile_sort(x, tile: int, num_keys: int, tb_row: int, alternate: bool,
         grid=(n // tile,),
         in_specs=[pl.BlockSpec((rows, tile), lambda t: (0, t))],
         out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
-        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32),
+        # vma propagates the caller's shard_map varying-axes set, so the
+        # pipeline works as-is inside distributed shard_map bodies
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
+                                       vma=jax.typeof(x).vma),
         interpret=interpret,
     )(x)
 
@@ -180,9 +183,10 @@ def _pass_splits(x, run_len, final, tile: int, num_keys: int, tb_row: int):
     tie-break ordering decides naturally.
 
     Returns int32[num_tiles, 8] rows
-    (a_align, roll_a, thr_a, b_align, roll_b, thr_b, out_asc, 0):
-    per side an aligned superwindow start, the cyclic roll that places
-    the wanted first record at lane 0, and the invalid-lane threshold
+    (a_blk, shift_a, thr_a, b_blk, shift_b, thr_b, out_asc, 0):
+    per side an aligned superwindow start (in lane-block units), the
+    non-negative cyclic lane shift in [0, win) that places the wanted
+    first record at lane 0, and the invalid-lane threshold
     (A: lanes >= thr_a are past the run end; B: lanes < thr_b are below
     B'[j0]); see _merge_pass_kernel for how they are applied.
     """
@@ -236,13 +240,21 @@ def _pass_splits(x, run_len, final, tile: int, num_keys: int, tb_row: int):
     b_clamp = b_base + jnp.maximum(0, L - j0 - tile)
     b_align = jnp.minimum((b_clamp // _LANE) * _LANE, n - win)
     roll_b = inv - (b_clamp - b_align)
-    cols = [a_align, roll_a, thr_a, b_align, roll_b, inv,
+    # aligned starts ship as LANE-BLOCK indices; the kernel multiplies
+    # by _LANE so Mosaic can statically prove the HBM slice offset is
+    # lane-divisible (a raw traced offset fails its divisibility check).
+    # Roll amounts are normalized to [0, win): hardware pltpu.roll
+    # miscomputes NEGATIVE dynamic shifts (interpret mode is fine), so
+    # only non-negative cyclic shifts may reach the kernel.
+    shift_a = jnp.mod(-roll_a, win)
+    shift_b = jnp.mod(roll_b, win)
+    cols = [a_align // _LANE, shift_a, thr_a, b_align // _LANE, shift_b, inv,
             out_asc.astype(jnp.int32), jnp.zeros_like(a_align)]
     return jnp.stack([c.astype(jnp.int32) for c in cols], axis=1)
 
 
 def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
-                       *, tile, num_keys, tb_row):
+                       *, tile, num_keys, tb_row, split_blk):
     """One output tile of one merge pass (see _pass_splits for the rank
     bookkeeping; every pass-dependent scalar arrives via splits_ref, so
     this kernel compiles once and serves all log2(n/tile) passes).
@@ -260,14 +272,14 @@ def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
     for ascending output, largest-T (positions [T, 2T) of the
     descending-direction network) for descending output."""
     rows = a_buf.shape[0]
-    t = pl.program_id(0)
-    a_align = splits_ref[t, 0]
-    roll_a = splits_ref[t, 1]
-    thr_a = splits_ref[t, 2]
-    b_align = splits_ref[t, 3]
-    roll_b = splits_ref[t, 4]
-    thr_b = splits_ref[t, 5]
-    out_asc = splits_ref[t, 6] != 0
+    s = pl.program_id(0) % split_blk     # this tile's row in the block
+    a_align = splits_ref[s, 0] * _LANE   # block idx -> provably aligned
+    shift_a = splits_ref[s, 1]           # non-negative cyclic shifts only
+    thr_a = splits_ref[s, 2]
+    b_align = splits_ref[s, 3] * _LANE
+    shift_b = splits_ref[s, 4]
+    thr_b = splits_ref[s, 5]
+    out_asc = splits_ref[s, 6] != 0
     win = tile + _LANE
 
     cp_a = pltpu.make_async_copy(x_hbm.at[:, pl.ds(a_align, win)], a_buf,
@@ -283,12 +295,12 @@ def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
     rowi = lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
     is_key_row = (rowi < num_keys) | (rowi == tb_row)
 
-    a_rows = pltpu.roll(a_buf[...], -roll_a, 1)[:, :tile]
+    a_rows = pltpu.roll(a_buf[...], shift_a, 1)[:, :tile]
     a_invalid = r_idx >= thr_a             # tail lanes past the run end
     a_rows = jnp.where(is_key_row & a_invalid,
                        jnp.broadcast_to(_INF, a_rows.shape), a_rows)
 
-    b_rows = pltpu.roll(b_buf[...], roll_b, 1)[:, :tile]
+    b_rows = pltpu.roll(b_buf[...], shift_b, 1)[:, :tile]
     b_invalid = r_idx < thr_b              # front lanes below B'[j0]
     b_rows = jnp.where(is_key_row & b_invalid,
                        jnp.broadcast_to(_INF, b_rows.shape), b_rows)
@@ -307,22 +319,31 @@ def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
 def _merge_pass(x, splits, tile: int, num_keys: int, tb_row: int,
                 interpret: bool = False):
     rows, n = x.shape
+    # The splits table is BLOCKED into SMEM a few rows per grid step: a
+    # whole-table scalar prefetch would put [num_tiles, 8] int32 in SMEM
+    # with the minor dim padded to 128 lanes — 4 MB at n=8M vs the 1 MB
+    # SMEM budget. An (8, 8) block is 256 bytes regardless of n (the
+    # lowering wants the sublane block dim divisible by 8 or equal to
+    # the array dim, hence 8 rows — the kernel picks its row by
+    # program_id % 8).
+    split_blk = min(8, n // tile)
     return pl.pallas_call(
         partial(_merge_pass_kernel, tile=tile, num_keys=num_keys,
-                tb_row=tb_row),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(n // tile,),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec((rows, tile), lambda t, s: (0, t)),
-            scratch_shapes=[
-                pltpu.VMEM((rows, tile + _LANE), jnp.uint32),
-                pltpu.VMEM((rows, tile + _LANE), jnp.uint32),
-                pltpu.SemaphoreType.DMA,
-                pltpu.SemaphoreType.DMA,
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32),
+                tb_row=tb_row, split_blk=split_blk),
+        grid=(n // tile,),
+        in_specs=[pl.BlockSpec((split_blk, 8),
+                               lambda t: (t // split_blk, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, tile + _LANE), jnp.uint32),
+            pltpu.VMEM((rows, tile + _LANE), jnp.uint32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
+                                       vma=jax.typeof(x).vma),
         interpret=interpret,
     )(splits, x)
 
