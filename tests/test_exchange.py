@@ -162,6 +162,51 @@ def test_multiround_matches_fused_exactly():
         np.testing.assert_array_equal(fw[d, :fv[d]], mw[d, :mv[d]])
 
 
+def test_lanes_payload_path_matches_gather_exactly():
+    # the Pallas lanes engine (interpret mode on the CPU mesh) must
+    # reproduce the gather path byte-for-byte: identical sort key
+    # (masked key words, invalid flag) and identical equal-key arrival
+    # order — including the invalid tail rows and the non-power-of-two
+    # shard sizes that exercise the +inf lane padding
+    mesh = _mesh()
+    p = 8
+    n = p * 48  # cap = n//p = 48, so each shard sorts p*cap = 384 rows:
+    #             not a power of two -> exercises the +inf lane padding
+    words = _random_words(n, 5, seed=23)
+    words[: n // 2, 0] = words[n // 2:, 0]  # duplicate first key words
+    spl = uniform_splitters(p)
+    kw = dict(capacity=n // p, num_keys=2, multiround="never")
+    gather = distributed_sort_step(words, spl, mesh, AXIS,
+                                   payload_path="gather", **kw)
+    gather.check()
+    lanes = distributed_sort_step(words, spl, mesh, AXIS,
+                                  payload_path="lanes", **kw)
+    lanes.check()
+    np.testing.assert_array_equal(np.asarray(gather.valid_counts),
+                                  np.asarray(lanes.valid_counts))
+    np.testing.assert_array_equal(np.asarray(gather.words),
+                                  np.asarray(lanes.words))
+
+
+def test_lanes_payload_path_multiround_skew():
+    # lanes engine under the windowed multi-round accumulator sort
+    mesh = _mesh()
+    p = 8
+    n = p * 64
+    words = _random_words(n, 3, seed=24)
+    words[:, 0] = 0  # every record to partition 0
+    res = distributed_sort_step(words, uniform_splitters(p), mesh, AXIS,
+                                capacity=8, num_keys=1,
+                                payload_path="lanes")
+    res.check()
+    out = np.asarray(res.words).reshape(p, -1, 3)
+    nvalid = np.asarray(res.valid_counts).reshape(-1)
+    assert nvalid[0] == n and nvalid[1:].sum() == 0
+    got = out[0, :n]
+    assert sorted(map(tuple, got)) == sorted(map(tuple, words))
+    assert got[:, 0].tolist() == sorted(got[:, 0].tolist())
+
+
 def test_sample_splitters_balance():
     rng = np.random.default_rng(6)
     # skewed distribution: half the mass near zero

@@ -39,17 +39,29 @@ __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
            "concat_packed", "resolve_sort_path"]
 
 
-def resolve_sort_path(path: str) -> str:
+def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
     """Resolve a payload-movement strategy name. "auto" picks
-    operand-carry on CPU (compile is cheap there) and permutation+gather
-    on accelerators — XLA's variadic-sort compile time grows
-    superlinearly in operand count, and on TPU remote-compile backends a
-    wide carry sort can take hours to compile. Resolution happens
-    EAGERLY, never inside a jitted trace: a trace-time choice would be
-    baked into the jit cache and survive a later platform switch."""
+    operand-carry on CPU (compile is cheap there) and, on accelerators,
+    the Pallas lanes pipeline when the caller supports it (``lanes_ok``)
+    or permutation+gather otherwise — XLA's variadic-sort compile time
+    grows superlinearly in operand count, and on TPU remote-compile
+    backends a wide carry sort can take hours to compile, while the
+    lanes pipeline is two Mosaic kernels regardless of width. Resolution
+    happens EAGERLY, never inside a jitted trace: a trace-time choice
+    would be baked into the jit cache and survive a later platform
+    switch."""
+    valid = ("carry", "gather", "lanes") if lanes_ok else ("carry", "gather")
     if path == "auto":
-        path = "carry" if jax.default_backend() == "cpu" else "gather"
-    if path not in ("carry", "gather"):
+        backend = jax.default_backend()
+        if backend == "cpu":
+            path = "carry"
+        elif lanes_ok and backend == "tpu":
+            # the lanes pipeline is Mosaic-TPU only; any other
+            # accelerator gets the universally-lowerable gather path
+            path = "lanes"
+        else:
+            path = "gather"
+    if path not in valid:
         raise ValueError(f"unknown sort path {path!r}")
     return path
 

@@ -41,6 +41,32 @@ __all__ = ["uniform_splitters", "sample_splitters", "distributed_sort_step",
 _INVALID = jnp.uint32(0xFFFFFFFF)
 
 
+def _lanes_interpret(payload_path: str, mesh: Mesh) -> bool:
+    """Pallas interpret-mode flag for the lanes path, resolved EAGERLY
+    off the MESH's device platform (CPU meshes — tests, dryruns — have
+    no Mosaic lowering, even when the host's default backend is a TPU).
+    False for every other path so it never splits their jit cache."""
+    return (payload_path == "lanes"
+            and mesh.devices.flat[0].platform == "cpu")
+
+
+def _resolve_payload_path(path: str, wcols: int, num_keys: int) -> str:
+    """resolve_sort_path with the lanes option, plus the width gate:
+    "auto" only picks lanes when the record fits the 32-row lanes layout
+    (num_keys masked keys + invalid flag + wcols payload + tie-break);
+    wider records fall back to gather instead of failing later. An
+    EXPLICIT "lanes" request is passed through and fails loudly in
+    _sort_valid_rows_lanes if too wide."""
+    from uda_tpu.ops import pallas_sort
+    from uda_tpu.ops.sort import resolve_sort_path
+
+    resolved = resolve_sort_path(path, lanes_ok=True)
+    if (resolved == "lanes" and path == "auto"
+            and num_keys + 1 + wcols > pallas_sort.TB_ROW_DEFAULT):
+        return "gather"
+    return resolved
+
+
 def uniform_splitters(num_partitions: int) -> np.ndarray:
     """Range splitters on the first key word for uniformly distributed
     keys (TeraSort's keyspace): partition i covers
@@ -80,19 +106,27 @@ class DistributedSortResult:
                 "shuffle_exchange's multi-round path")
 
 
-def _sort_valid_rows(flat, valid, num_keys, payload_path):
+def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
     """Stable local sort of ``flat``'s rows by the first ``num_keys``
     columns, with ``valid``-masked rows forced past every real key (the
     shared tail of the fused step and the multi-round accumulator sort).
 
-    payload_path="carry": all record columns ride the sort network
-    (fastest runtime, but XLA variadic-sort compile time grows
-    superlinearly in operand count — prohibitive on TPU remote-compile
-    backends). "gather": a narrow sort computes the permutation and
-    per-column gathers apply it (bounded compile; [n] gathers keep the
-    SoA/no-lane-padding rationale of terasort.bench_step — a row gather
-    on the [n, W] matrix would touch the lane-padded layout)."""
+    payload_path="lanes": the Pallas bitonic pipeline
+    (ops.pallas_sort.sort_lanes) — bounded compile (two Mosaic kernels
+    regardless of n and width) AND streaming payload movement; the TPU
+    default. The (masked keys, invalid flag) sort key rides as lanes
+    rows, stability via the pipeline's arrival tie-break, so equal-key
+    order is IDENTICAL to the lax.sort paths below. "carry": all record
+    columns ride the sort network (fast runtime, but XLA variadic-sort
+    compile time grows superlinearly in operand count — prohibitive on
+    TPU remote-compile backends). "gather": a narrow sort computes the
+    permutation and per-column gathers apply it (bounded compile; [n]
+    gathers keep the SoA/no-lane-padding rationale of
+    terasort.bench_step — a row gather on the [n, W] matrix would touch
+    the lane-padded layout)."""
     n, wcols = flat.shape
+    if payload_path == "lanes":
+        return _sort_valid_rows_lanes(flat, valid, num_keys, interpret)
     keycols = tuple(jnp.where(valid, flat[:, i], _INVALID)
                     for i in range(num_keys))
     invalid_last = jnp.where(valid, 0, 1)
@@ -109,10 +143,48 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path):
                            for i in range(wcols)), axis=1)
 
 
+def _sort_valid_rows_lanes(flat, valid, num_keys, interpret):
+    """Lanes-path body of _sort_valid_rows: pack rows into the [32, n]
+    lanes layout with sort key (masked key words, invalid flag), pad the
+    lane count to a power of two with +inf-key lanes, run the Pallas
+    pipeline, unpack the payload rows.
+
+    Order parity with the lax.sort paths: identical sort key, and the
+    pipeline's arrival-index tie-break == their stable row order. The
+    padding lanes share the invalid rows' (+inf, 1) key but have LARGER
+    arrival indices than every real lane, so they sort strictly after
+    all real rows and truncating back to n lanes drops exactly them."""
+    from uda_tpu.ops import pallas_sort
+
+    n, wcols = flat.shape
+    first_pay = num_keys + 1             # payload starts past the flag row
+    tb = pallas_sort.TB_ROW_DEFAULT
+    if first_pay + wcols > tb:
+        raise ValueError(
+            f"record width {wcols} + {num_keys} keys does not fit the "
+            f"{pallas_sort.ROWS}-row lanes layout; use payload_path="
+            "'gather'")
+    npad = max(128, 1 << (n - 1).bit_length())
+    tile = min(1024, npad)
+    mat = jnp.full((pallas_sort.ROWS, npad), _INVALID, jnp.uint32)
+    keyrows = jnp.stack([jnp.where(valid, flat[:, i], _INVALID)
+                         for i in range(num_keys)]
+                        + [jnp.where(valid, jnp.uint32(0), jnp.uint32(1))])
+    mat = lax.dynamic_update_slice(mat, keyrows, (0, 0))
+    mat = lax.dynamic_update_slice(mat, flat.T, (first_pay, 0))
+    # padding lanes keep _INVALID in the flag row too: (keys +inf,
+    # flag +inf) sorts strictly after real invalid lanes' (keys +inf,
+    # flag 1), so no arrival-index comparison against padding ever
+    # decides a real lane's position
+    out = pallas_sort.sort_lanes(mat, num_keys=num_keys + 1, tb_row=tb,
+                                 tile=tile, interpret=interpret)
+    return out[first_pay:first_pay + wcols, :n].T
+
+
 @partial(jax.jit, static_argnames=("mesh", "axis", "capacity", "num_keys",
-                                   "payload_path"))
+                                   "payload_path", "interpret"))
 def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
-               payload_path="carry"):
+               payload_path="carry", interpret=False):
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
              out_specs=(P(axis), P(axis), P(axis)))
     def _go(w, spl):
@@ -143,7 +215,8 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
         # 4. local sort: invalid rows forced past every real key
         row = jnp.arange(p * capacity, dtype=jnp.int32)
         valid = (row % capacity) < jnp.take(recv_counts, row // capacity)
-        out = _sort_valid_rows(flat, valid, num_keys, payload_path)
+        out = _sort_valid_rows(flat, valid, num_keys, payload_path,
+                               interpret)
         nvalid = jnp.sum(recv_counts)
         return out, nvalid[None], overflow[None]
 
@@ -162,8 +235,10 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
     first ``num_keys`` columns are the big-endian key words).
     ``capacity``: per-(src, dst) records per round — the credit window.
     ``payload_path``: how the local sort moves value columns ("auto":
-    operand-carry on CPU meshes, permutation+gather on accelerators
-    where wide variadic sorts compile pathologically slowly).
+    operand-carry on CPU meshes, the Pallas lanes pipeline on
+    accelerators — bounded compile AND streaming payload movement; see
+    _sort_valid_rows for the trade-offs and the "carry"/"gather"
+    fallbacks).
     ``multiround``: skew completion policy. "auto" (default) runs the
     fused single-round program and, if any (src, dst) bucket overflowed
     the credit window, re-runs the shuffle through the windowed
@@ -173,9 +248,8 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
     completes). "never" reports overflow in the result (caller handles
     it); "always" skips the fused attempt.
     """
-    from uda_tpu.ops.sort import resolve_sort_path
-
-    payload_path = resolve_sort_path(payload_path)
+    payload_path = _resolve_payload_path(payload_path, int(words.shape[1]),
+                                         num_keys)
     if multiround not in ("auto", "never", "always"):
         raise ValueError(f"unknown multiround policy {multiround!r}")
     if multiround == "always":
@@ -186,7 +260,9 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
     splitters_dev = jax.device_put(jnp.asarray(splitters, dtype=jnp.uint32),
                                    NamedSharding(mesh, P()))
     out, nvalid, overflow = _sort_step(words, splitters_dev, mesh, axis,
-                                       capacity, num_keys, payload_path)
+                                       capacity, num_keys, payload_path,
+                                       interpret=_lanes_interpret(
+                                           payload_path, mesh))
     res = DistributedSortResult(out, nvalid, overflow)
     if multiround == "auto" and int(np.asarray(overflow).sum()) != 0:
         return distributed_sort_multiround(words, splitters, mesh, axis,
@@ -228,8 +304,9 @@ def _round_scatter(words, dest, pos, acc, colbase, r, mesh, axis, capacity):
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis", "num_keys",
-                                   "payload_path"))
-def _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path):
+                                   "payload_path", "interpret"))
+def _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,
+                interpret=False):
     """Local stable sort of the accumulated shard. The accumulator is
     already in (src peer, arrival) order, so a stable sort by (keys,
     valid flag) reproduces exactly the fused single-round program's
@@ -239,7 +316,8 @@ def _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path):
              out_specs=P(axis))
     def _go(a, nv):
         row = jnp.arange(a.shape[0], dtype=jnp.int32)
-        return _sort_valid_rows(a, row < nv[0], num_keys, payload_path)
+        return _sort_valid_rows(a, row < nv[0], num_keys, payload_path,
+                                interpret)
 
     return _go(acc, nvalid)
 
@@ -260,10 +338,10 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
     is compacted into the accumulator immediately (donated buffer), so
     nothing scales with the round count.
     """
-    from uda_tpu.ops.sort import resolve_sort_path
     from uda_tpu.parallel.exchange import prepare_layout
 
-    payload_path = resolve_sort_path(payload_path)
+    payload_path = _resolve_payload_path(payload_path, int(words.shape[1]),
+                                         num_keys)
     p = int(np.prod(list(mesh.shape.values())))
     spec = NamedSharding(mesh, P(axis))
     words = jax.device_put(words, spec)
@@ -296,6 +374,7 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
                              capacity)
         metrics.add("exchange_rounds")
     nvalid = jax.device_put(per_dst.astype(np.int32), spec)
-    out = _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path)
+    out = _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,
+                      interpret=_lanes_interpret(payload_path, mesh))
     overflow = jax.device_put(np.zeros(p, np.int32), spec)
     return DistributedSortResult(out, nvalid, overflow)
