@@ -253,11 +253,17 @@ def _pass_splits(x, run_len, final, tile: int, num_keys: int, tb_row: int):
     return jnp.stack([c.astype(jnp.int32) for c in cols], axis=1)
 
 
-def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
-                       *, tile, num_keys, tb_row, split_blk):
+def _merge_pass_kernel(splits_ref, splits_nxt_ref, x_hbm, o_ref, a_bufs,
+                       b_bufs, sem_a, sem_b, *, tile, num_keys, tb_row,
+                       split_blk):
     """One output tile of one merge pass (see _pass_splits for the rank
     bookkeeping; every pass-dependent scalar arrives via splits_ref, so
     this kernel compiles once and serves all log2(n/tile) passes).
+
+    DMA double buffering: the windows for tile t+1 (whose aligned starts
+    arrive via splits_nxt_ref, the splits table shifted by one row) are
+    DMA'd into the other scratch slot WHILE tile t's merge network runs,
+    so HBM latency overlaps compute across sequential grid steps.
 
     Window construction: each side DMAs a lane-aligned superwindow of
     tile+128 lanes (align floor-clamped so it never leaves the array),
@@ -271,36 +277,53 @@ def _merge_pass_kernel(splits_ref, x_hbm, o_ref, a_buf, b_buf, sem_a, sem_b,
     always land in the discarded half of the merge: smallest-T taken
     for ascending output, largest-T (positions [T, 2T) of the
     descending-direction network) for descending output."""
-    rows = a_buf.shape[0]
-    s = pl.program_id(0) % split_blk     # this tile's row in the block
-    a_align = splits_ref[s, 0] * _LANE   # block idx -> provably aligned
+    rows = a_bufs.shape[1]
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+    s = t % split_blk                    # this tile's row in the block
+    slot = t % 2
+    win = tile + _LANE
+
+    def issue(spl, slot):
+        a_cp = pltpu.make_async_copy(
+            x_hbm.at[:, pl.ds(spl[s, 0] * _LANE, win)], a_bufs.at[slot],
+            sem_a.at[slot])
+        b_cp = pltpu.make_async_copy(
+            x_hbm.at[:, pl.ds(spl[s, 3] * _LANE, win)], b_bufs.at[slot],
+            sem_b.at[slot])
+        a_cp.start()
+        b_cp.start()
+
+    @pl.when(t == 0)
+    def _():
+        issue(splits_ref, 0)
+
+    @pl.when(t + 1 < nt)
+    def _():
+        issue(splits_nxt_ref, (t + 1) % 2)
+
+    # wait for this tile's windows (issued at t-1, or just above for t=0)
+    pltpu.make_async_copy(x_hbm.at[:, pl.ds(0, win)], a_bufs.at[slot],
+                          sem_a.at[slot]).wait()
+    pltpu.make_async_copy(x_hbm.at[:, pl.ds(0, win)], b_bufs.at[slot],
+                          sem_b.at[slot]).wait()
+
     shift_a = splits_ref[s, 1]           # non-negative cyclic shifts only
     thr_a = splits_ref[s, 2]
-    b_align = splits_ref[s, 3] * _LANE
     shift_b = splits_ref[s, 4]
     thr_b = splits_ref[s, 5]
     out_asc = splits_ref[s, 6] != 0
-    win = tile + _LANE
-
-    cp_a = pltpu.make_async_copy(x_hbm.at[:, pl.ds(a_align, win)], a_buf,
-                                 sem_a)
-    cp_b = pltpu.make_async_copy(x_hbm.at[:, pl.ds(b_align, win)], b_buf,
-                                 sem_b)
-    cp_a.start()
-    cp_b.start()
-    cp_a.wait()
-    cp_b.wait()
 
     r_idx = lax.broadcasted_iota(jnp.int32, (1, tile), 1)
     rowi = lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
     is_key_row = (rowi < num_keys) | (rowi == tb_row)
 
-    a_rows = pltpu.roll(a_buf[...], shift_a, 1)[:, :tile]
+    a_rows = pltpu.roll(a_bufs[slot], shift_a, 1)[:, :tile]
     a_invalid = r_idx >= thr_a             # tail lanes past the run end
     a_rows = jnp.where(is_key_row & a_invalid,
                        jnp.broadcast_to(_INF, a_rows.shape), a_rows)
 
-    b_rows = pltpu.roll(b_buf[...], shift_b, 1)[:, :tile]
+    b_rows = pltpu.roll(b_bufs[slot], shift_b, 1)[:, :tile]
     b_invalid = r_idx < thr_b              # front lanes below B'[j0]
     b_rows = jnp.where(is_key_row & b_invalid,
                        jnp.broadcast_to(_INF, b_rows.shape), b_rows)
@@ -327,25 +350,27 @@ def _merge_pass(x, splits, tile: int, num_keys: int, tb_row: int,
     # the array dim, hence 8 rows — the kernel picks its row by
     # program_id % 8).
     split_blk = min(8, n // tile)
+    # splits shifted by one row: step t reads tile t+1's aligned starts
+    # for the double-buffered prefetch (last row duplicated, never used)
+    splits_nxt = jnp.concatenate([splits[1:], splits[-1:]], axis=0)
+    blk = pl.BlockSpec((split_blk, 8), lambda t: (t // split_blk, 0),
+                       memory_space=pltpu.SMEM)
     return pl.pallas_call(
         partial(_merge_pass_kernel, tile=tile, num_keys=num_keys,
                 tb_row=tb_row, split_blk=split_blk),
         grid=(n // tile,),
-        in_specs=[pl.BlockSpec((split_blk, 8),
-                               lambda t: (t // split_blk, 0),
-                               memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=[blk, blk, pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
         scratch_shapes=[
-            pltpu.VMEM((rows, tile + _LANE), jnp.uint32),
-            pltpu.VMEM((rows, tile + _LANE), jnp.uint32),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, rows, tile + _LANE), jnp.uint32),
+            pltpu.VMEM((2, rows, tile + _LANE), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
                                        vma=jax.typeof(x).vma),
         interpret=interpret,
-    )(splits, x)
+    )(splits, splits_nxt, x)
 
 
 def sort_lanes(x, num_keys: int, tb_row: int = TB_ROW_DEFAULT,
