@@ -12,7 +12,7 @@ from uda_tpu.utils.errors import CompressionError
 
 
 def _codecs():
-    out = [compress.get_codec("zlib")]
+    out = [compress.get_codec("zlib"), compress.get_codec("lzo")]
     try:
         out.append(compress.get_codec("snappy"))
     except CompressionError:
@@ -49,7 +49,7 @@ def test_truncated_block_stream():
         compress.decompress_block_stream(blob[:-3], codec)
 
 
-@pytest.mark.parametrize("codec_name", ["zlib", "snappy"])
+@pytest.mark.parametrize("codec_name", ["zlib", "snappy", "lzo"])
 def test_compressed_merge_end_to_end(tmp_path, codec_name):
     """Full engine path over compressed MOFs: writer compresses, the
     DecompressingClient feeds the merge, output matches the plain run."""
@@ -110,6 +110,69 @@ def test_compressed_wordcount_via_config(tmp_path):
     want = collections.Counter(
         m.group(0).lower() for m in re.finditer(rb"[A-Za-z0-9]+", text))
     assert got == dict(want)
+
+
+class TestLzo:
+    """LZO1X codec (reference src/Merger/LzoDecompressor.cc): the
+    pure-Python stream implementation, plus the dlopen'd liblzo2 path
+    when the library is present."""
+
+    @pytest.mark.parametrize("size", [0, 1, 2, 3, 4, 17, 18, 238, 239,
+                                      240, 493, 4096, 100_003])
+    def test_pure_python_round_trip(self, size):
+        from uda_tpu.compress.lzo import (lzo1x_compress_py,
+                                          lzo1x_decompress_py)
+
+        rng = __import__("numpy").random.default_rng(size)
+        data = rng.bytes(size)
+        blob = lzo1x_compress_py(data)
+        assert lzo1x_decompress_py(blob, size) == data
+
+    def test_decodes_match_tokens_m2(self):
+        # hand-built stream exercising an overlapping M2 match:
+        # initial 1-literal run 'a', M2 copy 7 from distance 1 with one
+        # trailing literal 'b' (state bits), end marker
+        from uda_tpu.compress.lzo import lzo1x_decompress_py
+
+        stream = bytes([18]) + b"a" + bytes([193, 0]) + b"b" + b"\x11\x00\x00"
+        assert lzo1x_decompress_py(stream, 9) == b"aaaaaaaab"
+
+    def test_decodes_match_tokens_m3(self):
+        # M3 match: copy "cdef" from distance 6 after "abcdefgh"
+        from uda_tpu.compress.lzo import lzo1x_decompress_py
+
+        stream = bytes([25]) + b"abcdefgh" + bytes([34, 20, 0]) \
+            + b"\x11\x00\x00"
+        assert lzo1x_decompress_py(stream, 12) == b"abcdefghcdef"
+
+    def test_malformed_streams_raise(self):
+        from uda_tpu.compress.lzo import lzo1x_decompress_py
+
+        with pytest.raises(CompressionError):
+            lzo1x_decompress_py(b"\x12a\x11\x00\x00", 5)  # wrong length
+        with pytest.raises(CompressionError):
+            lzo1x_decompress_py(bytes([25]) + b"abc", 8)  # truncated
+        with pytest.raises(CompressionError):
+            # match reaching before the start of the output
+            lzo1x_decompress_py(bytes([18]) + b"a" + bytes([193, 9])
+                                + b"b\x11\x00\x00", 9)
+
+    def test_native_cross_check(self):
+        # gated: only runs where liblzo2.so is installed (the reference's
+        # runtime dlopen dependency, LzoDecompressor.cc:83-127)
+        from uda_tpu.compress.lzo import (_native_compress,
+                                          _native_decompress,
+                                          lzo1x_compress_py,
+                                          lzo1x_decompress_py,
+                                          native_lzo_available)
+
+        if not native_lzo_available():
+            pytest.skip("liblzo2.so not installed")
+        data = (b"the quick brown fox " * 400) + bytes(range(256)) * 8
+        native_blob = _native_compress(data)
+        assert lzo1x_decompress_py(native_blob, len(data)) == data
+        py_blob = lzo1x_compress_py(data)
+        assert _native_decompress(py_blob, len(data)) == data
 
 
 def test_zlib_rejects_wrong_length_header():

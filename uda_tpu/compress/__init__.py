@@ -119,6 +119,12 @@ def _snappy_codec() -> Codec:
     return Codec("snappy", compress, decompress)
 
 
+def _lzo_codec() -> Codec:
+    from uda_tpu.compress.lzo import lzo_codec
+
+    return lzo_codec()
+
+
 # codec class-name registry: the createInputClient dispatch of reference
 # reducer.cc:412-450 (Lzo/Snappy by Java class name; Default = zlib)
 _REGISTRY: Dict[str, Callable[[], Codec]] = {
@@ -126,6 +132,9 @@ _REGISTRY: Dict[str, Callable[[], Codec]] = {
     "zlib": _zlib_codec,
     "org.apache.hadoop.io.compress.SnappyCodec": _snappy_codec,
     "snappy": _snappy_codec,
+    "com.hadoop.compression.lzo.LzoCodec": _lzo_codec,
+    "com.hadoop.compression.lzo.LzopCodec": _lzo_codec,
+    "lzo": _lzo_codec,
 }
 
 
@@ -193,9 +202,16 @@ class DecompressingClient(InputClient):
     block tail, DecompressorWrapper.cc:199-235).
     """
 
-    def __init__(self, inner: InputClient, codec: Codec):
+    def __init__(self, inner: InputClient, codec: Codec,
+                 comp_chunk_size: Optional[int] = None):
+        """``comp_chunk_size``: size of the compressed-domain inner
+        fetches — the `ratio` share of each buffer pair that the
+        reference dedicates to wire-compressed bytes (calculateMemPool,
+        reducer.cc:453-496, conf mapred.rdma.compression.buffer.ratio).
+        Defaults to the caller's uncompressed chunk size."""
         self.inner = inner
         self.codec = codec
+        self.comp_chunk_size = comp_chunk_size
         self._streams: dict[tuple, _StreamState] = {}
         self._lock = threading.Lock()
 
@@ -215,7 +231,8 @@ class DecompressingClient(InputClient):
                 f"(expected {st.delivered if st else 0})"))
             return
         inner_req = ShuffleRequest(req.job_id, req.map_id, req.reduce_id,
-                                   st.comp_offset, req.chunk_size)
+                                   st.comp_offset,
+                                   self.comp_chunk_size or req.chunk_size)
 
         def _done(res) -> None:
             if isinstance(res, Exception):
