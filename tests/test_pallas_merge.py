@@ -80,3 +80,16 @@ def test_pallas_tile_power_of_two_guard():
     a = np.zeros((4, 4), np.uint32)
     with pytest.raises(ValueError):
         pallas_merge.merge_sorted_pair(a, a, 2, tile=384)
+
+
+def test_merge_pair_max_width_31():
+    # W=31 fits: record words occupy rows 0..30, tie-break at row 31
+    a = _sorted_run(40, 31, 2, seed=7)
+    b = _sorted_run(30, 31, 2, seed=8)
+    got = np.asarray(pallas_merge.merge_sorted_pair(a, b, 2,
+                                                    interpret=True))
+    assert (got == _host_merge(a, b, 2)).all()
+    with pytest.raises(ValueError):
+        pallas_merge.merge_sorted_pair(
+            np.zeros((4, 32), np.uint32), np.zeros((4, 32), np.uint32), 2,
+            interpret=True)

@@ -1,27 +1,27 @@
-"""Pallas merge kernel: pairwise merge of sorted runs.
+"""Pallas merge: pairwise merge of sorted runs on the lanes engine.
 
 The device-native replacement for the reference's network-levitated
 incremental merge (reference src/Merger/MergeQueue.h:276-427: as each
 segment lands it joins the k-way heap). Whole-run ``lax.sort`` is
 O(n log n) and re-does all comparison work every time a new run lands;
-merging two already-sorted runs is O(n). This kernel implements the
-classic merge-path algorithm, TPU-style:
+merging two already-sorted runs is O(n).
 
-1. XLA side (``_merge_splits``): a vectorized binary search finds, for
-   each output tile of T rows, the (i, j) split of the merge diagonal —
-   how many rows of A and of B precede the tile. Multi-word lexicographic
-   key comparison, with A-before-B on ties (stability by arrival).
-2. Pallas side (``_merge_tile_kernel``): each grid step DMAs its A and B
-   slices from HBM (dynamic offsets from the prefetched splits), pads
-   them to T with +inf keys, concatenates A with *reversed* B — a
-   bitonic sequence — and runs a vectorized bitonic-merge network
-   (log2(2T) compare-exchange stages over whole rows) whose smallest T
-   rows are exactly the tile's output.
+Implementation: one merge PASS of the lanes bitonic pipeline
+(uda_tpu.ops.pallas_sort). The two runs are packed into the
+``uint32[32, 2L]`` lanes layout exactly the way the pipeline's tile
+sort would have left them — A ascending in lanes [0, L), B stored
+DESCENDING in lanes [L, 2L) (so the pair is bitonic as stored), with
+the arrival index in the tie-break row and +inf-key padding lanes on
+the ascending tail / descending front. ``_pass_splits`` +
+``_merge_pass`` then merge them like any other pass. This reuses the
+ONE merge kernel that is validated on real TPU hardware; the earlier
+row-matrix merge kernel variant was unloadable under Mosaic (minor-dim
+slices of a [tile, W] block violate the 128-lane tiling rule — the
+same layout problem that motivated the lanes design in the first
+place).
 
-Rows travel as uint32[*, W] with the first ``num_keys`` columns the
-big-endian key words (the uda_tpu.ops.packing layout). A tie-break
-column (global arrival index) is appended internally so the bitonic
-network — unstable by itself — reproduces stable merge order.
+Rows travel as uint32[n, W] with the first ``num_keys`` columns the
+big-endian key words (the uda_tpu.ops.packing layout); W <= 31.
 """
 
 from __future__ import annotations
@@ -33,10 +33,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from uda_tpu.ops import pallas_sort
+from uda_tpu.ops.pallas_sort import _merge_pass, _pass_splits
 
 __all__ = ["merge_sorted_pair", "merge_splits"]
+
+_INF = np.uint32(0xFFFFFFFF)
 
 
 def _key_less(a_cols, b_cols):
@@ -53,7 +55,10 @@ def _key_less(a_cols, b_cols):
 def merge_splits(a, b, tile: int, num_keys: int):
     """For each output tile boundary d = t*tile, the number of A rows in
     the first d merged rows (merge-path diagonal intersection). Returns
-    int32[num_tiles]. Vectorized binary search, 32 fixed iterations."""
+    int32[num_tiles]. Vectorized binary search, 32 fixed iterations.
+
+    (Host-callable analysis utility; the kernel path computes its
+    windows with pallas_sort._pass_splits instead.)"""
     na, nb = a.shape[0], b.shape[0]
     num_tiles = (na + nb + tile - 1) // tile
     d = jnp.arange(num_tiles, dtype=jnp.int32) * tile
@@ -82,131 +87,57 @@ def merge_splits(a, b, tile: int, num_keys: int):
     return lo.astype(jnp.int32)
 
 
-def _bitonic_merge_rows(rows, num_keys, total_cols):
-    """Vectorized bitonic merge of a bitonic sequence of rows.
-
-    ``rows``: [L, C] uint32 where columns [0, num_keys) are key words and
-    column C-1 is the tie-break index; L is a power of two. Returns rows
-    sorted ascending by (keys, tie-break).
-    """
-    L = rows.shape[0]
-    stride = L // 2
-    while stride >= 1:
-        x = rows.reshape(L // (2 * stride), 2, stride, total_cols)
-        lo, hi = x[:, 0], x[:, 1]
-        lo_keys = tuple(lo[..., c] for c in range(num_keys)) + (lo[..., total_cols - 1],)
-        hi_keys = tuple(hi[..., c] for c in range(num_keys)) + (hi[..., total_cols - 1],)
-        swap = _key_less(hi_keys, lo_keys)[..., None]
-        new_lo = jnp.where(swap, hi, lo)
-        new_hi = jnp.where(swap, lo, hi)
-        rows = jnp.stack([new_lo, new_hi], axis=1).reshape(L, total_cols)
-        stride //= 2
-    return rows
-
-
-def _merge_tile_kernel(splits_ref, a_hbm, brev_hbm, out_ref, scratch_a,
-                       scratch_b, sem_a, sem_b, *, tile, num_keys,
-                       na, nb, cols):
-    # Mosaic has no in-kernel `rev`: B arrives PRE-REVERSED (brev_hbm =
-    # flip of the tail-padded B, done in XLA before pallas_call), and the
-    # window is addressed from the end so it is already descending.
-    t = pl.program_id(0)
-    d = t * tile
-    i0 = splits_ref[t]
-    j0 = d - i0
-    # A window [i0, i0+tile): tail-padded input keeps the DMA in bounds
-    # (0 <= i0 <= na); invalid rows sit at the ASCENDING tail.
-    # B window: brev rows [nb - j0, nb - j0 + tile) correspond to
-    # original rows gb = j0 + tile - 1 - r (descending); rows past B's
-    # end (gb >= nb) sit at the DESCENDING front. +inf masking at tail /
-    # front respectively keeps the concatenation bitonic.
-    cp_a = pltpu.make_async_copy(a_hbm.at[pl.ds(i0, tile)], scratch_a, sem_a)
-    cp_b = pltpu.make_async_copy(brev_hbm.at[pl.ds(nb - j0, tile)],
-                                 scratch_b, sem_b)
-    cp_a.start()
-    cp_b.start()
-    cp_a.wait()
-    cp_b.wait()
-
-    inf = jnp.uint32(0xFFFFFFFF)
-    ridx = lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
-    ga = ridx + i0
-    gb = j0 + (tile - 1) - ridx
-    a_rows = scratch_a[...]
-    b_rows = scratch_b[...]
-    a_valid = (ga >= i0) & (ga < na)
-    b_valid = (gb >= j0) & (gb < nb)
-    # append tie-break column: global arrival index (A first on ties)
-    a_tb = jnp.where(a_valid, ga, jnp.int32(-1)).astype(jnp.uint32)
-    b_tb = jnp.where(b_valid, gb + na, jnp.int32(-1)).astype(jnp.uint32)
-    a_aug = jnp.concatenate([a_rows, a_tb], axis=1)
-    b_aug = jnp.concatenate([b_rows, b_tb], axis=1)
-    # invalid rows: key -> +inf so they sort last
-    def mask(rows_aug, valid):
-        key_mask = jnp.where(valid, rows_aug[:, :num_keys],
-                             jnp.full((tile, num_keys), inf))
-        return jnp.concatenate([key_mask, rows_aug[:, num_keys:]], axis=1)
-
-    a_aug = mask(a_aug, a_valid)
-    b_aug = mask(b_aug, b_valid)
-    # ascending A ++ descending B = bitonic sequence
-    seq = jnp.concatenate([a_aug, b_aug], axis=0)
-    merged = _bitonic_merge_rows(seq, num_keys, cols + 1)
-    out_ref[...] = merged[:tile, :cols]
-
-
 @partial(jax.jit, static_argnames=("num_keys", "tile", "interpret"))
 def _merge_sorted_pair_jit(a, b, num_keys: int, tile: int, interpret: bool):
     """Shape-specialized core: jit so repeat calls at the same (na, nb)
     hit the executable cache instead of re-tracing the pallas_call
     (the overlapped merger calls this many times per job)."""
-    na, nb, cols = a.shape[0], b.shape[0], a.shape[1]
-    total = na + nb
-    num_tiles = (total + tile - 1) // tile
-    padded = num_tiles * tile
-    splits = merge_splits(a, b, tile, num_keys)
-    # tail-pad each input by one tile: every window ds(start, tile) with
-    # start <= n is then in bounds, and invalid rows only ever appear at
-    # a window's tail (see kernel comment on bitonicity). B is flipped
-    # here (XLA) because Mosaic cannot reverse in-kernel.
-    a = jnp.pad(a, ((0, tile), (0, 0)))
-    brev = jnp.flip(jnp.pad(b, ((0, tile), (0, 0))), axis=0)
+    na, nb, wcols = a.shape[0], b.shape[0], a.shape[1]
+    rows = pallas_sort.ROWS
+    tb = pallas_sort.TB_ROW_DEFAULT
+    # a single merge pass only needs L % tile == 0 (sort_lanes' pass
+    # CASCADE is what needs powers of two), so ceil-to-tile padding
+    # avoids up-to-2x wasted lanes on the overlapped merger's hot path
+    L = max(tile, -(-max(na, nb) // tile) * tile)
 
-    out = pl.pallas_call(
-        partial(_merge_tile_kernel, tile=tile, num_keys=num_keys,
-                na=na, nb=nb, cols=cols),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(num_tiles,),
-            in_specs=[
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=pl.BlockSpec((tile, cols), lambda t, s: (t, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((tile, cols), jnp.uint32),
-                pltpu.VMEM((tile, cols), jnp.uint32),
-                pltpu.SemaphoreType.DMA,
-                pltpu.SemaphoreType.DMA,
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((padded, cols), jnp.uint32),
-        interpret=interpret,
-    )(splits, a, brev)
-    return out[:total]
+    def run_lanes(r, n, base, descending):
+        """[n, W] sorted run -> [rows, L] lanes block: record words in
+        rows [0, W), arrival index (base+i) in the tie-break row, +inf
+        keys/tie-break in the L-n padding lanes; optionally stored
+        descending (flip) so padding sits at the stored front."""
+        lanes = jnp.full((rows, L), _INF, jnp.uint32)
+        lanes = lax.dynamic_update_slice(lanes, r.T.astype(jnp.uint32),
+                                         (0, 0))
+        idx = jnp.arange(L, dtype=jnp.uint32)
+        tbvals = jnp.where(idx < n, base + idx, _INF)
+        lanes = lanes.at[tb].set(tbvals)
+        # payload rows of padding lanes: don't leak _INF into non-key
+        # rows of real lanes; padding lanes' payload is never read
+        return jnp.flip(lanes, axis=1) if descending else lanes
+
+    x = jnp.concatenate([run_lanes(a, na, 0, False),
+                         run_lanes(b, nb, na, True)], axis=1)
+    splits = _pass_splits(x, jnp.int32(L), jnp.bool_(True), tile,
+                          num_keys, tb)
+    out = _merge_pass(x, splits, tile, num_keys, tb, interpret=interpret)
+    return out[:wcols, :na + nb].T
 
 
 def merge_sorted_pair(a, b, num_keys: int, tile: int = 512,
                       interpret: bool = False):
     """Merge two key-sorted row matrices into one (stable: A's rows
     precede B's on equal keys). ``a``/``b``: uint32[n, W] with key words
-    in the leading ``num_keys`` columns. Row counts are padded up to the
-    tile internally; the output has a.shape[0]+b.shape[0] rows."""
-    if tile <= 0 or (tile & (tile - 1)) != 0:
-        raise ValueError(f"tile must be a power of two, got {tile} "
-                         "(the bitonic merge network requires it)")
+    in the leading ``num_keys`` columns, W <= 31. The output has
+    a.shape[0]+b.shape[0] rows."""
+    if tile <= 0 or (tile & (tile - 1)) != 0 or tile % 128:
+        raise ValueError(f"tile must be a power of two multiple of 128, "
+                         f"got {tile} (the lanes merge kernel requires "
+                         "it)")
     a = jnp.asarray(a, jnp.uint32)
     b = jnp.asarray(b, jnp.uint32)
+    if a.shape[1] > pallas_sort.TB_ROW_DEFAULT:
+        raise ValueError(f"{a.shape[1]} record words do not fit the "
+                         f"{pallas_sort.ROWS}-row lanes layout")
     if a.shape[0] == 0:
         return b
     if b.shape[0] == 0:
