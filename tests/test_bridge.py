@@ -229,3 +229,181 @@ def test_reinit_stops_previous_engine(tmp_path):
     with pytest.raises(StorageError):
         first_engine.fetch(ShuffleRequest("jobRe", "x", 0, 0, 10))
     bridge.reduce_exit()
+
+
+def _ref_init_params(job, reduce_id, num_maps, key_class="uda.tpu.RawBytes",
+                     lpq=0, buf=64 * 1024, min_buf=4096, codec="0",
+                     comp_block=0, shuffle_mem=1 << 30, dirs=()):
+    """The reference's 10-param INIT layout + num_dirs + dirs
+    (reducer.cc:56-133)."""
+    return ([str(num_maps), job, str(reduce_id), str(lpq), str(buf),
+             str(min_buf), key_class, codec, str(comp_block),
+             str(shuffle_mem), str(len(dirs))] + list(dirs))
+
+
+def test_init_reference_layout_end_to_end(tmp_path):
+    # the 10-param INIT must drive a full merge just like the short form
+    import functools
+    import io as _io
+
+    from uda_tpu.utils.ifile import IFileReader
+
+    job = "jobI10"
+    expected = make_mof_tree(str(tmp_path), job, 3, 1, 25, seed=31)
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, ["-w", "8"], harness)
+    bridge.do_command(form_cmd(Cmd.INIT, _ref_init_params(
+        job, 0, 3, dirs=[str(tmp_path)])))
+    for mid in map_ids(job, 3):
+        bridge.do_command(form_cmd(Cmd.FETCH, ["localhost", job, mid, "0"]))
+    bridge.do_command(form_cmd(Cmd.FINAL, []))
+    assert harness.fetch_over.wait(timeout=30)
+    bridge.reduce_exit()
+    assert not harness.failures, harness.failures
+    got = list(IFileReader(_io.BytesIO(b"".join(harness.blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    want = sorted(expected[0], key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want
+
+
+def test_init_memory_budget_shrinks_buffer():
+    # shuffleMemorySize caps the double-buffered pool: 8 maps -> 13
+    # pairs; 1 MiB buffers would need 26 MiB+, only 1 MiB given ->
+    # buffer shrinks to mem/(pairs*2), page-aligned
+    bridge = UdaBridge()
+    bridge.start(True, [], None)
+    bridge.do_command(form_cmd(Cmd.INIT, _ref_init_params(
+        "jobShrink", 0, 8, buf=1 << 20, min_buf=4096,
+        shuffle_mem=13 * 2 * 12288)))
+    assert not bridge.failed
+    # 12288 -> page-aligned 8192 (12288 % 4096 == 0 -> stays 12288)
+    assert bridge.cfg.get("mapred.rdma.buf.size") == 12288 // 1024
+    bridge.reduce_exit()
+
+
+def test_init_memory_budget_violation_falls_back():
+    # budget so small the shrunken buffer is under the minimum ->
+    # UdaException-equivalent -> fallback (reducer.cc:104-112)
+    failures = []
+
+    class FB:
+        def failure_in_uda(self, e):
+            failures.append(e)
+
+    bridge = UdaBridge()
+    bridge.start(True, [], FB())
+    bridge.do_command(form_cmd(Cmd.INIT, _ref_init_params(
+        "jobOOM", 0, 8, buf=1 << 20, min_buf=64 * 1024,
+        shuffle_mem=1 << 20)))
+    assert bridge.failed
+    assert failures and "Not enough memory" in str(failures[0])
+
+
+def test_init_tiny_aligned_buffer_falls_back():
+    failures = []
+
+    class FB:
+        def failure_in_uda(self, e):
+            failures.append(e)
+
+    bridge = UdaBridge()
+    bridge.start(True, [], FB())
+    # 2048B buffer page-aligns to 0 -> "RDMA Buffer is too small"
+    bridge.do_command(form_cmd(Cmd.INIT, _ref_init_params(
+        "jobTiny", 0, 1, buf=2048, min_buf=1024)))
+    assert bridge.failed
+    assert failures and "too small" in str(failures[0])
+
+
+def test_fetch_attempt_dedupe_and_obsolescence(tmp_path):
+    # duplicate attempt -> ignored; a NEW attempt for the same map task
+    # before FINAL replaces the stale one; after FINAL -> fallback
+    # (reference UdaShuffleConsumerPluginShared.java:568-589)
+    job = "jobDup"
+    make_mof_tree(str(tmp_path), job, 2, 1, 10, seed=33)
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    bridge.do_command(form_cmd(
+        Cmd.INIT, [job, "0", "2", "uda.tpu.RawBytes", str(tmp_path)]))
+    a0, a1 = map_ids(job, 2)
+    bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a0, "0"]))
+    bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a0, "0"]))  # dup
+    assert bridge._pending_maps == [a0]
+    # speculative re-execution: attempt _1 obsoletes attempt _0
+    a1_retry = a1[:-1] + "1"
+    bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a1, "0"]))
+    bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a1_retry, "0"]))
+    assert bridge._pending_maps == [a0, a1_retry]
+    bridge.do_command(form_cmd(Cmd.FINAL, []))
+    assert harness.fetch_over.wait(timeout=30)
+    # the retried attempt has no MOF on disk -> that failure is expected
+    # here; what matters is the pre-FINAL bookkeeping above and the
+    # post-FINAL contract below
+    harness.failures.clear()
+    bridge._failed = False
+    bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a0[:-1] + "9", "0"]))
+    assert harness.failures and "after the merge" in str(harness.failures[0])
+    bridge.reduce_exit()
+
+
+def test_init_reference_layout_compressed_job(tmp_path):
+    # codec class + block size in INIT params 7/8 switch the client to
+    # the decompressing path, with the compressed sub-buffer sized by
+    # mapred.rdma.compression.buffer.ratio (calculateMemPool,
+    # reducer.cc:453-496)
+    import functools
+    import io as _io
+
+    from uda_tpu.compress import DecompressingClient, get_codec
+    from uda_tpu.mofserver.writer import MOFWriter
+    from uda_tpu.utils.ifile import IFileReader
+
+    job = "jobIC"
+    codec = get_codec("zlib")
+    writer = MOFWriter(str(tmp_path), job, codec=codec)
+    rng = __import__("numpy").random.default_rng(41)
+    expected = []
+    for m in range(2):
+        recs = sorted((rng.bytes(10), rng.bytes(40)) for _ in range(60))
+        expected += recs
+        writer.write(f"attempt_{job}_m_{m:06d}_0", [recs])
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    bridge.do_command(form_cmd(Cmd.INIT, _ref_init_params(
+        job, 0, 2, codec="zlib", comp_block=4096, dirs=[str(tmp_path)])))
+    mm_client = bridge._mm.client
+    assert isinstance(mm_client, DecompressingClient)
+    buf_bytes = bridge.cfg.get("mapred.rdma.buf.size") * 1024
+    ratio = float(bridge.cfg.get("mapred.rdma.compression.buffer.ratio"))
+    assert mm_client.comp_chunk_size == int(buf_bytes * ratio)
+    for mid in writer.map_ids:
+        bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, mid, "0"]))
+    bridge.do_command(form_cmd(Cmd.FINAL, []))
+    assert harness.fetch_over.wait(timeout=30)
+    bridge.reduce_exit()
+    assert not harness.failures, harness.failures
+    got = list(IFileReader(_io.BytesIO(b"".join(harness.blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    want = sorted(expected, key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want
+
+
+def test_short_form_init_with_many_dirs_not_misrouted(tmp_path):
+    # a short-form INIT with 6+ local dirs has >= 10 params; the layout
+    # discriminator (numeric num_maps/lpq_size) must still route it to
+    # the short form instead of failing int(job_id)
+    job = "jobDirs"
+    make_mof_tree(str(tmp_path), job, 1, 1, 5, seed=51)
+    dirs = [str(tmp_path)] + [str(tmp_path / f"d{i}") for i in range(6)]
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    bridge.do_command(form_cmd(
+        Cmd.INIT, [job, "0", "1", "uda.tpu.RawBytes"] + dirs))
+    assert not bridge.failed and not harness.failures
+    bridge.reduce_exit()

@@ -111,6 +111,8 @@ class UdaBridge:
         self._reduce_id: Optional[int] = None
         self._key_class = "uda.tpu.RawBytes"
         self._pending_maps: list[str] = []
+        self._attempt_by_task: dict[str, str] = {}
+        self._merge_started = False
         self._merge_thread: Optional[threading.Thread] = None
         # supplier side
         self._engine: Optional[DataEngine] = None
@@ -192,22 +194,35 @@ class UdaBridge:
 
     # -- reduce side (reduce_downcall_handler, reducer.cc:144-217) ----------
 
+    PAGE = 4096  # buffer page alignment (reference getpagesize())
+
     def _reduce_downcall(self, header: Cmd, params: list[str]) -> None:
         if header == Cmd.INIT:
-            # reference INIT carries 10 fixed params + local dirs
-            # (reducer.cc:56-133); we take: job_id, reduce_id, num_maps,
-            # key_class, then optional local dirs
-            if len(params) < 4:
-                raise ProtocolError(f"INIT needs >= 4 params, got {len(params)}")
             if self._mm is not None or self._owned_engine is not None:
                 # re-INIT (a second reduce attempt on the same bridge):
                 # tear down the previous task first — the prior engine's
                 # thread pool / fd cache must not leak until process exit
                 self.reduce_exit()
-            self._job_id, rid, _num_maps, self._key_class = params[:4]
-            self._reduce_id = int(rid)
             self._pending_maps = []
-            client = self._make_client(params[4:])
+            self._attempt_by_task = {}
+            self._merge_started = False
+            if (len(params) >= 10 and params[0].isdigit()
+                    and params[3].isdigit()):
+                # reference layout: [0]=num_maps and [3]=lpq_size are
+                # numeric; in the short form [0] is the job id and [3]
+                # the key CLASS name — never all-digits — so a short
+                # form with many local dirs cannot be misrouted here
+                local_dirs = self._init_reference_layout(params)
+            elif len(params) >= 4:
+                # short form (embedder convenience): job_id, reduce_id,
+                # num_maps, key_class, then optional local dirs
+                self._job_id, rid, _num_maps, self._key_class = params[:4]
+                self._reduce_id = int(rid)
+                local_dirs = params[4:]
+            else:
+                raise ProtocolError(
+                    f"INIT needs >= 4 params, got {len(params)}")
+            client = self._make_client(local_dirs)
             self._mm = MergeManager(client, self._key_class, self.cfg)
         elif header == Cmd.FETCH:
             # reference FETCH: host:jobid:attemptid:partition
@@ -216,10 +231,11 @@ class UdaBridge:
             if len(params) < 4:
                 raise ProtocolError("FETCH needs 4 params")
             _host, job_id, map_attempt, _partition = params[:4]
-            self._pending_maps.append(map_attempt)
+            self._fetch_attempt(map_attempt)
         elif header == Cmd.FINAL:
             if self._mm is None:
                 raise UdaError("FINAL before INIT")
+            self._merge_started = True
             maps = list(self._pending_maps)
             self._merge_thread = threading.Thread(
                 target=self._merge_main, args=(maps,), daemon=True,
@@ -230,6 +246,99 @@ class UdaBridge:
         else:
             raise ProtocolError(f"unexpected command {header.name} for "
                                 "NetMerger role")
+
+    def _init_reference_layout(self, params: list[str]) -> list[str]:
+        """Parse the reference's 10-param INIT and validate the buffer
+        budget (handle_init_msg, reducer.cc:56-133):
+
+          0 num_maps, 1 job_id, 2 reduce_task_id, 3 lpq_size,
+          4 rdma_buf_size(B), 5 min_buf(B), 6 key class, 7 codec class,
+          8 comp block size(B), 9 shuffle memory size(B),
+          [10 num_dirs, 11.. dirs]
+
+        Buffer sizing mirrors the reference exactly: shrink the buffer
+        when the double-buffered pool would exceed shuffleMemorySize,
+        page-align, and fail (-> fallback) when the result drops under
+        the configured minimum."""
+        num_maps = int(params[0])
+        self._job_id = params[1]
+        self._reduce_id = int(params[2])
+        lpq_size = int(params[3])
+        max_buf = int(params[4])
+        min_buf = int(params[5])
+        self._key_class = params[6]
+        comp_alg = params[7]
+        comp_block = int(params[8])
+        shuffle_mem = int(params[9])
+
+        # buffer pairs the pool will hold: 2 per in-flight segment + the
+        # extra staging buffers (RDMA_BUFFERS_PER_SEGMENT=2 /
+        # EXTRA_RDMA_BUFFERS=10, reducer.cc:49-50 -> pairs = maps + 5)
+        kv_bufs = max(1, num_maps + 5)
+        if shuffle_mem < kv_bufs * max_buf * 2:  # 2: double buffering
+            max_buf = shuffle_mem // (kv_bufs * 2)
+            if max_buf < min_buf:
+                raise UdaError(
+                    f"Not enough memory for rdma buffers: "
+                    f"shuffleMemorySize={shuffle_mem}B with {kv_bufs} "
+                    f"double-buffered pairs needs >= "
+                    f"{kv_bufs * min_buf * 2}B")
+            log.warn(f"shrinking buffer to {max_buf}B to fit "
+                     f"shuffleMemorySize={shuffle_mem}B")
+        buffer_size = max_buf - max_buf % self.PAGE  # page alignment
+        if buffer_size <= 0 or buffer_size < min_buf:
+            raise UdaError(
+                f"RDMA Buffer is too small: {max_buf}B aligns to "
+                f"{buffer_size}B < min {min_buf}B")
+        self.cfg.set("mapred.rdma.buf.size", max(1, buffer_size // 1024))
+        if lpq_size:
+            self.cfg.set("mapred.netmerger.hybrid.lpq.size", lpq_size)
+        if comp_alg and comp_alg not in ("0", "null", "None"):
+            self.cfg.set("mapred.compress.map.output", True)
+            self.cfg.set("mapred.map.output.compression.codec", comp_alg)
+            if comp_block:
+                self.cfg.set("io.compression.codec.lzo.buffersize",
+                             comp_block)
+        num_dirs = int(params[10]) if len(params) > 10 else 0
+        return params[11:11 + num_dirs]
+
+    @staticmethod
+    def _attempt_task(attempt: str) -> str:
+        """Map-task identity of an attempt id: attempt_X_m_NNNNNN_A ->
+        task X_m_NNNNNN (the dedupe key of the reference's
+        GetMapEventsThread, UdaShuffleConsumerPluginShared.java:434-602).
+        Ids not shaped like attempts dedupe by full string."""
+        parts = attempt.rsplit("_", 1)
+        if (len(parts) == 2 and attempt.startswith("attempt_")
+                and parts[1].isdigit()):
+            return parts[0]
+        return attempt
+
+    def _fetch_attempt(self, map_attempt: str) -> None:
+        """Fetch-attempt hygiene (reference UdaShuffleConsumerPluginShared
+        .java:568-589): an exact duplicate attempt is dropped; a NEW
+        attempt for a map task whose earlier attempt is already merged
+        (or merging) cannot be un-merged -> failure_in_uda (the
+        obsolete-after-success fallback); before the merge starts the
+        newer attempt simply replaces the stale one."""
+        task = self._attempt_task(map_attempt)
+        existing = self._attempt_by_task.get(task)
+        if existing == map_attempt:
+            log.debug(f"duplicate fetch for {map_attempt}, ignored")
+            return
+        if self._merge_started:
+            raise UdaError(
+                f"map attempt {map_attempt} arrived after the merge "
+                f"started"
+                + (f" (obsoletes already-merged {existing})"
+                   if existing else ""))
+        if existing is not None:
+            log.warn(f"map attempt {existing} obsoleted by {map_attempt}")
+            self._pending_maps[self._pending_maps.index(existing)] = \
+                map_attempt
+        else:
+            self._pending_maps.append(map_attempt)
+        self._attempt_by_task[task] = map_attempt
 
     def _make_client(self, local_dirs: list[str]) -> InputClient:
         """createInputClient: plain or decompressing transport by codec
@@ -244,10 +353,20 @@ class UdaBridge:
         self._owned_engine = engine
         client: InputClient = LocalFetchClient(engine)
         if self.cfg.get("mapred.compress.map.output"):
-            from uda_tpu.compress import DecompressingClient, get_codec
+            from uda_tpu.compress import (BLOCK_HEADER, DecompressingClient,
+                                          get_codec)
             codec = get_codec(
                 self.cfg.get("mapred.map.output.compression.codec") or "zlib")
-            client = DecompressingClient(client, codec)
+            # calculateMemPool's buffer split (reducer.cc:453-496): the
+            # compressed (wire) sub-buffer gets `ratio` of each pair,
+            # the decompressed side the rest — so compressed fetches are
+            # sized ratio * buffer while the merge consumes full chunks
+            ratio = float(
+                self.cfg.get("mapred.rdma.compression.buffer.ratio"))
+            buf_bytes = self.cfg.get("mapred.rdma.buf.size") * 1024
+            comp_chunk = max(BLOCK_HEADER.size + 1, int(buf_bytes * ratio))
+            client = DecompressingClient(client, codec,
+                                         comp_chunk_size=comp_chunk)
         return client
 
     def set_input_client(self, client: InputClient) -> None:
