@@ -1,0 +1,68 @@
+"""One process of the multi-process CPU exchange test (run by
+test_multihost.py as ``multihost_worker.py <pid> <nprocs> <port>``).
+
+Each process serves 4 virtual CPU devices; together they form the
+8-device global shuffle mesh, and the SAME SPMD program as the
+single-host path runs across the process boundary — the cross-node
+capability of the reference's RDMA data plane (reference
+src/DataNet/RDMAClient.cc:498-527 per-host connections), minus any
+per-host connection bookkeeping."""
+
+import os
+import sys
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from uda_tpu.parallel import multihost  # noqa: E402
+from uda_tpu.parallel.distributed import (distributed_sort_step,  # noqa: E402
+                                          uniform_splitters)
+
+multihost.initialize(f"localhost:{port}", nprocs, pid)
+assert jax.process_count() == nprocs, jax.process_count()
+mesh = multihost.global_mesh()
+P = len(jax.devices())
+assert P == 4 * nprocs, P
+
+
+def rows(seed: int, n: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(0, 1 << 32, size=(n, 4),
+                                                dtype=np.uint32)
+
+
+per_proc = 512
+local = rows(100 + pid, per_proc)
+words = multihost.shard_rows(local, mesh)
+res = distributed_sort_step(words, uniform_splitters(P), mesh, "shuffle",
+                            capacity=2 * per_proc * nprocs // P, num_keys=2)
+res.check()
+out = multihost.allgather(res.words)
+nvalid = multihost.allgather(res.valid_counts).reshape(-1)
+shard = out.reshape(P, -1, 4)
+got = np.concatenate([shard[d][: nvalid[d]] for d in range(P)])
+allwords = np.concatenate([rows(100 + i, per_proc) for i in range(nprocs)])
+ref = allwords[np.lexsort((allwords[:, 1], allwords[:, 0]))]
+assert got.shape == ref.shape, (got.shape, ref.shape)
+assert np.array_equal(got[:, :2], ref[:, :2]), "global key order mismatch"
+assert sorted(map(tuple, got)) == sorted(map(tuple, allwords)), \
+    "record multiset changed crossing the process boundary"
+
+# skew: every record to partition 0, capacity << bucket -> the windowed
+# multi-round backlog path, across processes
+local2 = local.copy()
+local2[:, 0] = 0
+words2 = multihost.shard_rows(local2, mesh)
+res2 = distributed_sort_step(words2, uniform_splitters(P), mesh, "shuffle",
+                             capacity=32, num_keys=1)
+res2.check()
+nv2 = multihost.allgather(res2.valid_counts).reshape(-1)
+assert nv2[0] == per_proc * nprocs and nv2[1:].sum() == 0, nv2.tolist()
+
+print(f"MULTIHOST-OK p{pid}", flush=True)

@@ -407,3 +407,42 @@ def test_short_form_init_with_many_dirs_not_misrouted(tmp_path):
         Cmd.INIT, [job, "0", "1", "uda.tpu.RawBytes"] + dirs))
     assert not bridge.failed and not harness.failures
     bridge.reduce_exit()
+
+
+def test_reinit_does_not_leak_compression_config(tmp_path):
+    # INIT job A with a codec sets compress=True in the bridge config; a
+    # re-INIT for an UNCOMPRESSED job B on the same bridge must get a
+    # fresh config — a stale compress flag would wrap B's plain IFile
+    # fetches in a DecompressingClient and hang the merge
+    import functools
+    import io as _io
+
+    from uda_tpu.compress import get_codec
+    from uda_tpu.mofserver.writer import MOFWriter
+    from uda_tpu.utils.ifile import IFileReader
+
+    jobA, jobB = "jobLeakA", "jobLeakB"
+    MOFWriter(str(tmp_path), jobA, codec=get_codec("zlib")).write(
+        f"attempt_{jobA}_m_000000_0", [[(b"k" * 10, b"v" * 10)]])
+    expected = make_mof_tree(str(tmp_path), jobB, 2, 1, 20, seed=61)
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, [], harness)
+    bridge.do_command(form_cmd(Cmd.INIT, _ref_init_params(
+        jobA, 0, 1, codec="zlib", dirs=[str(tmp_path)])))
+    assert bridge.cfg.get("mapred.compress.map.output")
+    # re-INIT (uncompressed job B, codec="0")
+    bridge.do_command(form_cmd(Cmd.INIT, _ref_init_params(
+        jobB, 0, 2, codec="0", dirs=[str(tmp_path)])))
+    assert not bridge.cfg.get("mapred.compress.map.output")
+    for mid in map_ids(jobB, 2):
+        bridge.do_command(form_cmd(Cmd.FETCH, ["h", jobB, mid, "0"]))
+    bridge.do_command(form_cmd(Cmd.FINAL, []))
+    assert harness.fetch_over.wait(timeout=30)
+    bridge.reduce_exit()
+    assert not harness.failures, harness.failures
+    got = list(IFileReader(_io.BytesIO(b"".join(harness.blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    want = sorted(expected[0], key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want

@@ -127,9 +127,8 @@ class UdaBridge:
         the conf pull channel, pick the role (UdaBridge.cc:187-263)."""
         self.callable = callable_obj
         self.is_net_merger = is_net_merger
-        self.cfg = Config.from_argv(list(argv))
-        if callable_obj is not None and hasattr(callable_obj, "get_conf_data"):
-            self.cfg.conf_source = callable_obj.get_conf_data
+        self._argv = list(argv)
+        self.cfg = self._fresh_cfg()
         if callable_obj is not None and hasattr(callable_obj, "log_to"):
             get_logger().set_sink(callable_obj.log_to)
         get_logger().set_level(self.cfg.get("uda.log.level"))
@@ -141,6 +140,19 @@ class UdaBridge:
         self.started = True
         log.info(f"uda_tpu bridge started as "
                  f"{'NetMerger' if is_net_merger else 'MOFSupplier'}")
+
+    def _fresh_cfg(self) -> Config:
+        """Config rebuilt from the start-time argv + conf up-call. Each
+        INIT gets a FRESH one: INIT-derived settings (codec class,
+        shrunken buffer size, lpq size) are per-job and must not leak
+        into the next re-INIT on the same bridge — a stale
+        compress=True would wrap an uncompressed job's fetches in a
+        DecompressingClient and hang the merge."""
+        cfg = Config.from_argv(list(self._argv))
+        if self.callable is not None and hasattr(self.callable,
+                                                 "get_conf_data"):
+            cfg.conf_source = self.callable.get_conf_data
+        return cfg
 
     def data_engine(self) -> DataEngine:
         """The supplier's engine (for in-process reduce-side clients —
@@ -206,6 +218,7 @@ class UdaBridge:
             self._pending_maps = []
             self._attempt_by_task = {}
             self._merge_started = False
+            self.cfg = self._fresh_cfg()  # per-job settings must not leak
             if (len(params) >= 10 and params[0].isdigit()
                     and params[3].isdigit()):
                 # reference layout: [0]=num_maps and [3]=lpq_size are

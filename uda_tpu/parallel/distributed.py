@@ -32,13 +32,17 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from uda_tpu.parallel.multihost import put_global, put_rows, zeros_global
 from uda_tpu.utils.errors import TransportError
 from uda_tpu.utils.metrics import metrics
 
 __all__ = ["uniform_splitters", "sample_splitters", "distributed_sort_step",
            "distributed_sort_multiround", "DistributedSortResult"]
 
-_INVALID = jnp.uint32(0xFFFFFFFF)
+# numpy scalar, NOT jnp: a module-level jnp constant would materialize
+# a device array at import time, initializing the XLA backend and
+# breaking any later jax.distributed.initialize (multi-host bring-up)
+_INVALID = np.uint32(0xFFFFFFFF)
 
 
 def _lanes_interpret(payload_path: str, mesh: Mesh) -> bool:
@@ -92,18 +96,29 @@ class DistributedSortResult:
     """Device-sharded sorted output of one distributed sort step."""
 
     def __init__(self, words: jax.Array, valid_counts: jax.Array,
-                 send_overflow: jax.Array):
+                 send_overflow: jax.Array, overflow_total=None):
         self.words = words              # [P*cap_total rows, W] sharded
         self.valid_counts = valid_counts  # [P] valid rows per device
         self.send_overflow = send_overflow  # [P] records dropped (0 = ok)
+        # replicated scalar: readable on EVERY process of a multi-host
+        # mesh (the per-device vector is not addressable cross-process)
+        self._overflow_total = overflow_total
+
+    def overflow(self) -> int:
+        if self._overflow_total is not None:
+            return int(np.asarray(self._overflow_total))
+        return int(np.asarray(self.send_overflow).sum())
 
     def check(self) -> None:
-        over = np.asarray(self.send_overflow)
-        if over.sum() != 0:
+        total = self.overflow()
+        if total != 0:
+            detail = ""
+            if self.send_overflow.is_fully_addressable:
+                over = np.asarray(self.send_overflow)
+                detail = f" on devices {np.nonzero(over)[0].tolist()}"
             raise TransportError(
-                f"exchange capacity overflow on devices {np.nonzero(over)[0]}"
-                f" ({over.sum()} records); raise capacity or use "
-                "shuffle_exchange's multi-round path")
+                f"exchange capacity overflow{detail} ({total} records); "
+                "raise capacity or use the multi-round path")
 
 
 def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
@@ -221,7 +236,9 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
         return out, nvalid[None], overflow[None]
 
     out, nvalid, overflow = _go(words, splitters[None, :])
-    return out, nvalid, overflow
+    # replicated total: host-readable on every process of a multi-host
+    # mesh, where the per-device overflow vector is not addressable
+    return out, nvalid, overflow, jnp.sum(overflow)
 
 
 def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
@@ -255,16 +272,14 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
     if multiround == "always":
         return distributed_sort_multiround(words, splitters, mesh, axis,
                                            capacity, num_keys, payload_path)
-    spec = NamedSharding(mesh, P(axis))
-    words = jax.device_put(words, spec)
-    splitters_dev = jax.device_put(jnp.asarray(splitters, dtype=jnp.uint32),
-                                   NamedSharding(mesh, P()))
-    out, nvalid, overflow = _sort_step(words, splitters_dev, mesh, axis,
-                                       capacity, num_keys, payload_path,
-                                       interpret=_lanes_interpret(
-                                           payload_path, mesh))
-    res = DistributedSortResult(out, nvalid, overflow)
-    if multiround == "auto" and int(np.asarray(overflow).sum()) != 0:
+    words = put_rows(words, mesh, axis)
+    splitters_dev = put_global(np.asarray(splitters, dtype=np.uint32),
+                               NamedSharding(mesh, P()))
+    out, nvalid, overflow, total = _sort_step(
+        words, splitters_dev, mesh, axis, capacity, num_keys, payload_path,
+        interpret=_lanes_interpret(payload_path, mesh))
+    res = DistributedSortResult(out, nvalid, overflow, total)
+    if multiround == "auto" and res.overflow() != 0:
         return distributed_sort_multiround(words, splitters, mesh, axis,
                                            capacity, num_keys, payload_path)
     return res
@@ -344,9 +359,9 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
                                          num_keys)
     p = int(np.prod(list(mesh.shape.values())))
     spec = NamedSharding(mesh, P(axis))
-    words = jax.device_put(words, spec)
-    splitters_dev = jax.device_put(jnp.asarray(splitters, dtype=jnp.uint32),
-                                   NamedSharding(mesh, P()))
+    words = put_rows(words, mesh, axis)
+    splitters_dev = put_global(np.asarray(splitters, dtype=np.uint32),
+                               NamedSharding(mesh, P()))
 
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
              out_specs=P(axis))
@@ -365,16 +380,17 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
     colbase[:, 1:] = np.cumsum(counts.T[:, :-1], axis=1)
     per_dst = counts.sum(axis=0).astype(np.int64)
     shard_rows = max(int(per_dst.max()), 1)
-    acc = jax.device_put(np.zeros((p * shard_rows, words.shape[1]),
-                                  np.uint32), spec)
-    colbase_dev = jax.device_put(colbase, spec)
+    acc = zeros_global((p * shard_rows, int(words.shape[1])), np.uint32,
+                       spec)
+    colbase_dev = put_global(colbase, spec)
     for r in range(rounds):
         acc = _round_scatter(layout.words, layout.dest, layout.pos, acc,
                              colbase_dev, jnp.int32(r), mesh, axis,
                              capacity)
         metrics.add("exchange_rounds")
-    nvalid = jax.device_put(per_dst.astype(np.int32), spec)
+    nvalid = put_global(per_dst.astype(np.int32), spec)
     out = _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,
                       interpret=_lanes_interpret(payload_path, mesh))
-    overflow = jax.device_put(np.zeros(p, np.int32), spec)
-    return DistributedSortResult(out, nvalid, overflow)
+    overflow = put_global(np.zeros(p, np.int32), spec)
+    return DistributedSortResult(out, nvalid, overflow,
+                                 overflow_total=np.int32(0))

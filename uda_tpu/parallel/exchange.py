@@ -34,6 +34,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from uda_tpu.parallel.multihost import allgather, put_rows
 from uda_tpu.utils.errors import TransportError
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.metrics import metrics
@@ -89,10 +90,12 @@ def prepare_layout(words: jax.Array, dest: jax.Array, mesh: Mesh,
         sw, sd, pos, counts = _bucket_local(w, d, axis)
         return sw, sd, pos, counts[None, :]
 
-    words = jax.device_put(words, spec_rows)
-    dest = jax.device_put(dest, spec_rows)
+    words = put_rows(words, mesh, axis)
+    dest = put_rows(dest, mesh, axis)
     sw, sd, pos, counts = _prep(words, dest)
-    return ShuffleLayout(sw, sd, pos, np.asarray(counts), mesh, axis)
+    # count-matrix readback: allgather works on multi-process meshes
+    # where the sharded array is not host-addressable
+    return ShuffleLayout(sw, sd, pos, allgather(counts), mesh, axis)
 
 
 def window_round_body(w, d, q, lo, axis: str, capacity: int):

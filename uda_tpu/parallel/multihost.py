@@ -1,0 +1,131 @@
+"""Multi-host (multi-process) wiring for the distributed exchange.
+
+The reference's data plane is cross-NODE by definition — one RDMA
+connection per remote supplier host (reference
+src/DataNet/RDMAClient.cc:498-527, 602-629 per-host DNS cache) over the
+IB fabric. The TPU-native equivalent spans hosts with the SAME SPMD
+program the single-host path runs: ``jax.distributed`` brings every
+process's local devices into one global runtime, the mesh covers all
+global devices, XLA lowers ``all_to_all`` to ICI within a slice and DCN
+across slices, and the host control plane (this module) only moves
+metadata.
+
+What this module provides:
+
+- ``initialize``: process bring-up (the rdma_cm connect dance of
+  RDMAClient.cc:215-356, replaced by the JAX coordination service);
+- ``global_mesh``: a shuffle mesh over every device of every process;
+- ``shard_rows`` / ``replicate``: build global arrays from
+  process-local data without requiring full addressability
+  (device_put needs every shard local; these do not);
+- ``allgather``: fetch a globally-sharded result back to every host
+  (the test/validation path — production consumers keep results
+  device-resident).
+
+CPU testing: JAX supports multi-process CPU (each process serves
+``--xla_force_host_platform_device_count`` virtual devices; collectives
+run over the coordination service), so the cross-process path is
+exercised by tests/test_multihost.py with 2 processes x 4 devices and
+no TPU pod — the multi-node-without-a-cluster capability the reference
+never had (SURVEY §4.5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from uda_tpu.parallel.mesh import SHUFFLE_AXIS
+
+__all__ = ["initialize", "global_mesh", "shard_rows", "replicate",
+           "allgather", "put_global", "put_rows", "zeros_global"]
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int,
+               local_device_count: Optional[int] = None) -> None:
+    """Join the global JAX runtime (jax.distributed): process 0 hosts the
+    coordination service at ``coordinator_address`` (host:port), every
+    process connects to it. Call before any other JAX API touches
+    devices. ``local_device_count`` pins the per-process CPU device
+    count for tests (set --xla_force_host_platform_device_count BEFORE
+    jax import when using it)."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh(axis: str = SHUFFLE_AXIS) -> Mesh:
+    """1-D shuffle mesh over every device of every process, in global
+    device order (process-major, so each process's row block is local)."""
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def put_global(arr: np.ndarray, sharding: NamedSharding) -> jax.Array:
+    """device_put that also works when the sharding spans processes:
+    jax.device_put requires every shard to be addressable; on a
+    multi-host mesh the global array is assembled from the local shards
+    via make_array_from_callback (each process materializes only its
+    devices' index slices)."""
+    arr = np.asarray(arr)
+    if sharding.is_fully_addressable:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def put_rows(words, mesh: Mesh, axis: str = SHUFFLE_AXIS) -> jax.Array:
+    """Row-shard a GLOBAL array onto the mesh. A jax.Array already
+    sharded over the mesh (e.g. built by shard_rows on a multi-process
+    mesh, where device_put of host data is impossible) passes through;
+    host data goes through put_global."""
+    spec = NamedSharding(mesh, P(axis))
+    if isinstance(words, jax.Array) and words.sharding == spec:
+        return words
+    return put_global(np.asarray(words), spec)
+
+
+def zeros_global(shape, dtype, sharding: NamedSharding) -> jax.Array:
+    """Globally-sharded zeros WITHOUT materializing the global array on
+    any host (put_global of np.zeros(shape) would allocate the full
+    global buffer per process — host RAM scaling with the global
+    shuffle size instead of the local shard)."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(np.zeros(shape, dtype), sharding)
+
+    def shard_zeros(idx):
+        dims = [len(range(*s.indices(dim))) for s, dim in zip(idx, shape)]
+        return np.zeros(dims, dtype)
+
+    return jax.make_array_from_callback(shape, sharding, shard_zeros)
+
+
+def shard_rows(local_rows: np.ndarray, mesh: Mesh,
+               axis: str = SHUFFLE_AXIS) -> jax.Array:
+    """Global row-sharded array from each process's LOCAL row block
+    (every process passes its own rows; global row count = sum)."""
+    sharding = NamedSharding(mesh, P(axis))
+    if sharding.is_fully_addressable:
+        return jax.device_put(local_rows, sharding)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        local_rows, mesh, P(axis))
+
+
+def replicate(arr: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Globally replicated array from identical per-process data."""
+    return put_global(np.asarray(arr), NamedSharding(mesh, P()))
+
+
+def allgather(arr: jax.Array) -> np.ndarray:
+    """Full global value on every process (host readback). On a
+    single-process mesh this is just np.asarray."""
+    if arr.is_fully_addressable:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
