@@ -1,0 +1,190 @@
+// UdaBridge.java — the JVM binding of the uda_tpu native bridge.
+//
+// Mirrors the reference's plugins/shared/com/mellanox/hadoop/mapred/
+// UdaBridge.java (the 4 native down-calls, UdaBridge.java:49-81, and
+// the static up-call receivers :85-145), but binds libuda_tpu_bridge.so
+// through the JDK's java.lang.foreign (FFM) API instead of JNI — no
+// extra jar, no jni.h: the shim exposes a plain C ABI
+// (uda_bridge_start / uda_bridge_do_command / uda_bridge_reduce_exit /
+// uda_bridge_set_log_level + an uda_callbacks_t function-pointer table,
+// uda_tpu/native/bridge_shim.cc) designed for exactly this kind of
+// foreign-function embedding.
+//
+// Requires JDK 22+ (final FFM API). Run with
+//   --enable-native-access=ALL-UNNAMED
+// so the upcall stubs are permitted.
+
+package com.mellanox.hadoop.mapred;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.invoke.MethodHandle;
+import java.lang.invoke.MethodHandles;
+import java.lang.invoke.MethodType;
+
+import static java.lang.foreign.ValueLayout.ADDRESS;
+import static java.lang.foreign.ValueLayout.JAVA_BYTE;
+import static java.lang.foreign.ValueLayout.JAVA_INT;
+import static java.lang.foreign.ValueLayout.JAVA_LONG;
+
+public final class UdaBridge {
+
+    /** Up-call surface, the UdaCallable of the reference (the subset a
+     *  consumer plugin needs; index/conf resolution stays native-side
+     *  via INIT local dirs). */
+    public interface Callable {
+        void fetchOverMessage();
+
+        void dataFromUda(byte[] data);
+
+        void logToJava(int level, String message);
+
+        void failureInUda(String what);
+    }
+
+    private static final Linker LINKER = Linker.nativeLinker();
+    private static final Arena ARENA = Arena.ofShared();
+
+    private final MethodHandle hStart;
+    private final MethodHandle hDoCommand;
+    private final MethodHandle hReduceExit;
+    private final MethodHandle hSetLogLevel;
+    private final MethodHandle hFailed;
+    private final MemorySegment callbacks; // uda_callbacks_t
+    private static volatile Callable target; // receiver of the up-calls
+
+    public UdaBridge(String libraryPath, Callable callable)
+            throws Throwable {
+        target = callable;
+        SymbolLookup lib = SymbolLookup.libraryLookup(libraryPath, ARENA);
+        hStart = LINKER.downcallHandle(
+                lib.find("uda_bridge_start").orElseThrow(),
+                FunctionDescriptor.of(JAVA_INT, JAVA_INT, JAVA_INT,
+                        ADDRESS, ADDRESS));
+        hDoCommand = LINKER.downcallHandle(
+                lib.find("uda_bridge_do_command").orElseThrow(),
+                FunctionDescriptor.of(JAVA_INT, ADDRESS));
+        hReduceExit = LINKER.downcallHandle(
+                lib.find("uda_bridge_reduce_exit").orElseThrow(),
+                FunctionDescriptor.of(JAVA_INT));
+        hSetLogLevel = LINKER.downcallHandle(
+                lib.find("uda_bridge_set_log_level").orElseThrow(),
+                FunctionDescriptor.of(JAVA_INT, JAVA_INT));
+        hFailed = LINKER.downcallHandle(
+                lib.find("uda_bridge_failed").orElseThrow(),
+                FunctionDescriptor.of(JAVA_INT));
+        callbacks = buildCallbacks();
+    }
+
+    // ---- static up-call receivers (the reference's static methods,
+    // UdaBridge.java:85-145) -------------------------------------------
+
+    private static void cbFetchOver(MemorySegment ctx) {
+        Callable t = target;
+        if (t != null) t.fetchOverMessage();
+    }
+
+    private static void cbDataFromUda(MemorySegment ctx, MemorySegment data,
+                                      long len) {
+        Callable t = target;
+        if (t == null) return;
+        byte[] out = new byte[(int) len];
+        MemorySegment.copy(data.reinterpret(len), JAVA_BYTE, 0, out, 0,
+                (int) len);
+        t.dataFromUda(out);
+    }
+
+    private static void cbLogTo(MemorySegment ctx, int level,
+                                MemorySegment msg) {
+        Callable t = target;
+        if (t != null) t.logToJava(level,
+                msg.reinterpret(1 << 16).getString(0));
+    }
+
+    private static void cbFailure(MemorySegment ctx, MemorySegment what) {
+        Callable t = target;
+        if (t != null) t.failureInUda(
+                what.reinterpret(1 << 16).getString(0));
+    }
+
+    private MemorySegment buildCallbacks() throws Throwable {
+        MethodHandles.Lookup l = MethodHandles.lookup();
+        MemorySegment fetchOver = LINKER.upcallStub(
+                l.findStatic(UdaBridge.class, "cbFetchOver",
+                        MethodType.methodType(void.class,
+                                MemorySegment.class)),
+                FunctionDescriptor.ofVoid(ADDRESS), ARENA);
+        MemorySegment dataFrom = LINKER.upcallStub(
+                l.findStatic(UdaBridge.class, "cbDataFromUda",
+                        MethodType.methodType(void.class,
+                                MemorySegment.class, MemorySegment.class,
+                                long.class)),
+                FunctionDescriptor.ofVoid(ADDRESS, ADDRESS, JAVA_LONG),
+                ARENA);
+        MemorySegment logTo = LINKER.upcallStub(
+                l.findStatic(UdaBridge.class, "cbLogTo",
+                        MethodType.methodType(void.class,
+                                MemorySegment.class, int.class,
+                                MemorySegment.class)),
+                FunctionDescriptor.ofVoid(ADDRESS, JAVA_INT, ADDRESS),
+                ARENA);
+        MemorySegment failure = LINKER.upcallStub(
+                l.findStatic(UdaBridge.class, "cbFailure",
+                        MethodType.methodType(void.class,
+                                MemorySegment.class, MemorySegment.class)),
+                FunctionDescriptor.ofVoid(ADDRESS, ADDRESS), ARENA);
+        // uda_callbacks_t: {ctx, fetch_over_message, data_from_uda,
+        //                   get_path_uda, get_conf_data, log_to,
+        //                   failure_in_uda} — 7 pointers
+        MemorySegment cbs = ARENA.allocate(7 * 8L, 8);
+        cbs.set(ADDRESS, 0, MemorySegment.NULL);        // ctx
+        cbs.set(ADDRESS, 8, fetchOver);
+        cbs.set(ADDRESS, 16, dataFrom);
+        cbs.set(ADDRESS, 24, MemorySegment.NULL);       // get_path_uda:
+        cbs.set(ADDRESS, 32, MemorySegment.NULL);       // get_conf_data:
+        // resolution runs native-side through INIT local dirs
+        cbs.set(ADDRESS, 40, logTo);
+        cbs.set(ADDRESS, 48, failure);
+        return cbs;
+    }
+
+    // ---- down-calls (startNative / doCommandNative /
+    // reduceExitMsgNative / setLogLevelNative) --------------------------
+
+    public void start(boolean isNetMerger, String[] argv) throws Throwable {
+        MemorySegment argvSeg = ARENA.allocate((long) argv.length * 8, 8);
+        for (int i = 0; i < argv.length; i++) {
+            argvSeg.set(ADDRESS, (long) i * 8,
+                    ARENA.allocateFrom(argv[i]));
+        }
+        int rc = (int) hStart.invokeExact(isNetMerger ? 1 : 0, argv.length,
+                argvSeg, callbacks);
+        if (rc != 0) throw new RuntimeException("uda_bridge_start rc=" + rc);
+    }
+
+    public void doCommand(String cmd) throws Throwable {
+        int rc = (int) hDoCommand.invokeExact(
+                (MemorySegment) ARENA.allocateFrom(cmd));
+        if (rc != 0) throw new RuntimeException(
+                "uda_bridge_do_command rc=" + rc + " cmd=" + cmd);
+    }
+
+    public void reduceExit() throws Throwable {
+        int rc = (int) hReduceExit.invokeExact();
+        if (rc != 0) throw new RuntimeException("uda_bridge_reduce_exit rc="
+                + rc);
+    }
+
+    public void setLogLevel(int level) throws Throwable {
+        int rc = (int) hSetLogLevel.invokeExact(level);
+        if (rc != 0) throw new RuntimeException(
+                "uda_bridge_set_log_level rc=" + rc);
+    }
+
+    public boolean failed() throws Throwable {
+        return (int) hFailed.invokeExact() != 0;
+    }
+}

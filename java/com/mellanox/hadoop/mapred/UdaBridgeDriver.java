@@ -1,0 +1,104 @@
+// UdaBridgeDriver — a JVM process completing a merge through the
+// native uda_tpu bridge (the proof the reference's L5 Java layer has a
+// working seat on this framework: the consumer flow of
+// UdaShuffleConsumerPluginShared.java init -> INIT/FETCH/FINAL ->
+// dataFromUda blocks -> fetchOverMessage).
+//
+// Usage:
+//   java --enable-native-access=ALL-UNNAMED \
+//        com.mellanox.hadoop.mapred.UdaBridgeDriver \
+//        <libuda_tpu_bridge.so> <mof_root> <job_id> <num_maps> <out_file>
+//
+// The MOF tree under <mof_root> is prepared by the caller (the gated
+// pytest uses the Python MOFWriter); the driver drives the command
+// protocol, collects the merged dataFromUda stream, and writes it to
+// <out_file> for the caller to validate. Exit code 0 = merge completed
+// without a failure_in_uda.
+
+package com.mellanox.hadoop.mapred;
+
+import java.io.ByteArrayOutputStream;
+import java.io.IOException;
+import java.nio.file.Files;
+import java.nio.file.Paths;
+import java.util.concurrent.CountDownLatch;
+import java.util.concurrent.TimeUnit;
+
+public final class UdaBridgeDriver implements UdaBridge.Callable {
+
+    private final ByteArrayOutputStream blocks = new ByteArrayOutputStream();
+    private final CountDownLatch done = new CountDownLatch(1);
+    private volatile String failure = null;
+
+    @Override
+    public void fetchOverMessage() {
+        done.countDown();
+    }
+
+    @Override
+    public void dataFromUda(byte[] data) {
+        try {
+            blocks.write(data);
+        } catch (IOException e) {
+            failure = "block write failed: " + e;
+            done.countDown();
+        }
+    }
+
+    @Override
+    public void logToJava(int level, String message) {
+        if (level <= 2) { // lsERROR and up
+            System.err.println("[uda_tpu] " + message);
+        }
+    }
+
+    @Override
+    public void failureInUda(String what) {
+        failure = what;
+        done.countDown();
+    }
+
+    public static void main(String[] args) throws Throwable {
+        if (args.length != 5) {
+            System.err.println("usage: UdaBridgeDriver <lib> <root> <job> "
+                    + "<num_maps> <out>");
+            System.exit(2);
+        }
+        String lib = args[0], root = args[1], job = args[2], out = args[4];
+        int numMaps = Integer.parseInt(args[3]);
+
+        UdaBridgeDriver driver = new UdaBridgeDriver();
+        UdaBridge bridge = new UdaBridge(lib, driver);
+        bridge.start(true, new String[] {"-w", "8"});
+        // short-form INIT: job, reduce_id, num_maps, key_class, dirs
+        bridge.doCommand(cmd("7", new String[] {job, "0",
+                String.valueOf(numMaps), "uda.tpu.RawBytes", root}));
+        for (int m = 0; m < numMaps; m++) {
+            String attempt = String.format("attempt_%s_m_%06d_0", job, m);
+            bridge.doCommand(cmd("4", new String[] {"localhost", job,
+                    attempt, "0"}));
+        }
+        bridge.doCommand(cmd("2", new String[] {}));
+        if (!driver.done.await(120, TimeUnit.SECONDS)) {
+            System.err.println("merge timed out");
+            System.exit(3);
+        }
+        bridge.reduceExit();
+        if (driver.failure != null) {
+            System.err.println("failure_in_uda: " + driver.failure);
+            System.exit(4);
+        }
+        Files.write(Paths.get(out), driver.blocks.toByteArray());
+        System.out.println("JVM-MERGE-OK " + driver.blocks.size()
+                + " bytes");
+    }
+
+    /** count:header:params protocol string (reference UdaCmd.formCmd,
+     *  UdaPlugin.java:562-587). */
+    private static String cmd(String header, String[] params) {
+        StringBuilder sb = new StringBuilder();
+        sb.append(params.length).append(':').append(header);
+        for (String p : params) sb.append(':').append(p);
+        return sb.toString();
+    }
+}

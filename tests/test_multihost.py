@@ -25,11 +25,13 @@ def test_multiprocess_cpu_exchange(nprocs):
     worker = os.path.join(root, "tests", "multihost_worker.py")
     port = _free_port()
     env = dict(os.environ)
-    # drop any sitecustomize dirs (e.g. an accelerator relay shim) from
-    # the path: they import jax at interpreter start, which forbids the
-    # later jax.distributed.initialize; workers are pure-CPU
+    # drop sitecustomize shim dirs (e.g. an accelerator relay hook) from
+    # the path: their sitecustomize.py imports jax at interpreter start,
+    # which forbids the later jax.distributed.initialize; workers are
+    # pure-CPU. Only dirs that actually carry a sitecustomize.py go.
     extra = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-             if p and "site" not in os.path.basename(p)]
+             if p and not os.path.exists(os.path.join(p,
+                                                      "sitecustomize.py"))]
     env["PYTHONPATH"] = os.pathsep.join([root] + extra)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"

@@ -32,8 +32,6 @@ never had (SURVEY §4.5).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -45,14 +43,12 @@ __all__ = ["initialize", "global_mesh", "shard_rows", "replicate",
 
 
 def initialize(coordinator_address: str, num_processes: int,
-               process_id: int,
-               local_device_count: Optional[int] = None) -> None:
+               process_id: int) -> None:
     """Join the global JAX runtime (jax.distributed): process 0 hosts the
     coordination service at ``coordinator_address`` (host:port), every
     process connects to it. Call before any other JAX API touches
-    devices. ``local_device_count`` pins the per-process CPU device
-    count for tests (set --xla_force_host_platform_device_count BEFORE
-    jax import when using it)."""
+    devices. (Per-process CPU device count for tests comes from
+    --xla_force_host_platform_device_count, set BEFORE jax import.)"""
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
