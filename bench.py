@@ -46,9 +46,11 @@ BASELINE_GBPS = 6.8  # FDR IB line rate, the reference data plane ceiling
 LOG2_RECORDS = int(os.environ.get("UDA_TPU_BENCH_LOG2", 23))
 ROUNDS_PER_DISPATCH = 4   # amortizes the ~75 ms dispatch+readback cost
 DISPATCHES = 2
-# lanes-path sort tile; clamped so smoke-sized runs (UDA_TPU_BENCH_LOG2)
-# still satisfy sort_lanes' n % tile == 0 contract
-LANES_TILE = min(1024, 1 << LOG2_RECORDS)
+# lanes-path sort tile; 4096 measured fastest on v5e (fewer merge
+# passes at the same total stage count — scripts/profile_lanes.py:
+# 0.85/1.07/1.18 GB/s at 1024/2048/4096); clamped so smoke-sized runs
+# (UDA_TPU_BENCH_LOG2) still satisfy sort_lanes' n % tile == 0 contract
+LANES_TILE = min(4096, 1 << LOG2_RECORDS)
 # run the Pallas kernels in interpret mode (CPU smoke runs of the lanes
 # path; useless on TPU and at full size)
 INTERPRET = os.environ.get("UDA_TPU_BENCH_INTERPRET") == "1"

@@ -54,11 +54,17 @@ public final class UdaBridge {
     private final MethodHandle hSetLogLevel;
     private final MethodHandle hFailed;
     private final MemorySegment callbacks; // uda_callbacks_t
-    private static volatile Callable target; // receiver of the up-calls
+    private final Callable callable;
+    // One live bridge per process (the shim keeps process-global state,
+    // like the reference's single reduce task per NetMerger process,
+    // reducer.h:137); the up-call receiver binds at start(), not at
+    // construction, so building a second instance cannot steal a live
+    // bridge's callbacks.
+    private static volatile Callable target;
 
     public UdaBridge(String libraryPath, Callable callable)
             throws Throwable {
-        target = callable;
+        this.callable = callable;
         SymbolLookup lib = SymbolLookup.libraryLookup(libraryPath, ARENA);
         hStart = LINKER.downcallHandle(
                 lib.find("uda_bridge_start").orElseThrow(),
@@ -155,21 +161,29 @@ public final class UdaBridge {
     // reduceExitMsgNative / setLogLevelNative) --------------------------
 
     public void start(boolean isNetMerger, String[] argv) throws Throwable {
-        MemorySegment argvSeg = ARENA.allocate((long) argv.length * 8, 8);
-        for (int i = 0; i < argv.length; i++) {
-            argvSeg.set(ADDRESS, (long) i * 8,
-                    ARENA.allocateFrom(argv[i]));
+        target = callable; // the live bridge's receiver (see field note)
+        // per-call natives live in a confined arena: freed on return
+        // (the shim copies argv into Python strings during the call)
+        try (Arena a = Arena.ofConfined()) {
+            MemorySegment argvSeg = a.allocate((long) Math.max(
+                    argv.length, 1) * 8, 8);
+            for (int i = 0; i < argv.length; i++) {
+                argvSeg.set(ADDRESS, (long) i * 8, a.allocateFrom(argv[i]));
+            }
+            int rc = (int) hStart.invokeExact(isNetMerger ? 1 : 0,
+                    argv.length, argvSeg, callbacks);
+            if (rc != 0) throw new RuntimeException(
+                    "uda_bridge_start rc=" + rc);
         }
-        int rc = (int) hStart.invokeExact(isNetMerger ? 1 : 0, argv.length,
-                argvSeg, callbacks);
-        if (rc != 0) throw new RuntimeException("uda_bridge_start rc=" + rc);
     }
 
     public void doCommand(String cmd) throws Throwable {
-        int rc = (int) hDoCommand.invokeExact(
-                (MemorySegment) ARENA.allocateFrom(cmd));
-        if (rc != 0) throw new RuntimeException(
-                "uda_bridge_do_command rc=" + rc + " cmd=" + cmd);
+        try (Arena a = Arena.ofConfined()) {
+            int rc = (int) hDoCommand.invokeExact(
+                    (MemorySegment) a.allocateFrom(cmd));
+            if (rc != 0) throw new RuntimeException(
+                    "uda_bridge_do_command rc=" + rc + " cmd=" + cmd);
+        }
     }
 
     public void reduceExit() throws Throwable {

@@ -250,6 +250,11 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
 
     ``words``: uint32[N, W] records (rows sharded over ``axis``; the
     first ``num_keys`` columns are the big-endian key words).
+    ``axis``: one mesh axis name, or a TUPLE of axis names for
+    multi-pod meshes — e.g. ``("dcn", "shuffle")`` on a (pods, chips)
+    mesh shards rows over both and XLA routes the all_to_all per axis
+    (ICI within a pod, DCN across pods); results are byte-identical to
+    the flat single-axis mesh of the same device order.
     ``capacity``: per-(src, dst) records per round — the credit window.
     ``payload_path``: how the local sort moves value columns ("auto":
     operand-carry on CPU meshes, the Pallas lanes pipeline on
