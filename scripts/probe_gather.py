@@ -55,8 +55,7 @@ def run(name, kern):
     try:
         f = pl.pallas_call(
             kern,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)
-                      if False else pl.BlockSpec((1, N), lambda: (0, 0)),
+            in_specs=[pl.BlockSpec((1, N), lambda: (0, 0)),
                       pl.BlockSpec((ROWS, N), lambda: (0, 0))],
             out_specs=pl.BlockSpec((ROWS, N), lambda: (0, 0)),
             out_shape=jax.ShapeDtypeStruct((ROWS, N), jnp.uint32),

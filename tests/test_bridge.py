@@ -331,12 +331,12 @@ def test_fetch_attempt_dedupe_and_obsolescence(tmp_path):
     a0, a1 = map_ids(job, 2)
     bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a0, "0"]))
     bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a0, "0"]))  # dup
-    assert bridge._pending_maps == [a0]
+    assert bridge._pending_maps == [("h", a0)]
     # speculative re-execution: attempt _1 obsoletes attempt _0
     a1_retry = a1[:-1] + "1"
     bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a1, "0"]))
     bridge.do_command(form_cmd(Cmd.FETCH, ["h", job, a1_retry, "0"]))
-    assert bridge._pending_maps == [a0, a1_retry]
+    assert bridge._pending_maps == [("h", a0), ("h", a1_retry)]
     bridge.do_command(form_cmd(Cmd.FINAL, []))
     assert harness.fetch_over.wait(timeout=30)
     # the retried attempt has no MOF on disk -> that failure is expected

@@ -325,3 +325,58 @@ def test_arena_slot_write_overflow():
     with pytest.raises(MergeError):
         slot.write(b"y" * 17)
     arena.release(slot)
+
+
+def test_host_routing_client_lazy_connect(tmp_path):
+    """Per-host transport table (reference RDMAClient.cc:498-527): maps
+    live on two different 'hosts' (separate MOF roots + DataEngines);
+    the router connects lazily on first use and the merge interleaves
+    records from both suppliers; an unknown host fails the fetch."""
+    import functools
+    import io
+
+    from tests.helpers import make_mof_tree, map_ids
+    from uda_tpu.merger import (HostRoutingClient, LocalFetchClient,
+                                MergeManager)
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver, ShuffleRequest
+    from uda_tpu.utils import comparators
+    from uda_tpu.utils.config import Config
+    from uda_tpu.utils.ifile import IFileReader
+
+    job = "jobHosts"
+    roots = {h: tmp_path / h for h in ("hostA", "hostB")}
+    expected = []
+    engines = {}
+    for i, (h, root) in enumerate(sorted(roots.items())):
+        root.mkdir()
+        exp = make_mof_tree(str(root), job, 2, 1, 25, seed=100 + i)
+        expected += exp[0]
+        engines[h] = DataEngine(DirIndexResolver(str(root)), Config())
+    connects = []
+
+    def connect(host):
+        connects.append(host)
+        return LocalFetchClient(engines[host])
+
+    router = HostRoutingClient(connect)
+    try:
+        mm = MergeManager(router, "uda.tpu.RawBytes", Config())
+        maps = ([("hostA", m) for m in map_ids(job, 2)]
+                + [("hostB", m) for m in map_ids(job, 2)])
+        blocks = []
+        mm.run(job, maps, 0, lambda b: blocks.append(bytes(b)))
+    finally:
+        for e in engines.values():
+            e.stop()
+    # one lazy connect per host, not per fetch
+    assert sorted(connects) == ["hostA", "hostB"]
+    got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    want = sorted(expected, key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want
+    # unknown host -> fetch completes with the connect error
+    errs = []
+    router.start_fetch(ShuffleRequest(job, "m", 0, 0, 10, host="nope"),
+                       errs.append)
+    assert errs and isinstance(errs[0], KeyError)

@@ -110,7 +110,7 @@ class UdaBridge:
         self._job_id: Optional[str] = None
         self._reduce_id: Optional[int] = None
         self._key_class = "uda.tpu.RawBytes"
-        self._pending_maps: list[str] = []
+        self._pending_maps: list[tuple[str, str]] = []  # (host, attempt)
         self._attempt_by_task: dict[str, str] = {}
         self._merge_started = False
         self._merge_thread: Optional[threading.Thread] = None
@@ -239,12 +239,12 @@ class UdaBridge:
             self._mm = MergeManager(client, self._key_class, self.cfg)
         elif header == Cmd.FETCH:
             # reference FETCH: host:jobid:attemptid:partition
-            # (UdaPlugin.java:322-334); host is vestigial on TPU (the
-            # exchange is mesh-global)
+            # (UdaPlugin.java:322-334); host rides with the attempt so
+            # a HostRoutingClient can route per supplier
             if len(params) < 4:
                 raise ProtocolError("FETCH needs 4 params")
-            _host, job_id, map_attempt, _partition = params[:4]
-            self._fetch_attempt(map_attempt)
+            host, job_id, map_attempt, _partition = params[:4]
+            self._fetch_attempt(host, map_attempt)
         elif header == Cmd.FINAL:
             if self._mm is None:
                 raise UdaError("FINAL before INIT")
@@ -327,13 +327,15 @@ class UdaBridge:
             return parts[0]
         return attempt
 
-    def _fetch_attempt(self, map_attempt: str) -> None:
+    def _fetch_attempt(self, host: str, map_attempt: str) -> None:
         """Fetch-attempt hygiene (reference UdaShuffleConsumerPluginShared
         .java:568-589): an exact duplicate attempt is dropped; a NEW
         attempt for a map task whose earlier attempt is already merged
         (or merging) cannot be un-merged -> failure_in_uda (the
         obsolete-after-success fallback); before the merge starts the
-        newer attempt simply replaces the stale one."""
+        newer attempt simply replaces the stale one. ``host`` rides with
+        the attempt so the transport can route per supplier
+        (HostRoutingClient; reference RDMAClient.cc:498-527)."""
         task = self._attempt_task(map_attempt)
         existing = self._attempt_by_task.get(task)
         if existing == map_attempt:
@@ -347,10 +349,11 @@ class UdaBridge:
                    if existing else ""))
         if existing is not None:
             log.warn(f"map attempt {existing} obsoleted by {map_attempt}")
-            self._pending_maps[self._pending_maps.index(existing)] = \
-                map_attempt
+            idx = next(i for i, (_, a) in enumerate(self._pending_maps)
+                       if a == existing)
+            self._pending_maps[idx] = (host, map_attempt)
         else:
-            self._pending_maps.append(map_attempt)
+            self._pending_maps.append((host, map_attempt))
         self._attempt_by_task[task] = map_attempt
 
     def _make_client(self, local_dirs: list[str]) -> InputClient:

@@ -232,7 +232,8 @@ class DecompressingClient(InputClient):
             return
         inner_req = ShuffleRequest(req.job_id, req.map_id, req.reduce_id,
                                    st.comp_offset,
-                                   self.comp_chunk_size or req.chunk_size)
+                                   self.comp_chunk_size or req.chunk_size,
+                                   host=req.host)
 
         def _done(res) -> None:
             if isinstance(res, Exception):

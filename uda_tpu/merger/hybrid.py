@@ -66,7 +66,7 @@ class SuperSegment:
             pass
 
 
-def run_hybrid(mm, job_id: str, map_ids: Sequence[str], reduce_id: int,
+def run_hybrid(mm, job_id: str, map_ids: Sequence, reduce_id: int,
                consumer: Callable[[memoryview], None]) -> int:
     """Fetch in LPQ-sized groups, spill device-merged runs, stream the
     final RPQ merge. ``mm`` is the owning MergeManager."""

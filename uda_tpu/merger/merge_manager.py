@@ -69,7 +69,7 @@ class MergeManager:
 
     # -- fetch phase --------------------------------------------------------
 
-    def fetch_all(self, job_id: str, map_ids: Sequence[str],
+    def fetch_all(self, job_id: str, map_ids: Sequence,
                   reduce_id: int,
                   on_segment: Optional[Callable[[int, Segment], None]] = None
                   ) -> list[Segment]:
@@ -86,8 +86,12 @@ class MergeManager:
         the overlapped merge uses to stage runs while later fetches are
         still in flight.
         """
-        segs = [Segment(self.client, job_id, m, reduce_id, self.chunk_size)
-                for m in map_ids]
+        # entries are "map_id" or ("host", "map_id") — the latter routes
+        # through a per-host transport (HostRoutingClient)
+        entries = [m if isinstance(m, tuple) else ("", m) for m in map_ids]
+        segs = [Segment(self.client, job_id, mid, reduce_id,
+                        self.chunk_size, host=host)
+                for host, mid in entries]
         index_of = {id(s): i for i, s in enumerate(segs)}
         order = list(range(len(segs)))
         random.Random(self.seed).shuffle(order)  # MergeManager.cc:58-63
@@ -154,7 +158,7 @@ class MergeManager:
         total bytes emitted."""
         return self.emitter.emit_batch(merged, consumer)
 
-    def run(self, job_id: str, map_ids: Sequence[str], reduce_id: int,
+    def run(self, job_id: str, map_ids: Sequence, reduce_id: int,
             consumer: Callable[[memoryview], None]) -> int:
         """The full online merge: fetch overlapped with device merge ->
         emit (reference merge_online, MergeManager.cc:184-193; the

@@ -26,7 +26,8 @@ from uda_tpu.utils.errors import MergeError
 from uda_tpu.utils.ifile import RecordBatch, crack_partial
 from uda_tpu.utils.metrics import metrics
 
-__all__ = ["InputClient", "LocalFetchClient", "Segment"]
+__all__ = ["InputClient", "LocalFetchClient", "HostRoutingClient",
+           "Segment"]
 
 
 class InputClient(abc.ABC):
@@ -57,6 +58,66 @@ class LocalFetchClient(InputClient):
         fut.add_done_callback(_done)
 
 
+class HostRoutingClient(InputClient):
+    """Per-supplier-host transport table with lazy connect.
+
+    The reference's reduce-side client opens one RDMA connection per
+    supplier host ON FIRST USE and caches it (connect-per-host with DNS
+    cache, reference src/DataNet/RDMAClient.cc:498-527, 602-629). Here
+    ``connect(host)`` builds the host's transport (e.g. a
+    LocalFetchClient over that host's DataEngine, or a remote client)
+    the first time a fetch addresses it; every later fetch for the host
+    reuses the cached transport. A failed connect surfaces through the
+    fetch's completion callback like any transport error (the
+    reference's connect-retry-then-fail path, RDMAClient.cc:215-356).
+    """
+
+    def __init__(self, connect):
+        self._connect = connect
+        self._clients: dict[str, InputClient] = {}
+        self._stopped = False
+        self._lock = threading.Lock()
+
+    def _client_for(self, host: str) -> InputClient:
+        with self._lock:
+            if self._stopped:
+                raise MergeError("HostRoutingClient is stopped")
+            client = self._clients.get(host)
+        if client is None:
+            client = self._connect(host)
+            with self._lock:
+                if self._stopped:
+                    loser = client  # connected after stop(): tear down
+                else:
+                    # a concurrent connect for the same host may have
+                    # won; the loser must be torn down, not leaked
+                    winner = self._clients.setdefault(host, client)
+                    loser = None if winner is client else client
+                    client = winner
+            if loser is not None:
+                loser.stop()
+            with self._lock:
+                if self._stopped:
+                    raise MergeError("HostRoutingClient is stopped")
+        return client
+
+    def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
+        try:
+            client = self._client_for(req.host)
+        except Exception as e:  # noqa: BLE001 - connect failure ->
+            on_complete(e)      # completion error, like the reference
+            return
+        client.start_fetch(req, on_complete)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.stop()
+
+
 class Segment:
     """One partition's record stream, fetched chunk-wise with a carry
     buffer for records split across chunk boundaries.
@@ -69,12 +130,13 @@ class Segment:
     """
 
     def __init__(self, client: InputClient, job_id: str, map_id: str,
-                 reduce_id: int, chunk_size: int):
+                 reduce_id: int, chunk_size: int, host: str = ""):
         self.client = client
         self.job_id = job_id
         self.map_id = map_id
         self.reduce_id = reduce_id
         self.chunk_size = chunk_size
+        self.host = host
         self.batches: list[RecordBatch] = []
         self.raw_length: Optional[int] = None
         self.on_done = None  # callback fired once when fetch finishes
@@ -96,7 +158,7 @@ class Segment:
 
     def _issue(self, offset: int) -> None:
         req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
-                             offset, self.chunk_size)
+                             offset, self.chunk_size, host=self.host)
         self.client.start_fetch(req, self._on_complete)
 
     def _on_complete(self, result) -> None:

@@ -47,13 +47,18 @@ log = get_logger()
 @dataclasses.dataclass(frozen=True)
 class ShuffleRequest:
     """One chunk fetch (reference shuffle_req_t, src/MOFServer/
-    IndexInfo.h:64-77: jobid, map, reduceID, map_offset, chunk_size)."""
+    IndexInfo.h:64-77: jobid, map, reduceID, map_offset, chunk_size).
+
+    ``host`` identifies the supplier serving this map output (the
+    reference addresses fetches per supplier host, RDMAClient.cc:
+    498-527); single-host transports ignore it."""
 
     job_id: str
     map_id: str
     reduce_id: int
     offset: int          # offset within the partition's record bytes
     chunk_size: int
+    host: str = ""
 
 
 @dataclasses.dataclass
