@@ -161,7 +161,7 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
     bandwidth). Records are either 26 separate [n] columns (SoA) or the
     [32, n] lanes layout.
 
-    Three device strategies:
+    Four device strategies:
 
     - ``path="lanes"`` (flagship): records live in the lanes layout and
       the full sort runs in the Pallas bitonic pipeline
@@ -169,6 +169,10 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
       lane moves of the 32-row tile — streaming HBM access, no gathers
       — and compile cost is BOUNDED (two Mosaic kernels total,
       regardless of n and record width).
+    - ``path="lanes2"``: the two-phase variant — each network runs on
+      an 8-row keys view and the payload moves with one in-kernel lane
+      gather (sort_lanes two_phase=True). Faster where Mosaic lowers
+      the dynamic gather well; bench.py decides by a measured fly-off.
     - ``path="carry"``: the payload rides the ``lax.sort`` network as
       extra operands. Fast at runtime (~12 GB/s measured) but XLA's
       variadic-sort compile time grows superlinearly in operand count —
@@ -186,7 +190,7 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
     consuming the sorted output in-graph keeps XLA from eliminating any
     round, and the caller asserts violations == 0 and checksum equality.
     """
-    if path not in ("lanes", "carry", "gather"):
+    if path not in ("lanes", "lanes2", "carry", "gather"):
         raise ValueError(f"unknown bench path {path!r}")
 
     def body_lanes(i, acc):
@@ -195,7 +199,8 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
         ck_in = ck_in + _checksum_cols(tuple(x[r]
                                              for r in range(RECORD_WORDS)))
         out = pallas_sort.sort_lanes(x, num_keys=KEY_WORDS, tile=tile,
-                                     interpret=interpret)
+                                     interpret=interpret,
+                                     two_phase=path == "lanes2")
         ck_out = ck_out + _checksum_cols(tuple(out[r]
                                                for r in range(RECORD_WORDS)))
         viol = viol + _violations_cols(out[0], out[1], out[2])
@@ -212,7 +217,7 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
         return (viol, ck_in, ck_out)
 
     zero = jnp.uint32(0)
-    body = body_lanes if path == "lanes" else body_cols
+    body = body_lanes if path in ("lanes", "lanes2") else body_cols
     return lax.fori_loop(0, k, body, (jnp.int32(0), zero, zero))
 
 

@@ -114,7 +114,24 @@ def _cmp_exchange(x, j: int, asc_mask, key_rows_idx):
     return jnp.where(keep_self, x, other)
 
 
-def _tile_sort_kernel(x_ref, o_ref, *, tile, num_keys, tb_row, alternate):
+def _keys_view(x, num_keys, tb_row):
+    """8-row (one sublane tile) working set for the two-phase engine:
+    rows [keys..., tie-break, lane-position, zero pad]. The network runs
+    on THIS view (4x less data movement per compare-exchange than the
+    full 32 rows); the position row rides through as payload and ends
+    up holding, for each sorted position, its SOURCE lane — the gather
+    index that then moves the full-width payload ONCE."""
+    n = x.shape[1]
+    pos = lax.broadcasted_iota(jnp.uint32, (1, n), 1)
+    pad = jnp.zeros((8 - num_keys - 2, n), jnp.uint32)
+    seq8 = jnp.concatenate([x[:num_keys], x[tb_row:tb_row + 1], pos, pad],
+                           axis=0)
+    key_rows = list(range(num_keys)) + [num_keys]
+    return seq8, key_rows, num_keys + 1  # (view, key row idx, pos row)
+
+
+def _tile_sort_kernel(x_ref, o_ref, *, tile, num_keys, tb_row, alternate,
+                      two_phase):
     t = pl.program_id(0)
     x = x_ref[...]
     lane = lax.broadcasted_iota(jnp.int32, (1, tile), 1)
@@ -122,13 +139,17 @@ def _tile_sort_kernel(x_ref, o_ref, *, tile, num_keys, tb_row, alternate):
     gidx = (lane + t * tile).astype(jnp.uint32)
     x = jnp.where(lax.broadcasted_iota(jnp.int32, x.shape, 0) == tb_row,
                   jnp.broadcast_to(gidx, x.shape), x)
-    key_rows_idx = list(range(num_keys)) + [tb_row]
     # whole-tile direction alternates by parity so merge inputs are
     # bitonic as stored (single-tile arrays sort ascending)
     if alternate:
         tile_asc = jnp.broadcast_to((t % 2) == 0, (1, tile))
     else:
         tile_asc = jnp.broadcast_to(jnp.bool_(True), (1, tile))
+
+    if two_phase:
+        net, key_rows_idx, pos_row = _keys_view(x, num_keys, tb_row)
+    else:
+        net, key_rows_idx = x, list(range(num_keys)) + [tb_row]
     k = 2
     while k <= tile:
         if k == tile:
@@ -139,20 +160,23 @@ def _tile_sort_kernel(x_ref, o_ref, *, tile, num_keys, tb_row, alternate):
             asc = ((lane & k) == 0) == tile_asc
         j = k // 2
         while j >= 1:
-            x = _cmp_exchange(x, j, asc, key_rows_idx)
+            net = _cmp_exchange(net, j, asc, key_rows_idx)
             j //= 2
         k *= 2
-    o_ref[...] = x
+    if two_phase:
+        o_ref[...] = jnp.take(x, net[pos_row].astype(jnp.int32), axis=1)
+    else:
+        o_ref[...] = net
 
 
 @partial(jax.jit, static_argnames=("tile", "num_keys", "tb_row",
-                                   "alternate", "interpret"))
+                                   "alternate", "interpret", "two_phase"))
 def _tile_sort(x, tile: int, num_keys: int, tb_row: int, alternate: bool,
-               interpret: bool = False):
+               interpret: bool = False, two_phase: bool = False):
     rows, n = x.shape
     return pl.pallas_call(
         partial(_tile_sort_kernel, tile=tile, num_keys=num_keys,
-                tb_row=tb_row, alternate=alternate),
+                tb_row=tb_row, alternate=alternate, two_phase=two_phase),
         grid=(n // tile,),
         in_specs=[pl.BlockSpec((rows, tile), lambda t: (0, t))],
         out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
@@ -255,7 +279,7 @@ def _pass_splits(x, run_len, final, tile: int, num_keys: int, tb_row: int):
 
 def _merge_pass_kernel(splits_ref, splits_nxt_ref, x_hbm, o_ref, a_bufs,
                        b_bufs, sem_a, sem_b, *, tile, num_keys, tb_row,
-                       split_blk):
+                       split_blk, two_phase):
     """One output tile of one merge pass (see _pass_splits for the rank
     bookkeeping; every pass-dependent scalar arrives via splits_ref, so
     this kernel compiles once and serves all log2(n/tile) passes).
@@ -329,18 +353,28 @@ def _merge_pass_kernel(splits_ref, splits_nxt_ref, x_hbm, o_ref, a_bufs,
                        jnp.broadcast_to(_INF, b_rows.shape), b_rows)
 
     seq = jnp.concatenate([a_rows, b_rows], axis=1)
-    key_rows_idx = list(range(num_keys)) + [tb_row]
     asc_mask = jnp.broadcast_to(out_asc, (1, 2 * tile))
+    if two_phase:
+        net, key_rows_idx, pos_row = _keys_view(seq, num_keys, tb_row)
+    else:
+        net, key_rows_idx = seq, list(range(num_keys)) + [tb_row]
     j = tile
     while j >= 1:
-        seq = _cmp_exchange(seq, j, asc_mask, key_rows_idx)
+        net = _cmp_exchange(net, j, asc_mask, key_rows_idx)
         j //= 2
-    o_ref[...] = jnp.where(out_asc, seq[:, :tile], seq[:, tile:])
+    if two_phase:
+        # select the kept half's indices BEFORE gathering: the gather is
+        # this path's cost center, no point moving lanes we discard
+        idx = jnp.where(out_asc, net[pos_row, :tile], net[pos_row, tile:])
+        o_ref[...] = jnp.take(seq, idx.astype(jnp.int32), axis=1)
+    else:
+        o_ref[...] = jnp.where(out_asc, net[:, :tile], net[:, tile:])
 
 
-@partial(jax.jit, static_argnames=("tile", "num_keys", "tb_row", "interpret"))
+@partial(jax.jit, static_argnames=("tile", "num_keys", "tb_row", "interpret",
+                                   "two_phase"))
 def _merge_pass(x, splits, tile: int, num_keys: int, tb_row: int,
-                interpret: bool = False):
+                interpret: bool = False, two_phase: bool = False):
     rows, n = x.shape
     # The splits table is BLOCKED into SMEM a few rows per grid step: a
     # whole-table scalar prefetch would put [num_tiles, 8] int32 in SMEM
@@ -357,7 +391,7 @@ def _merge_pass(x, splits, tile: int, num_keys: int, tb_row: int,
                        memory_space=pltpu.SMEM)
     return pl.pallas_call(
         partial(_merge_pass_kernel, tile=tile, num_keys=num_keys,
-                tb_row=tb_row, split_blk=split_blk),
+                tb_row=tb_row, split_blk=split_blk, two_phase=two_phase),
         grid=(n // tile,),
         in_specs=[blk, blk, pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
@@ -374,13 +408,20 @@ def _merge_pass(x, splits, tile: int, num_keys: int, tb_row: int,
 
 
 def sort_lanes(x, num_keys: int, tb_row: int = TB_ROW_DEFAULT,
-               tile: int = 1024, interpret: bool = False):
+               tile: int = 1024, interpret: bool = False,
+               two_phase: bool = False):
     """Full stable sort of records in lanes layout.
 
     ``x``: uint32[ROWS, n] with key words in rows [0, num_keys); row
     ``tb_row`` is overwritten with the arrival index (stability) and
     holds it in the output. n must be a power-of-two multiple of
     ``tile`` (pad with +inf-key records otherwise).
+
+    ``two_phase``: run every bitonic network on an 8-row keys view and
+    move the 32-row payload with ONE lane gather per kernel instead of
+    through every compare-exchange stage (~4x less data movement per
+    stage; requires Mosaic to lower a dynamic lane-axis gather — see
+    scripts/probe_gather.py; needs num_keys <= 6).
 
     Returns the sorted [ROWS, n] array (ascending by keys, stable by
     arrival among equal keys).
@@ -395,9 +436,11 @@ def sort_lanes(x, num_keys: int, tb_row: int = TB_ROW_DEFAULT,
                          f"tile={tile}")
     if not 0 < num_keys <= tb_row < rows:
         raise ValueError(f"bad num_keys={num_keys} / tb_row={tb_row}")
+    if two_phase and num_keys + 2 > 8:
+        raise ValueError(f"two_phase needs num_keys <= 6, got {num_keys}")
     levels = int(np.log2(n // tile))
     x = _tile_sort(x, tile, num_keys, tb_row, alternate=levels > 0,
-                   interpret=interpret)
+                   interpret=interpret, two_phase=two_phase)
     if levels == 0:
         return x
 
@@ -410,6 +453,6 @@ def sort_lanes(x, num_keys: int, tb_row: int = TB_ROW_DEFAULT,
         final = lvl == levels - 1
         splits = _pass_splits(x, run_len, final, tile, num_keys, tb_row)
         return _merge_pass(x, splits, tile, num_keys, tb_row,
-                           interpret=interpret)
+                           interpret=interpret, two_phase=two_phase)
 
     return lax.fori_loop(0, levels, body, x)
