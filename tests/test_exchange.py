@@ -268,3 +268,77 @@ def test_two_axis_dcn_ici_mesh_matches_flat():
     r3.check()
     nv = np.asarray(r3.valid_counts).reshape(-1)
     assert nv[0] == 512 and nv[1:].sum() == 0
+
+
+@pytest.mark.parametrize("seed", [41, 42, 43])
+def test_distributed_sort_randomized_boundaries(seed):
+    # randomized shapes/capacities around the rounding boundaries the
+    # dryrun's tiny shapes never reach: per-device rows not divisible
+    # by p, capacities exactly at / one under the max bucket, duplicate
+    # keys, and 1-record buckets
+    rng = np.random.default_rng(seed)
+    mesh = _mesh()
+    p = 8
+    n = p * int(rng.integers(50, 400))
+    w = int(rng.integers(2, 7))
+    nk = int(rng.integers(1, min(3, w) + 1))
+    words = _random_words(n, w, seed=seed)
+    if seed % 2:
+        # heavy duplication stresses stability + splitter ties
+        words[:, 0] = rng.integers(0, 5, size=n).astype(np.uint32) << 29
+    spl = uniform_splitters(p)
+    # max bucket size determines the exact-fit capacity
+    dest = np.searchsorted(spl, words[:, 0], side="right")
+    shard = n // p
+    counts = np.zeros((p, p), np.int64)
+    for s in range(p):
+        np.add.at(counts[s], dest[s * shard:(s + 1) * shard], 1)
+    maxb = int(counts.max())
+    for cap in (maxb, max(1, maxb - 1), max(1, maxb // 3)):
+        res = distributed_sort_step(words, spl, mesh, AXIS, capacity=cap,
+                                    num_keys=nk)
+        res.check()
+        out = np.asarray(res.words).reshape(p, -1, w)
+        nv = np.asarray(res.valid_counts).reshape(-1)
+        got = np.concatenate([out[d, :nv[d]] for d in range(p)])
+        assert got.shape[0] == n, (cap, got.shape)
+        keys = [tuple(r[:nk]) for r in got]
+        assert keys == sorted(keys), f"cap={cap}: unsorted"
+        assert sorted(map(tuple, got)) == sorted(map(tuple, words)), \
+            f"cap={cap}: multiset changed"
+
+
+def test_distributed_sort_realistic_size():
+    # 64K x 6-word records over the 8-device mesh — two orders of
+    # magnitude beyond the dryrun's 1,024-record shapes; checks order,
+    # multiset survival and the per-device partition totality contract
+    # (every record lands on exactly the device its key range owns,
+    # reference MOFServlet.cc:28-96)
+    mesh = _mesh()
+    p, n, w = 8, 1 << 16, 6
+    words = _random_words(n, w, seed=55)
+    spl = uniform_splitters(p)
+    res = distributed_sort_step(words, spl, mesh, AXIS,
+                                capacity=2 * n // (p * p), num_keys=3)
+    res.check()
+    out = np.asarray(res.words).reshape(p, -1, w)
+    nv = np.asarray(res.valid_counts).reshape(-1)
+    edges = np.concatenate([[0], spl.astype(np.uint64), [1 << 32]])
+    rows = []
+    for d in range(p):
+        shard = out[d, :nv[d]]
+        rows.append(shard)
+        if nv[d]:
+            assert shard[:, 0].astype(np.uint64).min() >= edges[d]
+            assert shard[:, 0].astype(np.uint64).max() < edges[d + 1]
+    got = np.concatenate(rows)
+    assert got.shape[0] == n
+    keys = got[:, :3]
+    assert np.array_equal(
+        keys, keys[np.lexsort((keys[:, 2], keys[:, 1], keys[:, 0]))])
+    # true ROW multiset check (per-column sorts would miss payload words
+    # swapped between records — the gather-bug corruption class)
+    def by_rows(a):
+        return a[np.lexsort(tuple(a[:, c] for c in range(w - 1, -1, -1)))]
+
+    assert np.array_equal(by_rows(got), by_rows(words))
