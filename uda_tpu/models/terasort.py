@@ -102,11 +102,13 @@ def single_chip_sort(words: jax.Array, path: str = "auto") -> jax.Array:
     reference's k-way PQ merge, src/Merger/MergeQueue.h:276-427).
 
     Payload-movement strategy (see bench_step for the full trade-off):
-    "carry" rides the 23 value words through the sort network (~12 GB/s
-    at runtime but superlinear-in-operands compile time on TPU
-    remote-compile backends), "gather" computes the permutation with a
-    4-operand sort and applies it with per-column gathers (bounded
-    compile, gather-bound runtime). "auto" resolves per the ambient
+    "carry" rides the 23 value words through the sort network (fast at
+    runtime — ~12 GB/s was measured on a CPU backend; never compiled on
+    the TPU remote-compile service, where variadic-sort compile time is
+    superlinear in operand count), "gather" computes the permutation
+    with a 4-operand sort and applies it with per-column gathers
+    (bounded compile; 0.30 GB/s measured END TO END on the v5e chip,
+    BENCH_r02 — random per-element HBM gathers dominate). "auto" resolves per the ambient
     backend at call time (resolve_sort_path).
     """
     return _single_chip_sort(words, resolve_sort_path(path))
@@ -174,14 +176,17 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
       gather (sort_lanes two_phase=True). Faster where Mosaic lowers
       the dynamic gather well; bench.py decides by a measured fly-off.
     - ``path="carry"``: the payload rides the ``lax.sort`` network as
-      extra operands. Fast at runtime (~12 GB/s measured) but XLA's
+      extra operands. Fast at runtime (~12 GB/s, CPU-backend
+      measurement) but XLA's
       variadic-sort compile time grows superlinearly in operand count —
       on remote-compile backends the 26-operand program can take hours
       to compile ONCE (it persists in the compile cache afterwards).
     - ``path="gather"``: a 4-operand sort (3 key words + iota) computes
       the permutation, then per-column gathers apply it. Compiles in
-      ~1 min cold; runtime is gather-bound (TPU random gathers are
-      element-at-a-time, ~2.4 GB/s).
+      ~1 min cold; runtime is gather-bound: 0.30 GB/s measured on the
+      v5e chip at the full bench shape (BENCH_r02) — TPU random
+      per-element gathers are the slowest payload mover by far, which
+      is what motivated the lanes pipeline.
 
     bench.py probes which path is compilable within its time budget and
     picks the fastest (see bench.py --probe).
