@@ -160,10 +160,38 @@ def _probe(path: str, timeout: float) -> bool:
     return ok
 
 
+def _backend_alive(timeout: float = 180.0) -> bool:
+    """Cheap liveness gate: one tiny device op in a capped subprocess.
+    A wedged accelerator pool hangs INSIDE client creation (observed on
+    the tunneled backend: a stuck device claim blocks make_c_api_client
+    forever), which would otherwise cost one full probe timeout PER
+    candidate path before the bench could report anything."""
+    # honor an explicit JAX_PLATFORMS like _enable_cache does (the TPU
+    # deployment's sitecustomize force-selects its backend via
+    # jax.config, silently overriding the env var)
+    code = ("import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+            "p and p != 'axon' and jax.config.update('jax_platforms', p); "
+            "import numpy as np, jax.numpy as jnp; "
+            "x = jnp.asarray(np.arange(8)); assert int(x.sum()) == 28; "
+            "print('alive')")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              timeout=timeout, capture_output=True,
+                              text=True, check=False)
+        return proc.returncode == 0 and "alive" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--probe":
         _compile_and_check(sys.argv[2])
         return
+
+    if not _backend_alive():
+        raise SystemExit(
+            "backend liveness check failed: device op did not complete "
+            "(accelerator pool unreachable or wedged); not probing")
 
     # Candidate selection: every lanes variant that compiles enters a
     # measured fly-off and the FASTER one wins (compile success alone
