@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# CI driver: build + test + regression + artifact bundle in one gate.
+#
+# The uda_tpu analogue of the reference's nightly build+smoke system
+# (reference scripts/build/: per-Hadoop-version builds, smoke runs,
+# db/latest_hadoops bookkeeping) collapsed to what this framework
+# needs: native libs -> unit/engine tests -> the workload-ladder
+# regression -> one artifacts directory a nightly can archive.
+#
+# Usage: scripts/build/ci.sh [artifacts_dir]
+# Exit code != 0 on any gate failure (the cases/uda.cases CI contract).
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+ART="${1:-ci_artifacts}"
+mkdir -p "$ART"
+echo "== uda_tpu CI $(date -u +%Y-%m-%dT%H:%M:%SZ) ==" | tee "$ART/ci.log"
+
+echo "-- native build" | tee -a "$ART/ci.log"
+make -C uda_tpu/native 2>&1 | tee -a "$ART/ci.log"
+make -C uda_tpu/native libuda_tpu_bridge.so 2>&1 | tee -a "$ART/ci.log"
+if command -v javac >/dev/null 2>&1; then
+  echo "-- java build" | tee -a "$ART/ci.log"
+  make -C java 2>&1 | tee -a "$ART/ci.log"
+else
+  echo "-- java build skipped (no JDK)" | tee -a "$ART/ci.log"
+fi
+
+echo "-- unit + engine tests" | tee -a "$ART/ci.log"
+python -m pytest tests/ -q 2>&1 | tee "$ART/pytest.log" | tail -2
+
+echo "-- workload-ladder regression" | tee -a "$ART/ci.log"
+python scripts/regression/run_regression.py --size small \
+  --out "$ART/regression" 2>&1 | tee -a "$ART/ci.log" | tail -3
+
+echo "-- multi-chip dryrun" | tee -a "$ART/ci.log"
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
+  2>&1 | tee -a "$ART/ci.log" | tail -1
+
+echo "== CI PASS ==" | tee -a "$ART/ci.log"

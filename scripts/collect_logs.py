@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Log/diagnostics collector: bundle everything a failure analysis needs.
+
+The uda_tpu analogue of the reference's utils/ log collectors
+(reference utils/master/daemon-log-collector.sh and the slave variants
+gather daemon + job logs from every node of the cluster into one
+archive). Here the sources are local: uda log files (the
+``mapred.uda.log.to.unique.file`` channel), bench/regression artifacts,
+probe failure logs, metrics dumps, and the environment snapshot.
+
+Usage: python scripts/collect_logs.py [--out DIR] [--extra PATH ...]
+Prints the bundle directory; never fails the caller (collection is
+best-effort by design — it runs AFTER something already went wrong).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import platform
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _snapshot_env(out_dir: str) -> None:
+    info = {
+        "time_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": platform.platform(),
+        "python": sys.version,
+        "argv_env": {k: v for k, v in os.environ.items()
+                     if k.startswith(("JAX_", "XLA_", "UDA_TPU_"))},
+    }
+    try:
+        info["git_head"] = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=REPO, capture_output=True,
+            text=True, timeout=30).stdout.strip()
+    except Exception:  # noqa: BLE001 - best-effort collection
+        pass
+    with open(os.path.join(out_dir, "environment.json"), "w") as f:
+        json.dump(info, f, indent=2)
+
+
+def collect(out_dir: str, extra: list[str]) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    _snapshot_env(out_dir)
+    patterns = [
+        os.path.join(REPO, ".bench_probe_*.log"),
+        os.path.join(REPO, "BENCH_r*.json"),
+        os.path.join(REPO, "MULTICHIP_r*.json"),
+        os.path.join(REPO, "ci_artifacts", "**", "*"),
+        # the private-file logging channel (udaNetMerger.log naming of
+        # the reference, IOUtility.cc:406-466)
+        os.path.join(REPO, "*.uda.log"),
+        "/tmp/uda_tpu*.log",
+    ] + list(extra)
+    copied = []
+    for pat in patterns:
+        for path in glob.glob(pat, recursive=True):
+            if os.path.isfile(path):
+                # preserve repo-relative structure: same-named files
+                # from different subdirs (regression results, nested
+                # ci logs) must not overwrite each other
+                if os.path.commonpath([REPO, os.path.abspath(path)]) \
+                        == REPO:
+                    rel = os.path.relpath(os.path.abspath(path), REPO)
+                else:
+                    rel = os.path.abspath(path).lstrip(os.sep)
+                dst = os.path.join(out_dir, rel)
+                try:
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    shutil.copy2(path, dst)
+                    copied.append(rel)
+                except OSError:
+                    pass
+    with open(os.path.join(out_dir, "MANIFEST"), "w") as f:
+        f.write("\n".join(sorted(copied)) + "\n")
+    return out_dir
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        REPO, f"diag_{time.strftime('%Y%m%d_%H%M%S')}"))
+    ap.add_argument("--extra", nargs="*", default=[])
+    args = ap.parse_args()
+    print(collect(args.out, args.extra))
+
+
+if __name__ == "__main__":
+    main()

@@ -380,3 +380,116 @@ def test_host_routing_client_lazy_connect(tmp_path):
     router.start_fetch(ShuffleRequest(job, "m", 0, 0, 10, host="nope"),
                        errs.append)
     assert errs and isinstance(errs[0], KeyError)
+
+
+class FlakyClient:
+    """Fault-injecting transport: fails the first ``fail_count`` fetches
+    per (map, offset-0 restart) — the fake the reference never had
+    (SURVEY §4.5: no mocks of the RDMA layer existed)."""
+
+    def __init__(self, inner, fail_count=2):
+        self.inner = inner
+        self.fail_count = fail_count
+        self.calls = 0
+        import threading as _t
+        self._lock = _t.Lock()
+
+    def start_fetch(self, req, on_complete):
+        with self._lock:
+            self.calls += 1
+            fail = self.calls <= self.fail_count
+        if fail:
+            on_complete(ConnectionError(f"injected failure {self.calls}"))
+            return
+        self.inner.start_fetch(req, on_complete)
+
+    def stop(self):
+        self.inner.stop()
+
+
+def test_fetch_retry_recovers_from_transient_failures(tmp_path):
+    # transport errors within the retry budget are retried from offset 0
+    # (the reference's connect-retry x5, RDMAClient.cc:41, 235-344) and
+    # the merge output is byte-exact
+    import functools
+    import io
+
+    from tests.helpers import make_mof_tree, map_ids
+    from uda_tpu.merger import LocalFetchClient, MergeManager
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.utils import comparators
+    from uda_tpu.utils.config import Config
+    from uda_tpu.utils.ifile import IFileReader
+
+    job = "jobFlaky"
+    expected = make_mof_tree(str(tmp_path), job, 3, 1, 30, seed=81)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    flaky = FlakyClient(LocalFetchClient(engine), fail_count=2)
+    try:
+        mm = MergeManager(flaky, "uda.tpu.RawBytes", Config())
+        blocks = []
+        mm.run(job, map_ids(job, 3), 0, lambda b: blocks.append(bytes(b)))
+    finally:
+        engine.stop()
+    got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    want = sorted(expected[0], key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want
+
+
+def test_fetch_retry_budget_exhaustion_fails(tmp_path):
+    from tests.helpers import make_mof_tree, map_ids
+    from uda_tpu.merger import LocalFetchClient, MergeManager
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.utils.config import Config
+
+    job = "jobFlaky2"
+    make_mof_tree(str(tmp_path), job, 1, 1, 10, seed=82)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    flaky = FlakyClient(LocalFetchClient(engine), fail_count=10**6)
+    try:
+        mm = MergeManager(flaky, "uda.tpu.RawBytes", Config())
+        with pytest.raises(ConnectionError):
+            mm.run(job, map_ids(job, 1), 0, lambda b: None)
+        # 1 initial + 3 retries (uda.tpu.fetch.retries default)
+        assert flaky.calls == 4
+    finally:
+        engine.stop()
+
+
+def test_fetch_retry_inline_failures_do_not_recurse(tmp_path):
+    # a transport failing INLINE (connect error delivered on the same
+    # stack, like HostRoutingClient's connect failure) must be retried
+    # iteratively: a huge retry budget may not overflow the stack
+    from uda_tpu.merger.segment import Segment
+
+    class InlineFail:
+        calls = 0
+
+        def start_fetch(self, req, on_complete):
+            InlineFail.calls += 1
+            on_complete(ConnectionError("inline"))
+
+    seg = Segment(InlineFail(), "j", "m", 0, 1024, retries=5000)
+    seg.start()
+    with pytest.raises(ConnectionError):
+        seg.wait(timeout=30)
+    assert InlineFail.calls == 5001
+
+
+def test_fetch_sync_raise_fails_segment_not_transport_thread(tmp_path):
+    # a transport that RAISES from start_fetch (e.g. DataEngine already
+    # stopped) must fail the segment instead of leaking the exception
+    # into the completion thread and leaving wait() hanging
+    from uda_tpu.merger.segment import Segment
+    from uda_tpu.utils.errors import StorageError
+
+    class RaiseClient:
+        def start_fetch(self, req, on_complete):
+            raise StorageError("engine stopped")
+
+    seg = Segment(RaiseClient(), "j", "m", 0, 1024, retries=2)
+    seg.start()
+    with pytest.raises(StorageError):
+        seg.wait(timeout=30)

@@ -89,8 +89,9 @@ class MergeManager:
         # entries are "map_id" or ("host", "map_id") — the latter routes
         # through a per-host transport (HostRoutingClient)
         entries = [m if isinstance(m, tuple) else ("", m) for m in map_ids]
+        retries = self.cfg.get("uda.tpu.fetch.retries")
         segs = [Segment(self.client, job_id, mid, reduce_id,
-                        self.chunk_size, host=host)
+                        self.chunk_size, host=host, retries=retries)
                 for host, mid in entries]
         index_of = {id(s): i for i, s in enumerate(segs)}
         order = list(range(len(segs)))

@@ -24,7 +24,10 @@ from typing import Optional
 from uda_tpu.mofserver.data_engine import DataEngine, FetchResult, ShuffleRequest
 from uda_tpu.utils.errors import MergeError
 from uda_tpu.utils.ifile import RecordBatch, crack_partial
+from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
+
+log = get_logger()
 
 __all__ = ["InputClient", "LocalFetchClient", "HostRoutingClient",
            "Segment"]
@@ -130,7 +133,8 @@ class Segment:
     """
 
     def __init__(self, client: InputClient, job_id: str, map_id: str,
-                 reduce_id: int, chunk_size: int, host: str = ""):
+                 reduce_id: int, chunk_size: int, host: str = "",
+                 retries: int = 3):
         self.client = client
         self.job_id = job_id
         self.map_id = map_id
@@ -142,6 +146,9 @@ class Segment:
         self.on_done = None  # callback fired once when fetch finishes
         self._carry = b""
         self._next_offset = 0
+        self._retries_left = max(0, retries)
+        self._issuing = False
+        self._inline = self._PENDING
         self._done = threading.Event()
         self._error: Optional[Exception] = None
         self._lock = threading.Lock()
@@ -153,36 +160,88 @@ class Segment:
 
     # -- fetch driving ------------------------------------------------------
 
-    def start(self) -> None:
-        self._issue(0)
+    _PENDING = object()  # sentinel: no inline completion delivered
 
-    def _issue(self, offset: int) -> None:
+    def start(self) -> None:
+        self._drive(self._try_issue(0))
+
+    def _try_issue(self, offset: int):
+        """Issue one fetch. Returns None when the transport took it
+        asynchronously (the completion callback will fire later), or
+        the RESULT (FetchResult or Exception) when the transport raised
+        synchronously / invoked the callback inline — the caller's
+        _drive loop then processes it WITHOUT recursing, so a transport
+        that fails inline (e.g. a router's connect error) cannot
+        overflow the stack however large the retry budget is."""
         req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
                              offset, self.chunk_size, host=self.host)
-        self.client.start_fetch(req, self._on_complete)
+        with self._lock:
+            self._inline = self._PENDING
+            self._issuing = True
+        try:
+            self.client.start_fetch(req, self._on_complete)
+        except Exception as e:  # noqa: BLE001 - a sync raise must fail
+            # the segment, never escape into the transport's thread
+            with self._lock:
+                self._issuing = False
+            return e
+        with self._lock:
+            self._issuing = False
+            r = self._inline
+            self._inline = self._PENDING
+        return None if r is self._PENDING else r
 
     def _on_complete(self, result) -> None:
-        if isinstance(result, Exception):
-            self._error = result
-            self._done.set()
-            self._notify_done()
-            return
-        try:
-            last = self._ingest(result)
-        except Exception as e:  # crack errors -> surfaced to the waiter
-            self._error = e
-            self._done.set()
-            self._notify_done()
-            return
-        # notify exactly once, outside _ingest's try scope: an exception
-        # thrown by the on_done callback itself must NOT re-enter the
-        # error path above and fire on_done a second time (double credit
-        # release / double progress count)
-        if last:
-            self._done.set()
-            self._notify_done()
-        else:
-            self._issue(self._next_offset)
+        with self._lock:
+            if self._issuing:  # inline completion: hand back to _drive
+                self._inline = result
+                return
+        self._drive(result)
+
+    def _drive(self, result) -> None:
+        """Iterative fetch state machine (one outstanding fetch at a
+        time; runs on whichever thread delivered the completion)."""
+        while result is not None:
+            if isinstance(result, Exception):
+                # transport-level retry (the reference retries its
+                # connect dance 5x and RNR-retries sends,
+                # RDMAClient.cc:41, 235-344; RDMAComm.h:29): restart the
+                # WHOLE segment from offset 0 — re-fetch-the-MOF
+                # granularity, which also resets any decompressing
+                # wrapper's stream state cleanly
+                with self._lock:
+                    retry = self._retries_left > 0
+                    if retry:
+                        self._retries_left -= 1
+                        self.batches = []
+                        self._carry = b""
+                        self._next_offset = 0
+                if not retry:
+                    self._error = result
+                    self._done.set()
+                    self._notify_done()
+                    return
+                log.warn(f"fetch of {self.map_id} failed ({result}); "
+                         f"retrying ({self._retries_left} left)")
+                metrics.add("fetch_retries")
+                result = self._try_issue(0)
+                continue
+            try:
+                last = self._ingest(result)
+            except Exception as e:  # crack errors -> surfaced to waiter
+                self._error = e
+                self._done.set()
+                self._notify_done()
+                return
+            # notify exactly once, outside _ingest's try scope: an
+            # exception thrown by the on_done callback itself must NOT
+            # re-enter the error path above and fire on_done a second
+            # time (double credit release / double progress count)
+            if last:
+                self._done.set()
+                self._notify_done()
+                return
+            result = self._try_issue(self._next_offset)
 
     def _ingest(self, res: FetchResult) -> bool:
         """Absorb one chunk; returns True when the segment is complete.

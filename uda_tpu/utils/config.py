@@ -84,6 +84,9 @@ _FLAG_LIST = [
          "normalized key bytes carried in device sort columns (multiple of 4)"),
     Flag("uda.tpu.run.records", 1 << 20, int,
          "records per HBM-resident sorted run before spilling"),
+    Flag("uda.tpu.fetch.retries", 3, int,
+         "whole-segment re-fetch attempts after a transport error (the "
+         "reference retries its RDMA connect dance 5x, RDMAClient.cc:41)"),
     Flag("uda.tpu.arena.slots", 16, int,
          "buffer-pair slots in the HBM staging arena"),
     Flag("uda.tpu.exchange.chunk.records", 1 << 18, int,
