@@ -93,3 +93,12 @@ def test_merge_pair_max_width_31():
         pallas_merge.merge_sorted_pair(
             np.zeros((4, 32), np.uint32), np.zeros((4, 32), np.uint32), 2,
             interpret=True)
+
+
+def test_merge_pair_two_phase_matches_default():
+    a = _sorted_run(700, 7, 3, seed=11, dup_rate=1.0)
+    b = _sorted_run(500, 7, 3, seed=12, dup_rate=1.0)
+    d = np.asarray(pallas_merge.merge_sorted_pair(a, b, 3, interpret=True))
+    t = np.asarray(pallas_merge.merge_sorted_pair(a, b, 3, interpret=True,
+                                                  two_phase=True))
+    np.testing.assert_array_equal(d, t)

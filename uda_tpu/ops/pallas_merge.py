@@ -87,8 +87,10 @@ def merge_splits(a, b, tile: int, num_keys: int):
     return lo.astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("num_keys", "tile", "interpret"))
-def _merge_sorted_pair_jit(a, b, num_keys: int, tile: int, interpret: bool):
+@partial(jax.jit, static_argnames=("num_keys", "tile", "interpret",
+                                   "two_phase"))
+def _merge_sorted_pair_jit(a, b, num_keys: int, tile: int, interpret: bool,
+                           two_phase: bool):
     """Shape-specialized core: jit so repeat calls at the same (na, nb)
     hit the executable cache instead of re-tracing the pallas_call
     (the overlapped merger calls this many times per job)."""
@@ -119,16 +121,18 @@ def _merge_sorted_pair_jit(a, b, num_keys: int, tile: int, interpret: bool):
                          run_lanes(b, nb, na, True)], axis=1)
     splits = _pass_splits(x, jnp.int32(L), jnp.bool_(True), tile,
                           num_keys, tb)
-    out = _merge_pass(x, splits, tile, num_keys, tb, interpret=interpret)
+    out = _merge_pass(x, splits, tile, num_keys, tb, interpret=interpret,
+                      two_phase=two_phase)
     return out[:wcols, :na + nb].T
 
 
 def merge_sorted_pair(a, b, num_keys: int, tile: int = 512,
-                      interpret: bool = False):
+                      interpret: bool = False, two_phase: bool = False):
     """Merge two key-sorted row matrices into one (stable: A's rows
     precede B's on equal keys). ``a``/``b``: uint32[n, W] with key words
     in the leading ``num_keys`` columns, W <= 31. The output has
-    a.shape[0]+b.shape[0] rows."""
+    a.shape[0]+b.shape[0] rows. ``two_phase`` selects the keys-view +
+    payload-gather kernel variant (see pallas_sort.sort_lanes)."""
     if tile <= 0 or (tile & (tile - 1)) != 0 or tile % 128:
         raise ValueError(f"tile must be a power of two multiple of 128, "
                          f"got {tile} (the lanes merge kernel requires "
@@ -142,4 +146,5 @@ def merge_sorted_pair(a, b, num_keys: int, tile: int = 512,
         return b
     if b.shape[0] == 0:
         return a
-    return _merge_sorted_pair_jit(a, b, num_keys, tile, interpret)
+    return _merge_sorted_pair_jit(a, b, num_keys, tile, interpret,
+                                  two_phase)
