@@ -342,3 +342,23 @@ def test_distributed_sort_realistic_size():
         return a[np.lexsort(tuple(a[:, c] for c in range(w - 1, -1, -1)))]
 
     assert np.array_equal(by_rows(got), by_rows(words))
+
+
+def test_lanes2_payload_path_matches_lanes():
+    # the two-phase engine behind the distributed step must be
+    # byte-identical to the one-phase lanes path
+    mesh = _mesh()
+    p = 8
+    n = p * 48
+    words = _random_words(n, 5, seed=67)
+    words[: n // 2, 0] = words[n // 2:, 0]
+    spl = uniform_splitters(p)
+    kw = dict(capacity=n // p, num_keys=2, multiround="never")
+    one = distributed_sort_step(words, spl, mesh, AXIS,
+                                payload_path="lanes", **kw)
+    two = distributed_sort_step(words, spl, mesh, AXIS,
+                                payload_path="lanes2", **kw)
+    one.check()
+    two.check()
+    np.testing.assert_array_equal(np.asarray(one.words),
+                                  np.asarray(two.words))

@@ -46,11 +46,11 @@ _INVALID = np.uint32(0xFFFFFFFF)
 
 
 def _lanes_interpret(payload_path: str, mesh: Mesh) -> bool:
-    """Pallas interpret-mode flag for the lanes path, resolved EAGERLY
+    """Pallas interpret-mode flag for the lanes paths, resolved EAGERLY
     off the MESH's device platform (CPU meshes — tests, dryruns — have
     no Mosaic lowering, even when the host's default backend is a TPU).
     False for every other path so it never splits their jit cache."""
-    return (payload_path == "lanes"
+    return (payload_path in ("lanes", "lanes2")
             and mesh.devices.flat[0].platform == "cpu")
 
 
@@ -65,7 +65,7 @@ def _resolve_payload_path(path: str, wcols: int, num_keys: int) -> str:
     from uda_tpu.ops.sort import resolve_sort_path
 
     resolved = resolve_sort_path(path, lanes_ok=True)
-    if (resolved == "lanes" and path == "auto"
+    if (resolved in ("lanes", "lanes2") and path == "auto"
             and num_keys + 1 + wcols > pallas_sort.TB_ROW_DEFAULT):
         return "gather"
     return resolved
@@ -140,8 +140,9 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
     terasort.bench_step — a row gather on the [n, W] matrix would touch
     the lane-padded layout)."""
     n, wcols = flat.shape
-    if payload_path == "lanes":
-        return _sort_valid_rows_lanes(flat, valid, num_keys, interpret)
+    if payload_path in ("lanes", "lanes2"):
+        return _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
+                                      two_phase=payload_path == "lanes2")
     keycols = tuple(jnp.where(valid, flat[:, i], _INVALID)
                     for i in range(num_keys))
     invalid_last = jnp.where(valid, 0, 1)
@@ -158,7 +159,8 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
                            for i in range(wcols)), axis=1)
 
 
-def _sort_valid_rows_lanes(flat, valid, num_keys, interpret):
+def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
+                           two_phase=False):
     """Lanes-path body of _sort_valid_rows: pack rows into the [32, n]
     lanes layout with sort key (masked key words, invalid flag), pad the
     lane count to a power of two with +inf-key lanes, run the Pallas
@@ -192,7 +194,8 @@ def _sort_valid_rows_lanes(flat, valid, num_keys, interpret):
     # flag 1), so no arrival-index comparison against padding ever
     # decides a real lane's position
     out = pallas_sort.sort_lanes(mat, num_keys=num_keys + 1, tb_row=tb,
-                                 tile=tile, interpret=interpret)
+                                 tile=tile, interpret=interpret,
+                                 two_phase=two_phase)
     return out[first_pay:first_pay + wcols, :n].T
 
 
