@@ -34,21 +34,15 @@ import numpy as np
 from jax import lax
 
 from uda_tpu.ops import pallas_sort
-from uda_tpu.ops.pallas_sort import _merge_pass, _pass_splits
+from uda_tpu.ops.pallas_sort import _lex_lt, _merge_pass, _pass_splits
 
 __all__ = ["merge_sorted_pair", "merge_splits"]
 
 _INF = np.uint32(0xFFFFFFFF)
 
-
-def _key_less(a_cols, b_cols):
-    """Lexicographic a < b over tuples of uint32 column arrays."""
-    lt = jnp.zeros(a_cols[0].shape, jnp.bool_)
-    eq = jnp.ones(a_cols[0].shape, jnp.bool_)
-    for a, b in zip(a_cols, b_cols):
-        lt = lt | (eq & (a < b))
-        eq = eq & (a == b)
-    return lt
+# lexicographic a < b over tuples of uint32 arrays — shared with the
+# lanes kernels (single implementation of the compare semantics)
+_key_less = _lex_lt
 
 
 @partial(jax.jit, static_argnames=("tile", "num_keys"))
