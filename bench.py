@@ -67,12 +67,16 @@ PROBE_TIMEOUT = float(os.environ.get("UDA_TPU_BENCH_PROBE_TIMEOUT", 600))
 # session behind it — one failed carry probe poisons the whole service.
 # Opt in with UDA_TPU_BENCH_TRY_CARRY=1 only where compiles are local
 # (CPU) or known-fast.
-# "lanes2" = the two-phase (keys-network + one payload gather) variant:
-# fastest when Mosaic lowers the dynamic lane gather, and the probe
-# falls through to "lanes" in seconds when it does not.
-PATHS = (("lanes2", "lanes", "carry", "gather")
+# "lanes2" = the two-phase (keys-network + one in-kernel payload
+# gather) variant: fastest when Mosaic lowers the dynamic lane gather,
+# and the probe falls through in seconds when it does not. "keys8" =
+# the whole cascade on an 8-row keys-only array + ONE global XLA
+# payload gather (the same idea with the gather hoisted out of Mosaic —
+# it lowers everywhere).
+PATHS = (("lanes2", "keys8", "lanes", "carry", "gather")
          if os.environ.get("UDA_TPU_BENCH_TRY_CARRY") == "1"
-         else ("lanes2", "lanes", "gather"))
+         else ("lanes2", "keys8", "lanes", "gather"))
+FLYOFF_PATHS = frozenset({"lanes", "lanes2", "keys8"})
 
 
 def _enable_cache() -> None:
@@ -198,8 +202,8 @@ def main() -> None:
     # would let a slowly-lowered gather variant shadow the faster
     # pipeline); the non-lanes fallbacks are probed only when no lanes
     # variant compiles, first success wins.
-    lanes_variants = [p for p in PATHS if p.startswith("lanes")]
-    fallbacks = [p for p in PATHS if not p.startswith("lanes")]
+    lanes_variants = [p for p in PATHS if p in FLYOFF_PATHS]
+    fallbacks = [p for p in PATHS if p not in FLYOFF_PATHS]
     candidates = [p for p in lanes_variants if _probe(p, PROBE_TIMEOUT)]
     for path in fallbacks:
         if candidates:

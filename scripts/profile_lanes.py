@@ -50,6 +50,15 @@ def step(seed, n, k, stage, tile):
             out = pallas_sort._tile_sort(x, tile, terasort.KEY_WORDS,
                                          pallas_sort.TB_ROW_DEFAULT,
                                          alternate=True)
+        elif stage == "keys8":
+            out = terasort.sort_lanes_keys8(x, tile=tile)
+        elif stage == "keys8sort":
+            # the 8-row cascade alone: the payload gather's output is
+            # unused below (checksum over zero pad rows), so XLA DCEs it
+            out8 = terasort._keys8_parts(x, tile, False)[0]
+            out = jnp.concatenate(
+                [out8, jnp.zeros((pallas_sort.ROWS - 8, x.shape[1]),
+                                 jnp.uint32)], axis=0)
         else:
             out = pallas_sort.sort_lanes(x, num_keys=terasort.KEY_WORDS,
                                          tile=tile)
@@ -81,9 +90,14 @@ if __name__ == "__main__":
           f"{jax.devices()[0].platform}")
     t_gen = time_stage("gen")
     t_tile = time_stage("tilesort", 1024)
-    for tile in (1024, 2048, 4096):
-        try:
-            time_stage("full", tile)
-        except Exception as e:  # noqa: BLE001 - report and continue sweep
-            print(f"      full tile={tile}: FAILED {type(e).__name__}: "
-                  f"{str(e)[:200]}", flush=True)
+    for stage, tiles in (("full", (1024, 2048, 4096)),
+                         ("keys8sort", (4096, 8192, 16384)),
+                         ("keys8", (4096, 8192, 16384))):
+        for tile in tiles:
+            if (N % tile) or ((N // tile) & (N // tile - 1)):
+                continue
+            try:
+                time_stage(stage, tile)
+            except Exception as e:  # noqa: BLE001 - report, continue sweep
+                print(f"      {stage} tile={tile}: FAILED "
+                      f"{type(e).__name__}: {str(e)[:200]}", flush=True)

@@ -362,3 +362,24 @@ def test_lanes2_payload_path_matches_lanes():
     two.check()
     np.testing.assert_array_equal(np.asarray(one.words),
                                   np.asarray(two.words))
+
+
+def test_keys8_payload_path_matches_lanes():
+    # the keys8 engine (keys-only cascade + one global payload gather)
+    # behind the distributed step must be byte-identical to the
+    # one-phase lanes path, duplicate keys included
+    mesh = _mesh()
+    p = 8
+    n = p * 48
+    words = _random_words(n, 5, seed=68)
+    words[: n // 2, 0] = words[n // 2:, 0]
+    spl = uniform_splitters(p)
+    kw = dict(capacity=n // p, num_keys=2, multiround="never")
+    one = distributed_sort_step(words, spl, mesh, AXIS,
+                                payload_path="lanes", **kw)
+    k8 = distributed_sort_step(words, spl, mesh, AXIS,
+                               payload_path="keys8", **kw)
+    one.check()
+    k8.check()
+    np.testing.assert_array_equal(np.asarray(one.words),
+                                  np.asarray(k8.words))

@@ -61,6 +61,26 @@ def test_bench_step_lanes_path_validates():
     assert np.uint32(ck_in) == np.uint32(ck_out)
 
 
+def test_bench_step_keys8_path_validates():
+    viol, ck_in, ck_out = terasort.bench_step(
+        jax.random.key(5), 2048, 2, path="keys8", tile=512, interpret=True)
+    assert int(viol) == 0
+    assert np.uint32(ck_in) == np.uint32(ck_out)
+
+
+def test_sort_lanes_keys8_matches_sort_lanes():
+    # the keys8 engine (keys-only cascade + one global payload gather)
+    # must be byte-identical to the 32-row pipeline, stability included
+    from uda_tpu.ops import pallas_sort
+
+    x = np.asarray(terasort.teragen_lanes(jax.random.key(12), 2048)).copy()
+    x[:3, 100:300] = x[:3, 700:900]  # duplicate keys
+    a = np.asarray(pallas_sort.sort_lanes(x, num_keys=terasort.KEY_WORDS,
+                                          tile=512, interpret=True))
+    b = np.asarray(terasort.sort_lanes_keys8(x, tile=512, interpret=True))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_bench_step_lanes_checksum_matches_oracle():
     # the lanes checksum must use the same per-column multipliers as the
     # SoA paths: a sorted output altered by a column swap fails

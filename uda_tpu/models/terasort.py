@@ -39,8 +39,8 @@ from uda_tpu.parallel.distributed import (DistributedSortResult,
 from uda_tpu.parallel.mesh import SHUFFLE_AXIS
 
 __all__ = ["KEY_WORDS", "RECORD_WORDS", "RECORD_BYTES", "teragen",
-           "teragen_lanes", "single_chip_sort", "distributed_terasort",
-           "validate_sorted"]
+           "teragen_lanes", "single_chip_sort", "sort_lanes_keys8",
+           "distributed_terasort", "validate_sorted"]
 
 KEY_WORDS = 3        # 10 key bytes -> 3 BE words (2 pad bytes, constant 0)
 VALUE_WORDS = 23     # 90 value bytes -> 23 words (2 pad bytes)
@@ -114,6 +114,59 @@ def single_chip_sort(words: jax.Array, path: str = "auto") -> jax.Array:
     return _single_chip_sort(words, resolve_sort_path(path))
 
 
+_KEYS8_ROWS = 8       # one sublane tile: 3 key rows + 4 pad + tie-break
+_KEYS8_TB = 7
+
+
+def _keys8_parts(x: jax.Array, tile: int, interpret: bool):
+    """The keys8 engine: run the ENTIRE bitonic cascade on an 8-row
+    keys-only array (one sublane tile: 3 key rows, 4 zero rows, the
+    tie-break row) and move the 23 payload rows ONCE with a global
+    XLA lane gather by the resulting permutation.
+
+    Rationale (v5e stage profile, scripts/profile_lanes.py): the 32-row
+    cascade is VPU-bound — every compare-exchange rolls/selects all 32
+    rows, and every merge pass sweeps the full 128 B/record through HBM.
+    The keys view cuts both by 4x; the single payload gather is the only
+    full-width pass besides generation. Unlike the in-kernel two-phase
+    gather (two_phase=True), the global gather is an XLA op — it lowers
+    on every backend (scripts/probe_gather.py: no dynamic lane-gather
+    formulation lowers in Mosaic on v5e).
+
+    Returns (sorted 8-row keys array, gathered [VALUE_WORDS, n] payload,
+    int32 permutation). Stability: the tie-break row holds the arrival
+    index, so the permutation lists equal keys in arrival order.
+    """
+    n = x.shape[1]
+    pad = jnp.zeros((_KEYS8_ROWS - KEY_WORDS, n), jnp.uint32)
+    s8 = pallas_sort.sort_lanes(
+        jnp.concatenate([x[:KEY_WORDS], pad], axis=0),
+        num_keys=KEY_WORDS, tb_row=_KEYS8_TB, tile=tile,
+        interpret=interpret)
+    perm = s8[_KEYS8_TB].astype(jnp.int32)
+    payload = jnp.take(x[KEY_WORDS:RECORD_WORDS], perm, axis=1,
+                       unique_indices=True, mode="clip")
+    return s8, payload, perm
+
+
+def sort_lanes_keys8(x: jax.Array, tile: int = 1024,
+                     interpret: bool = False) -> jax.Array:
+    """Stable TeraSort record sort in lanes layout via the keys8 engine.
+
+    Drop-in equal to ``pallas_sort.sort_lanes(x, num_keys=KEY_WORDS,
+    tile=tile)`` on teragen_lanes-shaped input (layout pad rows zero):
+    same [ROWS, n] output, byte-identical including the arrival-index
+    row — but the payload crosses HBM once instead of riding every
+    compare-exchange stage.
+    """
+    s8, payload, _ = _keys8_parts(jnp.asarray(x, jnp.uint32), tile,
+                                  interpret)
+    n = x.shape[1]
+    pad = jnp.zeros((pallas_sort.ROWS - RECORD_WORDS - 1, n), jnp.uint32)
+    return jnp.concatenate(
+        [s8[:KEY_WORDS], payload, pad, s8[_KEYS8_TB:_KEYS8_TB + 1]], axis=0)
+
+
 def distributed_terasort(words, mesh: Mesh, axis: str = SHUFFLE_AXIS,
                          capacity: Optional[int] = None
                          ) -> DistributedSortResult:
@@ -175,6 +228,11 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
       an 8-row keys view and the payload moves with one in-kernel lane
       gather (sort_lanes two_phase=True). Faster where Mosaic lowers
       the dynamic gather well; bench.py decides by a measured fly-off.
+    - ``path="keys8"``: the whole cascade runs on an 8-row keys-only
+      array (4x less VPU and HBM work than the 32-row pipeline) and the
+      payload moves ONCE via a global XLA lane gather (_keys8_parts) —
+      the gather that Mosaic cannot lower in-kernel, hoisted to where
+      XLA can.
     - ``path="carry"``: the payload rides the ``lax.sort`` network as
       extra operands. Fast at runtime (~12 GB/s, CPU-backend
       measurement) but XLA's
@@ -195,8 +253,20 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
     consuming the sorted output in-graph keeps XLA from eliminating any
     round, and the caller asserts violations == 0 and checksum equality.
     """
-    if path not in ("lanes", "lanes2", "carry", "gather"):
+    if path not in ("lanes", "lanes2", "keys8", "carry", "gather"):
         raise ValueError(f"unknown bench path {path!r}")
+
+    def body_keys8(i, acc):
+        viol, ck_in, ck_out = acc
+        x = teragen_lanes(jax.random.fold_in(seed, i), n)
+        ck_in = ck_in + _checksum_cols(tuple(x[r]
+                                             for r in range(RECORD_WORDS)))
+        s8, payload, _ = _keys8_parts(x, tile, interpret)
+        out_cols = (*(s8[r] for r in range(KEY_WORDS)),
+                    *(payload[r] for r in range(VALUE_WORDS)))
+        ck_out = ck_out + _checksum_cols(out_cols)
+        viol = viol + _violations_cols(s8[0], s8[1], s8[2])
+        return (viol, ck_in, ck_out)
 
     def body_lanes(i, acc):
         viol, ck_in, ck_out = acc
@@ -222,7 +292,8 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
         return (viol, ck_in, ck_out)
 
     zero = jnp.uint32(0)
-    body = body_lanes if path in ("lanes", "lanes2") else body_cols
+    body = {"lanes": body_lanes, "lanes2": body_lanes,
+            "keys8": body_keys8}.get(path, body_cols)
     return lax.fori_loop(0, k, body, (jnp.int32(0), zero, zero))
 
 

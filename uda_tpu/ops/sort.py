@@ -50,7 +50,7 @@ def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
     happens EAGERLY, never inside a jitted trace: a trace-time choice
     would be baked into the jit cache and survive a later platform
     switch."""
-    valid = (("carry", "gather", "lanes", "lanes2") if lanes_ok
+    valid = (("carry", "gather", "lanes", "lanes2", "keys8") if lanes_ok
              else ("carry", "gather"))
     if path == "auto":
         backend = jax.default_backend()

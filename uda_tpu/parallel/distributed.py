@@ -50,7 +50,7 @@ def _lanes_interpret(payload_path: str, mesh: Mesh) -> bool:
     off the MESH's device platform (CPU meshes — tests, dryruns — have
     no Mosaic lowering, even when the host's default backend is a TPU).
     False for every other path so it never splits their jit cache."""
-    return (payload_path in ("lanes", "lanes2")
+    return (payload_path in ("lanes", "lanes2", "keys8")
             and mesh.devices.flat[0].platform == "cpu")
 
 
@@ -65,7 +65,7 @@ def _resolve_payload_path(path: str, wcols: int, num_keys: int) -> str:
     from uda_tpu.ops.sort import resolve_sort_path
 
     resolved = resolve_sort_path(path, lanes_ok=True)
-    if (resolved in ("lanes", "lanes2") and path == "auto"
+    if (resolved in ("lanes", "lanes2", "keys8") and path == "auto"
             and num_keys + 1 + wcols > pallas_sort.TB_ROW_DEFAULT):
         return "gather"
     return resolved
@@ -129,7 +129,10 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
     payload_path="lanes": the Pallas bitonic pipeline
     (ops.pallas_sort.sort_lanes) — bounded compile (two Mosaic kernels
     regardless of n and width) AND streaming payload movement; the TPU
-    default. The (masked keys, invalid flag) sort key rides as lanes
+    default. "keys8": same pipeline on an 8-row keys-only view plus one
+    global XLA payload gather (see _sort_valid_rows_lanes). "lanes2":
+    the in-kernel two-phase variant (needs Mosaic dynamic-gather
+    lowering). The (masked keys, invalid flag) sort key rides as lanes
     rows, stability via the pipeline's arrival tie-break, so equal-key
     order is IDENTICAL to the lax.sort paths below. "carry": all record
     columns ride the sort network (fast runtime, but XLA variadic-sort
@@ -140,9 +143,10 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
     terasort.bench_step — a row gather on the [n, W] matrix would touch
     the lane-padded layout)."""
     n, wcols = flat.shape
-    if payload_path in ("lanes", "lanes2"):
+    if payload_path in ("lanes", "lanes2", "keys8"):
         return _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
-                                      two_phase=payload_path == "lanes2")
+                                      two_phase=payload_path == "lanes2",
+                                      keys8=payload_path == "keys8")
     keycols = tuple(jnp.where(valid, flat[:, i], _INVALID)
                     for i in range(num_keys))
     invalid_last = jnp.where(valid, 0, 1)
@@ -160,7 +164,7 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
 
 
 def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
-                           two_phase=False):
+                           two_phase=False, keys8=False):
     """Lanes-path body of _sort_valid_rows: pack rows into the [32, n]
     lanes layout with sort key (masked key words, invalid flag), pad the
     lane count to a power of two with +inf-key lanes, run the Pallas
@@ -193,6 +197,27 @@ def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
     # flag +inf) sorts strictly after real invalid lanes' (keys +inf,
     # flag 1), so no arrival-index comparison against padding ever
     # decides a real lane's position
+    if keys8:
+        # keys8 engine: the whole cascade runs on an 8-row keys-only
+        # array (4x less VPU/HBM work per stage than the 32-row
+        # pipeline) and the payload moves ONCE via a global XLA lane
+        # gather on the [wcols, npad] minor-dim layout (no lane
+        # padding). Same sort key and tie-break as the full-width
+        # pipeline, so equal-key order is identical.
+        k8 = num_keys + 1                # masked keys + invalid flag
+        if k8 + 1 > 8:
+            raise ValueError(
+                f"num_keys={num_keys} does not fit the 8-row keys view; "
+                "use payload_path='lanes'")
+        # rows k8..7 are zeros; row 7's content is irrelevant (the
+        # tile-sort kernel overwrites tb_row with the arrival index)
+        keys_only = jnp.concatenate(
+            [mat[:k8], jnp.zeros((8 - k8, npad), jnp.uint32)], axis=0)
+        out8 = pallas_sort.sort_lanes(keys_only, num_keys=k8, tb_row=7,
+                                      tile=tile, interpret=interpret)
+        perm = out8[7, :n].astype(jnp.int32)
+        return jnp.take(mat[first_pay:first_pay + wcols], perm, axis=1,
+                        unique_indices=True, mode="clip").T
     out = pallas_sort.sort_lanes(mat, num_keys=num_keys + 1, tb_row=tb,
                                  tile=tile, interpret=interpret,
                                  two_phase=two_phase)
