@@ -56,6 +56,9 @@ SIZES = {
     "invidx_docs": (20, 120, 600),
     "grep_bytes": (1 << 16, 1 << 20, 1 << 24),
     "dist_records_per_dev": (256, 2048, 16384),
+    "sort_records": (1 << 10, 1 << 13, 1 << 16),
+    "pi_points_per_map": (500, 5000, 50000),
+    "dfsio_bytes_per_file": (1 << 18, 1 << 22, 1 << 26),
 }
 
 
@@ -204,14 +207,55 @@ def wl_compressed_shuffle(size: str, work_dir: str) -> dict:
     return {"input_bytes": len(text)}
 
 
+def wl_sort(size: str, work_dir: str) -> dict:
+    # the Hadoop Sort example: identity map/reduce, pure shuffle+merge
+    import numpy as np
+
+    from uda_tpu.models.sort_job import run_sort
+    from uda_tpu.utils.comparators import memcmp
+
+    n = _size("sort_records", size)
+    rng = np.random.default_rng(11)
+    records = [(rng.bytes(int(rng.integers(1, 24))),
+                rng.bytes(int(rng.integers(0, 64)))) for _ in range(n)]
+    out = run_sort(records, num_maps=4, num_reducers=3, work_dir=work_dir)
+    got = []
+    for r, recs in sorted(out.items()):
+        keys = [k for k, _ in recs]
+        assert all(memcmp(a, b) <= 0 for a, b in zip(keys, keys[1:])), \
+            f"reducer {r} output not sorted"
+        got.extend(recs)
+    assert sorted(got) == sorted(records), "sort record multiset changed"
+    return {"records": n}
+
+
+def wl_pi(size: str, work_dir: str) -> dict:
+    from uda_tpu.models.pi import run_pi
+
+    pts = _size("pi_points_per_map", size)
+    res = run_pi(num_maps=4, points_per_map=pts, work_dir=work_dir)
+    assert abs(res["estimate"] - 3.14159) < 0.3, res
+    return res
+
+
+def wl_dfsio(size: str, work_dir: str) -> dict:
+    from uda_tpu.models.dfsio import run_dfsio
+
+    per = _size("dfsio_bytes_per_file", size)
+    return run_dfsio(num_files=4, bytes_per_file=per, work_dir=work_dir)
+
+
 WORKLOADS = {
     "wordcount": wl_wordcount,
     "terasort": wl_terasort,
     "distributed_terasort": wl_distributed_terasort,
+    "sort": wl_sort,
     "secondary_sort": wl_secondary_sort,
     "inverted_index": wl_inverted_index,
     "grep": wl_grep,
     "compressed_shuffle": wl_compressed_shuffle,
+    "pi": wl_pi,
+    "dfsio": wl_dfsio,
 }
 
 

@@ -61,6 +61,43 @@ def test_grep_counts_descending(tmp_path):
     assert dict(result) == dict(want)
 
 
+def test_sort_job_per_reducer_order_and_conservation(tmp_path):
+    from uda_tpu.models.sort_job import run_sort
+    from uda_tpu.utils.comparators import memcmp
+
+    rng = np.random.default_rng(4)
+    records = [(rng.bytes(int(rng.integers(1, 16))),
+                rng.bytes(int(rng.integers(0, 32)))) for _ in range(400)]
+    records[10] = records[300]  # duplicate (key, value) survives identity
+    out = run_sort(records, num_maps=3, num_reducers=3,
+                   work_dir=str(tmp_path))
+    got = []
+    for recs in out.values():
+        keys = [k for k, _ in recs]
+        assert all(memcmp(a, b) <= 0 for a, b in zip(keys, keys[1:]))
+        got.extend(recs)
+    assert sorted(got) == sorted(records)
+
+
+def test_pi_conserves_points_and_converges(tmp_path):
+    from uda_tpu.models.pi import run_pi
+
+    res = run_pi(num_maps=3, points_per_map=3000, work_dir=str(tmp_path))
+    assert res["inside"] + res["outside"] == res["points"]
+    # Halton at 9000 points: well inside +-0.1 of pi
+    assert abs(res["estimate"] - 3.14159) < 0.1, res
+
+
+def test_dfsio_round_trip_and_throughput(tmp_path):
+    from uda_tpu.models.dfsio import run_dfsio
+
+    res = run_dfsio(num_files=2, bytes_per_file=1 << 17,
+                    chunk_size=1 << 13, work_dir=str(tmp_path))
+    assert res["files"] == 2
+    assert res["chunks"] >= res["files"] * 2  # chunking actually engaged
+    assert res["write_mb_s"] > 0 and res["read_mb_s"] > 0
+
+
 def test_grouped_reduce_contract():
     records = [(b"a", b"1"), (b"a", b"2"), (b"b", b"3")]
     out = list(grouped_reduce(iter(records),

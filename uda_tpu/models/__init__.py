@@ -1,8 +1,9 @@
-"""Workloads (the benchmark ladder of BASELINE.json): TeraSort,
-WordCount, SecondarySort, InvertedIndex, Grep."""
+"""Workloads (the benchmark ladder of BASELINE.json and the reference
+regression suite, scripts/regression/namesConf.sh:20-35): TeraSort,
+Sort, WordCount, SecondarySort, InvertedIndex, Grep, Pi, DFSIO."""
 
-from uda_tpu.models import (grep, inverted_index, pipeline, secondary_sort,
-                            terasort, wordcount)
+from uda_tpu.models import (dfsio, grep, inverted_index, pi, pipeline,
+                            secondary_sort, sort_job, terasort, wordcount)
 
-__all__ = ["grep", "inverted_index", "pipeline", "secondary_sort",
-           "terasort", "wordcount"]
+__all__ = ["dfsio", "grep", "inverted_index", "pi", "pipeline",
+           "secondary_sort", "sort_job", "terasort", "wordcount"]

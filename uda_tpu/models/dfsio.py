@@ -1,0 +1,113 @@
+"""DFSIO: storage/serving throughput gate (the TestDFSIO analogue).
+
+The reference ladder runs Hadoop's TestDFSIO (reference
+scripts/regression/namesConf.sh:20-35) to gate the durable tier's
+write/read MB/s independently of shuffle logic. This framework's
+durable tier is the MOF layout (IFile segments + spill index) served
+by the DataEngine chunk path, so the analogue measures:
+
+- write: MOFWriter streaming ``num_files`` map outputs to disk
+  (IFile framing + index triples);
+- read: the full serving stack — DirIndexResolver, refcounted fd
+  cache, chunked ShuffleRequest/FetchResult loop, and the native
+  ReadPool when ``libuda_tpu_native.so`` is built (reference
+  src/MOFServer/AIOHandler.cc's role).
+
+Validity is byte-exact: every fetched partition is re-parsed with
+IFileReader and compared record-for-record against what was written.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import tempfile
+import time
+from typing import Optional
+
+from uda_tpu.mofserver import DataEngine, DirIndexResolver
+from uda_tpu.mofserver.data_engine import ShuffleRequest
+from uda_tpu.mofserver.writer import MOFWriter
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.ifile import IFileReader
+
+__all__ = ["run_dfsio"]
+
+
+def _records(file_idx: int, total_bytes: int, value_bytes: int):
+    """Deterministic fixed-stride records summing to ~total_bytes."""
+    n = max(1, total_bytes // (value_bytes + 12))
+    for i in range(n):
+        # value: cheap deterministic fill, unique per (file, record)
+        seed = (file_idx * 1_000_003 + i) & 0xFFFFFFFF
+        yield (b"%010d" % i,
+               struct.pack(">I", seed) * (value_bytes // 4))
+
+
+def run_dfsio(num_files: int = 4, bytes_per_file: int = 1 << 20,
+              chunk_size: int = 1 << 16, value_bytes: int = 4096,
+              config: Optional[Config] = None,
+              work_dir: Optional[str] = None) -> dict:
+    """Write ``num_files`` single-partition MOFs then read them back
+    through the chunked serving path. Returns throughput + validity
+    stats: {"write_mb_s", "read_mb_s", "bytes", "files", "chunks"}."""
+    own_dir = work_dir is None
+    root = work_dir or tempfile.mkdtemp(prefix="uda_dfsio_")
+    try:
+        return _run(root, num_files, bytes_per_file, chunk_size,
+                    value_bytes, config)
+    finally:
+        if own_dir:
+            import shutil
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def _run(root: str, num_files: int, bytes_per_file: int, chunk_size: int,
+         value_bytes: int, config: Optional[Config]) -> dict:
+    job = "dfsio"
+    writer = MOFWriter(root, job)
+
+    t0 = time.perf_counter()
+    for f in range(num_files):
+        writer.write(f"attempt_dfsio_m_{f:06d}_0",
+                     [_records(f, bytes_per_file, value_bytes)])
+    write_s = time.perf_counter() - t0
+
+    total = sum(
+        os.path.getsize(os.path.join(writer.map_dir(m), "file.out"))
+        for m in writer.map_ids)
+
+    engine = DataEngine(DirIndexResolver(root), config)
+    chunks = 0
+    t0 = time.perf_counter()
+    fetched: dict[str, bytes] = {}
+    try:
+        for m in writer.map_ids:
+            buf = io.BytesIO()
+            offset = 0
+            while True:
+                res = engine.fetch(ShuffleRequest(job, m, 0, offset,
+                                                  chunk_size))
+                buf.write(res.data)
+                offset += len(res.data)
+                chunks += 1
+                if res.is_last:
+                    break
+            fetched[m] = buf.getvalue()
+    finally:
+        engine.stop()
+    read_s = time.perf_counter() - t0
+
+    # validity: byte-exact record round trip per file
+    for f, m in enumerate(writer.map_ids):
+        got = list(IFileReader(io.BytesIO(fetched[m])))
+        want = list(_records(f, bytes_per_file, value_bytes))
+        if got != want:
+            raise AssertionError(f"DFSIO round-trip mismatch in {m}: "
+                                 f"{len(got)} vs {len(want)} records")
+
+    mb = total / 1e6
+    return {"write_mb_s": round(mb / write_s, 2),
+            "read_mb_s": round(mb / read_s, 2),
+            "bytes": total, "files": num_files, "chunks": chunks}

@@ -1,0 +1,75 @@
+"""Pi estimator: the ladder's map-compute workload.
+
+The reference regression ladder runs Hadoop's "pi" example (reference
+scripts/regression/namesConf.sh:20-35) — QuasiMonteCarlo: each mapper
+samples Halton-sequence points in the unit square and counts hits
+inside the inscribed quarter circle; one reducer sums the counts. The
+shuffle is tiny (two keys), so the workload gates the control path —
+job bring-up, map fan-out, grouped reduce — rather than the data
+plane, exactly the role it played in the reference suite.
+
+Keys are BooleanWritable (inside/outside), values LongWritable counts,
+matching the Hadoop example's writable types.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, Optional, Tuple
+
+from uda_tpu.models.pipeline import MapReduceJob, Record
+from uda_tpu.utils.config import Config
+
+__all__ = ["halton", "run_pi"]
+
+
+def halton(index: int, base: int) -> float:
+    """The radical-inverse (Halton) low-discrepancy sequence — the same
+    generator Hadoop's QuasiMonteCarlo uses for reproducible sampling."""
+    f, r = 1.0, 0.0
+    i = index
+    while i > 0:
+        f /= base
+        r += f * (i % base)
+        i //= base
+    return r
+
+
+def _mapper(split: Tuple[int, int]) -> Iterable[Record]:
+    offset, count = split
+    inside = 0
+    for i in range(offset, offset + count):
+        x = halton(i + 1, 2) - 0.5
+        y = halton(i + 1, 3) - 0.5
+        if x * x + y * y <= 0.25:
+            inside += 1
+    yield b"\x01", struct.pack(">q", inside)
+    yield b"\x00", struct.pack(">q", count - inside)
+
+
+def _reducer(key: bytes, values: list[bytes]) -> Iterable[Record]:
+    total = sum(struct.unpack(">q", v)[0] for v in values)
+    yield key, struct.pack(">q", total)
+
+
+def run_pi(num_maps: int = 4, points_per_map: int = 2000,
+           config: Optional[Config] = None,
+           work_dir: Optional[str] = None) -> dict:
+    """Estimate pi with ``num_maps`` mappers x ``points_per_map`` Halton
+    points. Returns {"estimate", "inside", "outside", "points"}; exact
+    point conservation is asserted (a lost or duplicated map output
+    would break it)."""
+    splits = [(m * points_per_map, points_per_map) for m in range(num_maps)]
+    job = MapReduceJob("pi", _mapper, _reducer,
+                       key_type="org.apache.hadoop.io.BooleanWritable",
+                       num_reducers=1, config=config, work_dir=work_dir)
+    outputs = job.run(splits)
+    counts = {k: struct.unpack(">q", v)[0] for k, v in outputs[0]}
+    inside = counts.get(b"\x01", 0)
+    outside = counts.get(b"\x00", 0)
+    points = num_maps * points_per_map
+    if inside + outside != points:
+        raise AssertionError(
+            f"point count not conserved: {inside}+{outside} != {points}")
+    return {"estimate": 4.0 * inside / points, "inside": inside,
+            "outside": outside, "points": points}

@@ -1,0 +1,61 @@
+"""Sort: the Hadoop Sort example through the full pipeline.
+
+The reference regression ladder's pure shuffle+merge workload
+(reference scripts/regression/namesConf.sh:20-35 lists "sort" beside
+TeraSort/wordcount): identity map and identity reduce over
+BytesWritable keys, so the job measures nothing but the engine —
+partitioned spill, chunked fetch, comparator merge, framed emission.
+Exercises variable-length binary keys through the byte-exact
+comparator path (4-byte length skip + memcmp, reference
+src/Merger/CompareFunc.cc:60-75).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, Optional, Sequence, Tuple
+
+from uda_tpu.models.pipeline import MapReduceJob, Record
+from uda_tpu.utils.config import Config
+
+__all__ = ["bytes_key", "parse_bytes_key", "run_sort"]
+
+
+def bytes_key(content: bytes) -> bytes:
+    """Serialize like org.apache.hadoop.io.BytesWritable (4-byte BE
+    length + bytes)."""
+    return struct.pack(">i", len(content)) + content
+
+
+def parse_bytes_key(key: bytes) -> bytes:
+    (n,) = struct.unpack(">i", key[:4])
+    return key[4:4 + n]
+
+
+def _mapper(split: Sequence[Tuple[bytes, bytes]]) -> Iterable[Record]:
+    for content, value in split:
+        yield bytes_key(content), value
+
+
+def _reducer(key: bytes, values: list[bytes]) -> Iterable[Record]:
+    for v in values:          # identity: duplicates preserved
+        yield key, v
+
+
+def run_sort(records: Sequence[Tuple[bytes, bytes]], num_maps: int = 4,
+             num_reducers: int = 3, config: Optional[Config] = None,
+             work_dir: Optional[str] = None
+             ) -> dict[int, list[Tuple[bytes, bytes]]]:
+    """Run the identity sort job over ``records`` ((key content, value)
+    pairs). Returns {reducer: [(key content, value), ...]} where each
+    reducer's list is comparator-sorted — the Hadoop Sort contract
+    (per-reducer total order under the default hash partitioner; global
+    order is TeraSort's splitter-partitioned variant)."""
+    splits = [list(records[m::num_maps]) for m in range(num_maps)]
+    job = MapReduceJob("sortjob", _mapper, _reducer,
+                       key_type="org.apache.hadoop.io.BytesWritable",
+                       num_reducers=num_reducers, config=config,
+                       work_dir=work_dir)
+    outputs = job.run(splits)
+    return {r: [(parse_bytes_key(k), v) for k, v in recs]
+            for r, recs in outputs.items()}

@@ -180,44 +180,47 @@ def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
     n, wcols = flat.shape
     first_pay = num_keys + 1             # payload starts past the flag row
     tb = pallas_sort.TB_ROW_DEFAULT
-    if first_pay + wcols > tb:
-        raise ValueError(
-            f"record width {wcols} + {num_keys} keys does not fit the "
-            f"{pallas_sort.ROWS}-row lanes layout; use payload_path="
-            "'gather'")
     npad = max(128, 1 << (n - 1).bit_length())
     tile = min(1024, npad)
-    mat = jnp.full((pallas_sort.ROWS, npad), _INVALID, jnp.uint32)
     keyrows = jnp.stack([jnp.where(valid, flat[:, i], _INVALID)
                          for i in range(num_keys)]
                         + [jnp.where(valid, jnp.uint32(0), jnp.uint32(1))])
-    mat = lax.dynamic_update_slice(mat, keyrows, (0, 0))
-    mat = lax.dynamic_update_slice(mat, flat.T, (first_pay, 0))
-    # padding lanes keep _INVALID in the flag row too: (keys +inf,
-    # flag +inf) sorts strictly after real invalid lanes' (keys +inf,
-    # flag 1), so no arrival-index comparison against padding ever
-    # decides a real lane's position
+    # padding lanes (n..npad) keep _INVALID in the flag row too: (keys
+    # +inf, flag +inf) sorts strictly after real invalid lanes' (keys
+    # +inf, flag 1), so no arrival-index comparison against padding
+    # ever decides a real lane's position
     if keys8:
         # keys8 engine: the whole cascade runs on an 8-row keys-only
         # array (4x less VPU/HBM work per stage than the 32-row
-        # pipeline) and the payload moves ONCE via a global XLA lane
-        # gather on the [wcols, npad] minor-dim layout (no lane
-        # padding). Same sort key and tie-break as the full-width
-        # pipeline, so equal-key order is identical.
+        # pipeline) and the payload never stages into a lanes matrix at
+        # all — it moves ONCE, a global XLA lane gather straight off
+        # ``flat`` (minor-dim layout, no lane padding). Same sort key
+        # and tie-break as the full-width pipeline, so equal-key order
+        # is identical; record width is unconstrained (no 32-row limit).
         k8 = num_keys + 1                # masked keys + invalid flag
         if k8 + 1 > 8:
             raise ValueError(
                 f"num_keys={num_keys} does not fit the 8-row keys view; "
                 "use payload_path='lanes'")
-        # rows k8..7 are zeros; row 7's content is irrelevant (the
-        # tile-sort kernel overwrites tb_row with the arrival index)
-        keys_only = jnp.concatenate(
-            [mat[:k8], jnp.zeros((8 - k8, npad), jnp.uint32)], axis=0)
-        out8 = pallas_sort.sort_lanes(keys_only, num_keys=k8, tb_row=7,
+        # rows k8..7 ride as payload (content irrelevant; the tile-sort
+        # kernel overwrites row 7 with the arrival index)
+        mat8 = jnp.full((8, npad), _INVALID, jnp.uint32)
+        mat8 = lax.dynamic_update_slice(mat8, keyrows, (0, 0))
+        out8 = pallas_sort.sort_lanes(mat8, num_keys=k8, tb_row=7,
                                       tile=tile, interpret=interpret)
+        # the n real lanes sort strictly before the padding, so the
+        # first n arrival indices all reference real rows of flat
         perm = out8[7, :n].astype(jnp.int32)
-        return jnp.take(mat[first_pay:first_pay + wcols], perm, axis=1,
+        return jnp.take(flat.T, perm, axis=1,
                         unique_indices=True, mode="clip").T
+    if first_pay + wcols > tb:
+        raise ValueError(
+            f"record width {wcols} + {num_keys} keys does not fit the "
+            f"{pallas_sort.ROWS}-row lanes layout; use payload_path="
+            "'gather'")
+    mat = jnp.full((pallas_sort.ROWS, npad), _INVALID, jnp.uint32)
+    mat = lax.dynamic_update_slice(mat, keyrows, (0, 0))
+    mat = lax.dynamic_update_slice(mat, flat.T, (first_pay, 0))
     out = pallas_sort.sort_lanes(mat, num_keys=num_keys + 1, tb_row=tb,
                                  tile=tile, interpret=interpret,
                                  two_phase=two_phase)
