@@ -76,6 +76,18 @@ PROBE_TIMEOUT = float(os.environ.get("UDA_TPU_BENCH_PROBE_TIMEOUT", 600))
 PATHS = (("lanes2", "keys8", "lanes", "carry", "gather")
          if os.environ.get("UDA_TPU_BENCH_TRY_CARRY") == "1"
          else ("lanes2", "keys8", "lanes", "gather"))
+# explicit candidate-list override (comma-separated), e.g. a short pool
+# window where only the known-good path should be timed:
+#   UDA_TPU_BENCH_PATHS=lanes python bench.py
+_KNOWN_PATHS = ("lanes", "lanes2", "keys8", "carry", "gather")
+if os.environ.get("UDA_TPU_BENCH_PATHS"):
+    PATHS = tuple(p.strip()
+                  for p in os.environ["UDA_TPU_BENCH_PATHS"].split(",")
+                  if p.strip())
+    bad = [p for p in PATHS if p not in _KNOWN_PATHS]
+    if bad or not PATHS:
+        raise SystemExit(f"UDA_TPU_BENCH_PATHS: unknown or empty path "
+                         f"list {bad or '(empty)'}; known: {_KNOWN_PATHS}")
 FLYOFF_PATHS = frozenset({"lanes", "lanes2", "keys8"})
 
 

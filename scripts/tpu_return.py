@@ -1,0 +1,170 @@
+"""Staged hardware-return sequence: spend a fresh pool window well.
+
+The TPU pool serves ONE device claim at a time and has wedged twice
+after killed-mid-compile clients, so windows are precious and
+concurrent clients are forbidden. This driver runs the return
+checklist as ordered stages, each in its own subprocess with a
+wall-clock budget, logging everything to ``--log-dir`` so a wedge
+mid-sequence still leaves a usable record:
+
+1. liveness    — one tiny device op (fail fast if the pool is wedged)
+2. take-ramp   — XLA lane-gather throughput at 2^16..2^22 (decides
+                 keys8 viability CHEAPLY before any full-size compile;
+                 each size is its own subprocess so a pathological
+                 compile costs one budget, not the window)
+3. bench       — python bench.py (the official JSON line; its fly-off
+                 probes keys8/lanes2/lanes itself with per-path budgets)
+4. regression  — the ambient workload ladder artifact
+5. profile     — keys8/lanes tile sweep (only if time remains)
+
+Discipline encoded here (learned from the 2026-07-30 wedges):
+stages run strictly sequentially; a timed-out stage is killed as a
+whole PROCESS GROUP (bench/regression spawn their own subprocesses —
+killing only the direct child leaves a grandchild holding the device
+claim, i.e. a concurrent client); stage output streams straight to
+the log file (no pipes: nothing to lose on a kill, nothing to block
+on); after any timeout the cheap liveness stage re-runs and the
+sequence aborts if the pool died.
+
+Usage: python scripts/tpu_return.py [--log-dir DIR] [--stop-after N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# honor an explicit JAX_PLATFORMS before any device use: the TPU
+# deployment's sitecustomize force-selects its backend via jax.config,
+# silently overriding the env var (same pattern as bench._enable_cache)
+_PLATFORM_PRELUDE = (
+    "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+    "p and p != 'axon' and jax.config.update('jax_platforms', p); ")
+
+LIVENESS = (_PLATFORM_PRELUDE +
+            "import jax.numpy as jnp, numpy as np; "
+            "print('ALIVE', int(jnp.asarray(np.arange(8)).sum()))")
+
+TAKE_RAMP = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+p = os.environ.get("JAX_PLATFORMS")
+if p and p != "axon":
+    jax.config.update("jax_platforms", p)
+from uda_tpu.utils import compile_cache
+compile_cache.enable()
+import jax.numpy as jnp, numpy as np
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def one(seed, n):
+    k = jax.random.key(seed[0])
+    x = jax.random.bits(k, (23, n), dtype=jnp.uint32)
+    perm = jax.random.permutation(jax.random.fold_in(k, 7), n)
+    return jnp.take(x, perm, axis=1, unique_indices=True,
+                    mode="clip").sum(dtype=jnp.uint32)
+
+n = 1 << {log2}
+t0 = time.perf_counter()
+int(one(np.array([1]), n))
+print(f"compile+first: {{time.perf_counter()-t0:.1f}}s", flush=True)
+best = 1e9
+for i in range(3):
+    t0 = time.perf_counter()
+    int(one(np.array([i + 2]), n))
+    best = min(best, time.perf_counter() - t0)
+gb = n * 23 * 4 / 1e9
+print(f"take[23,2^{log2}]: best {{best*1e3:.1f}} ms = "
+      f"{{gb/best:.2f}} GB/s", flush=True)
+"""
+
+
+def run_stage(name: str, argv: list[str], budget_s: float,
+              log_dir: str) -> tuple[bool, bool]:
+    """One subprocess stage -> (ok, timed_out). Output streams directly
+    to <log_dir>/<name>.log (stdout+stderr interleaved; nothing is lost
+    if the stage is killed). On budget overrun the stage's whole
+    process group is killed so no grandchild (bench --probe, regression
+    per-workload children) survives to hold the device claim."""
+    log = os.path.join(log_dir, f"{name}.log")
+    t0 = time.perf_counter()
+    timed_out = False
+    with open(log, "w") as f:
+        proc = subprocess.Popen(
+            argv, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env=dict(os.environ, JAX_TRACEBACK_FILTERING="off"))
+        try:
+            rc = proc.wait(timeout=budget_s)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            rc = -9
+            f.write(f"\n--- TIMEOUT: killed process group after "
+                    f"{budget_s:.0f}s ---\n")
+    ok = rc == 0
+    dt = time.perf_counter() - t0
+    print(f"[{name}] {'ok' if ok else 'FAIL'} in {dt:.0f}s -> {log}",
+          flush=True)
+    return ok, timed_out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log-dir", default=os.path.join(REPO, ".tpu_return"))
+    ap.add_argument("--stop-after", type=int, default=99)
+    args = ap.parse_args()
+    os.makedirs(args.log_dir, exist_ok=True)
+    py = sys.executable
+
+    stages = [
+        ("take16", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=16)], 900),
+        ("take19", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=19)], 900),
+        ("take22", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=22)], 1200),
+        ("bench", [py, "bench.py"], 3600),
+        ("regression", [py, "scripts/regression/run_regression.py",
+                        "--platform", "ambient", "--size", "small",
+                        "--out", os.path.join(args.log_dir, "ambient")],
+         3600),
+        ("profile", [py, "scripts/profile_lanes.py"], 3600),
+    ]
+
+    def alive(tag: str) -> bool:
+        ok, _ = run_stage(tag, [py, "-c", LIVENESS], 300, args.log_dir)
+        return ok
+
+    if not alive("liveness"):
+        print("pool wedged; aborting sequence", flush=True)
+        return 1
+    done = 0
+    for name, argv, budget in stages:
+        if done >= args.stop_after:
+            break
+        ok, timed_out = run_stage(name, argv, budget, args.log_dir)
+        done += 1
+        if timed_out and not alive(f"liveness_after_{name}"):
+            # a killed-mid-compile client is the documented wedge
+            # trigger; don't burn the remaining budgets against a
+            # dead pool
+            print(f"pool wedged after {name}; aborting sequence",
+                  flush=True)
+            return 1
+    print(json.dumps({"stages_run": done, "log_dir": args.log_dir}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
