@@ -79,16 +79,21 @@ PATHS = (("lanes2", "keys8", "lanes", "carry", "gather")
 # explicit candidate-list override (comma-separated), e.g. a short pool
 # window where only the known-good path should be timed:
 #   UDA_TPU_BENCH_PATHS=lanes python bench.py
-_KNOWN_PATHS = ("lanes", "lanes2", "keys8", "carry", "gather")
+# Path names come from the single source of truth in uda_tpu.ops.sort
+# (safe at module scope: importing jax does not lock the platform —
+# only the first device use does, after _enable_cache has re-applied
+# any JAX_PLATFORMS override).
+from uda_tpu.ops.sort import ALL_SORT_PATHS, LANES_ENGINES  # noqa: E402
+
 if os.environ.get("UDA_TPU_BENCH_PATHS"):
     PATHS = tuple(p.strip()
                   for p in os.environ["UDA_TPU_BENCH_PATHS"].split(",")
                   if p.strip())
-    bad = [p for p in PATHS if p not in _KNOWN_PATHS]
+    bad = [p for p in PATHS if p not in ALL_SORT_PATHS]
     if bad or not PATHS:
         raise SystemExit(f"UDA_TPU_BENCH_PATHS: unknown or empty path "
-                         f"list {bad or '(empty)'}; known: {_KNOWN_PATHS}")
-FLYOFF_PATHS = frozenset({"lanes", "lanes2", "keys8"})
+                         f"list {bad or '(empty)'}; known: {ALL_SORT_PATHS}")
+FLYOFF_PATHS = frozenset(LANES_ENGINES)
 
 
 def _enable_cache() -> None:
@@ -99,17 +104,11 @@ def _enable_cache() -> None:
     os.environ.setdefault("UDA_TPU_COMPILE_CACHE",
                           os.path.join(os.path.dirname(
                               os.path.abspath(__file__)), ".jax_cache"))
-    # Honor an explicit JAX_PLATFORMS: the TPU deployment's sitecustomize
-    # force-selects its backend via jax.config, which silently overrides
-    # the env var — without this, a CPU smoke run of bench.py (and its
-    # probe subprocesses) would hang waiting on the TPU relay.
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms and platforms != "axon":
-        import jax
-
-        jax.config.update("jax_platforms", platforms)
     from uda_tpu.utils import compile_cache
 
+    # honor an explicit JAX_PLATFORMS over the deployment sitecustomize
+    # (else a CPU smoke run hangs waiting on the TPU relay)
+    compile_cache.apply_platform_env()
     compile_cache.enable()
 
 
@@ -182,11 +181,10 @@ def _backend_alive(timeout: float = 180.0) -> bool:
     the tunneled backend: a stuck device claim blocks make_c_api_client
     forever), which would otherwise cost one full probe timeout PER
     candidate path before the bench could report anything."""
-    # honor an explicit JAX_PLATFORMS like _enable_cache does (the TPU
-    # deployment's sitecustomize force-selects its backend via
-    # jax.config, silently overriding the env var)
-    code = ("import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
-            "p and p != 'axon' and jax.config.update('jax_platforms', p); "
+    # honor an explicit JAX_PLATFORMS like _enable_cache does
+    from uda_tpu.utils.compile_cache import PLATFORM_PRELUDE
+
+    code = (PLATFORM_PRELUDE +
             "import numpy as np, jax.numpy as jnp; "
             "x = jnp.asarray(np.arange(8)); assert int(x.sum()) == 28; "
             "print('alive')")

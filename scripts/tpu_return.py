@@ -15,7 +15,8 @@ mid-sequence still leaves a usable record:
 3. bench       — python bench.py (the official JSON line; its fly-off
                  probes keys8/lanes2/lanes itself with per-path budgets)
 4. regression  — the ambient workload ladder artifact
-5. profile     — keys8/lanes tile sweep (only if time remains)
+5. profile     — keys8/lanes tile sweep (skip with --stop-after 4 when
+                 the window is precious)
 
 Discipline encoded here (learned from the 2026-07-30 wedges):
 stages run strictly sequentially; a timed-out stage is killed as a
@@ -42,27 +43,20 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
-# honor an explicit JAX_PLATFORMS before any device use: the TPU
-# deployment's sitecustomize force-selects its backend via jax.config,
-# silently overriding the env var (same pattern as bench._enable_cache)
-_PLATFORM_PRELUDE = (
-    "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
-    "p and p != 'axon' and jax.config.update('jax_platforms', p); ")
+sys.path.insert(0, REPO)
+from uda_tpu.utils.compile_cache import PLATFORM_PRELUDE  # noqa: E402
 
-LIVENESS = (_PLATFORM_PRELUDE +
+LIVENESS = (PLATFORM_PRELUDE +
             "import jax.numpy as jnp, numpy as np; "
             "print('ALIVE', int(jnp.asarray(np.arange(8)).sum()))")
 
 TAKE_RAMP = r"""
 import os, sys, time
 sys.path.insert(0, {repo!r})
-import jax
-p = os.environ.get("JAX_PLATFORMS")
-if p and p != "axon":
-    jax.config.update("jax_platforms", p)
 from uda_tpu.utils import compile_cache
+compile_cache.apply_platform_env()
 compile_cache.enable()
-import jax.numpy as jnp, numpy as np
+import jax, jax.numpy as jnp, numpy as np
 from functools import partial
 
 @partial(jax.jit, static_argnames=("n",))

@@ -253,7 +253,9 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
     consuming the sorted output in-graph keeps XLA from eliminating any
     round, and the caller asserts violations == 0 and checksum equality.
     """
-    if path not in ("lanes", "lanes2", "keys8", "carry", "gather"):
+    from uda_tpu.ops.sort import ALL_SORT_PATHS
+
+    if path not in ALL_SORT_PATHS:
         raise ValueError(f"unknown bench path {path!r}")
 
     def body_keys8(i, acc):

@@ -36,7 +36,19 @@ from jax import lax
 from uda_tpu.ops.packing import PackedKeys
 
 __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
-           "concat_packed", "resolve_sort_path"]
+           "concat_packed", "resolve_sort_path", "LANES_ENGINES",
+           "ALL_SORT_PATHS"]
+
+# The single source of truth for engine path names. LANES_ENGINES are
+# the Pallas-pipeline variants (bounded compile; interpret mode on CPU
+# meshes): "lanes" carries payload through the network, "lanes2" uses
+# the in-kernel two-phase gather, "keys8" runs the cascade on an 8-row
+# keys view + one global XLA payload gather. The lax.sort paths are
+# "carry" (operand-carry) and "gather" (permutation + per-column
+# gathers). bench.py, parallel.distributed, and models.terasort all
+# import these — adding an engine means extending ONE tuple.
+LANES_ENGINES = ("lanes", "lanes2", "keys8")
+ALL_SORT_PATHS = ("carry", "gather") + LANES_ENGINES
 
 
 def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
@@ -50,8 +62,7 @@ def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
     happens EAGERLY, never inside a jitted trace: a trace-time choice
     would be baked into the jit cache and survive a later platform
     switch."""
-    valid = (("carry", "gather", "lanes", "lanes2", "keys8") if lanes_ok
-             else ("carry", "gather"))
+    valid = ALL_SORT_PATHS if lanes_ok else ("carry", "gather")
     if path == "auto":
         backend = jax.default_backend()
         if backend == "cpu":

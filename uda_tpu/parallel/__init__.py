@@ -2,6 +2,8 @@
 mesh collectives): mesh helpers, windowed all-to-all exchange, fused
 distributed sort step."""
 
+from uda_tpu.parallel.bytes_exchange import (ExchangeFetchClient,
+                                             exchange_blobs)
 from uda_tpu.parallel.distributed import (DistributedSortResult,
                                           distributed_sort_step,
                                           sample_splitters,
@@ -15,5 +17,5 @@ from uda_tpu.parallel.mesh import (SHUFFLE_AXIS, make_mesh, mesh_from_config,
 __all__ = ["DistributedSortResult", "distributed_sort_step",
            "sample_splitters", "uniform_splitters", "ShuffleLayout",
            "exchange_record_batches", "exchange_round", "prepare_layout",
-           "shuffle_exchange", "SHUFFLE_AXIS", "make_mesh",
-           "mesh_from_config", "shard_spec"]
+           "shuffle_exchange", "exchange_blobs", "ExchangeFetchClient",
+           "SHUFFLE_AXIS", "make_mesh", "mesh_from_config", "shard_spec"]

@@ -50,7 +50,9 @@ def _lanes_interpret(payload_path: str, mesh: Mesh) -> bool:
     off the MESH's device platform (CPU meshes — tests, dryruns — have
     no Mosaic lowering, even when the host's default backend is a TPU).
     False for every other path so it never splits their jit cache."""
-    return (payload_path in ("lanes", "lanes2", "keys8")
+    from uda_tpu.ops.sort import LANES_ENGINES
+
+    return (payload_path in LANES_ENGINES
             and mesh.devices.flat[0].platform == "cpu")
 
 
@@ -62,10 +64,10 @@ def _resolve_payload_path(path: str, wcols: int, num_keys: int) -> str:
     EXPLICIT "lanes" request is passed through and fails loudly in
     _sort_valid_rows_lanes if too wide."""
     from uda_tpu.ops import pallas_sort
-    from uda_tpu.ops.sort import resolve_sort_path
+    from uda_tpu.ops.sort import LANES_ENGINES, resolve_sort_path
 
     resolved = resolve_sort_path(path, lanes_ok=True)
-    if (resolved in ("lanes", "lanes2", "keys8") and path == "auto"
+    if (resolved in LANES_ENGINES and path == "auto"
             and num_keys + 1 + wcols > pallas_sort.TB_ROW_DEFAULT):
         return "gather"
     return resolved
@@ -142,8 +144,10 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
     gathers keep the SoA/no-lane-padding rationale of
     terasort.bench_step — a row gather on the [n, W] matrix would touch
     the lane-padded layout)."""
+    from uda_tpu.ops.sort import LANES_ENGINES
+
     n, wcols = flat.shape
-    if payload_path in ("lanes", "lanes2", "keys8"):
+    if payload_path in LANES_ENGINES:
         return _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
                                       two_phase=payload_path == "lanes2",
                                       keys8=payload_path == "keys8")

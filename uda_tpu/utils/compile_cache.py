@@ -23,6 +23,27 @@ _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 _enabled = False
 
+# The one copy of the sitecustomize-override rule: the TPU deployment
+# force-selects its backend via jax.config at interpreter start, which
+# silently overrides the JAX_PLATFORMS env var — so CPU smoke runs must
+# re-apply it through jax.config BEFORE any device use. Import-level
+# callers use apply_platform_env(); `python -c` snippets (bench probes,
+# tpu_return stages) embed PLATFORM_PRELUDE.
+PLATFORM_PRELUDE = (
+    "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+    "p and p != 'axon' and jax.config.update('jax_platforms', p); ")
+
+
+def apply_platform_env() -> None:
+    """Re-apply an explicit ``JAX_PLATFORMS`` over the deployment's
+    sitecustomize backend selection (no-op when unset or already the
+    deployment platform). Must run before any jax device use."""
+    p = os.environ.get("JAX_PLATFORMS")
+    if p and p != "axon":
+        import jax
+
+        jax.config.update("jax_platforms", p)
+
 
 def cache_dir() -> str:
     """The cache directory: ``$UDA_TPU_COMPILE_CACHE`` or
