@@ -50,6 +50,23 @@ def test_exchange_blobs_multiround_and_empty():
     _check_round_trip(blobs, out, 4)
 
 
+def test_exchange_blobs_multiaxis_mesh_single_axis():
+    # a multi-axis mesh with one exchange axis: group size must be the
+    # AXIS size (4), not the device count (8) — dests past the axis
+    # size are rejected instead of silently dropped
+    import jax
+    import pytest
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dcn", SHUFFLE_AXIS))
+    blobs = _random_blobs(4, np.random.default_rng(23), max_blobs=4)
+    out = exchange_blobs(blobs, mesh, SHUFFLE_AXIS, row_payload_bytes=64)
+    _check_round_trip(blobs, out, 4)
+    with pytest.raises(ValueError, match="outside"):
+        exchange_blobs([[(7, b"x")]] + [[]] * 3, mesh, SHUFFLE_AXIS)
+
+
 def test_merge_manager_over_exchange():
     # the full reference flow: per-supplier sorted map-output partitions
     # -> mesh bytes transport -> reduce-side MergeManager merge

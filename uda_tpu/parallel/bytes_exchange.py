@@ -110,13 +110,17 @@ def exchange_blobs(blobs: Sequence[Sequence[Tuple[int, bytes]]],
         counts[:, 0] += nmax - np.asarray([r.shape[0] for r, _ in packed])
         capacity = max(1, int(counts.max()))
 
+    from uda_tpu.parallel.multihost import allgather
+
     results, _ = shuffle_exchange(words, dest, mesh, axis, capacity)
     cap = capacity
     streams: list[list[list[np.ndarray]]] = [
         [[] for _ in range(p)] for _ in range(p)]
     for recv_words, recv_counts in results:
-        rw = np.asarray(recv_words).reshape(p, p, cap, w)
-        rc = np.asarray(recv_counts).reshape(p, p)
+        # allgather: host-readable on every process of a multi-host
+        # mesh (np.asarray alone only covers fully-addressable arrays)
+        rw = allgather(recv_words).reshape(p, p, cap, w)
+        rc = allgather(recv_counts).reshape(p, p)
         for d in range(p):
             for s in range(p):
                 if rc[d, s]:
