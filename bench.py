@@ -51,6 +51,15 @@ DISPATCHES = 2
 # 0.85/1.07/1.18 GB/s at 1024/2048/4096); clamped so smoke-sized runs
 # (UDA_TPU_BENCH_LOG2) still satisfy sort_lanes' n % tile == 0 contract
 LANES_TILE = min(4096, 1 << LOG2_RECORDS)
+# the keys8 cascade works on 8-row arrays, so VMEM admits much larger
+# tiles (fewer merge passes); default 8192 pending a hardware sweep
+# (scripts/profile_lanes.py sweeps 4096/8192/16384)
+KEYS8_TILE = min(int(os.environ.get("UDA_TPU_BENCH_KEYS8_TILE", 8192)),
+                 1 << LOG2_RECORDS)
+
+
+def _tile_for(path: str) -> int:
+    return KEYS8_TILE if path == "keys8" else LANES_TILE
 # run the Pallas kernels in interpret mode (CPU smoke runs of the lanes
 # path; useless on TPU and at full size)
 INTERPRET = os.environ.get("UDA_TPU_BENCH_INTERPRET") == "1"
@@ -128,7 +137,7 @@ def _compile_and_check(path: str) -> None:
 
     viol, ck_in, ck_out = terasort.bench_step(
         jax.random.key(999), 1 << LOG2_RECORDS, ROUNDS_PER_DISPATCH,
-        path=path, tile=LANES_TILE, interpret=INTERPRET)
+        path=path, tile=_tile_for(path), interpret=INTERPRET)
     assert int(viol) == 0
     assert np.uint32(ck_in) == np.uint32(ck_out), "checksum mismatch"
 
@@ -241,7 +250,7 @@ def main() -> None:
         viol, ck_in, ck_out = terasort.bench_step(jax.random.key(seed), n,
                                                   ROUNDS_PER_DISPATCH,
                                                   path=path,
-                                                  tile=LANES_TILE,
+                                                  tile=_tile_for(path),
                                                   interpret=INTERPRET)
         ok = (int(viol) == 0, np.uint32(ck_in) == np.uint32(ck_out))
         dt = time.perf_counter() - t0

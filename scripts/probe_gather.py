@@ -24,21 +24,29 @@ from jax.experimental.pallas import tpu as pltpu
 ROWS, N = 32, 2048
 
 
-def kern_take(idx_ref, x_ref, o_ref):
+def kern_take(idx_ref, x_ref, o_ref, *, rows, n):
     o_ref[...] = jnp.take(x_ref[...], idx_ref[0], axis=1)
 
 
-def kern_take_along(idx_ref, x_ref, o_ref):
-    idx = jnp.broadcast_to(idx_ref[0][None, :], (ROWS, N))
+def kern_take_along(idx_ref, x_ref, o_ref, *, rows, n):
+    idx = jnp.broadcast_to(idx_ref[0][None, :], (rows, n))
     o_ref[...] = jnp.take_along_axis(x_ref[...], idx, axis=1)
 
 
-def kern_onehot_matmul(idx_ref, x_ref, o_ref):
+def kern_take_along_i32(idx_ref, x_ref, o_ref, *, rows, n):
+    # same, through an int32 view: Mosaic's gather support is
+    # dtype-sensitive (the uint32 onehot path already failed on a cast)
+    idx = jnp.broadcast_to(idx_ref[0][None, :], (rows, n))
+    xi = x_ref[...].astype(jnp.int32)
+    o_ref[...] = jnp.take_along_axis(xi, idx, axis=1).astype(jnp.uint32)
+
+
+def kern_onehot_matmul(idx_ref, x_ref, o_ref, *, rows, n):
     # permutation as one-hot matmul on the MXU: out = x @ P where
     # P[s, d] = 1 iff idx[d] == s  (uint32 payload split into 2 bf16-safe
     # halves would be needed for exactness; here int32 accumulate)
     idx = idx_ref[0]
-    src = lax.broadcasted_iota(jnp.int32, (N, N), 0)
+    src = lax.broadcasted_iota(jnp.int32, (n, n), 0)
     onehot = (src == idx[None, :]).astype(jnp.float32)
     o_ref[...] = jax.lax.dot_general(
         x_ref[...].astype(jnp.float32), onehot,
@@ -46,19 +54,19 @@ def kern_onehot_matmul(idx_ref, x_ref, o_ref):
         preferred_element_type=jnp.float32).astype(jnp.uint32)
 
 
-def run(name, kern):
+def run(name, kern, rows=ROWS, n=N):
     x = jnp.asarray(
-        np.random.default_rng(0).integers(0, 1 << 31, (ROWS, N)),
+        np.random.default_rng(0).integers(0, 1 << 31, (rows, n)),
         jnp.uint32)
-    perm = np.random.default_rng(1).permutation(N).astype(np.int32)
+    perm = np.random.default_rng(1).permutation(n).astype(np.int32)
     idx = jnp.asarray(perm)[None, :]
     try:
         f = pl.pallas_call(
-            kern,
-            in_specs=[pl.BlockSpec((1, N), lambda: (0, 0)),
-                      pl.BlockSpec((ROWS, N), lambda: (0, 0))],
-            out_specs=pl.BlockSpec((ROWS, N), lambda: (0, 0)),
-            out_shape=jax.ShapeDtypeStruct((ROWS, N), jnp.uint32),
+            partial(kern, rows=rows, n=n),
+            in_specs=[pl.BlockSpec((1, n), lambda: (0, 0)),
+                      pl.BlockSpec((rows, n), lambda: (0, 0))],
+            out_specs=pl.BlockSpec((rows, n), lambda: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32),
         )
         out = np.asarray(f(idx, x))
         want = np.asarray(x)[:, perm]
@@ -77,14 +85,23 @@ def run(name, kern):
         int(r[0, 0])
         dt = (time.perf_counter() - t0) / 50
         print(f"{name}: compiles, correct={ok}, ~{dt*1e6:.0f} us/call "
-              f"({ROWS*N*4/dt/1e9:.1f} GB/s)")
+              f"({rows*n*4/dt/1e9:.1f} GB/s)", flush=True)
     except Exception as e:  # noqa: BLE001
-        print(f"{name}: FAILED {type(e).__name__}: {str(e)[:160]}")
+        print(f"{name}: FAILED {type(e).__name__}: {str(e)[:160]}",
+              flush=True)
 
 
 if __name__ == "__main__":
-    print("backend:", jax.devices()[0].platform)
-    for name, kern in [("jnp.take(axis=1)", kern_take),
-                       ("take_along_axis", kern_take_along),
-                       ("onehot_matmul", kern_onehot_matmul)]:
-        run(name, kern)
+    print("backend:", jax.devices()[0].platform, flush=True)
+    for name, kern, kw in [
+            ("jnp.take(axis=1)", kern_take, {}),
+            ("take_along_axis", kern_take_along, {}),
+            ("take_along_axis_i32", kern_take_along_i32, {}),
+            # shape sensitivity: one sublane tile / short lane count
+            ("take_along[8,2048]", kern_take_along, dict(rows=8)),
+            ("take_along[8,512]", kern_take_along, dict(rows=8, n=512)),
+            ("take_along_i32[8,512]", kern_take_along_i32,
+             dict(rows=8, n=512)),
+            ("onehot_matmul", kern_onehot_matmul, {}),
+    ]:
+        run(name, kern, **kw)

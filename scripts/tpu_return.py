@@ -15,8 +15,14 @@ mid-sequence still leaves a usable record:
 3. bench       — python bench.py (the official JSON line; its fly-off
                  probes keys8/lanes2/lanes itself with per-path budgets)
 4. regression  — the ambient workload ladder artifact
-5. profile     — keys8/lanes tile sweep (skip with --stop-after 4 when
-                 the window is precious)
+5. gatherprobe — in-kernel Mosaic gather formulations (exploratory,
+                 lanes2 viability) — AFTER the primary artifacts, so a
+                 hung variant compile cannot cost them the window
+6. profile     — keys8/lanes tile sweep
+
+Stage order is the priority order; pass --stop-after N to cut the tail
+(e.g. --stop-after 4 = through the regression artifact, skipping the
+exploratory stages).
 
 Discipline encoded here (learned from the 2026-07-30 wedges):
 stages run strictly sequentially; a timed-out stage is killed as a
@@ -133,6 +139,7 @@ def main() -> int:
                         "--platform", "ambient", "--size", "small",
                         "--out", os.path.join(args.log_dir, "ambient")],
          3600),
+        ("gatherprobe", [py, "scripts/probe_gather.py"], 1200),
         ("profile", [py, "scripts/profile_lanes.py"], 3600),
     ]
 

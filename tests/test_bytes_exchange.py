@@ -110,6 +110,28 @@ def test_merge_manager_over_exchange():
         assert contents == sorted(contents), f"reducer {r} unsorted"
 
 
+try:
+    from hypothesis import given, settings, strategies as st
+    _HYP = True
+except ImportError:           # pragma: no cover - hypothesis is baked in
+    _HYP = False
+
+if _HYP:
+    @settings(max_examples=15, deadline=None)
+    @given(st.lists(st.lists(st.tuples(st.integers(0, 3),
+                                       st.binary(max_size=300)),
+                             max_size=4),
+                    min_size=4, max_size=4),
+           st.integers(1, 3))
+    def test_exchange_blobs_property(blobs, capacity):
+        # arbitrary blob sizes (incl. empty), dest patterns, and round
+        # windows must all reassemble byte-identically in send order
+        mesh = make_mesh(4)
+        out = exchange_blobs(blobs, mesh, SHUFFLE_AXIS, capacity=capacity,
+                             row_payload_bytes=64)
+        _check_round_trip(blobs, out, 4)
+
+
 def test_exchange_fetch_client_unknown_map():
     import pytest
 
