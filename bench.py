@@ -82,9 +82,9 @@ PROBE_TIMEOUT = float(os.environ.get("UDA_TPU_BENCH_PROBE_TIMEOUT", 600))
 # the whole cascade on an 8-row keys-only array + ONE global XLA
 # payload gather (the same idea with the gather hoisted out of Mosaic —
 # it lowers everywhere).
-PATHS = (("lanes2", "keys8", "lanes", "carry", "gather")
+PATHS = (("lanes2", "keys8", "gather2", "lanes", "carry", "gather")
          if os.environ.get("UDA_TPU_BENCH_TRY_CARRY") == "1"
-         else ("lanes2", "keys8", "lanes", "gather"))
+         else ("lanes2", "keys8", "gather2", "lanes", "gather"))
 # explicit candidate-list override (comma-separated), e.g. a short pool
 # window where only the known-good path should be timed:
 #   UDA_TPU_BENCH_PATHS=lanes python bench.py
@@ -92,7 +92,7 @@ PATHS = (("lanes2", "keys8", "lanes", "carry", "gather")
 # (safe at module scope: importing jax does not lock the platform —
 # only the first device use does, after _enable_cache has re-applied
 # any JAX_PLATFORMS override).
-from uda_tpu.ops.sort import ALL_SORT_PATHS, LANES_ENGINES  # noqa: E402
+from uda_tpu.ops.sort import ALL_SORT_PATHS, FLYOFF_ENGINES  # noqa: E402
 
 if os.environ.get("UDA_TPU_BENCH_PATHS"):
     PATHS = tuple(p.strip()
@@ -102,7 +102,7 @@ if os.environ.get("UDA_TPU_BENCH_PATHS"):
     if bad or not PATHS:
         raise SystemExit(f"UDA_TPU_BENCH_PATHS: unknown or empty path "
                          f"list {bad or '(empty)'}; known: {ALL_SORT_PATHS}")
-FLYOFF_PATHS = frozenset(LANES_ENGINES)
+FLYOFF_PATHS = frozenset(FLYOFF_ENGINES)
 
 
 def _enable_cache() -> None:
@@ -142,7 +142,7 @@ def _compile_and_check(path: str) -> None:
     assert np.uint32(ck_in) == np.uint32(ck_out), "checksum mismatch"
 
 
-def _probe(path: str, timeout: float) -> bool:
+def _probe(path: str, timeout: float, extra_env=None) -> bool:
     """Compile `path` in a subprocess under a wall-clock cap.
 
     Failures must stay diagnosable after the fact: the subprocess runs
@@ -150,7 +150,8 @@ def _probe(path: str, timeout: float) -> bool:
     .bench_probe_<path>.log next to this file (the last-3-lines tail of
     a filtered JAX traceback is boilerplate, useless for debugging)."""
     here = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ, JAX_TRACEBACK_FILTERING="off")
+    env = dict(os.environ, JAX_TRACEBACK_FILTERING="off",
+               **(extra_env or {}))
     log = os.path.join(here, f".bench_probe_{path}.log")
     t0 = time.perf_counter()
     try:
@@ -221,9 +222,24 @@ def main() -> None:
     # would let a slowly-lowered gather variant shadow the faster
     # pipeline); the non-lanes fallbacks are probed only when no lanes
     # variant compiles, first success wins.
+    global KEYS8_TILE
     lanes_variants = [p for p in PATHS if p in FLYOFF_PATHS]
     fallbacks = [p for p in PATHS if p not in FLYOFF_PATHS]
-    candidates = [p for p in lanes_variants if _probe(p, PROBE_TIMEOUT)]
+    candidates = []
+    for p in lanes_variants:
+        if _probe(p, PROBE_TIMEOUT):
+            candidates.append(p)
+        elif p == "keys8" and KEYS8_TILE != LANES_TILE:
+            # the bigger keys8 tile is a bet pending the hardware
+            # sweep; a failed compile must not drop the engine from
+            # the fly-off — retry at the validated lanes tile
+            print(f"# keys8 tile={KEYS8_TILE} failed; retrying at "
+                  f"{LANES_TILE}", file=sys.stderr)
+            if _probe(p, PROBE_TIMEOUT,
+                      extra_env={"UDA_TPU_BENCH_KEYS8_TILE":
+                                 str(LANES_TILE)}):
+                KEYS8_TILE = LANES_TILE
+                candidates.append(p)
     for path in fallbacks:
         if candidates:
             break

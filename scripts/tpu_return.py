@@ -21,8 +21,9 @@ mid-sequence still leaves a usable record:
 6. profile     — keys8/lanes tile sweep
 
 Stage order is the priority order; pass --stop-after N to cut the tail
-(e.g. --stop-after 4 = through the regression artifact, skipping the
-exploratory stages).
+(the three take-ramp sizes count separately: --stop-after 5 = take16,
+take19, take22, bench, regression — the primary artifacts, skipping
+the exploratory stages).
 
 Discipline encoded here (learned from the 2026-07-30 wedges):
 stages run strictly sequentially; a timed-out stage is killed as a

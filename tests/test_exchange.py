@@ -364,6 +364,25 @@ def test_lanes2_payload_path_matches_lanes():
                                   np.asarray(two.words))
 
 
+def test_gather2_payload_path_matches_gather():
+    # one minor-dim take vs per-column takes: byte-identical output
+    mesh = _mesh()
+    p = 8
+    n = p * 48
+    words = _random_words(n, 5, seed=69)
+    words[: n // 2, 0] = words[n // 2:, 0]
+    spl = uniform_splitters(p)
+    kw = dict(capacity=n // p, num_keys=2, multiround="never")
+    a = distributed_sort_step(words, spl, mesh, AXIS,
+                              payload_path="gather", **kw)
+    b = distributed_sort_step(words, spl, mesh, AXIS,
+                              payload_path="gather2", **kw)
+    a.check()
+    b.check()
+    np.testing.assert_array_equal(np.asarray(a.words),
+                                  np.asarray(b.words))
+
+
 def test_keys8_payload_path_matches_lanes():
     # the keys8 engine (keys-only cascade + one global payload gather)
     # behind the distributed step must be byte-identical to the

@@ -233,6 +233,10 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
       payload moves ONCE via a global XLA lane gather (_keys8_parts) —
       the gather that Mosaic cannot lower in-kernel, hoisted to where
       XLA can.
+    - ``path="gather2"``: keys8 with the permutation from the narrow
+      4-operand ``lax.sort`` instead of the Pallas cascade (same single
+      payload gather). Bounded compile; whichever permutation engine is
+      faster on the ambient backend wins bench.py's fly-off.
     - ``path="carry"``: the payload rides the ``lax.sort`` network as
       extra operands. Fast at runtime (~12 GB/s, CPU-backend
       measurement) but XLA's
@@ -270,6 +274,25 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
         viol = viol + _violations_cols(s8[0], s8[1], s8[2])
         return (viol, ck_in, ck_out)
 
+    def body_gather2(i, acc):
+        # keys8's XLA-native twin: permutation from the narrow 4-operand
+        # lax.sort (XLA's tuned on-chip sort), payload via the same
+        # single minor-dim gather — no Pallas in the program at all
+        viol, ck_in, ck_out = acc
+        x = teragen_lanes(jax.random.fold_in(seed, i), n)
+        ck_in = ck_in + _checksum_cols(tuple(x[r]
+                                             for r in range(RECORD_WORDS)))
+        iota = lax.iota(jnp.int32, n)
+        k0, k1, k2, perm = lax.sort((x[0], x[1], x[2], iota),
+                                    num_keys=KEY_WORDS, is_stable=True)
+        payload = jnp.take(x[KEY_WORDS:RECORD_WORDS], perm, axis=1,
+                           unique_indices=True, mode="clip")
+        out_cols = (k0, k1, k2,
+                    *(payload[r] for r in range(VALUE_WORDS)))
+        ck_out = ck_out + _checksum_cols(out_cols)
+        viol = viol + _violations_cols(k0, k1, k2)
+        return (viol, ck_in, ck_out)
+
     def body_lanes(i, acc):
         viol, ck_in, ck_out = acc
         x = teragen_lanes(jax.random.fold_in(seed, i), n)
@@ -295,7 +318,8 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
 
     zero = jnp.uint32(0)
     body = {"lanes": body_lanes, "lanes2": body_lanes,
-            "keys8": body_keys8}.get(path, body_cols)
+            "keys8": body_keys8, "gather2": body_gather2}.get(path,
+                                                             body_cols)
     return lax.fori_loop(0, k, body, (jnp.int32(0), zero, zero))
 
 

@@ -37,18 +37,23 @@ from uda_tpu.ops.packing import PackedKeys
 
 __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
            "concat_packed", "resolve_sort_path", "LANES_ENGINES",
-           "ALL_SORT_PATHS"]
+           "FLYOFF_ENGINES", "ALL_SORT_PATHS"]
 
 # The single source of truth for engine path names. LANES_ENGINES are
 # the Pallas-pipeline variants (bounded compile; interpret mode on CPU
 # meshes): "lanes" carries payload through the network, "lanes2" uses
 # the in-kernel two-phase gather, "keys8" runs the cascade on an 8-row
-# keys view + one global XLA payload gather. The lax.sort paths are
-# "carry" (operand-carry) and "gather" (permutation + per-column
-# gathers). bench.py, parallel.distributed, and models.terasort all
-# import these — adding an engine means extending ONE tuple.
+# keys view + one global XLA payload gather. "gather2" is keys8's
+# XLA-native twin: the permutation comes from a narrow lax.sort
+# instead of the Pallas cascade, the payload moves with the same
+# single minor-dim gather (differs from "gather", which does one
+# gather PER COLUMN on [n] arrays). The remaining lax.sort paths are
+# "carry" (operand-carry) and "gather". bench.py, parallel.distributed
+# and models.terasort all import these — adding an engine means
+# extending ONE tuple.
 LANES_ENGINES = ("lanes", "lanes2", "keys8")
-ALL_SORT_PATHS = ("carry", "gather") + LANES_ENGINES
+FLYOFF_ENGINES = LANES_ENGINES + ("gather2",)
+ALL_SORT_PATHS = ("carry", "gather") + FLYOFF_ENGINES
 
 
 def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:

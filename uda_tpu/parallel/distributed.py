@@ -140,10 +140,12 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
     columns ride the sort network (fast runtime, but XLA variadic-sort
     compile time grows superlinearly in operand count — prohibitive on
     TPU remote-compile backends). "gather": a narrow sort computes the
-    permutation and per-column gathers apply it (bounded compile; [n]
-    gathers keep the SoA/no-lane-padding rationale of
-    terasort.bench_step — a row gather on the [n, W] matrix would touch
-    the lane-padded layout)."""
+    permutation and per-column gathers on [n] arrays apply it (bounded
+    compile, avoids the lane-padded [n, W] layout). "gather2": the same
+    narrow-sort permutation applied with ONE minor-dim gather on the
+    transposed [W, n] view instead — deliberately trading layouts; the
+    faster of the two is backend-dependent and bench.py's fly-off
+    measures it."""
     from uda_tpu.ops.sort import LANES_ENGINES
 
     n, wcols = flat.shape
@@ -163,6 +165,11 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
     row = jnp.arange(n, dtype=jnp.int32)
     *_, perm = lax.sort((*keycols, invalid_last, row),
                         num_keys=num_keys + 1, is_stable=True)
+    if payload_path == "gather2":
+        # one minor-dim gather of all columns at once (vs "gather"'s
+        # per-column takes) — same permutation, same output
+        return jnp.take(flat.T, perm, axis=1,
+                        unique_indices=True, mode="clip").T
     return jnp.stack(tuple(jnp.take(flat[:, i], perm, axis=0)
                            for i in range(wcols)), axis=1)
 
