@@ -82,9 +82,11 @@ PROBE_TIMEOUT = float(os.environ.get("UDA_TPU_BENCH_PROBE_TIMEOUT", 600))
 # the whole cascade on an 8-row keys-only array + ONE global XLA
 # payload gather (the same idea with the gather hoisted out of Mosaic —
 # it lowers everywhere).
-PATHS = (("lanes2", "keys8", "gather2", "lanes", "carry", "gather")
+PATHS = (("lanes2", "keys8", "gather2", "carrychunk", "lanes", "carry",
+          "gather")
          if os.environ.get("UDA_TPU_BENCH_TRY_CARRY") == "1"
-         else ("lanes2", "keys8", "gather2", "lanes", "gather"))
+         else ("lanes2", "keys8", "gather2", "carrychunk", "lanes",
+               "gather"))
 # explicit candidate-list override (comma-separated), e.g. a short pool
 # window where only the known-good path should be timed:
 #   UDA_TPU_BENCH_PATHS=lanes python bench.py
@@ -142,17 +144,20 @@ def _compile_and_check(path: str) -> None:
     assert np.uint32(ck_in) == np.uint32(ck_out), "checksum mismatch"
 
 
-def _probe(path: str, timeout: float, extra_env=None) -> bool:
+def _probe(path: str, timeout: float, extra_env=None,
+           log_name: str = "") -> bool:
     """Compile `path` in a subprocess under a wall-clock cap.
 
     Failures must stay diagnosable after the fact: the subprocess runs
     with JAX_TRACEBACK_FILTERING=off and its FULL stderr persists to
-    .bench_probe_<path>.log next to this file (the last-3-lines tail of
-    a filtered JAX traceback is boilerplate, useless for debugging)."""
+    .bench_probe_<log_name or path>.log next to this file (the
+    last-3-lines tail of a filtered JAX traceback is boilerplate,
+    useless for debugging). Retries pass a distinct ``log_name`` so a
+    prior failure's log survives the retry's success-path cleanup."""
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ, JAX_TRACEBACK_FILTERING="off",
                **(extra_env or {}))
-    log = os.path.join(here, f".bench_probe_{path}.log")
+    log = os.path.join(here, f".bench_probe_{log_name or path}.log")
     t0 = time.perf_counter()
     try:
         proc = subprocess.run(
@@ -217,27 +222,30 @@ def main() -> None:
             "backend liveness check failed: device op did not complete "
             "(accelerator pool unreachable or wedged); not probing")
 
-    # Candidate selection: every lanes variant that compiles enters a
-    # measured fly-off and the FASTER one wins (compile success alone
-    # would let a slowly-lowered gather variant shadow the faster
-    # pipeline); the non-lanes fallbacks are probed only when no lanes
-    # variant compiles, first success wins.
+    # Candidate selection: every fly-off engine that compiles enters a
+    # measured fly-off and the FASTEST wins (compile success alone
+    # would let a slowly-lowered variant shadow a faster one); the
+    # slow-or-risky fallbacks ("gather": measured 0.3 GB/s; "carry":
+    # pathological compile) are probed only when NO fly-off engine
+    # compiles, first success wins.
     global KEYS8_TILE
-    lanes_variants = [p for p in PATHS if p in FLYOFF_PATHS]
+    flyoff_variants = [p for p in PATHS if p in FLYOFF_PATHS]
     fallbacks = [p for p in PATHS if p not in FLYOFF_PATHS]
     candidates = []
-    for p in lanes_variants:
+    for p in flyoff_variants:
         if _probe(p, PROBE_TIMEOUT):
             candidates.append(p)
         elif p == "keys8" and KEYS8_TILE != LANES_TILE:
             # the bigger keys8 tile is a bet pending the hardware
             # sweep; a failed compile must not drop the engine from
-            # the fly-off — retry at the validated lanes tile
+            # the fly-off — retry at the validated lanes tile, under a
+            # DISTINCT log name so the big-tile failure log survives
             print(f"# keys8 tile={KEYS8_TILE} failed; retrying at "
                   f"{LANES_TILE}", file=sys.stderr)
             if _probe(p, PROBE_TIMEOUT,
                       extra_env={"UDA_TPU_BENCH_KEYS8_TILE":
-                                 str(LANES_TILE)}):
+                                 str(LANES_TILE)},
+                      log_name=f"{p}_tile{LANES_TILE}"):
                 KEYS8_TILE = LANES_TILE
                 candidates.append(p)
     for path in fallbacks:

@@ -364,8 +364,9 @@ def test_lanes2_payload_path_matches_lanes():
                                   np.asarray(two.words))
 
 
-def test_gather2_payload_path_matches_gather():
-    # one minor-dim take vs per-column takes: byte-identical output
+def test_gather2_and_carrychunk_payload_paths_match_gather():
+    # one minor-dim take / chunked carry sorts vs per-column takes:
+    # byte-identical output for every permutation-apply strategy
     mesh = _mesh()
     p = 8
     n = p * 48
@@ -375,12 +376,13 @@ def test_gather2_payload_path_matches_gather():
     kw = dict(capacity=n // p, num_keys=2, multiround="never")
     a = distributed_sort_step(words, spl, mesh, AXIS,
                               payload_path="gather", **kw)
-    b = distributed_sort_step(words, spl, mesh, AXIS,
-                              payload_path="gather2", **kw)
     a.check()
-    b.check()
-    np.testing.assert_array_equal(np.asarray(a.words),
-                                  np.asarray(b.words))
+    for path in ("gather2", "carrychunk"):
+        b = distributed_sort_step(words, spl, mesh, AXIS,
+                                  payload_path=path, **kw)
+        b.check()
+        np.testing.assert_array_equal(np.asarray(a.words),
+                                      np.asarray(b.words), err_msg=path)
 
 
 def test_keys8_payload_path_matches_lanes():

@@ -75,6 +75,13 @@ def test_bench_step_gather2_path_validates():
     assert np.uint32(ck_in) == np.uint32(ck_out)
 
 
+def test_bench_step_carrychunk_path_validates():
+    viol, ck_in, ck_out = terasort.bench_step(
+        jax.random.key(5), 2048, 2, path="carrychunk", tile=512)
+    assert int(viol) == 0
+    assert np.uint32(ck_in) == np.uint32(ck_out)
+
+
 def test_sort_lanes_keys8_matches_sort_lanes():
     # the keys8 engine (keys-only cascade + one global payload gather)
     # must be byte-identical to the 32-row pipeline, stability included

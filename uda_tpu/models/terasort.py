@@ -237,6 +237,10 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
       4-operand ``lax.sort`` instead of the Pallas cascade (same single
       payload gather). Bounded compile; whichever permutation engine is
       faster on the ambient backend wins bench.py's fly-off.
+    - ``path="carrychunk"``: gather-free — the permutation is inverted
+      with a 2-operand sort and applied with ceil(23/6) narrow carry
+      sorts. Payload moves through sort networks like "carry" but every
+      sort stays far below the operand count where compile blows up.
     - ``path="carry"``: the payload rides the ``lax.sort`` network as
       extra operands. Fast at runtime (~12 GB/s, CPU-backend
       measurement) but XLA's
@@ -272,6 +276,26 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
                     *(payload[r] for r in range(VALUE_WORDS)))
         ck_out = ck_out + _checksum_cols(out_cols)
         viol = viol + _violations_cols(s8[0], s8[1], s8[2])
+        return (viol, ck_in, ck_out)
+
+    def body_carrychunk(i, acc):
+        # gather-free payload move (ops.sort.apply_perm_chunked):
+        # payload crosses sort networks like "carry", compile stays
+        # bounded
+        from uda_tpu.ops.sort import apply_perm_chunked
+
+        viol, ck_in, ck_out = acc
+        x = teragen_lanes(jax.random.fold_in(seed, i), n)
+        ck_in = ck_in + _checksum_cols(tuple(x[r]
+                                             for r in range(RECORD_WORDS)))
+        iota = lax.iota(jnp.int32, n)
+        k0, k1, k2, perm = lax.sort((x[0], x[1], x[2], iota),
+                                    num_keys=KEY_WORDS, is_stable=True)
+        cols = apply_perm_chunked(
+            perm, [x[r] for r in range(KEY_WORDS, RECORD_WORDS)])
+        out_cols = (k0, k1, k2, *cols)
+        ck_out = ck_out + _checksum_cols(out_cols)
+        viol = viol + _violations_cols(k0, k1, k2)
         return (viol, ck_in, ck_out)
 
     def body_gather2(i, acc):
@@ -318,8 +342,8 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
 
     zero = jnp.uint32(0)
     body = {"lanes": body_lanes, "lanes2": body_lanes,
-            "keys8": body_keys8, "gather2": body_gather2}.get(path,
-                                                             body_cols)
+            "keys8": body_keys8, "gather2": body_gather2,
+            "carrychunk": body_carrychunk}.get(path, body_cols)
     return lax.fori_loop(0, k, body, (jnp.int32(0), zero, zero))
 
 

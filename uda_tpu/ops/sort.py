@@ -36,8 +36,8 @@ from jax import lax
 from uda_tpu.ops.packing import PackedKeys
 
 __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
-           "concat_packed", "resolve_sort_path", "LANES_ENGINES",
-           "FLYOFF_ENGINES", "ALL_SORT_PATHS"]
+           "concat_packed", "resolve_sort_path", "apply_perm_chunked",
+           "LANES_ENGINES", "FLYOFF_ENGINES", "ALL_SORT_PATHS"]
 
 # The single source of truth for engine path names. LANES_ENGINES are
 # the Pallas-pipeline variants (bounded compile; interpret mode on CPU
@@ -51,8 +51,13 @@ __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
 # "carry" (operand-carry) and "gather". bench.py, parallel.distributed
 # and models.terasort all import these — adding an engine means
 # extending ONE tuple.
+# "carrychunk" applies the narrow-sort permutation with a few SMALL
+# operand-carry sorts (invert the permutation with a 2-operand sort,
+# then re-sort payload chunks of ~6 columns by it): no gathers, no
+# Pallas, and every sort stays far below the operand count where XLA's
+# variadic-sort compile time blows up.
 LANES_ENGINES = ("lanes", "lanes2", "keys8")
-FLYOFF_ENGINES = LANES_ENGINES + ("gather2",)
+FLYOFF_ENGINES = LANES_ENGINES + ("gather2", "carrychunk")
 ALL_SORT_PATHS = ("carry", "gather") + FLYOFF_ENGINES
 
 
@@ -81,6 +86,27 @@ def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
     if path not in valid:
         raise ValueError(f"unknown sort path {path!r}")
     return path
+
+
+def apply_perm_chunked(perm, cols, chunk_cols: int = 6) -> list:
+    """Apply ``perm`` to columns WITHOUT gathers: ``out[c][j] ==
+    cols[c][perm[j]]``. Inverts the permutation with a 2-operand sort
+    (iota carried through a sort BY perm lands at the inverse), then
+    re-sorts payload chunks of ``chunk_cols`` columns by it — every
+    sort stays far below the operand count where XLA's variadic-sort
+    compile time blows up. The single implementation behind the
+    "carrychunk" engine (terasort bench and the distributed step)."""
+    n = perm.shape[0]
+    iota = lax.iota(jnp.int32, n)
+    # perm keys are distinct, so unstable sorts are exact
+    _, inv = lax.sort((perm.astype(jnp.int32), iota), num_keys=1,
+                      is_stable=False)
+    out_cols: list = []
+    for base in range(0, len(cols), chunk_cols):
+        chunk = tuple(cols[base:base + chunk_cols])
+        out = lax.sort((inv, *chunk), num_keys=1, is_stable=False)
+        out_cols.extend(out[1:])
+    return out_cols
 
 
 @partial(jax.jit, static_argnames=("num_key_words",))

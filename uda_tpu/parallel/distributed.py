@@ -145,7 +145,10 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
     narrow-sort permutation applied with ONE minor-dim gather on the
     transposed [W, n] view instead — deliberately trading layouts; the
     faster of the two is backend-dependent and bench.py's fly-off
-    measures it."""
+    measures it. "carrychunk": the same permutation applied with NO
+    gathers at all — inverted via a 2-operand sort and re-applied in
+    narrow carry-sort chunks (ops.sort.apply_perm_chunked), every sort
+    far below the operand count where compile blows up."""
     from uda_tpu.ops.sort import LANES_ENGINES
 
     n, wcols = flat.shape
@@ -170,6 +173,13 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
         # per-column takes) — same permutation, same output
         return jnp.take(flat.T, perm, axis=1,
                         unique_indices=True, mode="clip").T
+    if payload_path == "carrychunk":
+        # gather-free permutation apply (ops.sort.apply_perm_chunked)
+        from uda_tpu.ops.sort import apply_perm_chunked
+
+        cols = apply_perm_chunked(perm,
+                                  [flat[:, i] for i in range(wcols)])
+        return jnp.stack(cols, axis=1)
     return jnp.stack(tuple(jnp.take(flat[:, i], perm, axis=0)
                            for i in range(wcols)), axis=1)
 
