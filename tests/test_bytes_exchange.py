@@ -132,6 +132,42 @@ if _HYP:
         _check_round_trip(blobs, out, 4)
 
 
+def test_pipeline_mesh_shuffle_matches_local(tmp_path):
+    # the full MapReduce driver with the mesh as the wire: identical
+    # output to the local DataEngine shuffle, plain and compressed
+    from uda_tpu.utils.config import Config
+
+    import collections
+    import re
+    import struct
+
+    from uda_tpu.models import wordcount as wc
+    from uda_tpu.models.pipeline import MapReduceJob
+
+    text = (b"alpha beta gamma alpha beta alpha delta " * 40)
+    mesh = make_mesh(4)
+    splits = [text[: len(text) // 2], text[len(text) // 2:],
+              b"alpha", b"", b"beta"]
+    want = collections.Counter(
+        m.group(0).lower() for s in splits
+        for m in re.finditer(rb"[A-Za-z0-9]+", s))
+
+    for tag, cfg in (("plain", None),
+                     ("zlib", Config({"mapred.compress.map.output": True,
+                                      "mapred.map.output.compression.codec":
+                                      "zlib"}))):
+        job = MapReduceJob(f"wc_mesh_{tag}", wc._mapper, wc._reducer,
+                           key_type="org.apache.hadoop.io.Text",
+                           num_reducers=3, config=cfg,
+                           work_dir=str(tmp_path / tag))
+        outputs = job.run(splits, mesh=mesh)
+        got = {}
+        for recs in outputs.values():
+            for k, v in recs:
+                got[wc.parse_text_key(k)] = struct.unpack(">q", v)[0]
+        assert got == dict(want), tag
+
+
 def test_exchange_fetch_client_unknown_map():
     import pytest
 

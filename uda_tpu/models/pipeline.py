@@ -112,29 +112,99 @@ class MapReduceJob:
 
     # -- reduce phase -------------------------------------------------------
 
+    def _reduce_all(self, writer: MOFWriter,
+                    make_client: Callable[[int], object]
+                    ) -> dict[int, list[Record]]:
+        """The per-reducer merge+reduce loop shared by every transport:
+        ``make_client(r)`` builds reducer r's raw InputClient; the codec
+        wrap, MergeManager run, framed-block reassembly, and grouped
+        reduce are identical whichever wire the bytes crossed."""
+        codec = self._codec()
+        outputs: dict[int, list[Record]] = {}
+        for r in range(self.num_reducers):
+            client = make_client(r)
+            if codec is not None:
+                from uda_tpu.compress import DecompressingClient
+                client = DecompressingClient(client, codec)
+            mm = MergeManager(client, self.key_type, self.cfg)
+            blocks: list[bytes] = []
+            mm.run(self.job_id, writer.map_ids, r,
+                   lambda b: blocks.append(bytes(b)))
+            merged = IFileReader(io.BytesIO(b"".join(blocks)))
+            with metrics.timer("reduce_phase"):
+                outputs[r] = list(grouped_reduce(
+                    merged, self.reducer, self.key_type.content))
+        return outputs
+
     def run_reduces(self, writer: MOFWriter) -> dict[int, list[Record]]:
         """Shuffle+merge each partition through the engine, apply the
         reducer over the grouped sorted stream."""
         engine = DataEngine(DirIndexResolver(self.work_dir), self.cfg)
-        codec = self._codec()
-        outputs: dict[int, list[Record]] = {}
         try:
-            for r in range(self.num_reducers):
-                client: object = LocalFetchClient(engine)
-                if codec is not None:
-                    from uda_tpu.compress import DecompressingClient
-                    client = DecompressingClient(client, codec)
-                mm = MergeManager(client, self.key_type, self.cfg)
-                blocks: list[bytes] = []
-                mm.run(self.job_id, writer.map_ids, r,
-                       lambda b: blocks.append(bytes(b)))
-                merged = IFileReader(io.BytesIO(b"".join(blocks)))
-                with metrics.timer("reduce_phase"):
-                    outputs[r] = list(grouped_reduce(
-                        merged, self.reducer, self.key_type.content))
+            return self._reduce_all(writer,
+                                    lambda r: LocalFetchClient(engine))
         finally:
             engine.stop()
-        return outputs
 
-    def run(self, inputs: Sequence[object]) -> dict[int, list[Record]]:
-        return self.run_reduces(self.run_maps(inputs))
+    def run_reduces_mesh(self, writer: MOFWriter, mesh,
+                         axis: str = "shuffle") -> dict[int, list[Record]]:
+        """Shuffle the map-output partitions ACROSS THE DEVICE MESH and
+        merge per reducer — the cluster deployment shape with the mesh
+        as the wire (the role the reference's RDMA fabric plays between
+        MOFSupplier and NetMerger hosts): map m's outputs live on
+        supplier device ``m % P``, reducer r is served on device
+        ``r % P``, and the on-disk partition bytes (compressed or not)
+        cross via parallel.bytes_exchange. Per-(src, dst) blob order is
+        the deterministic (map, reducer) send order, so delivered blobs
+        map back to their (map, reducer) pair positionally. Output is
+        byte-identical to run_reduces.
+        """
+        from uda_tpu.mofserver.index import read_index_file
+        from uda_tpu.parallel.bytes_exchange import (ExchangeFetchClient,
+                                                     exchange_blobs,
+                                                     exchange_group_size)
+
+        p = exchange_group_size(mesh, axis)
+        blobs: list[list] = [[] for _ in range(p)]
+        meta: list[list] = [[] for _ in range(p)]  # (map_id, r, raw_len)
+        for m, map_id in enumerate(writer.map_ids):
+            d = writer.map_dir(map_id)
+            recs = read_index_file(os.path.join(d, "file.out.index"),
+                                   os.path.join(d, "file.out"))
+            with open(os.path.join(d, "file.out"), "rb") as f:
+                mof = f.read()
+            src = m % p
+            for r in range(self.num_reducers):
+                ir = recs[r]
+                blobs[src].append((r % p,
+                                   mof[ir.start_offset:ir.start_offset
+                                       + ir.part_length]))
+                meta[src].append((map_id, r, ir.raw_length))
+        with metrics.timer("mesh_shuffle"):
+            delivered = exchange_blobs(blobs, mesh, axis)
+        # regroup positionally: delivered[dst][src][k] is the k-th blob
+        # that src addressed to dst, in send order
+        per_reduce: dict[int, dict[str, bytes]] = {
+            r: {} for r in range(self.num_reducers)}
+        raw_lens: dict[int, dict[str, int]] = {
+            r: {} for r in range(self.num_reducers)}
+        for src in range(p):
+            cursors = {d: 0 for d in range(p)}
+            for (map_id, r, raw), (dstdev, _) in zip(meta[src],
+                                                     blobs[src]):
+                k = cursors[dstdev]
+                cursors[dstdev] += 1
+                per_reduce[r][map_id] = delivered[dstdev][src][k]
+                raw_lens[r][map_id] = raw
+        return self._reduce_all(
+            writer, lambda r: ExchangeFetchClient(per_reduce[r],
+                                                  raw_lengths=raw_lens[r]))
+
+    def run(self, inputs: Sequence[object],
+            mesh=None) -> dict[int, list[Record]]:
+        """Full job. With ``mesh``, the shuffle crosses the device mesh
+        (run_reduces_mesh); otherwise it stays on the local DataEngine."""
+        writer = self.run_maps(inputs)
+        if mesh is not None:
+            return self.run_reduces_mesh(writer, mesh)
+        return self.run_reduces(writer)

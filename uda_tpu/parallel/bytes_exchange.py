@@ -34,7 +34,17 @@ from uda_tpu.merger.segment import InputClient
 from uda_tpu.mofserver.data_engine import FetchResult, ShuffleRequest
 from uda_tpu.utils.errors import MergeError
 
-__all__ = ["exchange_blobs", "ExchangeFetchClient"]
+__all__ = ["exchange_blobs", "exchange_group_size", "ExchangeFetchClient"]
+
+
+def exchange_group_size(mesh: Mesh, axis) -> int:
+    """Number of exchange participants = product of the NAMED axes only
+    (a multi-axis mesh with a single exchange axis runs one independent
+    exchange per replica of the other axes; counting all axes would
+    address dests the all_to_all never reaches and silently drop their
+    rows). The one rule callers sizing ``blobs`` must share."""
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    return int(np.prod([mesh.shape[a] for a in axes]))
 
 _SENTINEL = np.uint32(0xFFFFFFFF)   # blob id of padding rows
 _HDR_WORDS = 2                      # [blob_id, valid_bytes]
@@ -75,15 +85,10 @@ def exchange_blobs(blobs: Sequence[Sequence[Tuple[int, bytes]]],
     """
     from uda_tpu.parallel.exchange import shuffle_exchange
 
-    # group size = the EXCHANGE axes only (a multi-axis mesh with a
-    # single named axis runs one independent exchange per replica of
-    # the other axes; counting all axes here would address dests the
-    # all_to_all never reaches and silently drop their rows)
-    axes = (axis,) if isinstance(axis, str) else tuple(axis)
-    p = int(np.prod([mesh.shape[a] for a in axes]))
+    p = exchange_group_size(mesh, axis)
     if len(blobs) != p:
         raise ValueError(f"blobs has {len(blobs)} sources for a {p}-way "
-                         f"exchange over axes {axes}")
+                         f"exchange over {axis!r}")
     for s, items in enumerate(blobs):
         for dst, _ in items:
             if not 0 <= dst < p:
@@ -154,10 +159,20 @@ class ExchangeFetchClient(InputClient):
     inline — the bytes already crossed the wire; chunking preserves the
     Segment carry-buffer contract (records split across chunks) so the
     whole reduce-side stack behaves exactly as over the RDMA-style
-    transport."""
+    transport.
 
-    def __init__(self, segments: dict[str, bytes]):
+    ``raw_lengths`` carries each partition's UNCOMPRESSED size when the
+    exchanged bytes are codec-compressed (the spill index's raw_length
+    vs part_length split). It exists for FetchResult CONTRACT fidelity —
+    the reference ACK carries both lengths (RDMAServer.cc:597-607) —
+    not because the decompression path needs it: DecompressingClient
+    tracks uncompressed progress itself and never reads the inner
+    raw_length. Defaults to the on-wire length (uncompressed)."""
+
+    def __init__(self, segments: dict[str, bytes],
+                 raw_lengths: Optional[dict[str, int]] = None):
         self._segments = dict(segments)
+        self._raw = dict(raw_lengths or {})
 
     def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
         data = self._segments.get(req.map_id)
@@ -167,5 +182,7 @@ class ExchangeFetchClient(InputClient):
             return
         chunk = data[req.offset:req.offset + req.chunk_size]
         last = req.offset + len(chunk) >= len(data)
-        on_complete(FetchResult(chunk, len(data), len(data), req.offset,
+        on_complete(FetchResult(chunk,
+                                self._raw.get(req.map_id, len(data)),
+                                len(data), req.offset,
                                 f"mesh://{req.map_id}", last))
