@@ -229,6 +229,32 @@ def wl_sort(size: str, work_dir: str) -> dict:
     return {"records": n}
 
 
+def wl_mesh_shuffle(size: str, work_dir: str) -> dict:
+    # the MapReduce driver with the device mesh as the wire (the
+    # cluster deployment shape): output must match a direct count
+    import collections
+    import re
+
+    import jax
+    import numpy as np
+
+    from uda_tpu.models.wordcount import run_wordcount
+    from uda_tpu.parallel.mesh import make_mesh
+
+    ndev = min(4, len(jax.devices()))
+    n = max(1 << 14, _size("wordcount_bytes", size) // 4)
+    rng = np.random.default_rng(13)
+    text = b" ".join(b"m%03d" % int(rng.integers(0, 200))
+                     for _ in range(n // 5))
+    got = run_wordcount(text, num_maps=3, num_reducers=3,
+                        work_dir=work_dir, mesh=make_mesh(ndev))
+    want = collections.Counter(
+        m.group(0).lower()
+        for m in re.finditer(rb"[A-Za-z0-9]+", text))
+    assert got == dict(want), "mesh shuffle wordcount mismatch"
+    return {"input_bytes": len(text), "distinct_words": len(want)}
+
+
 def wl_pi(size: str, work_dir: str) -> dict:
     from uda_tpu.models.pi import run_pi
 
@@ -254,6 +280,7 @@ WORKLOADS = {
     "inverted_index": wl_inverted_index,
     "grep": wl_grep,
     "compressed_shuffle": wl_compressed_shuffle,
+    "mesh_shuffle": wl_mesh_shuffle,
     "pi": wl_pi,
     "dfsio": wl_dfsio,
 }

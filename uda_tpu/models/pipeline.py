@@ -164,6 +164,13 @@ class MapReduceJob:
                                                      exchange_blobs,
                                                      exchange_group_size)
 
+        axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        missing = [a for a in axes if a not in mesh.shape]
+        if missing:
+            raise ValueError(
+                f"mesh has axes {tuple(mesh.shape)}, not {missing}; pass "
+                f"axis= matching the mesh (e.g. run(..., axis="
+                f"{next(iter(mesh.shape))!r}))")
         p = exchange_group_size(mesh, axis)
         blobs: list[list] = [[] for _ in range(p)]
         meta: list[list] = [[] for _ in range(p)]  # (map_id, r, raw_len)
@@ -200,11 +207,12 @@ class MapReduceJob:
             writer, lambda r: ExchangeFetchClient(per_reduce[r],
                                                   raw_lengths=raw_lens[r]))
 
-    def run(self, inputs: Sequence[object],
-            mesh=None) -> dict[int, list[Record]]:
+    def run(self, inputs: Sequence[object], mesh=None,
+            axis: str = "shuffle") -> dict[int, list[Record]]:
         """Full job. With ``mesh``, the shuffle crosses the device mesh
-        (run_reduces_mesh); otherwise it stays on the local DataEngine."""
+        (run_reduces_mesh, over ``axis``); otherwise it stays on the
+        local DataEngine."""
         writer = self.run_maps(inputs)
         if mesh is not None:
-            return self.run_reduces_mesh(writer, mesh)
+            return self.run_reduces_mesh(writer, mesh, axis=axis)
         return self.run_reduces(writer)

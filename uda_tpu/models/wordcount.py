@@ -45,9 +45,11 @@ def _reducer(key: bytes, values: list[bytes]) -> Iterable[Record]:
 
 def run_wordcount(text: bytes, num_maps: int = 4, num_reducers: int = 2,
                   config: Optional[Config] = None,
-                  work_dir: Optional[str] = None) -> dict[bytes, int]:
+                  work_dir: Optional[str] = None,
+                  mesh=None) -> dict[bytes, int]:
     """Run WordCount over ``text`` split into ``num_maps`` chunks; returns
-    {word: count} merged across reducers."""
+    {word: count} merged across reducers. With ``mesh``, the shuffle
+    crosses the device mesh (MapReduceJob.run_reduces_mesh)."""
     n = len(text)
     step = max(1, n // num_maps)
     splits = []
@@ -63,7 +65,7 @@ def run_wordcount(text: bytes, num_maps: int = 4, num_reducers: int = 2,
                        key_type="org.apache.hadoop.io.Text",
                        num_reducers=num_reducers, config=config,
                        work_dir=work_dir)
-    outputs = job.run(splits)
+    outputs = job.run(splits, mesh=mesh)
     result: dict[bytes, int] = {}
     for recs in outputs.values():
         for k, v in recs:
