@@ -298,7 +298,12 @@ def main() -> None:
         chosen = candidates[0]
         timed_dispatch(chosen, 999)  # warmup (compile cache hit)
 
-    best = min(timed_dispatch(chosen, i) for i in range(DISPATCHES))
+    # UDA_TPU_XPROF=<dir> captures a device profile of the timed
+    # dispatches (no-op otherwise)
+    from uda_tpu.utils.metrics import device_trace
+
+    with device_trace():
+        best = min(timed_dispatch(chosen, i) for i in range(DISPATCHES))
     gbps = gb_per_dispatch / best
     print(json.dumps({
         "metric": "terasort_singlechip_shuffle_merge_gbps",

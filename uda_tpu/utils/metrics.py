@@ -17,7 +17,7 @@ import time
 from collections import defaultdict
 from typing import Dict, Iterator
 
-__all__ = ["Metrics", "metrics"]
+__all__ = ["Metrics", "metrics", "device_trace"]
 
 
 class Metrics:
@@ -63,6 +63,42 @@ class Metrics:
             ]
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str | None = None) -> Iterator[None]:
+    """Capture a device (Xprof) profile around a block, correlating the
+    host-side spans above with on-device timelines — the SURVEY §7
+    stage-8 'Xprof hooks'. Enabled by passing ``log_dir`` or setting
+    ``UDA_TPU_XPROF=<dir>``; a no-op otherwise (and when the ambient
+    backend does not support jax.profiler, e.g. relay backends — the
+    failure is logged, never raised: profiling must not take down the
+    job)."""
+    import os
+
+    d = log_dir or os.environ.get("UDA_TPU_XPROF")
+    if not d:
+        yield
+        return
+    import jax
+
+    try:
+        jax.profiler.start_trace(d)
+    except Exception as e:  # noqa: BLE001 - profiling is best-effort
+        from uda_tpu.utils.logging import get_logger
+
+        get_logger().warn(f"device trace unavailable: {e}")
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            from uda_tpu.utils.logging import get_logger
+
+            get_logger().warn(f"device trace stop failed: {e}")
 
 
 metrics = Metrics()
