@@ -36,6 +36,23 @@ def test_single_chip_sort_gather_path_matches_carry():
     np.testing.assert_array_equal(a, b)
 
 
+def test_single_chip_sort_all_engines_match_carry():
+    # every public engine, byte-identical to the carry oracle — with a
+    # non-power-of-two n (padding engages), duplicate keys (stability),
+    # and records whose keys are all 0xFFFFFFFF (they TIE with the
+    # padding lanes' +inf keys; the arrival tie-break must still place
+    # every real record before the padding)
+    words = np.asarray(terasort.teragen(jax.random.key(21), 1000)).copy()
+    words[5:8, :3] = 0xFFFFFFFF
+    words[100:200, :3] = words[300:400, :3]
+    a = np.asarray(terasort.single_chip_sort(words, path="carry"))
+    for path in ("lanes", "lanes2", "keys8", "gather", "gather2",
+                 "carrychunk"):
+        b = np.asarray(terasort.single_chip_sort(words, path=path,
+                                                 tile=512, interpret=True))
+        np.testing.assert_array_equal(a, b, err_msg=path)
+
+
 def test_bench_step_both_paths_validate():
     for path in ("carry", "gather"):
         viol, ck_in, ck_out = terasort.bench_step(

@@ -80,14 +80,27 @@ def teragen_lanes(key: jax.Array, n: int) -> jax.Array:
 
 def _sort_record_cols(cols: tuple, path: str) -> tuple:
     """Stable lexicographic sort of SoA record columns by the first
-    KEY_WORDS columns — the single source of truth for the carry/gather
-    strategy switch (see bench_step for the trade-off)."""
+    KEY_WORDS columns — the single source of truth for every lax.sort
+    payload strategy (see bench_step for the trade-offs): "carry" rides
+    all columns through the network; the rest compute a narrow-sort
+    permutation and apply it with per-column gathers ("gather"), one
+    minor-dim gather on the stacked value columns ("gather2"), or
+    chunked carry sorts ("carrychunk")."""
     if path == "carry":
         return lax.sort(cols, num_keys=KEY_WORDS, is_stable=True)
     iota = lax.iota(jnp.int32, cols[0].shape[0])
     *sk, perm = lax.sort((*cols[:KEY_WORDS], iota),
                          num_keys=KEY_WORDS, is_stable=True)
-    return (*sk, *(jnp.take(c, perm, axis=0) for c in cols[KEY_WORDS:]))
+    vals = cols[KEY_WORDS:]
+    if path == "gather2":
+        pay = jnp.take(jnp.stack(vals, axis=0), perm, axis=1,
+                       unique_indices=True, mode="clip")
+        return (*sk, *(pay[i] for i in range(len(vals))))
+    if path == "carrychunk":
+        from uda_tpu.ops.sort import apply_perm_chunked
+
+        return (*sk, *apply_perm_chunked(perm, list(vals)))
+    return (*sk, *(jnp.take(c, perm, axis=0) for c in vals))
 
 
 @partial(jax.jit, static_argnames=("path",))
@@ -96,22 +109,64 @@ def _single_chip_sort(words: jax.Array, path: str) -> jax.Array:
     return jnp.stack(_sort_record_cols(cols, path), axis=1)
 
 
-def single_chip_sort(words: jax.Array, path: str = "auto") -> jax.Array:
+@partial(jax.jit, static_argnames=("path", "tile", "interpret"))
+def _single_chip_sort_lanes(words: jax.Array, path: str, tile: int,
+                            interpret: bool) -> jax.Array:
+    """Lanes-engine body of single_chip_sort: pad the record count to a
+    power-of-two multiple of ``tile`` with +inf-key lanes and run the
+    Pallas pipeline. Padding lanes sit PAST every real lane, so even a
+    real record whose keys are all 0xFFFFFFFF sorts before them (the
+    tile-sort kernel's arrival-index tie-break is the lane index, and
+    padding occupies the highest lanes); truncating to n drops exactly
+    the padding."""
+    n, w = words.shape
+    m = max(tile, 1 << max(0, n - 1).bit_length())
+    if path == "keys8":
+        # keys-only: never materialize the 32-row matrix — the payload
+        # is gathered straight off the caller's rows
+        mat8 = jnp.full((_KEYS8_ROWS, m), np.uint32(0xFFFFFFFF),
+                        jnp.uint32)
+        mat8 = lax.dynamic_update_slice(
+            mat8, words[:, :KEY_WORDS].T.astype(jnp.uint32), (0, 0))
+        s8 = pallas_sort.sort_lanes(mat8, num_keys=KEY_WORDS,
+                                    tb_row=_KEYS8_TB, tile=tile,
+                                    interpret=interpret)
+        perm = s8[_KEYS8_TB, :n].astype(jnp.int32)
+        return jnp.take(words.T, perm, axis=1,
+                        unique_indices=True, mode="clip").T
+    mat = jnp.full((pallas_sort.ROWS, m), np.uint32(0xFFFFFFFF),
+                   jnp.uint32)
+    mat = lax.dynamic_update_slice(mat, words.T.astype(jnp.uint32), (0, 0))
+    out = pallas_sort.sort_lanes(mat, num_keys=KEY_WORDS, tile=tile,
+                                 interpret=interpret,
+                                 two_phase=path == "lanes2")
+    return pallas_sort.lanes_to_rows(out, w)[:n]
+
+
+def single_chip_sort(words: jax.Array, path: str = "auto",
+                     tile: int = 1024,
+                     interpret: bool = False) -> jax.Array:
     """The single-chip shuffle+merge: stable lexicographic sort of whole
     records by their 3 key words (the device replacement of the
     reference's k-way PQ merge, src/Merger/MergeQueue.h:276-427).
 
     Payload-movement strategy (see bench_step for the full trade-off):
-    "carry" rides the 23 value words through the sort network (fast at
-    runtime — ~12 GB/s was measured on a CPU backend; never compiled on
-    the TPU remote-compile service, where variadic-sort compile time is
-    superlinear in operand count), "gather" computes the permutation
-    with a 4-operand sort and applies it with per-column gathers
-    (bounded compile; 0.30 GB/s measured END TO END on the v5e chip,
-    BENCH_r02 — random per-element HBM gathers dominate). "auto" resolves per the ambient
+    the lanes engines ("lanes"/"lanes2"/"keys8" — the TPU default via
+    "auto") run the Pallas bitonic pipeline with bounded compile;
+    "carry" rides the 23 value words through a ``lax.sort`` network
+    (fast at runtime, pathological compile on TPU remote-compile
+    backends — the CPU default); "gather"/"gather2"/"carrychunk" apply
+    a narrow-sort permutation (per-column gathers / one minor-dim
+    gather / chunked carry sorts). "auto" resolves per the ambient
     backend at call time (resolve_sort_path).
     """
-    return _single_chip_sort(words, resolve_sort_path(path))
+    path = resolve_sort_path(path, lanes_ok=True)
+    if path in ("lanes", "lanes2", "keys8"):
+        if int(words.shape[0]) == 0:
+            return jnp.asarray(words, jnp.uint32)
+        return _single_chip_sort_lanes(jnp.asarray(words, jnp.uint32),
+                                       path, tile, interpret)
+    return _single_chip_sort(words, path)
 
 
 _KEYS8_ROWS = 8       # one sublane tile: 3 key rows + 4 pad + tie-break
