@@ -1,0 +1,107 @@
+"""Regression report analyzer: results.json -> comparison tables.
+
+The reference rendered its cluster regression into time tables
+(reference scripts/regression/analizeTerasort.sh:1-60 awk over job
+logs, mr-dstatExcel.sh for resource charts). The equivalent here reads
+one or more run_regression.py reports and renders a markdown table —
+one run: per-workload wall/cpu/rss; several runs: side-by-side
+wall-clock with the speedup of the LAST run vs the FIRST (e.g. CPU vs
+ambient-chip, or before vs after a change).
+
+Usage: python scripts/regression/analyze.py results.json [more.json...]
+       [--out report.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def _label(report: dict) -> str:
+    return f"{report.get('platform', '?')}/{report.get('size', '?')}"
+
+
+def _rows(report: dict) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    for r in report.get("results", []):
+        # keep the best (min wall) rep per workload, like the bench's
+        # best-of-dispatches rule
+        cur = out.get(r["workload"])
+        if cur is None or r["wall_s"] < cur["wall_s"]:
+            out[r["workload"]] = r
+    return out
+
+
+def render(reports: list[dict]) -> str:
+    labels = [_label(r) for r in reports]
+    tables = [_rows(r) for r in reports]
+    names: list[str] = []
+    for t in tables:
+        names.extend(n for n in t if n not in names)
+
+    lines = []
+    if len(reports) == 1:
+        t = tables[0]
+        lines.append(f"# Regression report — {labels[0]}")
+        lines.append("")
+        lines.append("| workload | status | wall s | cpu s | rss MB |")
+        lines.append("|---|---|---:|---:|---:|")
+        for n in names:
+            r = t[n]
+            lines.append(
+                f"| {n} | {r['status']} | {r['wall_s']:.2f} | "
+                f"{r['cpu_user_s'] + r['cpu_sys_s']:.2f} | "
+                f"{r['max_rss_mb']:.0f} |")
+    else:
+        lines.append("# Regression comparison — " + " vs ".join(labels))
+        lines.append("")
+        hdr = "| workload | " + " | ".join(f"{lb} wall s" for lb in labels)
+        lines.append(hdr + f" | {labels[-1]} vs {labels[0]} |")
+        lines.append("|---|" + "---:|" * (len(labels) + 1))
+        for n in names:
+            cells = []
+            for t in tables:
+                r = t.get(n)
+                cells.append("—" if r is None
+                             else (f"{r['wall_s']:.2f}"
+                                   if r["status"] == "PASS"
+                                   else r["status"]))
+            a, b = tables[0].get(n), tables[-1].get(n)
+            if (a and b and a["status"] == b["status"] == "PASS"
+                    and b["wall_s"] > 0):
+                ratio = f"{a['wall_s'] / b['wall_s']:.2f}x"
+            else:
+                ratio = "—"
+            lines.append(f"| {n} | " + " | ".join(cells) + f" | {ratio} |")
+    # failure scan covers EVERY rep of every report (a failing rep must
+    # not be masked by a faster passing rep of the same workload)
+    fails = [(lb, r["workload"], r.get("rep", 0))
+             for lb, rep in zip(labels, reports)
+             for r in rep.get("results", []) if r["status"] != "PASS"]
+    lines.append("")
+    lines.append("All PASS." if not fails else
+                 "FAILURES: " + ", ".join(f"{n} rep{i} ({lb})"
+                                          for lb, n, i in fails))
+    return "\n".join(lines) + "\n", not fails
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("reports", nargs="+")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    reports = []
+    for p in args.reports:
+        with open(p) as f:
+            reports.append(json.load(f))
+    text, ok = render(reports)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    print(text, end="")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,3 +33,38 @@ def test_harness_pass_produces_report(tmp_path):
 def test_harness_unknown_workload_errors(tmp_path):
     proc = _run(tmp_path, "not_a_workload")
     assert proc.returncode == 2
+
+
+def test_analyzer_single_and_comparison(tmp_path):
+    # the analizeTerasort.sh equivalent: tables from report JSONs
+    def report(platform, wall, status="PASS"):
+        return {"platform": platform, "size": "small", "results": [
+            {"workload": "terasort", "rep": 0, "size": "small",
+             "status": status, "wall_s": wall, "cpu_user_s": wall,
+             "cpu_sys_s": 0.0, "max_rss_mb": 100.0, "detail": {},
+             "error": ""}]}
+
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(report("cpu", 4.0)))
+    b.write_text(json.dumps(report("tpu", 2.0)))
+    script = os.path.join(REPO, "scripts", "regression", "analyze.py")
+    one = subprocess.run([sys.executable, script, str(a)],
+                         capture_output=True, text=True, check=False)
+    assert one.returncode == 0 and "| terasort | PASS | 4.00 |" in one.stdout
+    cmp_ = subprocess.run([sys.executable, script, str(a), str(b)],
+                          capture_output=True, text=True, check=False)
+    assert cmp_.returncode == 0 and "2.00x" in cmp_.stdout  # tpu 2x faster
+    # a failing run flips the exit code and is named
+    b.write_text(json.dumps(report("tpu", 2.0, status="FAIL")))
+    bad = subprocess.run([sys.executable, script, str(a), str(b)],
+                         capture_output=True, text=True, check=False)
+    assert bad.returncode == 1 and "FAILURES" in bad.stdout
+    # a FAIL rep must not be masked by a faster PASS rep of the same
+    # workload (the table keeps best-of, the gate scans every rep)
+    rep = report("cpu", 1.0)
+    slow_fail = dict(rep["results"][0], rep=1, wall_s=5.0, status="FAIL")
+    rep["results"].append(slow_fail)
+    a.write_text(json.dumps(rep))
+    masked = subprocess.run([sys.executable, script, str(a)],
+                            capture_output=True, text=True, check=False)
+    assert masked.returncode == 1 and "rep1" in masked.stdout

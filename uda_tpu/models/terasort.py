@@ -120,20 +120,19 @@ def _single_chip_sort_lanes(words: jax.Array, path: str, tile: int,
     padding occupies the highest lanes); truncating to n drops exactly
     the padding."""
     n, w = words.shape
-    m = max(tile, 1 << max(0, n - 1).bit_length())
+    m, tile = pallas_sort.pad_pow2(n, tile)
     if path == "keys8":
-        # keys-only: never materialize the 32-row matrix — the payload
-        # is gathered straight off the caller's rows
-        mat8 = jnp.full((_KEYS8_ROWS, m), np.uint32(0xFFFFFFFF),
-                        jnp.uint32)
-        mat8 = lax.dynamic_update_slice(
-            mat8, words[:, :KEY_WORDS].T.astype(jnp.uint32), (0, 0))
-        s8 = pallas_sort.sort_lanes(mat8, num_keys=KEY_WORDS,
-                                    tb_row=_KEYS8_TB, tile=tile,
-                                    interpret=interpret)
-        perm = s8[_KEYS8_TB, :n].astype(jnp.int32)
-        return jnp.take(words.T, perm, axis=1,
-                        unique_indices=True, mode="clip").T
+        # keys-only cascade (shared core: pallas_sort.keys8_sort_perm);
+        # sorted keys come back from the cascade, so only the 23 value
+        # rows cross the permutation gather
+        keyr = jnp.full((KEY_WORDS, m), np.uint32(0xFFFFFFFF), jnp.uint32)
+        keyr = lax.dynamic_update_slice(
+            keyr, words[:, :KEY_WORDS].T.astype(jnp.uint32), (0, 0))
+        sk, perm = pallas_sort.keys8_sort_perm(keyr, tile=tile,
+                                               interpret=interpret)
+        pay = jnp.take(words[:, KEY_WORDS:].T, perm[:n], axis=1,
+                       unique_indices=True, mode="clip")
+        return jnp.concatenate([sk[:, :n], pay], axis=0).T
     mat = jnp.full((pallas_sort.ROWS, m), np.uint32(0xFFFFFFFF),
                    jnp.uint32)
     mat = lax.dynamic_update_slice(mat, words.T.astype(jnp.uint32), (0, 0))
@@ -169,10 +168,6 @@ def single_chip_sort(words: jax.Array, path: str = "auto",
     return _single_chip_sort(words, path)
 
 
-_KEYS8_ROWS = 8       # one sublane tile: 3 key rows + 4 pad + tie-break
-_KEYS8_TB = 7
-
-
 def _keys8_parts(x: jax.Array, tile: int, interpret: bool):
     """The keys8 engine: run the ENTIRE bitonic cascade on an 8-row
     keys-only array (one sublane tile: 3 key rows, 4 zero rows, the
@@ -188,20 +183,15 @@ def _keys8_parts(x: jax.Array, tile: int, interpret: bool):
     on every backend (scripts/probe_gather.py: no dynamic lane-gather
     formulation lowers in Mosaic on v5e).
 
-    Returns (sorted 8-row keys array, gathered [VALUE_WORDS, n] payload,
-    int32 permutation). Stability: the tie-break row holds the arrival
-    index, so the permutation lists equal keys in arrival order.
+    Returns (sorted [KEY_WORDS, n] key rows, gathered [VALUE_WORDS, n]
+    payload, int32 permutation). Stability: the tie-break row holds the
+    arrival index, so the permutation lists equal keys in arrival order.
     """
-    n = x.shape[1]
-    pad = jnp.zeros((_KEYS8_ROWS - KEY_WORDS, n), jnp.uint32)
-    s8 = pallas_sort.sort_lanes(
-        jnp.concatenate([x[:KEY_WORDS], pad], axis=0),
-        num_keys=KEY_WORDS, tb_row=_KEYS8_TB, tile=tile,
-        interpret=interpret)
-    perm = s8[_KEYS8_TB].astype(jnp.int32)
+    sk, perm = pallas_sort.keys8_sort_perm(x[:KEY_WORDS], tile=tile,
+                                           interpret=interpret)
     payload = jnp.take(x[KEY_WORDS:RECORD_WORDS], perm, axis=1,
                        unique_indices=True, mode="clip")
-    return s8, payload, perm
+    return sk, payload, perm
 
 
 def sort_lanes_keys8(x: jax.Array, tile: int = 1024,
@@ -214,12 +204,12 @@ def sort_lanes_keys8(x: jax.Array, tile: int = 1024,
     row — but the payload crosses HBM once instead of riding every
     compare-exchange stage.
     """
-    s8, payload, _ = _keys8_parts(jnp.asarray(x, jnp.uint32), tile,
-                                  interpret)
+    sk, payload, perm = _keys8_parts(jnp.asarray(x, jnp.uint32), tile,
+                                     interpret)
     n = x.shape[1]
     pad = jnp.zeros((pallas_sort.ROWS - RECORD_WORDS - 1, n), jnp.uint32)
     return jnp.concatenate(
-        [s8[:KEY_WORDS], payload, pad, s8[_KEYS8_TB:_KEYS8_TB + 1]], axis=0)
+        [sk, payload, pad, perm[None, :].astype(jnp.uint32)], axis=0)
 
 
 def distributed_terasort(words, mesh: Mesh, axis: str = SHUFFLE_AXIS,

@@ -52,7 +52,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["ROWS", "sort_lanes", "rows_to_lanes", "lanes_to_rows",
-           "TB_ROW_DEFAULT"]
+           "keys8_sort_perm", "pad_pow2", "TB_ROW_DEFAULT"]
 
 ROWS = 32               # sublane-padded row count of the lanes layout
 TB_ROW_DEFAULT = 31     # default tie-break row (last)
@@ -405,6 +405,41 @@ def _merge_pass(x, splits, tile: int, num_keys: int, tb_row: int,
                                        vma=jax.typeof(x).vma),
         interpret=interpret,
     )(splits, splits_nxt, x)
+
+
+def pad_pow2(n: int, tile: int) -> tuple[int, int]:
+    """The lane-padding rule every lanes-engine entry point shares:
+    pad ``n`` lanes up to ``m`` (a power of two, at least one lane
+    block) and clamp ``tile`` so sort_lanes' preconditions hold
+    (m % tile == 0 with m/tile a power of two). Returns (m, tile)."""
+    m = max(_LANE, 1 << max(0, n - 1).bit_length())
+    return m, min(tile, m)
+
+
+def keys8_sort_perm(keyrows, tile: int = 1024, interpret: bool = False):
+    """The keys8 cascade core, shared by every keys8 engine (the
+    single-chip sort, the bench bodies, the distributed local sort):
+    run the FULL bitonic pipeline on an 8-row keys-only matrix and
+    return ``(sorted_key_rows, perm)`` — ``perm[j]`` is the source lane
+    of sorted position j (int32), stable by arrival order among equal
+    keys (the row-7 tie-break holds the lane index).
+
+    ``keyrows``: uint32[k, m] with k <= 7 key rows, m a power-of-two
+    multiple of ``tile``. Rows k..6 pad with zeros (never compared);
+    row 7 is overwritten by the tile-sort kernel. Callers own their
+    lane padding: pad lanes' key rows must sort after every real
+    lane's (e.g. all-0xFFFFFFFF keys tie with real all-max keys, and
+    the arrival tie-break then keeps real lanes first because padding
+    occupies the highest lane indices)."""
+    k, m = keyrows.shape
+    if not 0 < k <= 7:
+        raise ValueError(f"keys8 needs 1..7 key rows, got {k}")
+    mat8 = jnp.concatenate(
+        [jnp.asarray(keyrows, jnp.uint32),
+         jnp.zeros((8 - k, m), jnp.uint32)], axis=0)
+    out8 = sort_lanes(mat8, num_keys=k, tb_row=7, tile=tile,
+                      interpret=interpret)
+    return out8[:k], out8[7].astype(jnp.int32)
 
 
 def sort_lanes(x, num_keys: int, tb_row: int = TB_ROW_DEFAULT,

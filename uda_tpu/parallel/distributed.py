@@ -201,8 +201,7 @@ def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
     n, wcols = flat.shape
     first_pay = num_keys + 1             # payload starts past the flag row
     tb = pallas_sort.TB_ROW_DEFAULT
-    npad = max(128, 1 << (n - 1).bit_length())
-    tile = min(1024, npad)
+    npad, tile = pallas_sort.pad_pow2(n, 1024)
     keyrows = jnp.stack([jnp.where(valid, flat[:, i], _INVALID)
                          for i in range(num_keys)]
                         + [jnp.where(valid, jnp.uint32(0), jnp.uint32(1))])
@@ -219,20 +218,17 @@ def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
         # and tie-break as the full-width pipeline, so equal-key order
         # is identical; record width is unconstrained (no 32-row limit).
         k8 = num_keys + 1                # masked keys + invalid flag
-        if k8 + 1 > 8:
+        if k8 > 7:
             raise ValueError(
                 f"num_keys={num_keys} does not fit the 8-row keys view; "
                 "use payload_path='lanes'")
-        # rows k8..7 ride as payload (content irrelevant; the tile-sort
-        # kernel overwrites row 7 with the arrival index)
-        mat8 = jnp.full((8, npad), _INVALID, jnp.uint32)
-        mat8 = lax.dynamic_update_slice(mat8, keyrows, (0, 0))
-        out8 = pallas_sort.sort_lanes(mat8, num_keys=k8, tb_row=7,
-                                      tile=tile, interpret=interpret)
+        base = jnp.full((k8, npad), _INVALID, jnp.uint32)
+        keyr = lax.dynamic_update_slice(base, keyrows, (0, 0))
         # the n real lanes sort strictly before the padding, so the
         # first n arrival indices all reference real rows of flat
-        perm = out8[7, :n].astype(jnp.int32)
-        return jnp.take(flat.T, perm, axis=1,
+        _, perm = pallas_sort.keys8_sort_perm(keyr, tile=tile,
+                                              interpret=interpret)
+        return jnp.take(flat.T, perm[:n], axis=1,
                         unique_indices=True, mode="clip").T
     if first_pay + wcols > tb:
         raise ValueError(
