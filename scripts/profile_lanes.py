@@ -53,12 +53,13 @@ def step(seed, n, k, stage, tile):
         elif stage == "keys8":
             out = terasort.sort_lanes_keys8(x, tile=tile)
         elif stage == "keys8sort":
-            # the 8-row cascade alone: the payload gather's output is
-            # unused below (checksum over zero pad rows), so XLA DCEs it
-            out8 = terasort._keys8_parts(x, tile, False)[0]
+            # the keys cascade alone: _keys8_parts returns the sorted
+            # KEY rows; the payload gather's output is unused below
+            # (checksum over zero pad rows), so XLA DCEs it
+            sk = terasort._keys8_parts(x, tile, False)[0]
             out = jnp.concatenate(
-                [out8, jnp.zeros((pallas_sort.ROWS - 8, x.shape[1]),
-                                 jnp.uint32)], axis=0)
+                [sk, jnp.zeros((pallas_sort.ROWS - terasort.KEY_WORDS,
+                                x.shape[1]), jnp.uint32)], axis=0)
         else:
             out = pallas_sort.sort_lanes(x, num_keys=terasort.KEY_WORDS,
                                          tile=tile)

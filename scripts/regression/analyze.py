@@ -26,14 +26,21 @@ def _rows(report: dict) -> dict[str, dict]:
     out: dict[str, dict] = {}
     for r in report.get("results", []):
         # keep the best (min wall) rep per workload, like the bench's
-        # best-of-dispatches rule
+        # best-of-dispatches rule — but a PASS rep always beats a FAIL
+        # rep (a fast crash must not hide a valid timing; the exit-code
+        # gate scans every rep separately)
         cur = out.get(r["workload"])
-        if cur is None or r["wall_s"] < cur["wall_s"]:
+        better = (cur is None
+                  or (r["status"] == "PASS") > (cur["status"] == "PASS")
+                  or (r["status"] == cur["status"]
+                      and r["wall_s"] < cur["wall_s"]))
+        if better:
             out[r["workload"]] = r
     return out
 
 
-def render(reports: list[dict]) -> str:
+def render(reports: list[dict]) -> tuple[str, bool]:
+    """Returns (markdown text, all_reps_passed)."""
     labels = [_label(r) for r in reports]
     tables = [_rows(r) for r in reports]
     names: list[str] = []

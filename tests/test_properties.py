@@ -115,3 +115,27 @@ def test_rawbytes_comparator_matches_memcmp(a, b):
 @given(st.binary(max_size=4096))
 def test_lzo_pure_python_round_trip(data):
     assert lzo1x_decompress_py(lzo1x_compress_py(data), len(data)) == data
+
+
+@settings(max_examples=8, deadline=None)
+@given(st.integers(min_value=1, max_value=400), st.integers(0, 2 ** 32 - 1),
+       st.floats(0.0, 1.0))
+def test_sort_engines_agree(n, seed, dup_rate):
+    # every payload-movement engine must produce byte-identical output
+    # (stability included) for arbitrary record counts, key
+    # distributions, and duplicate rates — the equivalence the fly-off
+    # depends on
+    import jax
+
+    from uda_tpu.models import terasort
+
+    words = np.asarray(terasort.teragen(jax.random.key(seed % 1000), n))
+    words = words.copy()
+    ndup = int(dup_rate * n / 2)
+    if ndup:
+        words[:ndup, :3] = words[n - ndup:, :3]  # forced duplicate keys
+    want = np.asarray(terasort.single_chip_sort(words, path="carry"))
+    for path in ("gather", "gather2", "carrychunk", "keys8", "lanes"):
+        got = np.asarray(terasort.single_chip_sort(
+            words, path=path, tile=128, interpret=True))
+        np.testing.assert_array_equal(want, got, err_msg=path)
