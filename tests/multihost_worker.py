@@ -54,6 +54,15 @@ assert np.array_equal(got[:, :2], ref[:, :2]), "global key order mismatch"
 assert sorted(map(tuple, got)) == sorted(map(tuple, allwords)), \
     "record multiset changed crossing the process boundary"
 
+# the keys8 Pallas engine (interpret mode on the CPU mesh) must be
+# byte-identical to the carry path ACROSS the process boundary too
+res3 = distributed_sort_step(words, uniform_splitters(P), mesh, "shuffle",
+                             capacity=2 * per_proc * nprocs // P,
+                             num_keys=2, payload_path="keys8")
+res3.check()
+assert np.array_equal(multihost.allgather(res3.words), out), \
+    "keys8 engine diverges across the process boundary"
+
 # skew: every record to partition 0, capacity << bucket -> the windowed
 # multi-round backlog path, across processes
 local2 = local.copy()

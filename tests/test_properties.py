@@ -135,7 +135,8 @@ def test_sort_engines_agree(n, seed, dup_rate):
     if ndup:
         words[:ndup, :3] = words[n - ndup:, :3]  # forced duplicate keys
     want = np.asarray(terasort.single_chip_sort(words, path="carry"))
-    for path in ("gather", "gather2", "carrychunk", "keys8", "lanes"):
+    for path in ("gather", "gather2", "carrychunk", "keys8", "lanes",
+                 "lanes2"):
         got = np.asarray(terasort.single_chip_sort(
             words, path=path, tile=128, interpret=True))
         np.testing.assert_array_equal(want, got, err_msg=path)
