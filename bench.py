@@ -56,10 +56,16 @@ LANES_TILE = min(4096, 1 << LOG2_RECORDS)
 # (scripts/profile_lanes.py sweeps 4096/8192/16384)
 KEYS8_TILE = min(int(os.environ.get("UDA_TPU_BENCH_KEYS8_TILE", 8192)),
                  1 << LOG2_RECORDS)
+# per-path timing-tile overrides set by a successful probe RETRY at a
+# smaller tile: only that path's fly-off tile changes, so a keys8f
+# retry can never silently move keys8 to a tile it was not probed at
+_TILE_OVERRIDE: dict = {}
 
 
 def _tile_for(path: str) -> int:
-    return KEYS8_TILE if path == "keys8" else LANES_TILE
+    if path in _TILE_OVERRIDE:
+        return _TILE_OVERRIDE[path]
+    return KEYS8_TILE if path in ("keys8", "keys8f") else LANES_TILE
 # run the Pallas kernels in interpret mode (CPU smoke runs of the lanes
 # path; useless on TPU and at full size)
 INTERPRET = os.environ.get("UDA_TPU_BENCH_INTERPRET") == "1"
@@ -82,11 +88,11 @@ PROBE_TIMEOUT = float(os.environ.get("UDA_TPU_BENCH_PROBE_TIMEOUT", 600))
 # the whole cascade on an 8-row keys-only array + ONE global XLA
 # payload gather (the same idea with the gather hoisted out of Mosaic —
 # it lowers everywhere).
-PATHS = (("lanes2", "keys8", "gather2", "carrychunk", "lanes", "carry",
-          "gather")
+PATHS = (("lanes2", "keys8f", "keys8", "gather2", "carrychunk", "lanes",
+          "carry", "gather")
          if os.environ.get("UDA_TPU_BENCH_TRY_CARRY") == "1"
-         else ("lanes2", "keys8", "gather2", "carrychunk", "lanes",
-               "gather"))
+         else ("lanes2", "keys8f", "keys8", "gather2", "carrychunk",
+               "lanes", "gather"))
 # explicit candidate-list override (comma-separated), e.g. a short pool
 # window where only the known-good path should be timed:
 #   UDA_TPU_BENCH_PATHS=lanes python bench.py
@@ -94,7 +100,7 @@ PATHS = (("lanes2", "keys8", "gather2", "carrychunk", "lanes", "carry",
 # (safe at module scope: importing jax does not lock the platform —
 # only the first device use does, after _enable_cache has re-applied
 # any JAX_PLATFORMS override).
-from uda_tpu.ops.sort import ALL_SORT_PATHS, FLYOFF_ENGINES  # noqa: E402
+from uda_tpu.ops.sort import ALL_SORT_PATHS, BENCH_FLYOFF  # noqa: E402
 
 if os.environ.get("UDA_TPU_BENCH_PATHS"):
     PATHS = tuple(p.strip()
@@ -104,7 +110,7 @@ if os.environ.get("UDA_TPU_BENCH_PATHS"):
     if bad or not PATHS:
         raise SystemExit(f"UDA_TPU_BENCH_PATHS: unknown or empty path "
                          f"list {bad or '(empty)'}; known: {ALL_SORT_PATHS}")
-FLYOFF_PATHS = frozenset(FLYOFF_ENGINES)
+FLYOFF_PATHS = frozenset(BENCH_FLYOFF)
 
 
 def _enable_cache() -> None:
@@ -228,25 +234,24 @@ def main() -> None:
     # slow-or-risky fallbacks ("gather": measured 0.3 GB/s; "carry":
     # pathological compile) are probed only when NO fly-off engine
     # compiles, first success wins.
-    global KEYS8_TILE
     flyoff_variants = [p for p in PATHS if p in FLYOFF_PATHS]
     fallbacks = [p for p in PATHS if p not in FLYOFF_PATHS]
     candidates = []
     for p in flyoff_variants:
         if _probe(p, PROBE_TIMEOUT):
             candidates.append(p)
-        elif p == "keys8" and KEYS8_TILE != LANES_TILE:
+        elif p in ("keys8", "keys8f") and KEYS8_TILE != LANES_TILE:
             # the bigger keys8 tile is a bet pending the hardware
             # sweep; a failed compile must not drop the engine from
             # the fly-off — retry at the validated lanes tile, under a
             # DISTINCT log name so the big-tile failure log survives
-            print(f"# keys8 tile={KEYS8_TILE} failed; retrying at "
+            print(f"# {p} tile={KEYS8_TILE} failed; retrying at "
                   f"{LANES_TILE}", file=sys.stderr)
             if _probe(p, PROBE_TIMEOUT,
                       extra_env={"UDA_TPU_BENCH_KEYS8_TILE":
                                  str(LANES_TILE)},
                       log_name=f"{p}_tile{LANES_TILE}"):
-                KEYS8_TILE = LANES_TILE
+                _TILE_OVERRIDE[p] = LANES_TILE
                 candidates.append(p)
     for path in fallbacks:
         if candidates:

@@ -1,0 +1,59 @@
+"""Folded keys cascade (ops.pallas_fold) vs the standard keys8 pipeline."""
+
+import numpy as np
+import pytest
+
+from uda_tpu.ops import pallas_fold, pallas_sort
+
+
+def _keys(n, seed, dup=False):
+    rng = np.random.default_rng(seed)
+    x = np.zeros((8, n), np.uint32)
+    x[:3] = rng.integers(0, 2 ** 32, (3, n), dtype=np.uint32)
+    if dup:
+        x[:3, : n // 4] = x[:3, n // 2: n // 2 + n // 4]
+    return x
+
+
+@pytest.mark.parametrize("n,tile", [(256, 256), (1024, 256), (2048, 512),
+                                    (4096, 512)])
+def test_folded_matches_standard(n, tile):
+    x = _keys(n, seed=n, dup=True)
+    a = np.asarray(pallas_sort.sort_lanes(x, num_keys=3, tb_row=7,
+                                          tile=tile, interpret=True))
+    b = np.asarray(pallas_fold.sort_lanes_folded(x, num_keys=3, tile=tile,
+                                                 interpret=True))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_folded_narrow_keys_and_guards():
+    x = _keys(512, seed=9)
+    # num_keys < 3: rows beyond the keys are zero filler, still exact
+    a = np.asarray(pallas_sort.sort_lanes(x, num_keys=2, tb_row=7,
+                                          tile=256, interpret=True))
+    b = np.asarray(pallas_fold.sort_lanes_folded(x, num_keys=2, tile=256,
+                                                 interpret=True))
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="num_keys"):
+        pallas_fold.sort_lanes_folded(x, num_keys=4, tile=256,
+                                      interpret=True)
+    with pytest.raises(ValueError, match="tile"):
+        pallas_fold.sort_lanes_folded(x, num_keys=3, tile=128,
+                                      interpret=True)
+    with pytest.raises(ValueError, match="8-row"):
+        pallas_fold.sort_lanes_folded(np.zeros((32, 512), np.uint32),
+                                      num_keys=3, tile=256, interpret=True)
+
+
+def test_keys8_sort_perm_folded_param():
+    # the shared core routes to the folded cascade and falls back to
+    # the standard one when the tile cannot fold — same results
+    x = _keys(1024, seed=5, dup=True)
+    sk0, p0 = pallas_sort.keys8_sort_perm(x[:3], tile=256, interpret=True)
+    sk1, p1 = pallas_sort.keys8_sort_perm(x[:3], tile=256, interpret=True,
+                                          folded=True)
+    sk2, p2 = pallas_sort.keys8_sort_perm(x[:3], tile=128, interpret=True,
+                                          folded=True)  # fallback
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p2))
+    np.testing.assert_array_equal(np.asarray(sk0), np.asarray(sk1))

@@ -135,8 +135,12 @@ def test_sort_engines_agree(n, seed, dup_rate):
     if ndup:
         words[:ndup, :3] = words[n - ndup:, :3]  # forced duplicate keys
     want = np.asarray(terasort.single_chip_sort(words, path="carry"))
-    for path in ("gather", "gather2", "carrychunk", "keys8", "lanes",
-                 "lanes2"):
+    for path in ("gather", "gather2", "carrychunk", "keys8", "keys8f",
+                 "lanes", "lanes2"):
+        # tile=256 lets keys8f fold when n > 128 (pad_pow2 clamps the
+        # tile for smaller n and keys8f falls back to the standard
+        # cascade; tests/test_pallas_fold.py covers folding
+        # deterministically)
         got = np.asarray(terasort.single_chip_sort(
-            words, path=path, tile=128, interpret=True))
+            words, path=path, tile=256, interpret=True))
         np.testing.assert_array_equal(want, got, err_msg=path)

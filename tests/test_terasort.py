@@ -46,8 +46,8 @@ def test_single_chip_sort_all_engines_match_carry():
     words[5:8, :3] = 0xFFFFFFFF
     words[100:200, :3] = words[300:400, :3]
     a = np.asarray(terasort.single_chip_sort(words, path="carry"))
-    for path in ("lanes", "lanes2", "keys8", "gather", "gather2",
-                 "carrychunk"):
+    for path in ("lanes", "lanes2", "keys8", "keys8f", "gather",
+                 "gather2", "carrychunk"):
         b = np.asarray(terasort.single_chip_sort(words, path=path,
                                                  tile=512, interpret=True))
         np.testing.assert_array_equal(a, b, err_msg=path)
@@ -79,10 +79,12 @@ def test_bench_step_lanes_path_validates():
 
 
 def test_bench_step_keys8_path_validates():
-    viol, ck_in, ck_out = terasort.bench_step(
-        jax.random.key(5), 2048, 2, path="keys8", tile=512, interpret=True)
-    assert int(viol) == 0
-    assert np.uint32(ck_in) == np.uint32(ck_out)
+    for path in ("keys8", "keys8f"):
+        viol, ck_in, ck_out = terasort.bench_step(
+            jax.random.key(5), 2048, 2, path=path, tile=512,
+            interpret=True)
+        assert int(viol) == 0, path
+        assert np.uint32(ck_in) == np.uint32(ck_out), path
 
 
 def test_bench_step_gather2_path_validates():

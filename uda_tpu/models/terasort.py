@@ -121,15 +121,17 @@ def _single_chip_sort_lanes(words: jax.Array, path: str, tile: int,
     the padding."""
     n, w = words.shape
     m, tile = pallas_sort.pad_pow2(n, tile)
-    if path == "keys8":
-        # keys-only cascade (shared core: pallas_sort.keys8_sort_perm);
-        # sorted keys come back from the cascade, so only the 23 value
-        # rows cross the permutation gather
+    if path in ("keys8", "keys8f"):
+        # keys-only cascade (shared core: pallas_sort.keys8_sort_perm;
+        # "keys8f" = the folded half-width variant); sorted keys come
+        # back from the cascade, so only the 23 value rows cross the
+        # permutation gather
         keyr = jnp.full((KEY_WORDS, m), np.uint32(0xFFFFFFFF), jnp.uint32)
         keyr = lax.dynamic_update_slice(
             keyr, words[:, :KEY_WORDS].T.astype(jnp.uint32), (0, 0))
         sk, perm = pallas_sort.keys8_sort_perm(keyr, tile=tile,
-                                               interpret=interpret)
+                                               interpret=interpret,
+                                               folded=path == "keys8f")
         pay = jnp.take(words[:, KEY_WORDS:].T, perm[:n], axis=1,
                        unique_indices=True, mode="clip")
         return jnp.concatenate([sk[:, :n], pay], axis=0).T
@@ -160,7 +162,7 @@ def single_chip_sort(words: jax.Array, path: str = "auto",
     backend at call time (resolve_sort_path).
     """
     path = resolve_sort_path(path, lanes_ok=True)
-    if path in ("lanes", "lanes2", "keys8"):
+    if path in ("lanes", "lanes2", "keys8", "keys8f"):
         if int(words.shape[0]) == 0:
             return jnp.asarray(words, jnp.uint32)
         return _single_chip_sort_lanes(jnp.asarray(words, jnp.uint32),
@@ -168,7 +170,8 @@ def single_chip_sort(words: jax.Array, path: str = "auto",
     return _single_chip_sort(words, path)
 
 
-def _keys8_parts(x: jax.Array, tile: int, interpret: bool):
+def _keys8_parts(x: jax.Array, tile: int, interpret: bool,
+                 folded: bool = False):
     """The keys8 engine: run the ENTIRE bitonic cascade on an 8-row
     keys-only array (one sublane tile: 3 key rows, 4 zero rows, the
     tie-break row) and move the 23 payload rows ONCE with a global
@@ -188,7 +191,8 @@ def _keys8_parts(x: jax.Array, tile: int, interpret: bool):
     arrival index, so the permutation lists equal keys in arrival order.
     """
     sk, perm = pallas_sort.keys8_sort_perm(x[:KEY_WORDS], tile=tile,
-                                           interpret=interpret)
+                                           interpret=interpret,
+                                           folded=folded)
     payload = jnp.take(x[KEY_WORDS:RECORD_WORDS], perm, axis=1,
                        unique_indices=True, mode="clip")
     return sk, payload, perm
@@ -316,7 +320,8 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
         x = teragen_lanes(jax.random.fold_in(seed, i), n)
         ck_in = ck_in + _checksum_cols(tuple(x[r]
                                              for r in range(RECORD_WORDS)))
-        s8, payload, _ = _keys8_parts(x, tile, interpret)
+        s8, payload, _ = _keys8_parts(x, tile, interpret,
+                                      folded=path == "keys8f")
         out_cols = (*(s8[r] for r in range(KEY_WORDS)),
                     *(payload[r] for r in range(VALUE_WORDS)))
         ck_out = ck_out + _checksum_cols(out_cols)
@@ -387,7 +392,8 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
 
     zero = jnp.uint32(0)
     body = {"lanes": body_lanes, "lanes2": body_lanes,
-            "keys8": body_keys8, "gather2": body_gather2,
+            "keys8": body_keys8, "keys8f": body_keys8,
+            "gather2": body_gather2,
             "carrychunk": body_carrychunk}.get(path, body_cols)
     return lax.fori_loop(0, k, body, (jnp.int32(0), zero, zero))
 

@@ -1,0 +1,275 @@
+"""Folded keys cascade: the keys8 pipeline at half lane width.
+
+The keys8 engine (pallas_sort.keys8_sort_perm) spends its VPU time on
+compare-exchange stages over an [8, n] array in which only 4 rows carry
+data (<= 3 key rows + the tie-break). This module packs TWO
+element-halves into the 8 rows — element ``e`` of a folded block of
+``2h`` elements lives at lane ``e % h`` in row group ``(e // h) * 4``,
+rows ``[k0, k1, k2, tb]`` — so:
+
+- every lane-stride stage (j < h) rolls/selects an [8, h] array that
+  holds 2h elements: HALF the per-element data movement of the
+  standard layout's [8, 2h];
+- the stride-h stage pairs the two row groups at equal lanes — a
+  static row-group swap plus selects, NO rolls at all;
+- strides above h never occur (bitonic strides are powers of two
+  below the span, and e XOR j for j < h never crosses the half bit).
+
+Everything else — merge-path windows, per-side alignment rolls, the
+HBM layout between passes (standard keys8 [8, n], one record per
+lane) — is unchanged: kernels fold on entry and unfold on exit with
+static row slices, so the pass bookkeeping (pallas_sort._pass_splits)
+is reused as-is. Requires num_keys <= 3 (keys + tie-break fit the
+4-row slot); the TeraSort keyset is exactly that shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from uda_tpu.ops.pallas_sort import _LANE, _lex_lt, _pass_splits
+
+__all__ = ["sort_lanes_folded"]
+
+_INF = np.uint32(0xFFFFFFFF)
+_SLOT = 4                # rows per element-half: 3 key rows + tie-break
+_TB = 7                  # tie-break row of the standard keys8 layout
+
+
+def _fold(x, h):
+    """[8, 2h] standard keys8 rows -> [8, h] folded (two 4-row slots)."""
+    return jnp.concatenate([x[:3, :h], x[_TB:_TB + 1, :h],
+                            x[:3, h:], x[_TB:_TB + 1, h:]], axis=0)
+
+
+def _slot_to_rows(slot4, h):
+    """One [4, h] slot -> [8, h] standard keys8 rows (rows 3..6 zero)."""
+    return jnp.concatenate(
+        [slot4[:3], jnp.zeros((_TB - 3, h), jnp.uint32), slot4[3:4]],
+        axis=0)
+
+
+def _unfold(F, h):
+    """Inverse of _fold: [8, h] folded -> [8, 2h] standard keys8 rows
+    (rows 3..6 zero)."""
+    return jnp.concatenate([_slot_to_rows(F[:_SLOT], h),
+                            _slot_to_rows(F[_SLOT:], h)], axis=1)
+
+
+def _emat(h):
+    """Element index of every folded cell: [8, h] int32, constant within
+    each 4-row slot (lane + h for the upper slot)."""
+    lane = lax.broadcasted_iota(jnp.int32, (8, h), 1)
+    upper = lax.broadcasted_iota(jnp.int32, (8, 1), 0) >= _SLOT
+    return lane + jnp.where(upper, h, 0)
+
+
+def _cmp_exchange_folded(F, j: int, asc_mat, num_keys: int, h: int):
+    """One compare-exchange stage at element stride j on the folded
+    layout. ``asc_mat``: [8, h] bool, constant within each slot."""
+    rowi = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+    e = _emat(h)
+    low = (e & j) == 0
+    if j >= h:           # vertical: partner is the other slot, same lane
+        other = jnp.concatenate([F[_SLOT:], F[:_SLOT]], axis=0)
+    else:                # lane stage: both slots roll identically
+        left = jnp.roll(F, -j, axis=1)
+        right = jnp.roll(F, j, axis=1)
+        other = jnp.where(low, left, right)
+    krl = list(range(num_keys)) + [3]
+    lt_lo = _lex_lt([F[r] for r in krl],
+                    [other[r] for r in krl])[None, :]
+    lt_hi = _lex_lt([F[r + _SLOT] for r in krl],
+                    [other[r + _SLOT] for r in krl])[None, :]
+    lt = jnp.where(rowi < _SLOT, lt_lo, lt_hi)
+    keep_self = (asc_mat == low) == lt
+    return jnp.where(keep_self, F, other)
+
+
+def _tile_sort_kernel_folded(x_ref, o_ref, *, tile, num_keys, alternate):
+    t = pl.program_id(0)
+    h = tile // 2
+    F = _fold(x_ref[...], h)
+    rowi = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+    e = _emat(h)
+    # stability: global arrival index into both tie-break rows
+    g = (e + t * tile).astype(jnp.uint32)
+    F = jnp.where((rowi == 3) | (rowi == _TB), g, F)
+    if alternate:
+        tile_asc = (t % 2) == 0
+    else:
+        tile_asc = jnp.bool_(True)
+    k = 2
+    while k <= tile:
+        if k == tile:
+            asc = jnp.broadcast_to(tile_asc, (8, h))
+        else:
+            asc = ((e & k) == 0) == tile_asc
+        j = k // 2
+        while j >= 1:
+            F = _cmp_exchange_folded(F, j, asc, num_keys, h)
+            j //= 2
+        k *= 2
+    o_ref[...] = _unfold(F, h)
+
+
+@partial(jax.jit, static_argnames=("tile", "num_keys", "alternate",
+                                   "interpret"))
+def _tile_sort_folded(x, tile: int, num_keys: int, alternate: bool,
+                      interpret: bool = False):
+    rows, n = x.shape
+    return pl.pallas_call(
+        partial(_tile_sort_kernel_folded, tile=tile, num_keys=num_keys,
+                alternate=alternate),
+        grid=(n // tile,),
+        in_specs=[pl.BlockSpec((rows, tile), lambda t: (0, t))],
+        out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
+                                       vma=jax.typeof(x).vma),
+        interpret=interpret,
+    )(x)
+
+
+def _merge_pass_kernel_folded(splits_ref, splits_nxt_ref, x_hbm, o_ref,
+                              a_bufs, b_bufs, sem_a, sem_b, *, tile,
+                              num_keys, split_blk):
+    """One output tile of one merge pass, folded: same DMA double
+    buffering and window construction as pallas_sort._merge_pass_kernel
+    (see there for the rank bookkeeping), but the 2*tile-element merge
+    network runs on an [8, tile] folded array — the A window in the
+    lower 4-row slot, B in the upper — so every lane stage moves half
+    the data and the first stage (stride=tile) is a row-group swap.
+
+    MAINTENANCE: the DMA issue/wait protocol, the splits plumbing, and
+    the non-negative-shift pltpu.roll contract are a deliberate mirror
+    of pallas_sort._merge_pass_kernel (kept separate so the
+    hardware-validated kernel stays untouched); any hardware-erratum
+    fix applied there MUST be applied here too."""
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+    s = t % split_blk
+    slot = t % 2
+    win = tile + _LANE
+
+    def issue(spl, slot):
+        a_cp = pltpu.make_async_copy(
+            x_hbm.at[:, pl.ds(spl[s, 0] * _LANE, win)], a_bufs.at[slot],
+            sem_a.at[slot])
+        b_cp = pltpu.make_async_copy(
+            x_hbm.at[:, pl.ds(spl[s, 3] * _LANE, win)], b_bufs.at[slot],
+            sem_b.at[slot])
+        a_cp.start()
+        b_cp.start()
+
+    @pl.when(t == 0)
+    def _():
+        issue(splits_ref, 0)
+
+    @pl.when(t + 1 < nt)
+    def _():
+        issue(splits_nxt_ref, (t + 1) % 2)
+
+    pltpu.make_async_copy(x_hbm.at[:, pl.ds(0, win)], a_bufs.at[slot],
+                          sem_a.at[slot]).wait()
+    pltpu.make_async_copy(x_hbm.at[:, pl.ds(0, win)], b_bufs.at[slot],
+                          sem_b.at[slot]).wait()
+
+    shift_a = splits_ref[s, 1]
+    thr_a = splits_ref[s, 2]
+    shift_b = splits_ref[s, 4]
+    thr_b = splits_ref[s, 5]
+    out_asc = splits_ref[s, 6] != 0
+
+    r_idx = lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+    rowi = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+    is_key_row = (rowi < num_keys) | (rowi == _TB)
+
+    a_rows = pltpu.roll(a_bufs[slot], shift_a, 1)[:, :tile]
+    a_rows = jnp.where(is_key_row & (r_idx >= thr_a),
+                       jnp.broadcast_to(_INF, a_rows.shape), a_rows)
+    b_rows = pltpu.roll(b_bufs[slot], shift_b, 1)[:, :tile]
+    b_rows = jnp.where(is_key_row & (r_idx < thr_b),
+                       jnp.broadcast_to(_INF, b_rows.shape), b_rows)
+
+    F = _fold(jnp.concatenate([a_rows, b_rows], axis=1), tile)
+    asc = jnp.broadcast_to(out_asc, (8, tile))
+    j = tile
+    while j >= 1:
+        F = _cmp_exchange_folded(F, j, asc, num_keys, tile)
+        j //= 2
+    # ascending output keeps the smallest tile elements = the lower
+    # slot; descending keeps positions [tile, 2*tile) = the upper
+    cho = jnp.where(jnp.broadcast_to(out_asc, (_SLOT, tile)),
+                    F[:_SLOT], F[_SLOT:])
+    o_ref[...] = _slot_to_rows(cho, tile)
+
+
+@partial(jax.jit, static_argnames=("tile", "num_keys", "interpret"))
+def _merge_pass_folded(x, splits, tile: int, num_keys: int,
+                       interpret: bool = False):
+    rows, n = x.shape
+    split_blk = min(8, n // tile)
+    splits_nxt = jnp.concatenate([splits[1:], splits[-1:]], axis=0)
+    blk = pl.BlockSpec((split_blk, 8), lambda t: (t // split_blk, 0),
+                       memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        partial(_merge_pass_kernel_folded, tile=tile, num_keys=num_keys,
+                split_blk=split_blk),
+        grid=(n // tile,),
+        in_specs=[blk, blk, pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, tile + _LANE), jnp.uint32),
+            pltpu.VMEM((2, rows, tile + _LANE), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
+                                       vma=jax.typeof(x).vma),
+        interpret=interpret,
+    )(splits, splits_nxt, x)
+
+
+def sort_lanes_folded(x, num_keys: int, tile: int = 1024,
+                      interpret: bool = False):
+    """Drop-in for ``pallas_sort.sort_lanes(x, num_keys, tb_row=7)`` on
+    8-row keys arrays with ``num_keys <= 3``: same output contract
+    (rows 3..6 zeroed, row 7 = arrival index), half the network work.
+    ``tile`` must be a power-of-two multiple of 256 (the folded lane
+    width tile/2 must stay lane-aligned)."""
+    x = jnp.asarray(x, jnp.uint32)
+    rows, n = x.shape
+    if rows != 8:
+        raise ValueError(f"folded cascade needs an 8-row keys array, "
+                         f"got {rows} rows")
+    if not 0 < num_keys <= 3:
+        raise ValueError(f"folded cascade needs num_keys <= 3, got "
+                         f"{num_keys}")
+    if tile & (tile - 1) or tile % (2 * _LANE):
+        raise ValueError(f"tile={tile} must be a power of two multiple "
+                         f"of {2 * _LANE}")
+    if n % tile or (n // tile) & (n // tile - 1):
+        raise ValueError(f"n={n} must be a power-of-two multiple of "
+                         f"tile={tile}")
+    levels = int(np.log2(n // tile))
+    x = _tile_sort_folded(x, tile, num_keys, alternate=levels > 0,
+                          interpret=interpret)
+    if levels == 0:
+        return x
+
+    def body(lvl, x):
+        run_len = jnp.int32(tile) << lvl
+        final = lvl == levels - 1
+        splits = _pass_splits(x, run_len, final, tile, num_keys, _TB)
+        return _merge_pass_folded(x, splits, tile, num_keys,
+                                  interpret=interpret)
+
+    return lax.fori_loop(0, levels, body, x)

@@ -284,6 +284,10 @@ def _merge_pass_kernel(splits_ref, splits_nxt_ref, x_hbm, o_ref, a_bufs,
     bookkeeping; every pass-dependent scalar arrives via splits_ref, so
     this kernel compiles once and serves all log2(n/tile) passes).
 
+    MAINTENANCE: ops.pallas_fold._merge_pass_kernel_folded mirrors this
+    kernel's DMA protocol and roll contract — apply hardware-erratum
+    fixes to both.
+
     DMA double buffering: the windows for tile t+1 (whose aligned starts
     arrive via splits_nxt_ref, the splits table shifted by one row) are
     DMA'd into the other scratch slot WHILE tile t's merge network runs,
@@ -416,7 +420,8 @@ def pad_pow2(n: int, tile: int) -> tuple[int, int]:
     return m, min(tile, m)
 
 
-def keys8_sort_perm(keyrows, tile: int = 1024, interpret: bool = False):
+def keys8_sort_perm(keyrows, tile: int = 1024, interpret: bool = False,
+                    folded: bool = False):
     """The keys8 cascade core, shared by every keys8 engine (the
     single-chip sort, the bench bodies, the distributed local sort):
     run the FULL bitonic pipeline on an 8-row keys-only matrix and
@@ -437,8 +442,19 @@ def keys8_sort_perm(keyrows, tile: int = 1024, interpret: bool = False):
     mat8 = jnp.concatenate(
         [jnp.asarray(keyrows, jnp.uint32),
          jnp.zeros((8 - k, m), jnp.uint32)], axis=0)
-    out8 = sort_lanes(mat8, num_keys=k, tb_row=7, tile=tile,
-                      interpret=interpret)
+    if folded and tile % (2 * _LANE) == 0:
+        # the folded cascade (ops.pallas_fold): half the network work,
+        # needs the compare set to fit a 4-row slot. Tiles below two
+        # lane blocks cannot fold (the half width must stay
+        # lane-aligned) and quietly use the standard cascade — the
+        # output contract is identical.
+        from uda_tpu.ops.pallas_fold import sort_lanes_folded
+
+        out8 = sort_lanes_folded(mat8, num_keys=k, tile=tile,
+                                 interpret=interpret)
+    else:
+        out8 = sort_lanes(mat8, num_keys=k, tb_row=7, tile=tile,
+                          interpret=interpret)
     return out8[:k], out8[7].astype(jnp.int32)
 
 

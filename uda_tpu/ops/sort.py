@@ -37,7 +37,8 @@ from uda_tpu.ops.packing import PackedKeys
 
 __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
            "concat_packed", "resolve_sort_path", "apply_perm_chunked",
-           "LANES_ENGINES", "FLYOFF_ENGINES", "ALL_SORT_PATHS"]
+           "LANES_ENGINES", "FLYOFF_ENGINES", "BENCH_FLYOFF",
+           "ALL_SORT_PATHS"]
 
 # The single source of truth for engine path names. LANES_ENGINES are
 # the Pallas-pipeline variants (bounded compile; interpret mode on CPU
@@ -55,10 +56,16 @@ __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
 # operand-carry sorts (invert the permutation with a 2-operand sort,
 # then re-sort payload chunks of ~6 columns by it): no gathers, no
 # Pallas, and every sort stays far below the operand count where XLA's
-# variadic-sort compile time blows up.
-LANES_ENGINES = ("lanes", "lanes2", "keys8")
-FLYOFF_ENGINES = LANES_ENGINES + ("gather2", "carrychunk")
-ALL_SORT_PATHS = ("carry", "gather") + FLYOFF_ENGINES
+# variadic-sort compile time blows up. "keys8f" is keys8 with the
+# FOLDED cascade (ops.pallas_fold: two element-halves share the 8-row
+# tile, halving per-stage work) — it needs the compare set to fit a
+# 4-row slot, so it is a narrow-key specialization (<= 3 compare rows
+# + tie-break; the TeraSort flagship shape) and joins the bench
+# fly-off but not the general-purpose engine set.
+LANES_ENGINES = ("lanes", "lanes2", "keys8", "keys8f")
+FLYOFF_ENGINES = ("lanes", "lanes2", "keys8", "gather2", "carrychunk")
+BENCH_FLYOFF = FLYOFF_ENGINES + ("keys8f",)
+ALL_SORT_PATHS = ("carry", "gather") + BENCH_FLYOFF
 
 
 def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:

@@ -153,9 +153,11 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
 
     n, wcols = flat.shape
     if payload_path in LANES_ENGINES:
-        return _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
-                                      two_phase=payload_path == "lanes2",
-                                      keys8=payload_path == "keys8")
+        return _sort_valid_rows_lanes(
+            flat, valid, num_keys, interpret,
+            two_phase=payload_path == "lanes2",
+            keys8=payload_path in ("keys8", "keys8f"),
+            folded=payload_path == "keys8f")
     keycols = tuple(jnp.where(valid, flat[:, i], _INVALID)
                     for i in range(num_keys))
     invalid_last = jnp.where(valid, 0, 1)
@@ -185,7 +187,7 @@ def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
 
 
 def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
-                           two_phase=False, keys8=False):
+                           two_phase=False, keys8=False, folded=False):
     """Lanes-path body of _sort_valid_rows: pack rows into the [32, n]
     lanes layout with sort key (masked key words, invalid flag), pad the
     lane count to a power of two with +inf-key lanes, run the Pallas
@@ -222,12 +224,18 @@ def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
             raise ValueError(
                 f"num_keys={num_keys} does not fit the 8-row keys view; "
                 "use payload_path='lanes'")
+        if folded and k8 > 3:
+            raise ValueError(
+                f"keys8f needs num_keys <= 2 here (keys + invalid flag "
+                f"must fit the folded 4-row slot); got {num_keys} — use "
+                "payload_path='keys8'")
         base = jnp.full((k8, npad), _INVALID, jnp.uint32)
         keyr = lax.dynamic_update_slice(base, keyrows, (0, 0))
         # the n real lanes sort strictly before the padding, so the
         # first n arrival indices all reference real rows of flat
         _, perm = pallas_sort.keys8_sort_perm(keyr, tile=tile,
-                                              interpret=interpret)
+                                              interpret=interpret,
+                                              folded=folded)
         return jnp.take(flat.T, perm[:n], axis=1,
                         unique_indices=True, mode="clip").T
     if first_pay + wcols > tb:
