@@ -50,13 +50,15 @@ def step(seed, n, k, stage, tile):
             out = pallas_sort._tile_sort(x, tile, terasort.KEY_WORDS,
                                          pallas_sort.TB_ROW_DEFAULT,
                                          alternate=True)
-        elif stage == "keys8":
-            out = terasort.sort_lanes_keys8(x, tile=tile)
-        elif stage == "keys8sort":
+        elif stage in ("keys8", "keys8f"):
+            out = terasort.sort_lanes_keys8(x, tile=tile,
+                                            folded=stage == "keys8f")
+        elif stage in ("keys8sort", "keys8fsort"):
             # the keys cascade alone: _keys8_parts returns the sorted
             # KEY rows; the payload gather's output is unused below
             # (checksum over zero pad rows), so XLA DCEs it
-            sk = terasort._keys8_parts(x, tile, False)[0]
+            sk = terasort._keys8_parts(x, tile, False,
+                                       folded=stage == "keys8fsort")[0]
             out = jnp.concatenate(
                 [sk, jnp.zeros((pallas_sort.ROWS - terasort.KEY_WORDS,
                                 x.shape[1]), jnp.uint32)], axis=0)
@@ -93,7 +95,9 @@ if __name__ == "__main__":
     t_tile = time_stage("tilesort", 1024)
     for stage, tiles in (("full", (1024, 2048, 4096)),
                          ("keys8sort", (4096, 8192, 16384)),
-                         ("keys8", (4096, 8192, 16384))):
+                         ("keys8fsort", (4096, 8192, 16384)),
+                         ("keys8", (4096, 8192, 16384)),
+                         ("keys8f", (4096, 8192, 16384))):
         for tile in tiles:
             if (N % tile) or ((N // tile) & (N // tile - 1)):
                 continue

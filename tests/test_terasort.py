@@ -103,15 +103,19 @@ def test_bench_step_carrychunk_path_validates():
 
 def test_sort_lanes_keys8_matches_sort_lanes():
     # the keys8 engine (keys-only cascade + one global payload gather)
-    # must be byte-identical to the 32-row pipeline, stability included
+    # must be byte-identical to the 32-row pipeline, stability included,
+    # in both the standard and folded cascade variants
     from uda_tpu.ops import pallas_sort
 
     x = np.asarray(terasort.teragen_lanes(jax.random.key(12), 2048)).copy()
     x[:3, 100:300] = x[:3, 700:900]  # duplicate keys
     a = np.asarray(pallas_sort.sort_lanes(x, num_keys=terasort.KEY_WORDS,
                                           tile=512, interpret=True))
-    b = np.asarray(terasort.sort_lanes_keys8(x, tile=512, interpret=True))
-    np.testing.assert_array_equal(a, b)
+    for folded in (False, True):
+        b = np.asarray(terasort.sort_lanes_keys8(x, tile=512,
+                                                 interpret=True,
+                                                 folded=folded))
+        np.testing.assert_array_equal(a, b, err_msg=f"folded={folded}")
 
 
 def test_bench_step_lanes_checksum_matches_oracle():

@@ -199,17 +199,19 @@ def _keys8_parts(x: jax.Array, tile: int, interpret: bool,
 
 
 def sort_lanes_keys8(x: jax.Array, tile: int = 1024,
-                     interpret: bool = False) -> jax.Array:
+                     interpret: bool = False,
+                     folded: bool = False) -> jax.Array:
     """Stable TeraSort record sort in lanes layout via the keys8 engine.
 
     Drop-in equal to ``pallas_sort.sort_lanes(x, num_keys=KEY_WORDS,
     tile=tile)`` on teragen_lanes-shaped input (layout pad rows zero):
     same [ROWS, n] output, byte-identical including the arrival-index
     row — but the payload crosses HBM once instead of riding every
-    compare-exchange stage.
+    compare-exchange stage. ``folded`` selects the half-width cascade
+    (ops.pallas_fold; the keys8f engine).
     """
     sk, payload, perm = _keys8_parts(jnp.asarray(x, jnp.uint32), tile,
-                                     interpret)
+                                     interpret, folded=folded)
     n = x.shape[1]
     pad = jnp.zeros((pallas_sort.ROWS - RECORD_WORDS - 1, n), jnp.uint32)
     return jnp.concatenate(
