@@ -28,11 +28,12 @@ def _rows(report: dict) -> dict[str, dict]:
         # keep the best (min wall) rep per workload, like the bench's
         # best-of-dispatches rule — but a PASS rep always beats a FAIL
         # rep (a fast crash must not hide a valid timing; the exit-code
-        # gate scans every rep separately)
+        # gate scans every rep separately). Any two non-PASS statuses
+        # rank equal (FAIL vs TIMEOUT) and fall through to wall time.
         cur = out.get(r["workload"])
         better = (cur is None
                   or (r["status"] == "PASS") > (cur["status"] == "PASS")
-                  or (r["status"] == cur["status"]
+                  or ((r["status"] == "PASS") == (cur["status"] == "PASS")
                       and r["wall_s"] < cur["wall_s"]))
         if better:
             out[r["workload"]] = r

@@ -1,0 +1,192 @@
+"""Native loser-tree k-way merge (native/merge.cc) parity vs the Python
+heap merge (ops/merge.merge_record_streams — the semantic oracle, the
+reference MergeQueue.h:276-427 contract). Byte-identity is the bar: the
+native block stream concatenated must equal the oracle's records
+re-framed, EOF marker included."""
+
+import functools
+import io
+
+import numpy as np
+import pytest
+
+from uda_tpu import native
+from uda_tpu.ops import merge as merge_ops
+from uda_tpu.utils import ifile
+from uda_tpu.utils.comparators import get_key_type
+from uda_tpu.utils.errors import StorageError
+
+pytestmark = pytest.mark.skipif(
+    not (native.available() or native.build()),
+    reason="native library not built and build failed")
+
+
+def _write_run(path, records):
+    with open(path, "wb") as f:
+        w = ifile.IFileWriter(f)
+        for k, v in records:
+            w.append(k, v)
+        w.close()
+
+
+def _sorted_runs(kt, n_runs, n_recs, keygen, seed=0):
+    rng = np.random.default_rng(seed)
+    runs = []
+    for _ in range(n_runs):
+        recs = [(keygen(rng), rng.bytes(int(rng.integers(0, 40))))
+                for _ in range(n_recs)]
+        recs.sort(key=functools.cmp_to_key(
+            lambda a, b: kt.compare(a[0], b[0])))
+        runs.append(recs)
+    return runs
+
+
+def _oracle_bytes(paths, kt):
+    streams = [ifile.iter_file_records(p) for p in paths]
+    return ifile.write_records(merge_ops.merge_record_streams(streams, kt))
+
+
+def _native_bytes(paths, kt, **kw):
+    return b"".join(native.kway_merge_paths(paths, kt, **kw))
+
+
+def _spill(tmp_path, runs):
+    paths = []
+    for i, recs in enumerate(runs):
+        p = str(tmp_path / f"run-{i:03d}")
+        _write_run(p, recs)
+        paths.append(p)
+    return paths
+
+
+def _text_key(rng):
+    # Text framing: VInt(len) + bytes (comparator skips the VInt)
+    content = rng.bytes(int(rng.integers(0, 12)))
+    from uda_tpu.utils import vint
+    return vint.encode_vlong(len(content)) + content
+
+
+@pytest.mark.parametrize("name,keygen", [
+    ("uda.tpu.RawBytes", lambda rng: rng.bytes(int(rng.integers(0, 10)))),
+    ("org.apache.hadoop.io.Text", _text_key),
+    ("org.apache.hadoop.io.IntWritable",
+     lambda rng: int(rng.integers(-2**31, 2**31)).to_bytes(
+         4, "big", signed=True)),
+    ("org.apache.hadoop.io.BytesWritable",
+     lambda rng: (lambda c: len(c).to_bytes(4, "big") + c)(
+         rng.bytes(int(rng.integers(0, 8))))),
+    ("uda.tpu.IntNumeric",
+     lambda rng: int(rng.integers(-2**31, 2**31)).to_bytes(
+         4, "big", signed=True)),
+])
+def test_kway_parity(tmp_path, name, keygen):
+    kt = get_key_type(name)
+    runs = _sorted_runs(kt, n_runs=5, n_recs=120, keygen=keygen,
+                        seed=hash(name) % 2**31)
+    paths = _spill(tmp_path, runs)
+    assert _native_bytes(paths, kt) == _oracle_bytes(paths, kt)
+
+
+def test_kway_int_memcmp_quirk(tmp_path):
+    """memcmp order puts negative IntWritables AFTER positive ones (the
+    reference CompareFunc quirk) — both paths must agree on it."""
+    kt = get_key_type("org.apache.hadoop.io.IntWritable")
+    vals = [-5, -1, 0, 1, 7, 2**31 - 1, -2**31]
+    keys = sorted((v.to_bytes(4, "big", signed=True) for v in vals))
+    runs = [[(k, b"v%d" % i) for i, k in enumerate(keys)]]
+    paths = _spill(tmp_path, runs)
+    out = _native_bytes(paths, kt)
+    assert out == _oracle_bytes(paths, kt)
+    # and the first record is a non-negative key (high bit clear)
+    batch = ifile.crack(out)
+    assert batch.key(0)[0] < 0x80
+
+
+def test_kway_tie_stability(tmp_path):
+    """Equal keys come out in spill-file order (seq tiebreak)."""
+    kt = get_key_type("uda.tpu.RawBytes")
+    runs = [[(b"k", b"from-%d" % i)] for i in range(6)]
+    paths = _spill(tmp_path, runs)
+    out = _native_bytes(paths, kt)
+    assert out == _oracle_bytes(paths, kt)
+    batch = ifile.crack(out)
+    assert [batch.value(i) for i in range(6)] == \
+        [b"from-%d" % i for i in range(6)]
+
+
+def test_kway_empty_and_single(tmp_path):
+    kt = get_key_type("uda.tpu.RawBytes")
+    # a run holding only the EOF marker merges as zero records
+    empty = str(tmp_path / "empty")
+    _write_run(empty, [])
+    single = str(tmp_path / "single")
+    _write_run(single, [(b"a", b"1"), (b"b", b"2")])
+    for paths in ([empty], [single], [empty, single], [single, empty]):
+        assert _native_bytes(paths, kt) == _oracle_bytes(paths, kt)
+    # no paths at all -> just the EOF marker
+    assert _native_bytes([], kt) == ifile.EOF_MARKER
+
+
+def test_kway_small_buffers_span_records(tmp_path):
+    """Records far larger than the cursor read buffer and the output
+    block exercise the refill/grow paths."""
+    kt = get_key_type("uda.tpu.RawBytes")
+    rng = np.random.default_rng(3)
+    runs = _sorted_runs(kt, n_runs=3, n_recs=40,
+                        keygen=lambda r: r.bytes(int(r.integers(0, 6))),
+                        seed=3)
+    # add some jumbo values so single records exceed buffer_size=64
+    for recs in runs:
+        for j in range(0, len(recs), 7):
+            recs[j] = (recs[j][0], rng.bytes(500))
+    paths = _spill(tmp_path, runs)
+    out = _native_bytes(paths, kt, block_bytes=128, buffer_size=64)
+    assert out == _oracle_bytes(paths, kt)
+
+
+def test_kway_missing_eof_marker(tmp_path):
+    kt = get_key_type("uda.tpu.RawBytes")
+    p = str(tmp_path / "trunc")
+    full = ifile.write_records([(b"a", b"1"), (b"b", b"2")])
+    with open(p, "wb") as f:
+        f.write(full[:-2])  # strip the marker
+    with pytest.raises(StorageError):
+        _native_bytes([p], kt)
+
+
+def test_kway_unsupported_keytype_detection():
+    from uda_tpu.utils.comparators import KeyType
+    custom = KeyType("custom", lambda b: bytes(b))
+    assert not native.kway_supported(custom)
+    assert native.kway_supported(get_key_type("org.apache.hadoop.io.Text"))
+
+
+def test_hybrid_rpq_native_vs_python_identical(tmp_path):
+    """run_hybrid's consumer stream is byte-identical with the native
+    RPQ on and off (the kill-switch contract)."""
+    from tests.helpers import make_mof_tree, map_ids
+    from uda_tpu.merger import LocalFetchClient, MergeManager
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.utils.config import Config
+
+    def run(root, use_native):
+        cfg = Config({"mapred.netmerger.merge.approach": 2,
+                      "mapred.netmerger.hybrid.lpq.size": 2,
+                      "uda.tpu.spill.dirs": str(root / "spill")})
+        make_mof_tree(str(root), "jobK", 6, 1, 60, seed=11)
+        engine = DataEngine(DirIndexResolver(str(root)), cfg)
+        kt = get_key_type("uda.tpu.RawBytes")
+        ifile.set_native_enabled(use_native)
+        try:
+            mm = MergeManager(LocalFetchClient(engine), kt, cfg)
+            blocks = []
+            mm.run("jobK", map_ids("jobK", 6), 0,
+                   lambda b: blocks.append(bytes(b)))
+            return b"".join(blocks)
+        finally:
+            ifile.set_native_enabled(True)
+            engine.stop()
+
+    a = run(tmp_path / "nat", True)
+    b = run(tmp_path / "py", False)
+    assert a == b and len(a) > 0

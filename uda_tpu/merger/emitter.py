@@ -95,21 +95,20 @@ class FramedEmitter:
         metrics.add("emitted_bytes", total)
         return total
 
-    def emit_batch(self, batch: RecordBatch,
-                   consumer: Callable[[memoryview], None]) -> int:
-        """Bulk emission of a RecordBatch: records are framed in native
-        chunk passes (uda_tpu.native.frame_batch — the C++ twin of the
-        reference's write_kv_to_stream hot loop, StreamRW.cc:151-225)
-        instead of a per-record Python loop, then streamed to the
-        consumer in exactly-block_size slices (the stream concatenation
+    def emit_framed(self, pieces: Iterable[bytes],
+                    consumer: Callable[[memoryview], None]) -> int:
+        """Stream an already-framed record stream (``pieces`` concatenate
+        to the complete IFile stream INCLUDING the EOF marker) to the
+        consumer in exactly-block_size slices. The stream concatenation
         contract is identical to emit(); blocks are not record-aligned,
-        which emit() already allows for oversized records)."""
+        which emit() already allows for oversized records. Feeds both
+        emit_batch (native chunk framing) and the native RPQ merge
+        (uda_tpu.native.kway_merge_paths)."""
         total = 0
         held: list = []
         buf = bytearray()
         try:
-            for piece in native.iter_framed_chunks(
-                    batch, FRAME_CHUNK_RECORDS, write_eof=True):
+            for piece in pieces:
                 buf += piece
                 while len(buf) >= self.block_size:
                     total += self._deliver(bytes(buf[:self.block_size]),
@@ -124,6 +123,17 @@ class FramedEmitter:
                 self.arena.release(slot)
         metrics.add("emitted_bytes", total)
         return total
+
+    def emit_batch(self, batch: RecordBatch,
+                   consumer: Callable[[memoryview], None]) -> int:
+        """Bulk emission of a RecordBatch: records are framed in native
+        chunk passes (uda_tpu.native.frame_batch — the C++ twin of the
+        reference's write_kv_to_stream hot loop, StreamRW.cc:151-225)
+        instead of a per-record Python loop, then streamed through
+        emit_framed."""
+        return self.emit_framed(
+            native.iter_framed_chunks(batch, FRAME_CHUNK_RECORDS,
+                                      write_eof=True), consumer)
 
 
 def emit_framed_records(records: Iterable[Tuple[bytes, bytes]],

@@ -125,9 +125,23 @@ def run_hybrid(mm, job_id: str, map_ids: Sequence, reduce_id: int,
     # RPQ: bounded-memory streaming merge of the sorted spill files —
     # each SuperSegment contributes a buffered file cursor, so peak RAM
     # is one read-buffer per spill file, never the whole shuffle
-    # (compression off by contract, MergeManager.cc:240-288)
+    # (compression off by contract, MergeManager.cc:240-288). The hot
+    # path is the native loser tree (merge.cc — the reference ran this
+    # final merge in C++, MergeQueue.h:276-427 + StreamRW.cc:151-225);
+    # the Python heap remains the semantic reference for comparators
+    # the native table doesn't cover and when native is off/unbuilt
+    # (byte-identical either way, tests/test_native.py).
     try:
         with metrics.timer("rpq_phase"):
+            from uda_tpu.utils.ifile import native_enabled
+
+            if (native_enabled() and native.kway_supported(mm.key_type)
+                    and native.build()):
+                log.info(f"RPQ: native loser-tree merge of "
+                         f"{len(supers)} spills")
+                pieces = native.kway_merge_paths(
+                    [s.path for s in supers], mm.key_type)
+                return mm.emitter.emit_framed(pieces, consumer)
             streams = [s.stream() for s in supers]
             merged = merge_ops.merge_record_streams(streams, mm.key_type)
             return mm.emitter.emit(merged, consumer)

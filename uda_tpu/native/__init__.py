@@ -25,7 +25,8 @@ from uda_tpu.utils.logging import get_logger
 
 __all__ = ["available", "build", "crack_native", "crack_partial_native",
            "decode_vlongs_native", "write_records_native", "frame_batch",
-           "iter_framed_chunks", "ReadPool"]
+           "iter_framed_chunks", "ReadPool", "kway_supported",
+           "kway_merge_paths"]
 
 log = get_logger()
 
@@ -42,60 +43,88 @@ def _load():
             return _lib
         if not os.path.exists(_SO):
             return None
-        lib = ctypes.CDLL(_SO)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.uda_crack.restype = ctypes.c_int64
-        lib.uda_crack.argtypes = [u8p, ctypes.c_int64, i64p, i64p, i64p,
-                                  i64p, ctypes.c_int64, i64p,
-                                  ctypes.POINTER(ctypes.c_int32)]
-        lib.uda_decode_vlongs.restype = ctypes.c_int64
-        lib.uda_decode_vlongs.argtypes = [u8p, ctypes.c_int64, i64p,
-                                          ctypes.c_int64]
-        lib.uda_pool_create.restype = ctypes.c_void_p
-        lib.uda_pool_create.argtypes = [ctypes.c_int]
-        lib.uda_pool_destroy.argtypes = [ctypes.c_void_p]
-        lib.uda_pool_submit.restype = ctypes.c_int
-        lib.uda_pool_submit.argtypes = [ctypes.c_void_p, ctypes.c_int,
-                                        ctypes.c_int64, ctypes.c_int64,
-                                        u8p, ctypes.c_uint64]
-        lib.uda_pool_get_events.restype = ctypes.c_int
-        lib.uda_pool_get_events.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), i64p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_double]
-        lib.uda_write_records.restype = ctypes.c_int64
-        lib.uda_write_records.argtypes = [u8p, i64p, i64p, i64p, i64p,
-                                          ctypes.c_int64, u8p,
-                                          ctypes.c_int64, ctypes.c_int32]
+        try:
+            lib = _bind(ctypes.CDLL(_SO))
+        except AttributeError as e:
+            # a stale .so from an older build lacks newer symbols; fall
+            # back to pure Python rather than poisoning every caller
+            log.warn(f"native library is stale ({e}); rebuild with "
+                     f"`make -C uda_tpu/native` — using pure Python")
+            return None
         _lib = lib
         return lib
+
+
+def _bind(lib):
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.uda_crack.restype = ctypes.c_int64
+    lib.uda_crack.argtypes = [u8p, ctypes.c_int64, i64p, i64p, i64p,
+                              i64p, ctypes.c_int64, i64p,
+                              ctypes.POINTER(ctypes.c_int32)]
+    lib.uda_decode_vlongs.restype = ctypes.c_int64
+    lib.uda_decode_vlongs.argtypes = [u8p, ctypes.c_int64, i64p,
+                                      ctypes.c_int64]
+    lib.uda_pool_create.restype = ctypes.c_void_p
+    lib.uda_pool_create.argtypes = [ctypes.c_int]
+    lib.uda_pool_destroy.argtypes = [ctypes.c_void_p]
+    lib.uda_pool_submit.restype = ctypes.c_int
+    lib.uda_pool_submit.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_int64, ctypes.c_int64,
+                                    u8p, ctypes.c_uint64]
+    lib.uda_pool_get_events.restype = ctypes.c_int
+    lib.uda_pool_get_events.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), i64p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_double]
+    lib.uda_write_records.restype = ctypes.c_int64
+    lib.uda_write_records.argtypes = [u8p, i64p, i64p, i64p, i64p,
+                                      ctypes.c_int64, u8p,
+                                      ctypes.c_int64, ctypes.c_int32]
+    lib.uda_kway_create.restype = ctypes.c_void_p
+    lib.uda_kway_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, i64p]
+    lib.uda_kway_next_block.restype = ctypes.c_int64
+    lib.uda_kway_next_block.argtypes = [ctypes.c_void_p, u8p,
+                                        ctypes.c_int64, i64p]
+    lib.uda_kway_destroy.argtypes = [ctypes.c_void_p]
+    return lib
 
 
 def available() -> bool:
     return _load() is not None
 
 
-_build_failed = False
+_build_attempted = False
+_build_ok = False
 
 
 def build(quiet: bool = True) -> bool:
-    """Best-effort build of the shared library (g++ via make). A failed
-    build is remembered so later callers don't re-spawn a doomed make
-    per DataEngine construction."""
-    global _build_failed, _lib
-    if os.path.exists(_SO):
-        return True
-    if _build_failed:
-        return False
+    """Best-effort build of the shared library (g++ via make), run at
+    most once per process — even when the .so already exists, so a
+    STALE library (older than its sources, e.g. after a pull) is
+    rebuilt instead of crashing symbol binds. The outcome (either way)
+    is remembered so later callers don't re-spawn make per DataEngine
+    construction."""
+    global _build_attempted, _build_ok, _lib
+    if _build_attempted:
+        return _build_ok
+    _build_attempted = True
     try:
         subprocess.run(["make", "-C", _DIR],
                        check=True, capture_output=quiet)
+        _lib = None  # rebind in case make refreshed a stale .so
     except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        if os.path.exists(_SO):
+            log.warn(f"native rebuild failed; keeping the existing "
+                     f"library: {e}")
+            _build_ok = available()
+            return _build_ok
         log.warn(f"native build failed, using pure-Python codec: {e}")
-        _build_failed = True
+        _build_ok = False
         return False
-    _lib = None
-    return available()
+    _build_ok = available()
+    return _build_ok
 
 
 def _u8ptr(arr: np.ndarray):
@@ -219,6 +248,82 @@ def iter_framed_chunks(batch: RecordBatch, chunk_records: int = 1 << 16,
         from uda_tpu.utils.ifile import EOF_MARKER
 
         yield EOF_MARKER
+
+
+# KeyType.name -> (key_mode, key_param) for the native loser-tree merge
+# (merge.cc). The mode family is exactly the reference CompareFunc
+# dispatch (CompareFunc.cc:70-113): identity memcmp, Text VInt-skip,
+# BytesWritable 4-byte skip, plus this framework's sign-flip numeric
+# variants. Comparators outside this table (user-registered) fall back
+# to the Python heap merge — the reference's unsupported-comparator
+# posture (CompareFunc.cc:95-113).
+_KWAY_MODES = {
+    "raw": (0, 0), "boolean": (0, 0), "byte": (0, 0), "short": (0, 0),
+    "int": (0, 0), "long": (0, 0),
+    "text": (1, 0),
+    "bytes": (2, 0), "ibytes": (2, 0),
+    "int_numeric": (3, 4), "long_numeric": (3, 8),
+}
+
+_KWAY_ERRORS = {-1: "corrupt record framing / missing EOF marker",
+                -4: "read failure"}
+
+
+def kway_supported(kt) -> bool:
+    """Whether the native merge implements this KeyType's comparator."""
+    return kt.name in _KWAY_MODES
+
+
+def kway_merge_paths(paths, kt, block_bytes: int = 1 << 20,
+                     buffer_size: int = 1 << 20, write_eof: bool = True):
+    """Streaming k-way merge of sorted IFile spill files: yields framed
+    byte blocks whose concatenation is the merged record stream
+    (+ EOF marker when ``write_eof``) — byte-identical to
+    ``ops.merge.merge_record_streams`` over the same files re-framed.
+    The C++ loser tree (merge.cc, the reference MergeQueue.h:276-427
+    analogue) does all comparator and framing work; peak memory is one
+    read buffer per file + one output block."""
+    from uda_tpu.utils.ifile import EOF_MARKER
+
+    mode, param = _KWAY_MODES[kt.name]
+    if not paths:
+        if write_eof:
+            yield EOF_MARKER
+        return
+    lib = _load()
+    if lib is None:
+        raise StorageError("native library not built")
+    arr = (ctypes.c_char_p * len(paths))(
+        *[os.fsencode(p) for p in paths])
+    err = ctypes.c_int64(0)
+    h = lib.uda_kway_create(arr, len(paths), mode, param, buffer_size,
+                            ctypes.byref(err))
+    if not h:
+        reason = _KWAY_ERRORS.get(int(err.value), "open failed")
+        raise StorageError(f"native kway merge over {list(paths)}: "
+                           f"{reason}")
+    try:
+        cap = block_bytes
+        out = np.empty(cap, np.uint8)
+        need = ctypes.c_int64(0)
+        while True:
+            n = lib.uda_kway_next_block(h, _u8ptr(out), cap,
+                                        ctypes.byref(need))
+            if n == -3:  # one record larger than the block: grow
+                cap = max(cap * 2, int(need.value))
+                out = np.empty(cap, np.uint8)
+                continue
+            if n < 0:
+                raise StorageError(
+                    f"native kway merge: "
+                    f"{_KWAY_ERRORS.get(int(n), f'error {n}')}")
+            if n == 0:
+                break
+            yield out[:n].tobytes()
+        if write_eof:
+            yield EOF_MARKER
+    finally:
+        lib.uda_kway_destroy(h)
 
 
 class ReadPool:

@@ -12,30 +12,9 @@
 #include <cstdint>
 #include <cstddef>
 
-namespace {
+#include "vlong.h"
 
-// Hadoop zero-compressed VLong decode. Returns bytes consumed, 0 on
-// truncation. Mirrors decodeVIntSize/readVLong semantics
-// (IOUtility.cc:228-397).
-inline int decode_vlong(const uint8_t* buf, int64_t len, int64_t pos,
-                        int64_t* out) {
-  if (pos >= len) return 0;
-  int8_t first = static_cast<int8_t>(buf[pos]);
-  if (first >= -112) {
-    *out = first;
-    return 1;
-  }
-  int size = (first >= -120) ? (-111 - first) : (-119 - first);
-  if (pos + size > len) return 0;
-  uint64_t v = 0;
-  for (int i = 1; i < size; ++i) {
-    v = (v << 8) | buf[pos + i];
-  }
-  *out = (first < -120) ? static_cast<int64_t>(~v) : static_cast<int64_t>(v);
-  return size;
-}
-
-}  // namespace
+using uda::decode_vlong;
 
 extern "C" {
 
@@ -100,25 +79,7 @@ int64_t uda_crack(const uint8_t* buf, int64_t len,
 // Serialize records into IFile framing (VInt klen, VInt vlen, key, val).
 // Returns bytes written or -1 if out_cap is too small. Appends the EOF
 // marker when write_eof != 0.
-static inline int encode_vlong(int64_t v, uint8_t* out) {
-  if (v >= -112 && v <= 127) {
-    out[0] = static_cast<uint8_t>(v);
-    return 1;
-  }
-  int tag = -112;
-  uint64_t u = static_cast<uint64_t>(v);
-  if (v < 0) {
-    u = ~u;
-    tag = -120;
-  }
-  int body = 0;
-  for (uint64_t t = u; t; t >>= 8) ++body;
-  out[0] = static_cast<uint8_t>(tag - body);
-  for (int i = 0; i < body; ++i) {
-    out[1 + i] = static_cast<uint8_t>(u >> (8 * (body - 1 - i)));
-  }
-  return body + 1;
-}
+using uda::encode_vlong;
 
 int64_t uda_write_records(const uint8_t* data,
                           const int64_t* key_off, const int64_t* key_len,

@@ -94,8 +94,9 @@ def exchange_blobs(blobs: Sequence[Sequence[Tuple[int, bytes]]],
             if not 0 <= dst < p:
                 raise ValueError(f"source {s}: dest {dst} outside the "
                                  f"{p}-way exchange group")
-    if row_payload_bytes % 4:
-        raise ValueError("row_payload_bytes must be a multiple of 4")
+    if row_payload_bytes <= 0 or row_payload_bytes % 4:
+        raise ValueError("row_payload_bytes must be a positive multiple "
+                         "of 4")
     packed = [_pack_src(items, row_payload_bytes) for items in blobs]
     w = _HDR_WORDS + row_payload_bytes // 4
     nmax = max((r.shape[0] for r, _ in packed), default=0) or 1
@@ -167,7 +168,10 @@ class ExchangeFetchClient(InputClient):
     the reference ACK carries both lengths (RDMAServer.cc:597-607) —
     not because the decompression path needs it: DecompressingClient
     tracks uncompressed progress itself and never reads the inner
-    raw_length. Defaults to the on-wire length (uncompressed)."""
+    raw_length. Defaults to the on-wire length — correct ONLY for
+    uncompressed segments, so callers exchanging codec-compressed bytes
+    MUST pass ``raw_lengths`` (run_reduces_mesh does) or
+    FetchResult.raw_length misreports the part_length."""
 
     def __init__(self, segments: dict[str, bytes],
                  raw_lengths: Optional[dict[str, int]] = None):
