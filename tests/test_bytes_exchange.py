@@ -117,6 +117,9 @@ except ImportError:           # pragma: no cover - hypothesis is baked in
     _HYP = False
 
 if _HYP:
+    import pytest as _pytest
+
+    @_pytest.mark.slow
     @settings(max_examples=15, deadline=None)
     @given(st.lists(st.lists(st.tuples(st.integers(0, 3),
                                        st.binary(max_size=300)),

@@ -117,6 +117,7 @@ def test_distributed_sort_step_overflow_detected():
         res.check()
 
 
+@pytest.mark.slow
 def test_distributed_sort_auto_multiround_completes_skew():
     # same massive skew, default policy: the multi-round backlog path
     # must drain it completely with capacity << bucket size
@@ -137,6 +138,7 @@ def test_distributed_sort_auto_multiround_completes_skew():
     assert keys == sorted(keys)
 
 
+@pytest.mark.slow
 def test_multiround_matches_fused_exactly():
     # on non-overflowing data, "always" must produce the same per-shard
     # valid rows as the fused single-round program (incl. duplicate-key
@@ -162,6 +164,7 @@ def test_multiround_matches_fused_exactly():
         np.testing.assert_array_equal(fw[d, :fv[d]], mw[d, :mv[d]])
 
 
+@pytest.mark.slow
 def test_lanes_payload_path_matches_gather_exactly():
     # the Pallas lanes engine (interpret mode on the CPU mesh) must
     # reproduce the gather path byte-for-byte: identical sort key
@@ -188,6 +191,7 @@ def test_lanes_payload_path_matches_gather_exactly():
                                   np.asarray(lanes.words))
 
 
+@pytest.mark.slow
 def test_lanes_payload_path_multiround_skew():
     # lanes engine under the windowed multi-round accumulator sort
     mesh = _mesh()
@@ -235,6 +239,7 @@ def test_exchange_record_batches_host():
     ]
 
 
+@pytest.mark.slow
 def test_two_axis_dcn_ici_mesh_matches_flat():
     # multi-pod shape: a (dcn=2, shuffle=4) mesh with rows sharded over
     # BOTH axes must produce byte-identical results to the flat 8-way
@@ -270,6 +275,7 @@ def test_two_axis_dcn_ici_mesh_matches_flat():
     assert nv[0] == 512 and nv[1:].sum() == 0
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [41, 42, 43])
 def test_distributed_sort_randomized_boundaries(seed):
     # randomized shapes/capacities around the rounding boundaries the

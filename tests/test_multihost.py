@@ -10,6 +10,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # spawns real multi-process meshes
+
 
 def _free_port() -> int:
     s = socket.socket()

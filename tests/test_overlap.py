@@ -47,6 +47,7 @@ def test_overlap_matches_global_resort():
     assert not om.stats["overflow"]
 
 
+@pytest.mark.slow
 def test_overlap_pallas_engine_matches_host():
     # force the device merge-path kernel (interpret mode on CPU): the
     # integration the TPU deployment runs, against the host twin

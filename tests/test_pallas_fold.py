@@ -5,6 +5,8 @@ import pytest
 
 from uda_tpu.ops import pallas_fold, pallas_sort
 
+pytestmark = pytest.mark.slow  # interpret-mode Pallas kernels
+
 
 def _keys(n, seed, dup=False):
     rng = np.random.default_rng(seed)

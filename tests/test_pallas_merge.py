@@ -5,6 +5,8 @@ import pytest
 
 from uda_tpu.ops import pallas_merge
 
+pytestmark = pytest.mark.slow  # interpret-mode Pallas kernels
+
 
 def _sorted_run(n, w, num_keys, seed, dup_rate=0.0):
     rng = np.random.default_rng(seed)

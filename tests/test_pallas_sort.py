@@ -5,6 +5,8 @@ import pytest
 
 from uda_tpu.ops import pallas_sort
 
+pytestmark = pytest.mark.slow  # interpret-mode Pallas kernels
+
 
 def _gen(n, num_keys=3, dup_rate=0.0, seed=0, payload_rows=None):
     rng = np.random.default_rng(seed)

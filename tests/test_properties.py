@@ -19,6 +19,8 @@ from uda_tpu.utils import comparators, vint
 from uda_tpu.utils.ifile import (IFileReader, IFileWriter, crack,
                                  crack_partial, write_records)
 
+pytestmark = pytest.mark.slow  # property sweeps (hypothesis) dominate the suite
+
 # CI-fast but NOT derandomized: a frozen example set would never
 # explore new inputs across runs (reproduce failures via the printed
 # @reproduce_failure blob / hypothesis example database)

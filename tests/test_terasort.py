@@ -36,6 +36,7 @@ def test_single_chip_sort_gather_path_matches_carry():
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_single_chip_sort_all_engines_match_carry():
     # every public engine, byte-identical to the carry oracle — with a
     # non-power-of-two n (padding engages), duplicate keys (stability),
@@ -70,6 +71,7 @@ def test_teragen_lanes_matches_layout():
     assert x[terasort.RECORD_WORDS:].max() == 0  # layout pad rows zero
 
 
+@pytest.mark.slow
 def test_bench_step_lanes_path_validates():
     # interpret=True: Pallas kernels run on the CPU test backend
     viol, ck_in, ck_out = terasort.bench_step(
@@ -78,6 +80,7 @@ def test_bench_step_lanes_path_validates():
     assert np.uint32(ck_in) == np.uint32(ck_out)
 
 
+@pytest.mark.slow
 def test_bench_step_keys8_path_validates():
     for path in ("keys8", "keys8f"):
         viol, ck_in, ck_out = terasort.bench_step(
@@ -101,6 +104,7 @@ def test_bench_step_carrychunk_path_validates():
     assert np.uint32(ck_in) == np.uint32(ck_out)
 
 
+@pytest.mark.slow
 def test_sort_lanes_keys8_matches_sort_lanes():
     # the keys8 engine (keys-only cascade + one global payload gather)
     # must be byte-identical to the 32-row pipeline, stability included,
@@ -118,6 +122,7 @@ def test_sort_lanes_keys8_matches_sort_lanes():
         np.testing.assert_array_equal(a, b, err_msg=f"folded={folded}")
 
 
+@pytest.mark.slow
 def test_bench_step_lanes_checksum_matches_oracle():
     # the lanes checksum must use the same per-column multipliers as the
     # SoA paths: a sorted output altered by a column swap fails
@@ -190,6 +195,7 @@ def test_distributed_terasort_8dev():
     terasort.validate_sorted(rows, words)
 
 
+@pytest.mark.slow
 def test_graft_entry_contract():
     import __graft_entry__ as g
 

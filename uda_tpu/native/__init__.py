@@ -33,23 +33,27 @@ log = get_logger()
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libuda_tpu_native.so")
 _lib = None
-_lib_lock = threading.Lock()
+_lib_stale = False  # cached "old .so lacks newer symbols" outcome
+_lib_lock = threading.RLock()
 
 
 def _load():
-    global _lib
+    global _lib, _lib_stale
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
+        if _lib_stale or not os.path.exists(_SO):
             return None
         try:
             lib = _bind(ctypes.CDLL(_SO))
         except AttributeError as e:
             # a stale .so from an older build lacks newer symbols; fall
-            # back to pure Python rather than poisoning every caller
+            # back to pure Python rather than poisoning every caller.
+            # Cached (and cleared by a successful build()) so hot paths
+            # don't re-dlopen + re-warn per call.
             log.warn(f"native library is stale ({e}); rebuild with "
                      f"`make -C uda_tpu/native` — using pure Python")
+            _lib_stale = True
             return None
         _lib = lib
         return lib
@@ -105,26 +109,31 @@ def build(quiet: bool = True) -> bool:
     STALE library (older than its sources, e.g. after a pull) is
     rebuilt instead of crashing symbol binds. The outcome (either way)
     is remembered so later callers don't re-spawn make per DataEngine
-    construction."""
-    global _build_attempted, _build_ok, _lib
-    if _build_attempted:
-        return _build_ok
-    _build_attempted = True
-    try:
-        subprocess.run(["make", "-C", _DIR],
-                       check=True, capture_output=quiet)
-        _lib = None  # rebind in case make refreshed a stale .so
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
-        if os.path.exists(_SO):
-            log.warn(f"native rebuild failed; keeping the existing "
-                     f"library: {e}")
-            _build_ok = available()
+    construction. Thread-safe via the lib lock; concurrent PROCESSES
+    are safe because the Makefile links to a temp file and renames
+    (dlopen never sees a half-written .so) and make itself no-ops when
+    the library is current."""
+    global _build_attempted, _build_ok, _lib, _lib_stale
+    with _lib_lock:
+        if _build_attempted:
             return _build_ok
-        log.warn(f"native build failed, using pure-Python codec: {e}")
-        _build_ok = False
-        return False
-    _build_ok = available()
-    return _build_ok
+        _build_attempted = True
+        try:
+            subprocess.run(["make", "-C", _DIR],
+                           check=True, capture_output=quiet)
+            _lib = None       # rebind in case make refreshed a stale .so
+            _lib_stale = False
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            if os.path.exists(_SO):
+                log.warn(f"native rebuild failed; keeping the existing "
+                         f"library: {e}")
+                _build_ok = available()
+                return _build_ok
+            log.warn(f"native build failed, using pure-Python codec: {e}")
+            _build_ok = False
+            return False
+        _build_ok = available()
+        return _build_ok
 
 
 def _u8ptr(arr: np.ndarray):

@@ -35,6 +35,11 @@ constexpr int64_t kErrCorrupt = -1;   // bad framing / missing EOF marker
 constexpr int64_t kErrTooSmall = -3;  // record larger than the out block
 constexpr int64_t kErrIo = -4;        // read() failure
 
+// Sanity bound on a single key/value length: a corrupt VLong must fail
+// as kErrCorrupt, not overflow int64 arithmetic or balloon the cursor
+// buffer until bad_alloc escapes the C boundary.
+constexpr int64_t kMaxPartLen = int64_t{1} << 30;  // 1 GiB
+
 // One spill-file cursor: buffered sequential reads, one parsed record
 // at a time (rec/key offsets point into buf and stay valid until the
 // cursor's own next advance — the merge copies the record out before
@@ -87,7 +92,10 @@ struct Cursor {
             exhausted = true;
             return 0;
           }
-          if (klen < 0 || vlen < 0) return kErrCorrupt;
+          if (klen < 0 || vlen < 0 ||
+              klen > kMaxPartLen || vlen > kMaxPartLen) {
+            return kErrCorrupt;
+          }
           if (p + klen + vlen <= filled) {
             rec_off = start;
             rec_len = (p + klen + vlen) - start;
