@@ -33,8 +33,8 @@ import static java.lang.foreign.ValueLayout.JAVA_LONG;
 public final class UdaBridge {
 
     /** Up-call surface, the UdaCallable of the reference (the subset a
-     *  consumer plugin needs; index/conf resolution stays native-side
-     *  via INIT local dirs). */
+     *  consumer plugin needs; index/conf resolution is the separate
+     *  PathResolver/ConfSource surface below). */
     public interface Callable {
         void fetchOverMessage();
 
@@ -43,6 +43,37 @@ public final class UdaBridge {
         void logToJava(int level, String message);
 
         void failureInUda(String what);
+    }
+
+    /** One reduce partition of one map output — the Java view of the
+     *  shim's uda_index_record_t (bridge_shim.cc:41-46; reference
+     *  index_record_t, src/MOFServer/IndexInfo.h:98-104). */
+    public static final class IndexRecord {
+        public final String path;
+        public final long startOffset;
+        public final long rawLength;
+        public final long partLength;
+
+        public IndexRecord(String path, long startOffset, long rawLength,
+                           long partLength) {
+            this.path = path;
+            this.startOffset = startOffset;
+            this.rawLength = rawLength;
+            this.partLength = partLength;
+        }
+    }
+
+    /** Supplier-side index resolution (the getPathUda up-call target,
+     *  reference UdaBridge.cc:352-438 -> UdaPluginSH.getPathIndex,
+     *  UdaPluginSH.java:107-144). Return null on failure. */
+    public interface PathResolver {
+        IndexRecord getPathIndex(String jobId, String mapId, int reduce);
+    }
+
+    /** Pull-based conf channel (the getConfData up-call, reference
+     *  UdaBridge.cc:441-471 -> UdaPluginRT.getDataFromConf). */
+    public interface ConfSource {
+        String get(String name, String defaultValue);
     }
 
     private static final Linker LINKER = Linker.nativeLinker();
@@ -57,14 +88,29 @@ public final class UdaBridge {
     private final Callable callable;
     // One live bridge per process (the shim keeps process-global state,
     // like the reference's single reduce task per NetMerger process,
-    // reducer.h:137); the up-call receiver binds at start(), not at
+    // reducer.h:137); the up-call receivers bind at start(), not at
     // construction, so building a second instance cannot steal a live
     // bridge's callbacks.
     private static volatile Callable target;
+    private static volatile PathResolver pathResolver;
+    private static volatile ConfSource confSource;
+    private final PathResolver resolver;
+    private final ConfSource conf;
 
     public UdaBridge(String libraryPath, Callable callable)
             throws Throwable {
+        this(libraryPath, callable, null, null);
+    }
+
+    /** Full surface: a consumer embedding passes a Callable; a supplier
+     *  embedding additionally registers the PathResolver (and either
+     *  may expose pull-based conf). */
+    public UdaBridge(String libraryPath, Callable callable,
+                     PathResolver resolver, ConfSource conf)
+            throws Throwable {
         this.callable = callable;
+        this.resolver = resolver;
+        this.conf = conf;
         SymbolLookup lib = SymbolLookup.libraryLookup(libraryPath, ARENA);
         hStart = LINKER.downcallHandle(
                 lib.find("uda_bridge_start").orElseThrow(),
@@ -101,6 +147,59 @@ public final class UdaBridge {
         MemorySegment.copy(data.reinterpret(len), JAVA_BYTE, 0, out, 0,
                 (int) len);
         t.dataFromUda(out);
+    }
+
+    // uda_index_record_t layout (bridge_shim.cc:41-46):
+    // char path[4096]; long long start_offset, raw_length, part_length
+    private static final long REC_PATH_CAP = 4096;
+    private static final long REC_SIZE = 4096 + 3 * 8;
+
+    private static int cbGetPath(MemorySegment ctx, MemorySegment job,
+                                 MemorySegment map, int reduce,
+                                 MemorySegment rec) {
+        PathResolver r = pathResolver;
+        if (r == null) return 1;
+        try {
+            IndexRecord ir = r.getPathIndex(
+                    job.reinterpret(1 << 16).getString(0),
+                    map.reinterpret(1 << 16).getString(0), reduce);
+            if (ir == null) return 1;
+            byte[] path = ir.path.getBytes(
+                    java.nio.charset.StandardCharsets.UTF_8);
+            if (path.length >= REC_PATH_CAP) return 1;
+            MemorySegment out = rec.reinterpret(REC_SIZE);
+            MemorySegment.copy(path, 0, out, JAVA_BYTE, 0, path.length);
+            out.set(JAVA_BYTE, path.length, (byte) 0);
+            out.set(JAVA_LONG, 4096, ir.startOffset);
+            out.set(JAVA_LONG, 4104, ir.rawLength);
+            out.set(JAVA_LONG, 4112, ir.partLength);
+            return 0;
+        } catch (Throwable t) {
+            // never let an exception unwind into native
+            return 1;
+        }
+    }
+
+    private static void cbGetConf(MemorySegment ctx, MemorySegment name,
+                                  MemorySegment dflt, MemorySegment out,
+                                  int cap) {
+        String value = null;
+        try {
+            String dfltStr = dflt.reinterpret(1 << 16).getString(0);
+            ConfSource c = confSource;
+            value = c == null ? dfltStr
+                    : c.get(name.reinterpret(1 << 16).getString(0), dfltStr);
+            if (value == null) value = dfltStr;
+        } catch (Throwable t) {
+            value = "";
+        }
+        if (cap <= 0) return;
+        byte[] bytes = value.getBytes(
+                java.nio.charset.StandardCharsets.UTF_8);
+        int n = Math.min(bytes.length, cap - 1);
+        MemorySegment o = out.reinterpret(cap);
+        MemorySegment.copy(bytes, 0, o, JAVA_BYTE, 0, n);
+        o.set(JAVA_BYTE, n, (byte) 0);
     }
 
     private static void cbLogTo(MemorySegment ctx, int level,
@@ -142,6 +241,22 @@ public final class UdaBridge {
                         MethodType.methodType(void.class,
                                 MemorySegment.class, MemorySegment.class)),
                 FunctionDescriptor.ofVoid(ADDRESS, ADDRESS), ARENA);
+        MemorySegment getPath = LINKER.upcallStub(
+                l.findStatic(UdaBridge.class, "cbGetPath",
+                        MethodType.methodType(int.class,
+                                MemorySegment.class, MemorySegment.class,
+                                MemorySegment.class, int.class,
+                                MemorySegment.class)),
+                FunctionDescriptor.of(JAVA_INT, ADDRESS, ADDRESS, ADDRESS,
+                        JAVA_INT, ADDRESS), ARENA);
+        MemorySegment getConf = LINKER.upcallStub(
+                l.findStatic(UdaBridge.class, "cbGetConf",
+                        MethodType.methodType(void.class,
+                                MemorySegment.class, MemorySegment.class,
+                                MemorySegment.class, MemorySegment.class,
+                                int.class)),
+                FunctionDescriptor.ofVoid(ADDRESS, ADDRESS, ADDRESS,
+                        ADDRESS, JAVA_INT), ARENA);
         // uda_callbacks_t: {ctx, fetch_over_message, data_from_uda,
         //                   get_path_uda, get_conf_data, log_to,
         //                   failure_in_uda} — 7 pointers
@@ -149,9 +264,8 @@ public final class UdaBridge {
         cbs.set(ADDRESS, 0, MemorySegment.NULL);        // ctx
         cbs.set(ADDRESS, 8, fetchOver);
         cbs.set(ADDRESS, 16, dataFrom);
-        cbs.set(ADDRESS, 24, MemorySegment.NULL);       // get_path_uda:
-        cbs.set(ADDRESS, 32, MemorySegment.NULL);       // get_conf_data:
-        // resolution runs native-side through INIT local dirs
+        cbs.set(ADDRESS, 24, getPath);   // -> PathResolver (or rc=1)
+        cbs.set(ADDRESS, 32, getConf);   // -> ConfSource (or default)
         cbs.set(ADDRESS, 40, logTo);
         cbs.set(ADDRESS, 48, failure);
         return cbs;
@@ -161,7 +275,10 @@ public final class UdaBridge {
     // reduceExitMsgNative / setLogLevelNative) --------------------------
 
     public void start(boolean isNetMerger, String[] argv) throws Throwable {
-        target = callable; // the live bridge's receiver (see field note)
+        // the live bridge's receivers (see field note)
+        target = callable;
+        pathResolver = resolver;
+        confSource = conf;
         // per-call natives live in a confined arena: freed on return
         // (the shim copies argv into Python strings during the call)
         try (Arena a = Arena.ofConfined()) {

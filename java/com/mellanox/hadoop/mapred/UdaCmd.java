@@ -1,0 +1,34 @@
+// The count:header:params command protocol (reference UdaCmd,
+// plugins/shared/.../UdaPlugin.java:562-587; Python twin:
+// uda_tpu/bridge/protocol.py — the enum values must stay identical).
+package com.mellanox.hadoop.mapred;
+
+import java.util.List;
+
+final class UdaCmd {
+
+    static final int EXIT_COMMAND = 0;
+    static final int NEW_MAP_COMMAND = 1;
+    static final int FINAL_MERGE_COMMAND = 2;
+    static final int RESULT_COMMAND = 3;
+    static final int FETCH_COMMAND = 4;
+    static final int FETCH_OVER_COMMAND = 5;
+    static final int JOB_OVER_COMMAND = 6;
+    static final int INIT_COMMAND = 7;
+    static final int MORE_COMMAND = 8;
+    static final int NETLEV_REDUCE_LAUNCHED = 9;
+    private static final char SEPARATOR = ':';
+
+    private UdaCmd() {
+    }
+
+    /** num_params:cmd:param1:param2... */
+    static String formCmd(int cmd, List<String> params) {
+        StringBuilder sb = new StringBuilder();
+        sb.append(params.size()).append(SEPARATOR).append(cmd);
+        for (String p : params) {
+            sb.append(SEPARATOR).append(p);
+        }
+        return sb.toString();
+    }
+}

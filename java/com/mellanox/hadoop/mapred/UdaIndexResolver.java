@@ -1,0 +1,151 @@
+// The spill-index resolution core: (job, map, reduce) -> IndexRecord,
+// served out of a bounded LRU cache over Hadoop spill-index files.
+//
+// This is the reference's IndexCacheBridge + UdaPluginSH.getPathIndex
+// pair (plugins/shared/org/apache/hadoop/mapred/IndexCacheBridge.java,
+// plugins/mlx-2.x/.../UdaPluginSH.java:107-144) as one reusable class:
+// UdaPluginSH composes it for the NodeManager service, and a consumer
+// embedding can register it directly as the bridge's PathResolver
+// (conf key uda.tpu.path.resolver.class) to exercise the getPathUda
+// round trip in-process. The index file format is the Hadoop one —
+// 24-byte (start, raw, part) big-endian triples, the same bytes
+// uda_tpu/mofserver/index.py reads and writes.
+package com.mellanox.hadoop.mapred;
+
+import java.io.DataInputStream;
+import java.io.File;
+import java.io.FileInputStream;
+import java.io.IOException;
+import java.util.LinkedHashMap;
+import java.util.Map;
+import java.util.concurrent.ConcurrentHashMap;
+import java.util.logging.Logger;
+
+import org.apache.hadoop.mapred.JobConf;
+import org.apache.hadoop.mapred.JobID;
+
+public class UdaIndexResolver implements UdaBridge.PathResolver {
+
+    static final Logger LOG =
+            Logger.getLogger(UdaIndexResolver.class.getName());
+
+    private static final int INDEX_CACHE_ENTRIES = 1024;
+
+    private final JobConf jobConf;
+    private final Map<String, String> userByJob =
+            new ConcurrentHashMap<>();
+    // (job, map) -> index triples; LRU-bounded like the reference's
+    // mapreduce.tasktracker.indexcache.mb budget
+    private final Map<String, long[][]> indexCache =
+            java.util.Collections.synchronizedMap(
+                    new LinkedHashMap<>(64, 0.75f, true) {
+                        @Override
+                        protected boolean removeEldestEntry(
+                                Map.Entry<String, long[][]> eldest) {
+                            return size() > INDEX_CACHE_ENTRIES;
+                        }
+                    });
+
+    public UdaIndexResolver(JobConf jobConf) {
+        this.jobConf = jobConf;
+    }
+
+    public void addJob(String user, JobID jobId) {
+        userByJob.put(jobId.toString(), user);
+    }
+
+    public void removeJob(JobID jobId) {
+        userByJob.remove(jobId.toString());
+        synchronized (indexCache) {
+            indexCache.keySet().removeIf(
+                    k -> k.startsWith(jobId.toString() + "/"));
+        }
+    }
+
+    /** Roots to search: uda.tpu.index.local.dirs when set (a supplier
+     *  embedded in a consumer process serves from dirs the reduce task
+     *  does not list as its own), else the job's local dirs. */
+    private String[] roots() {
+        String[] own = jobConf.getTrimmedStrings("uda.tpu.index.local.dirs");
+        return own.length > 0 ? own : jobConf.getLocalDirs();
+    }
+
+    /** MOF directory of one map output: the YARN
+     *  usercache/<user>/appcache/<app>/output/<map> layout when the job
+     *  has a registered user (UdaPluginSH.java:107-137), else the flat
+     *  <root>/<job>/<map> layout of uda_tpu's DirIndexResolver. */
+    private File mapDir(String root, String jobIdStr, String mapId) {
+        String user = userByJob.get(jobIdStr);
+        if (user != null) {
+            JobID jobId = JobID.forName(jobIdStr);
+            String app = "application_" + jobId.getJtIdentifier() + "_"
+                    + String.format("%04d", jobId.getId());
+            return new File(root, "usercache/" + user + "/appcache/" + app
+                    + "/output/" + mapId);
+        }
+        return new File(new File(root, jobIdStr), mapId);
+    }
+
+    @Override
+    public UdaBridge.IndexRecord getPathIndex(String jobId, String mapId,
+                                              int reduce) {
+        String cacheKey = jobId + "/" + mapId;
+        long[][] triples = indexCache.get(cacheKey);
+        File mof = null;
+        for (String root : roots()) {
+            File dir = mapDir(root.trim(), jobId, mapId);
+            File candidate = new File(dir, "file.out");
+            if (candidate.isFile()) {
+                mof = candidate;
+                if (triples == null) {
+                    try {
+                        triples = readIndexFile(
+                                new File(dir, "file.out.index"));
+                        indexCache.put(cacheKey, triples);
+                    } catch (IOException e) {
+                        LOG.severe("got an exception while retrieving the "
+                                + "index info: " + e);
+                        return null;
+                    }
+                }
+                break;
+            }
+        }
+        if (mof == null || triples == null) {
+            LOG.severe("no MOF for " + jobId + "/" + mapId
+                    + " under local dirs");
+            return null;
+        }
+        if (reduce < 0 || reduce >= triples.length) {
+            LOG.severe("reduce " + reduce + " out of range for " + mapId
+                    + " (" + triples.length + " partitions)");
+            return null;
+        }
+        long[] t = triples[reduce];
+        return new UdaBridge.IndexRecord(mof.getPath(), t[0], t[1], t[2]);
+    }
+
+    /** Hadoop spill index: (start, raw, part) 8-byte BE triples
+     *  (uda_tpu/mofserver/index.py read_index_file twin). */
+    static long[][] readIndexFile(File index) throws IOException {
+        long size = index.length();
+        if (size % 24 != 0) {
+            throw new IOException("index file " + index + " length " + size
+                    + " not a multiple of 24");
+        }
+        long[][] out = new long[(int) (size / 24)][3];
+        try (DataInputStream in = new DataInputStream(
+                new FileInputStream(index))) {
+            for (long[] triple : out) {
+                triple[0] = in.readLong();
+                triple[1] = in.readLong();
+                triple[2] = in.readLong();
+                if (triple[0] < 0 || triple[1] < 0 || triple[2] < 0) {
+                    throw new IOException(
+                            "negative field in index record of " + index);
+                }
+            }
+        }
+        return out;
+    }
+}

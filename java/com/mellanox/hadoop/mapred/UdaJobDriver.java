@@ -1,0 +1,161 @@
+// UdaJobDriver — a JVM process driving the FULL Hadoop plugin stack
+// end-to-end: UdaShuffleConsumerPlugin.init(Context) constructs
+// UdaPluginRT (shuffle-memory budget + INIT over the bridge), a fake
+// umbilical feeds map-completion events to the GetMapEventsThread
+// (dedupe + fetch + final merge), run() returns the J2CQueue
+// RawKeyValueIterator, and the driver drains it through the KVBuf ring
+// — the whole consumer path a real ReduceTask would execute, plus the
+// supplier-side getPathUda round trip when the resolver mode is on.
+//
+// Usage:
+//   java --enable-native-access=ALL-UNNAMED \
+//        com.mellanox.hadoop.mapred.UdaJobDriver \
+//        <libuda_tpu_bridge.so> <mof_root> <job_id> <num_maps> <out> \
+//        <mode: dirs | upcall>
+//
+// mode=dirs:   INIT carries the MOF root as a local dir (engine-side
+//              DirIndexResolver).
+// mode=upcall: INIT carries NO dirs; the engine resolves every map
+//              output through the get_path_uda up-call into
+//              UdaIndexResolver (the reference's IndexCache round trip,
+//              UdaBridge.cc:352-438 -> UdaPluginSH.java:107-144).
+//
+// The merged records are re-framed (VInt klen, VInt vlen, key, value +
+// EOF marker) into <out> for the Python caller to validate byte-level.
+package com.mellanox.hadoop.mapred;
+
+import java.io.DataOutputStream;
+import java.io.FileOutputStream;
+import java.io.IOException;
+import java.util.ArrayList;
+import java.util.List;
+
+import org.apache.hadoop.io.DataInputBuffer;
+import org.apache.hadoop.io.WritableUtils;
+import org.apache.hadoop.mapred.JobID;
+import org.apache.hadoop.mapred.MapTaskCompletionEventsUpdate;
+import org.apache.hadoop.mapred.RawKeyValueIterator;
+import org.apache.hadoop.mapred.Reporter;
+import org.apache.hadoop.mapred.ShuffleConsumerPlugin;
+import org.apache.hadoop.mapred.TaskAttemptID;
+import org.apache.hadoop.mapred.TaskCompletionEvent;
+import org.apache.hadoop.mapred.TaskUmbilicalProtocol;
+import org.apache.hadoop.mapred.JobConf;
+
+public final class UdaJobDriver {
+
+    /** Serves SUCCEEDED events in two batches (exercising incremental
+     *  fromEventId) and prepends a duplicate attempt of map 0 (the
+     *  dedupe path, UdaShuffleConsumerPluginShared.java:546-566). */
+    private static final class FakeUmbilical
+            implements TaskUmbilicalProtocol {
+
+        private final List<TaskCompletionEvent> events = new ArrayList<>();
+
+        FakeUmbilical(String job, int numMaps) {
+            for (int m = 0; m < numMaps; m++) {
+                String attempt = String.format("attempt_%s_m_%06d_0",
+                        job.substring("job_".length()), m);
+                events.add(new TaskCompletionEvent(
+                        TaskCompletionEvent.Status.SUCCEEDED,
+                        TaskAttemptID.forName(attempt),
+                        "http://localhost:8080"));
+                if (m == 0) {
+                    // a second attempt of the same task: must be ignored
+                    events.add(new TaskCompletionEvent(
+                            TaskCompletionEvent.Status.SUCCEEDED,
+                            TaskAttemptID.forName(String.format(
+                                    "attempt_%s_m_%06d_1",
+                                    job.substring("job_".length()), m)),
+                            "http://localhost:8080"));
+                }
+            }
+        }
+
+        @Override
+        public MapTaskCompletionEventsUpdate getMapCompletionEvents(
+                JobID jobId, int fromEventId, int maxLocs,
+                TaskAttemptID reduceId) {
+            int half = Math.max(1, events.size() / 2);
+            int upto = fromEventId == 0 ? half : events.size();
+            if (fromEventId >= events.size()) {
+                return new MapTaskCompletionEventsUpdate(
+                        new TaskCompletionEvent[0], false);
+            }
+            List<TaskCompletionEvent> batch =
+                    events.subList(fromEventId, upto);
+            return new MapTaskCompletionEventsUpdate(
+                    batch.toArray(new TaskCompletionEvent[0]), false);
+        }
+    }
+
+    public static void main(String[] args) throws Exception {
+        if (args.length != 6) {
+            System.err.println("usage: UdaJobDriver <lib> <root> <job> "
+                    + "<num_maps> <out> <dirs|upcall>");
+            System.exit(2);
+        }
+        String lib = args[0], root = args[1], job = args[2], out = args[4];
+        int numMaps = Integer.parseInt(args[3]);
+        boolean upcall = args[5].equals("upcall");
+
+        JobConf conf = new JobConf();
+        conf.set("uda.tpu.bridge.library", lib);
+        conf.set("mapreduce.job.maps", Integer.toString(numMaps));
+        conf.set("mapreduce.job.output.key.class", "uda.tpu.RawBytes");
+        if (upcall) {
+            // no local dirs in INIT -> the engine resolves through the
+            // get_path_uda up-call into UdaIndexResolver
+            conf.set("uda.tpu.path.resolver.class",
+                    "com.mellanox.hadoop.mapred.UdaIndexResolver");
+            conf.set("uda.tpu.index.local.dirs", root);
+        } else {
+            conf.set("mapred.local.dir", root);
+        }
+
+        String jt = job.substring("job_".length(),
+                job.lastIndexOf('_'));
+        String jobNum = job.substring(job.lastIndexOf('_') + 1);
+        TaskAttemptID reduceId = TaskAttemptID.forName(
+                "attempt_" + jt + "_" + jobNum + "_r_000000_0");
+        Reporter reporter = new Reporter() {
+            @Override
+            public void progress() {
+            }
+
+            @Override
+            public void setStatus(String status) {
+            }
+        };
+
+        UdaShuffleConsumerPlugin<byte[], byte[]> plugin =
+                new UdaShuffleConsumerPlugin<>();
+        plugin.init(new ShuffleConsumerPlugin.Context<>(reduceId, conf,
+                reporter, new FakeUmbilical(job, numMaps)));
+        RawKeyValueIterator it = plugin.run();
+
+        int records = 0;
+        try (DataOutputStream o = new DataOutputStream(
+                new FileOutputStream(out))) {
+            while (it.next()) {
+                DataInputBuffer k = it.getKey();
+                DataInputBuffer v = it.getValue();
+                int klen = k.getLength() - k.getPosition();
+                int vlen = v.getLength() - v.getPosition();
+                WritableUtils.writeVInt(o, klen);
+                WritableUtils.writeVInt(o, vlen);
+                o.write(k.getData(), k.getPosition(), klen);
+                o.write(v.getData(), v.getPosition(), vlen);
+                records++;
+            }
+            o.writeByte(0xFF);  // EOF marker: VInt(-1) VInt(-1)
+            o.writeByte(0xFF);
+        }
+        plugin.close();
+        System.out.println("JVM-PLUGIN-OK " + records + " records mode="
+                + args[5]);
+    }
+
+    private UdaJobDriver() {
+    }
+}

@@ -1,0 +1,525 @@
+// UdaPluginRT — the reduce-task side of the plugin layer: owns the
+// bridge lifecycle, the shuffle-memory budget, the INIT construction,
+// the KVBuf staging ring, and the J2CQueue RawKeyValueIterator the
+// reduce consumes.
+//
+// Re-creation of the reference's UdaPluginRT (plugins/shared/com/
+// mellanox/hadoop/mapred/UdaPlugin.java:146-556) against the uda_tpu
+// bridge:
+//
+// - shuffle-memory budget: mapred.rdma.shuffle.total.size when set,
+//   else maxHeap * mapred.job.shuffle.input.buffer.percent (default
+//   0.7, out-of-range values reset to default) — UdaPlugin.java:209-244;
+// - INIT construction: the 10-param layout + checked local dirs that
+//   uda_tpu/bridge/bridge.py:263-316 parses (num_maps, job, reduce,
+//   lpq_size, buf(B), min_buf(B), key class, codec, codec block size,
+//   shuffle memory, num_dirs, dirs...) — UdaPlugin.java:266-316;
+// - KVBuf ring: kv_buf_num staging buffers cycling between
+//   RECV_READY/REDC_READY under per-buffer monitors — :164-179,
+//   :368-402;
+// - J2CQueue implements RawKeyValueIterator: walks the VInt-framed
+//   record stream out of the ring — :435-555. One deliberate redesign:
+//   uda_tpu's emitter cuts blocks at exactly the block size, so records
+//   MAY span blocks; J2CQueue carries the partial-record tail into the
+//   next buffer (the join the reference ran native-side,
+//   src/Merger/StreamRW.cc:542-590);
+// - 1 Hz log-level re-sync into the native side — UdaPlugin.java:99-143
+//   (java.util.logging here; the JDK has no commons-logging).
+//
+// fetchOverMessage: the engine reports fetch progress per 20 segments
+// plus once at fetch completion (bridge.py INIT wiring of the
+// MergeManager progress hook), and the count-against-numMaps rule below
+// decides fetch-phase completion — the reference's exact contract.
+package com.mellanox.hadoop.mapred;
+
+import java.io.EOFException;
+import java.io.IOException;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.Timer;
+import java.util.TimerTask;
+import java.util.logging.Level;
+import java.util.logging.Logger;
+
+import org.apache.hadoop.io.DataInputBuffer;
+import org.apache.hadoop.io.WritableUtils;
+import org.apache.hadoop.mapred.JobConf;
+import org.apache.hadoop.mapred.RawKeyValueIterator;
+import org.apache.hadoop.mapred.Reporter;
+import org.apache.hadoop.mapred.TaskAttemptID;
+import org.apache.hadoop.util.Progress;
+
+public class UdaPluginRT<K, V> implements UdaBridge.Callable {
+
+    static final Logger LOG =
+            Logger.getLogger(UdaPluginRT.class.getName());
+
+    private static final float DEFAULT_SHUFFLE_INPUT_PERCENT = 0.7f;
+    static final int KV_BUF_NUM = 2;            // reference kv_buf_num
+    static final int KV_BUF_SIZE = 1 << 20;     // reference 1 MB staging
+
+    private final UdaShuffleConsumerPluginShared<K, V> udaShuffleConsumer;
+    private final TaskAttemptID reduceId;
+    private final JobConf jobConf;
+    private final Reporter reporter;
+    private final int numMaps;
+    private final UdaBridge bridge;
+    private final Progress progress = new Progress();
+    private final KVBuf[] kvBufs = new KVBuf[KV_BUF_NUM];
+    private final J2CQueue j2cQueue = new J2CQueue();
+    private final Timer logLevelTimer = new Timer("uda-log-level", true);
+    private int curKvIdx = 0;   // producer cursor over the ring
+    private int lastLogLevel = -1;
+    // closing: producers drop data instead of blocking on the ring, so
+    // reduceExit's merge-thread join cannot deadlock on an abandoned
+    // J2CQueue (abnormal close with both buffers REDC_READY)
+    private volatile boolean shutdown = false;
+
+    public UdaPluginRT(UdaShuffleConsumerPluginShared<K, V> consumer,
+                       TaskAttemptID reduceId, JobConf jobConf,
+                       Reporter reporter, int numMaps) throws IOException {
+        this.udaShuffleConsumer = consumer;
+        this.reduceId = reduceId;
+        this.jobConf = jobConf;
+        this.reporter = reporter;
+        this.numMaps = numMaps;
+        for (int i = 0; i < KV_BUF_NUM; i++) {
+            kvBufs[i] = new KVBuf(KV_BUF_SIZE);
+        }
+
+        long maxRdmaBufferKb = jobConf.getLong("mapred.rdma.buf.size", 1024);
+        long minRdmaBufferKb =
+                jobConf.getLong("mapred.rdma.buf.size.min", 16);
+        long shuffleMemory = shuffleMemoryBudget();
+
+        if (jobConf.getSpeculativeExecution()) {
+            LOG.info("UDA has limited support for map task speculative "
+                    + "execution");
+        }
+        LOG.info("UDA: fetching " + numMaps + " segments; shuffle memory "
+                + (shuffleMemory >> 20) + " MB, buf " + maxRdmaBufferKb
+                + " KB (min " + minRdmaBufferKb + " KB)");
+
+        String lib = jobConf.get("uda.tpu.bridge.library",
+                "libuda_tpu_bridge.so");
+        try {
+            // when INIT announces no usable local dirs, the engine
+            // resolves MOF paths by up-call; a resolver class here
+            // (e.g. UdaIndexResolver) serves that round trip in-process
+            UdaBridge.PathResolver resolver = null;
+            String resolverClass =
+                    jobConf.get("uda.tpu.path.resolver.class", null);
+            if (resolverClass != null) {
+                resolver = (UdaBridge.PathResolver) Class
+                        .forName(resolverClass)
+                        .getConstructor(JobConf.class)
+                        .newInstance(jobConf);
+            }
+            bridge = new UdaBridge(lib, this, resolver,
+                    new JobConfSource());
+            bridge.start(true, buildCmdParams());
+        } catch (Throwable t) {
+            throw new IOException("failed to launch the uda_tpu bridge", t);
+        }
+        syncLogLevel();
+        logLevelTimer.schedule(new TimerTask() {
+            @Override
+            public void run() {
+                syncLogLevel();
+            }
+        }, 1000, 1000);
+
+        List<String> p = new ArrayList<>();
+        p.add(Integer.toString(numMaps));
+        p.add(reduceId.getJobID().toString());
+        p.add(Integer.toString(reduceId.getTaskID().getId()));
+        p.add(jobConf.get("mapred.netmerger.hybrid.lpq.size", "0"));
+        p.add(Long.toString(maxRdmaBufferKb * 1024));
+        p.add(Long.toString(minRdmaBufferKb * 1024));
+        p.add(jobConf.getOutputKeyClass().getName());
+        String codec = null;
+        if (jobConf.getCompressMapOutput()) {
+            codec = jobConf.get("mapred.map.output.compression.codec", null);
+        }
+        p.add(codec == null ? "0" : codec);
+        String blockSize = Integer.toString(256 * 1024);
+        if (codec != null) {
+            if (codec.contains("lzo.LzoCodec")) {
+                blockSize = jobConf.get("io.compression.codec.lzo.buffersize",
+                        blockSize);
+            } else if (codec.contains("SnappyCodec")) {
+                blockSize = jobConf.get(
+                        "io.compression.codec.snappy.buffersize", blockSize);
+            }
+        }
+        p.add(blockSize);
+        p.add(Long.toString(shuffleMemory));
+        List<String> dirs = usableLocalDirs();
+        p.add(Integer.toString(dirs.size()));
+        p.addAll(dirs);
+
+        doCommand(UdaCmd.formCmd(UdaCmd.INIT_COMMAND, p));
+        progress.set(0.5f);
+    }
+
+    /** Budget rule of UdaPlugin.java:209-244. */
+    private long shuffleMemoryBudget() {
+        long total = jobConf.getLong("mapred.rdma.shuffle.total.size", 0);
+        if (total < 0) {
+            LOG.warning("Illegal parameter value: "
+                    + "mapred.rdma.shuffle.total.size=" + total);
+        }
+        if (total > 0) {
+            LOG.info("Using mapred.rdma.shuffle.total.size to limit UDA "
+                    + "shuffle memory");
+            return total;
+        }
+        long maxHeap = Runtime.getRuntime().maxMemory();
+        float percent = jobConf.getFloat(
+                "mapred.job.shuffle.input.buffer.percent",
+                DEFAULT_SHUFFLE_INPUT_PERCENT);
+        if (percent < 0 || percent > 1) {
+            LOG.warning("mapred.job.shuffle.input.buffer.percent out of "
+                    + "range - using default "
+                    + DEFAULT_SHUFFLE_INPUT_PERCENT);
+            percent = DEFAULT_SHUFFLE_INPUT_PERCENT;
+        }
+        LOG.info("Using JAVA Xmx with "
+                + "mapred.job.shuffle.input.buffer.percent to limit UDA "
+                + "shuffle memory");
+        return (long) (maxHeap * percent);
+    }
+
+    /** Local dirs that exist and are writable (the DiskChecker pass,
+     *  UdaPlugin.java:296-311). */
+    private List<String> usableLocalDirs() {
+        List<String> ok = new ArrayList<>();
+        for (String d : jobConf.getLocalDirs()) {
+            java.io.File f = new java.io.File(d.trim());
+            if ((f.isDirectory() && f.canWrite()) || f.mkdirs()) {
+                ok.add(d.trim());
+            }
+        }
+        return ok;
+    }
+
+    /** argv of the C++ launch (buildCmdParams, UdaPlugin.java:181-201).
+     *  Short opts parsed by uda_tpu/utils/config.py. */
+    private String[] buildCmdParams() {
+        return new String[] {
+            "-w", jobConf.get("mapred.rdma.wqe.per.conn", "256"),
+            "-r", jobConf.get("mapred.rdma.cma.port", "9011"),
+            "-a", jobConf.get("mapred.netmerger.merge.approach", "1"),
+            "-m", "1",
+            "-s", jobConf.get("mapred.rdma.buf.size", "1024"),
+        };
+    }
+
+    /** Count enabled levels like the reference's calcAndCompareLogLevel
+     *  (UdaPlugin.java:80-91): fatal..trace -> 1..6. */
+    private static int currentLogLevel() {
+        Logger log = LOG;
+        int level = 0;
+        Level[] ladder = {Level.SEVERE, Level.SEVERE, Level.WARNING,
+                Level.INFO, Level.FINE, Level.FINEST};
+        for (Level l : ladder) {
+            if (log.isLoggable(l)) {
+                level++;
+            }
+        }
+        return level;
+    }
+
+    private synchronized void syncLogLevel() {
+        int level = currentLogLevel();
+        if (level == lastLogLevel) {
+            return;
+        }
+        lastLogLevel = level;
+        try {
+            bridge.setLogLevel(level);
+        } catch (Throwable t) {
+            LOG.warning("setLogLevel failed: " + t);
+        }
+    }
+
+    private void doCommand(String msg) throws IOException {
+        try {
+            bridge.doCommand(msg);
+        } catch (Throwable t) {
+            throw new IOException("bridge command failed: " + msg, t);
+        }
+    }
+
+    /** host:jobid:attemptid:partition (sendFetchReq,
+     *  UdaPlugin.java:322-334). */
+    public void sendFetchReq(String host, String jobId, String attemptId)
+            throws IOException {
+        List<String> p = new ArrayList<>();
+        p.add(host);
+        p.add(jobId);
+        p.add(attemptId);
+        p.add(Integer.toString(reduceId.getTaskID().getId()));
+        doCommand(UdaCmd.formCmd(UdaCmd.FETCH_COMMAND, p));
+    }
+
+    /** Start the final merge (FINAL_MERGE_COMMAND; the reference issued
+     *  it from the C++ side's fetch bookkeeping, here the shared plugin
+     *  issues it when all maps are announced). */
+    public void startFinalMerge() throws IOException {
+        doCommand(UdaCmd.formCmd(UdaCmd.FINAL_MERGE_COMMAND,
+                new ArrayList<>()));
+    }
+
+    public RawKeyValueIterator createKVIteratorRdma() {
+        j2cQueue.initialize();
+        return j2cQueue;
+    }
+
+    public void close() {
+        logLevelTimer.cancel();
+        // release the ring BEFORE reduceExit: reduceExit joins the merge
+        // thread, which may be blocked in dataFromUda waiting for a slot
+        // the (possibly abandoned) J2CQueue will never free
+        shutdown = true;
+        for (KVBuf buf : kvBufs) {
+            synchronized (buf) {
+                buf.notifyAll();
+            }
+        }
+        try {
+            bridge.reduceExit();
+        } catch (Throwable t) {
+            LOG.warning("reduceExit failed: " + t);
+        }
+        j2cQueue.close();
+    }
+
+    // ---- callbacks from the native side --------------------------------
+
+    static final int REPORT_COUNT = 20;  // reference mReportCount
+    private int mapsCount = 0;
+
+    /** One up-call per REPORT_COUNT fetched segments (+ one at fetch
+     *  completion); counting against numMaps decides when the fetch
+     *  phase is done (reference UdaPlugin.java:351-364). The merge
+     *  STREAM's end is signaled in-band by the IFile EOF marker the
+     *  J2CQueue consumes. */
+    @Override
+    public synchronized void fetchOverMessage() {
+        // synchronized: the engine fires this from fetch completion
+        // threads; a lost mapsCount update would hang fetchOutputs
+        mapsCount += REPORT_COUNT;
+        if (mapsCount > numMaps) {
+            mapsCount = numMaps;
+        }
+        reporter.progress();
+        LOG.info("fetchOverMessage: mapsCount=" + mapsCount + " numMaps="
+                + numMaps);
+        if (mapsCount >= numMaps) {
+            udaShuffleConsumer.notifyFetchCompleted();
+        }
+    }
+
+    @Override
+    public void dataFromUda(byte[] data) {
+        KVBuf buf = kvBufs[curKvIdx];
+        synchronized (buf) {
+            while (buf.status != KVBuf.RECV_READY && !shutdown) {
+                try {
+                    buf.wait();
+                } catch (InterruptedException e) {
+                    Thread.currentThread().interrupt();
+                    return;
+                }
+            }
+            if (shutdown) {
+                return;  // closing: drop the block, unblock the engine
+            }
+            if (data.length > buf.bytes.length) {
+                // emitter blocks are bounded by the INIT buffer size;
+                // grow defensively rather than corrupt the ring
+                buf.bytes = new byte[data.length];
+            }
+            System.arraycopy(data, 0, buf.bytes, 0, data.length);
+            buf.actLen = data.length;
+            buf.status = KVBuf.REDC_READY;
+            curKvIdx = (curKvIdx + 1) % KV_BUF_NUM;
+            buf.notifyAll();
+        }
+    }
+
+    @Override
+    public void logToJava(int level, String message) {
+        // bridge levels: 1 fatal, 2 error, 3 warn, 4 info, 5 debug, 6 trace
+        Level l = level <= 2 ? Level.SEVERE
+                : level == 3 ? Level.WARNING
+                : level == 4 ? Level.INFO : Level.FINE;
+        LOG.log(l, "[uda_tpu] " + message);
+    }
+
+    @Override
+    public void failureInUda(String what) {
+        udaShuffleConsumer.failureInUda(
+                new UdaRuntimeException("UDA failure in an engine thread: "
+                        + what));
+    }
+
+    Progress getProgress() {
+        return progress;
+    }
+
+    /** One staging buffer of the ring (reference KVBuf,
+     *  UdaPlugin.java:421-433). */
+    private static final class KVBuf {
+        static final int RECV_READY = 1;
+        static final int REDC_READY = 2;
+
+        byte[] bytes;
+        int actLen;
+        int status = RECV_READY;
+
+        KVBuf(int size) {
+            bytes = new byte[size];
+        }
+    }
+
+    /** The RawKeyValueIterator the reduce drains (reference J2CQueue,
+     *  UdaPlugin.java:435-555) with cross-buffer record joining. */
+    private final class J2CQueue implements RawKeyValueIterator {
+
+        private final DataInputBuffer key = new DataInputBuffer();
+        private final DataInputBuffer val = new DataInputBuffer();
+        private final DataInputBuffer cur = new DataInputBuffer();
+        private byte[] carry = new byte[0];  // partial record tail
+        private int consumerIdx = -1;
+        private boolean sawEof = false;
+        private boolean closed = false;
+        private int timeCount = 0;
+
+        void initialize() {
+            timeCount = 0;
+        }
+
+        /** Release the drained buffer and block for the next one;
+         *  prepends the carry tail so split records re-join. */
+        private void moveToNextKv() throws IOException {
+            int remaining = cur.getLength() - cur.getPosition();
+            if (remaining > 0) {
+                byte[] tail = new byte[remaining];
+                System.arraycopy(cur.getData(), cur.getPosition(), tail, 0,
+                        remaining);
+                carry = tail;
+            }
+            if (consumerIdx >= 0) {
+                KVBuf finished = kvBufs[consumerIdx];
+                synchronized (finished) {
+                    finished.status = KVBuf.RECV_READY;
+                    finished.notifyAll();
+                }
+            }
+            consumerIdx = (consumerIdx + 1) % KV_BUF_NUM;
+            KVBuf next = kvBufs[consumerIdx];
+            synchronized (next) {
+                while (next.status != KVBuf.REDC_READY && !closed) {
+                    try {
+                        next.wait();
+                    } catch (InterruptedException e) {
+                        Thread.currentThread().interrupt();
+                        throw new IOException("interrupted waiting for "
+                                + "merge data");
+                    }
+                }
+                if (closed && next.status != KVBuf.REDC_READY) {
+                    throw new EOFException("queue closed mid-stream");
+                }
+                if (carry.length == 0) {
+                    cur.reset(next.bytes, 0, next.actLen);
+                } else {
+                    byte[] joined = new byte[carry.length + next.actLen];
+                    System.arraycopy(carry, 0, joined, 0, carry.length);
+                    System.arraycopy(next.bytes, 0, joined, carry.length,
+                            next.actLen);
+                    carry = new byte[0];
+                    cur.reset(joined, 0, joined.length);
+                }
+            }
+        }
+
+        @Override
+        public DataInputBuffer getKey() {
+            return key;
+        }
+
+        @Override
+        public DataInputBuffer getValue() {
+            return val;
+        }
+
+        @Override
+        public boolean next() throws IOException {
+            if (sawEof) {
+                return false;
+            }
+            if (timeCount > 1000) {
+                reporter.progress();
+                timeCount = 0;
+            }
+            timeCount++;
+            for (;;) {
+                int mark = cur.getPosition();
+                try {
+                    int keyLen = WritableUtils.readVInt(cur);
+                    int valLen = WritableUtils.readVInt(cur);
+                    if (keyLen == -1 && valLen == -1) {
+                        sawEof = true;    // the (-1,-1) stream marker
+                        return false;
+                    }
+                    if (keyLen < 0 || valLen < 0) {
+                        throw new IOException("corrupt record framing: ("
+                                + keyLen + ", " + valLen + ")");
+                    }
+                    if (cur.getPosition() + keyLen + valLen
+                            > cur.getLength()) {
+                        cur.reset(cur.getData(), mark,
+                                cur.getLength() - mark);
+                        moveToNextKv();  // record spans buffers: join
+                        continue;
+                    }
+                    key.reset(cur.getData(), cur.getPosition(), keyLen);
+                    cur.skipBytes(keyLen);
+                    val.reset(cur.getData(), cur.getPosition(), valLen);
+                    cur.skipBytes(valLen);
+                    return true;
+                } catch (EOFException e) {
+                    // framing split across the buffer boundary
+                    cur.reset(cur.getData(), mark, cur.getLength() - mark);
+                    moveToNextKv();
+                }
+            }
+        }
+
+        @Override
+        public void close() {
+            closed = true;
+            for (KVBuf buf : kvBufs) {
+                synchronized (buf) {
+                    buf.notifyAll();
+                }
+            }
+        }
+
+        @Override
+        public Progress getProgress() {
+            return progress;
+        }
+    }
+
+    /** Pull-based conf for the bridge's get_conf_data up-call. */
+    private final class JobConfSource implements UdaBridge.ConfSource {
+        @Override
+        public String get(String name, String defaultValue) {
+            return jobConf.get(name, defaultValue);
+        }
+    }
+}

@@ -1,0 +1,94 @@
+// UdaPluginSH — the supplier (NodeManager) side of the plugin layer:
+// launches the MOFSupplier role of the bridge and serves getPathIndex
+// up-calls through the UdaIndexResolver cache.
+//
+// Re-creation of the reference's UdaPluginSH (plugins/mlx-2.x/com/
+// mellanox/hadoop/mapred/UdaPluginSH.java:67-146): job -> user
+// registration (addJob/removeJob) and the getPathIndex resolution the
+// bridge's get_path_uda up-call lands on, closing the IndexCache round
+// trip the reference ran through JNI (UdaBridge.cc:352-438 ->
+// UdaPluginSH.java:107-144).
+package com.mellanox.hadoop.mapred;
+
+import java.io.IOException;
+import java.util.logging.Logger;
+
+import org.apache.hadoop.conf.Configuration;
+import org.apache.hadoop.mapred.JobConf;
+import org.apache.hadoop.mapred.JobID;
+
+public class UdaPluginSH implements UdaBridge.Callable {
+
+    static final Logger LOG =
+            Logger.getLogger(UdaPluginSH.class.getName());
+
+    private final JobConf jobConf;
+    private final UdaIndexResolver resolver;
+    private final UdaBridge bridge;
+
+    public UdaPluginSH(Configuration conf) throws IOException {
+        this.jobConf = new JobConf(conf);
+        this.resolver = new UdaIndexResolver(jobConf);
+        LOG.info("initApp of UdaPluginSH");
+        String lib = jobConf.get("uda.tpu.bridge.library",
+                "libuda_tpu_bridge.so");
+        try {
+            bridge = new UdaBridge(lib, this, resolver, (name, dflt) ->
+                    jobConf.get(name, dflt));
+            bridge.start(false, buildCmdParams());
+        } catch (Throwable t) {
+            throw new IOException("failed to launch the uda_tpu supplier "
+                    + "bridge", t);
+        }
+    }
+
+    private String[] buildCmdParams() {
+        return new String[] {
+            "-w", jobConf.get("mapred.rdma.wqe.per.conn", "256"),
+            "-r", jobConf.get("mapred.rdma.cma.port", "9011"),
+            "-s", jobConf.get("mapred.rdma.buf.size", "1024"),
+        };
+    }
+
+    public void addJob(String user, JobID jobId) {
+        resolver.addJob(user, jobId);
+    }
+
+    public void removeJob(JobID jobId) {
+        resolver.removeJob(jobId);
+    }
+
+    public void close() {
+        try {
+            bridge.reduceExit();  // EXIT teardown for the supplier role
+        } catch (Throwable t) {
+            LOG.warning("supplier close failed: " + t);
+        }
+    }
+
+    // ---- Callable (supplier side only logs/fails) -----------------------
+
+    @Override
+    public void fetchOverMessage() {
+    }
+
+    @Override
+    public void dataFromUda(byte[] data) {
+    }
+
+    @Override
+    public void logToJava(int level, String message) {
+        if (level <= 2) {
+            LOG.severe("[uda_tpu] " + message);
+        } else if (level == 3) {
+            LOG.warning("[uda_tpu] " + message);
+        } else {
+            LOG.info("[uda_tpu] " + message);
+        }
+    }
+
+    @Override
+    public void failureInUda(String what) {
+        LOG.severe("UDA supplier failure: " + what);
+    }
+}

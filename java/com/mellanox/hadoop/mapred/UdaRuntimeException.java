@@ -1,0 +1,17 @@
+// The plugin layer's unchecked failure type (reference
+// plugins/shared/com/mellanox/hadoop/mapred/UdaRuntimeException.java;
+// Python analogue: uda_tpu/utils/errors.py UdaError). Thrown where the
+// reference threw it: fallback-impossible states, obsolete-after-success
+// map attempts, reset-after-success event updates.
+package com.mellanox.hadoop.mapred;
+
+public class UdaRuntimeException extends RuntimeException {
+
+    public UdaRuntimeException(String message) {
+        super(message);
+    }
+
+    public UdaRuntimeException(String message, Throwable cause) {
+        super(message, cause);
+    }
+}

@@ -1,0 +1,44 @@
+// The class Hadoop actually loads: set
+//   mapreduce.job.reduce.shuffle.consumer.plugin.class =
+//       com.mellanox.hadoop.mapred.UdaShuffleConsumerPlugin
+// and the ReduceTask drives init -> run -> close through the
+// hadoop-2.x ShuffleConsumerPlugin SPI.
+//
+// Mirrors the reference's per-version UdaShuffleConsumerPlugin
+// (plugins/mlx-2.x/com/mellanox/hadoop/mapred/
+// UdaShuffleConsumerPlugin.java:30-84): a thin SPI adapter over the
+// shared core — init delegates, run = fetchOutputs + createKVIterator,
+// close delegates.
+package com.mellanox.hadoop.mapred;
+
+import java.io.IOException;
+
+import org.apache.hadoop.mapred.RawKeyValueIterator;
+import org.apache.hadoop.mapred.ShuffleConsumerPlugin;
+
+public class UdaShuffleConsumerPlugin<K, V>
+        implements ShuffleConsumerPlugin<K, V> {
+
+    private final UdaShuffleConsumerPluginShared<K, V> udaPlugin =
+            new UdaShuffleConsumerPluginShared<>();
+
+    @Override
+    public void init(ShuffleConsumerPlugin.Context<K, V> context) {
+        udaPlugin.init(context);
+    }
+
+    @Override
+    public RawKeyValueIterator run() throws IOException,
+            InterruptedException {
+        if (udaPlugin.fetchOutputs()) {
+            return udaPlugin.createKVIterator();
+        }
+        throw new IOException(
+                "critical failure in udaPlugin.fetchOutputs()");
+    }
+
+    @Override
+    public void close() {
+        udaPlugin.close();
+    }
+}

@@ -1,0 +1,338 @@
+// The version-independent consumer core: fetch orchestration, the
+// map-completion-events poller, and the fallback-to-vanilla machinery.
+//
+// Re-creation of the reference's UdaShuffleConsumerPluginShared
+// (plugins/shared/com/mellanox/hadoop/mapred/
+// UdaShuffleConsumerPluginShared.java):
+//
+// - init constructs the UdaPluginRT channel; any throwable during init
+//   triggers fallback (:180-202);
+// - doFallbackInit: developer mode (mapred.rdma.developer.mode) fails
+//   loudly instead of falling back (:205-232 — the reference called
+//   System.exit(1); an embedded library must not kill its JVM, so this
+//   throws UdaRuntimeException instead); otherwise the vanilla plugin
+//   class is loaded reflectively and initialized with the same context;
+// - fetchOutputs blocks on the fetch lock until the engine's
+//   fetchOverMessage (or a failure) wakes it (:249-298);
+// - createKVIterator returns the J2CQueue on success, or replays
+//   fetchOutputs on the fallback plugin (:320-344);
+// - GetMapEventsThread polls the umbilical at 1 Hz, dedupes attempts by
+//   TaskID, fetches SUCCEEDED maps, treats obsolete-after-success and
+//   reset-after-success as fallback triggers (:434-602). The same
+//   dedupe/obsolescence contract is enforced engine-side
+//   (uda_tpu/bridge/bridge.py _fetch_attempt) — defense in depth.
+package com.mellanox.hadoop.mapred;
+
+import java.io.IOException;
+import java.net.URI;
+import java.util.HashMap;
+import java.util.HashSet;
+import java.util.Map;
+import java.util.Set;
+import java.util.logging.Logger;
+
+import org.apache.hadoop.mapred.JobConf;
+import org.apache.hadoop.mapred.MapTaskCompletionEventsUpdate;
+import org.apache.hadoop.mapred.RawKeyValueIterator;
+import org.apache.hadoop.mapred.Reporter;
+import org.apache.hadoop.mapred.ShuffleConsumerPlugin;
+import org.apache.hadoop.mapred.TaskAttemptID;
+import org.apache.hadoop.mapred.TaskCompletionEvent;
+import org.apache.hadoop.mapred.TaskID;
+import org.apache.hadoop.mapred.TaskUmbilicalProtocol;
+
+public class UdaShuffleConsumerPluginShared<K, V> {
+
+    static final Logger LOG = Logger.getLogger(
+            UdaShuffleConsumerPluginShared.class.getName());
+
+    private static final long EVENT_POLL_MS = 1000;
+    private static final int MAX_EVENTS_TO_FETCH = 10000;
+
+    TaskAttemptID reduceId;
+    JobConf jobConf;
+    Reporter reporter;
+    TaskUmbilicalProtocol umbilical;
+    ShuffleConsumerPlugin.Context<K, V> context;
+    UdaPluginRT<K, V> rdmaChannel;
+    ShuffleConsumerPlugin<K, V> fallbackPlugin;
+
+    private final Object fetchLock = new Object();
+    private volatile boolean fetchCompleted;
+    private volatile boolean fetchOutputsCompleted;
+    private volatile boolean fallbackFetchOutputsDone;
+    private volatile boolean exitGetMapEvents;
+
+    void notifyFetchCompleted() {
+        synchronized (fetchLock) {
+            fetchCompleted = true;
+            fetchLock.notifyAll();
+        }
+    }
+
+    /** Usually called from an engine thread (:161-177). */
+    void failureInUda(Throwable t) {
+        try {
+            doFallbackInit(t);
+            synchronized (fetchLock) {
+                fetchLock.notifyAll();
+            }
+        } catch (Throwable t2) {
+            throw new UdaRuntimeException(
+                    "Failure in UDA and failure when trying to fallback "
+                    + "to vanilla", t2);
+        }
+    }
+
+    public void init(ShuffleConsumerPlugin.Context<K, V> context) {
+        try {
+            LOG.info("init - Using UdaShuffleConsumerPlugin");
+            this.context = context;
+            this.reduceId = context.getReduceId();
+            this.jobConf = context.getJobConf();
+            this.reporter = context.getReporter();
+            this.umbilical = context.getUmbilical();
+            this.rdmaChannel = new UdaPluginRT<>(this, reduceId, jobConf,
+                    reporter, jobConf.getNumMapTasks());
+        } catch (Throwable t) {
+            try {
+                doFallbackInit(t);
+            } catch (IOException e) {
+                throw new UdaRuntimeException("fallback init failed", e);
+            }
+        }
+    }
+
+    synchronized void doFallbackInit(Throwable t) throws IOException {
+        if (fallbackPlugin != null) {
+            return;  // already done
+        }
+        exitGetMapEvents = true;  // sanity
+        String devModeProperty = "mapred.rdma.developer.mode";
+        if (jobConf.getBoolean(devModeProperty, false)) {
+            // the reference aborted the process here (:213-217); an
+            // embedded library throws instead and lets the task fail
+            throw new UdaRuntimeException("Got UDA fatal error and cannot "
+                    + "fallback to vanilla under " + devModeProperty, t);
+        }
+        if (t != null) {
+            LOG.severe("Critical failure in UdaPlugin - switching to the "
+                    + "vanilla fallbackPlugin: " + t);
+        }
+        String vanilla = jobConf.get(
+                "mapred.uda.fallback.plugin.class",
+                "org.apache.hadoop.mapreduce.task.reduce.Shuffle");
+        try {
+            @SuppressWarnings("unchecked")
+            ShuffleConsumerPlugin<K, V> plugin =
+                    (ShuffleConsumerPlugin<K, V>) Class.forName(vanilla)
+                            .getDeclaredConstructor().newInstance();
+            plugin.init(context);
+            fallbackPlugin = plugin;
+            LOG.info("Successfully switched to the fallbackPlugin "
+                    + vanilla);
+        } catch (ReflectiveOperationException e) {
+            throw new UdaRuntimeException("Failed to initialize UDA "
+                    + "shuffle and failed to fallback to vanilla ("
+                    + vanilla + ")", e);
+        }
+    }
+
+    private boolean fetchOutputsInternal() throws IOException {
+        GetMapEventsThread events = new GetMapEventsThread();
+        events.start();
+        LOG.info("fetchOutputs - Using UdaShuffleConsumerPlugin");
+        synchronized (fetchLock) {
+            while (!fetchCompleted && fallbackPlugin == null) {
+                try {
+                    fetchLock.wait();
+                } catch (InterruptedException e) {
+                    Thread.currentThread().interrupt();
+                    throw new IOException("interrupted in fetchOutputs");
+                }
+            }
+        }
+        exitGetMapEvents = true;
+        if (fallbackPlugin != null) {
+            throw new UdaRuntimeException(
+                    "another thread has indicated Uda failure");
+        }
+        try {
+            events.join();
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+        }
+        fetchOutputsCompleted = true;
+        return true;
+    }
+
+    public boolean fetchOutputs() throws IOException {
+        try {
+            if (fallbackPlugin == null) {
+                return fetchOutputsInternal();
+            }
+        } catch (Throwable t) {
+            doFallbackInit(t);
+        }
+        LOG.info("fetchOutputs: Using fallbackPlugin");
+        return doFallbackFetchOutputs();
+    }
+
+    private synchronized boolean doFallbackFetchOutputs()
+            throws IOException {
+        if (fallbackFetchOutputsDone) {
+            return true;
+        }
+        doFallbackInit(null);  // sanity
+        // the hadoop-2 plugin SPI folds fetch into run(): the actual
+        // replay is fallbackPlugin.run() in createKVIterator; this stage
+        // only records that the fallback path is armed
+        fallbackFetchOutputsDone = true;
+        return true;
+    }
+
+    public RawKeyValueIterator createKVIterator()
+            throws IOException, InterruptedException {
+        try {
+            if (fetchOutputsCompleted) {
+                LOG.info("createKVIterator - Using "
+                        + "UdaShuffleConsumerPlugin");
+                return rdmaChannel.createKVIteratorRdma();
+            }
+        } catch (Throwable t) {
+            doFallbackInit(t);
+        }
+        if (!fallbackFetchOutputsDone) {
+            doFallbackFetchOutputs();
+        }
+        LOG.info("createKVIterator: Using fallbackPlugin");
+        return fallbackPlugin.run();
+    }
+
+    public void close() {
+        if (fallbackPlugin == null) {
+            LOG.info("close - Using UdaShuffleConsumerPlugin");
+            rdmaChannel.close();
+            return;
+        }
+        LOG.info("close: Using fallbackPlugin");
+        fallbackPlugin.close();
+        if (rdmaChannel != null) {
+            // close the engine side too, bounded like the reference's
+            // UdaCloserThread join(1000) (:346-391)
+            Thread closer = new Thread(rdmaChannel::close,
+                    "UdaCloserThread");
+            closer.setDaemon(true);
+            closer.start();
+            try {
+                closer.join(1000);
+            } catch (InterruptedException e) {
+                Thread.currentThread().interrupt();
+            }
+        }
+    }
+
+    /** The 1 Hz map-completion poller (:434-602). */
+    private final class GetMapEventsThread extends Thread {
+
+        private int fromEventId = 0;
+        private final Map<TaskID, TaskAttemptID> succeededTasks =
+                new HashMap<>();
+        private final Set<TaskAttemptID> succeededAttempts =
+                new HashSet<>();
+        private int mapsFetched = 0;
+
+        GetMapEventsThread() {
+            setName("Thread for polling Map Completion Events");
+            setDaemon(true);
+        }
+
+        @Override
+        public void run() {
+            LOG.info(reduceId + " thread started: " + getName());
+            do {
+                try {
+                    getMapCompletionEvents();
+                    Thread.sleep(EVENT_POLL_MS);
+                } catch (InterruptedException e) {
+                    LOG.warning(reduceId + " GetMapEventsThread returning "
+                            + "after an interrupted exception");
+                    return;
+                } catch (Throwable t) {
+                    LOG.severe("error in GetMapEventsThread: " + t);
+                    failureInUda(t);
+                    break;
+                }
+            } while (!exitGetMapEvents);
+            LOG.info("GetMapEventsThread exiting");
+        }
+
+        private void getMapCompletionEvents() throws IOException {
+            MapTaskCompletionEventsUpdate update =
+                    umbilical.getMapCompletionEvents(reduceId.getJobID(),
+                            fromEventId, MAX_EVENTS_TO_FETCH, reduceId);
+            TaskCompletionEvent[] events =
+                    update.getMapTaskCompletionEvents();
+            if (update.shouldReset()) {
+                fromEventId = 0;
+                if (succeededTasks.isEmpty()) {
+                    LOG.info("got reset update before any succeeded map - "
+                            + "this is OK");
+                } else {
+                    throw new UdaRuntimeException("got reset update after "
+                            + succeededTasks.size() + " succeeded maps");
+                }
+            }
+            fromEventId += events.length;
+            for (TaskCompletionEvent event : events) {
+                switch (event.getTaskStatus()) {
+                    case SUCCEEDED: {
+                        TaskAttemptID attempt = event.getTaskAttemptId();
+                        succeededAttempts.add(attempt);
+                        TaskID task = attempt.getTaskID();
+                        if (succeededTasks.containsKey(task)) {
+                            LOG.info("Ignoring succeeded attempt "
+                                    + attempt + ": task already succeeded "
+                                    + "via " + succeededTasks.get(task));
+                            break;
+                        }
+                        succeededTasks.put(task, attempt);
+                        String host = URI.create(
+                                event.getTaskTrackerHttp()).getHost();
+                        rdmaChannel.sendFetchReq(host == null ? "localhost"
+                                : host, attempt.getJobID().toString(),
+                                attempt.toString());
+                        if (++mapsFetched >= jobConf.getNumMapTasks()) {
+                            // all maps announced: start the final merge
+                            // (the reference's C++ tracked this count
+                            // engine-side)
+                            rdmaChannel.startFinalMerge();
+                        }
+                        break;
+                    }
+                    case FAILED:
+                    case KILLED:
+                    case OBSOLETE: {
+                        TaskAttemptID attempt = event.getTaskAttemptId();
+                        if (succeededAttempts.contains(attempt)) {
+                            throw new UdaRuntimeException(
+                                    "encountered obsolete map attempt "
+                                    + attempt + " (status "
+                                    + event.getTaskStatus() + ") after it "
+                                    + "was already successful");
+                        }
+                        LOG.info("Ignoring failed attempt " + attempt
+                                + " with status " + event.getTaskStatus());
+                        break;
+                    }
+                    case TIPFAILED:
+                        LOG.info("Ignoring output of failed map TIP: "
+                                + event.getTaskAttemptId());
+                        break;
+                    default:
+                        break;
+                }
+            }
+        }
+    }
+}

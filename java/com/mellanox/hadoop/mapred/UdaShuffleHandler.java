@@ -1,0 +1,87 @@
+// UdaShuffleHandler — the NodeManager auxiliary service the provider
+// side registers as (yarn.nodemanager.aux-services = uda_shuffle,
+// yarn.nodemanager.aux-services.uda_shuffle.class = this class).
+//
+// Mirrors the reference's UdaShuffleHandler (plugins/mlx-2.x/com/
+// mellanox/hadoop/mapred/UdaShuffleHandler.java:59-151): service
+// lifecycle owns the UdaPluginSH channel; per-application init/stop
+// keeps the job -> user registry getPathIndex resolves through.
+package com.mellanox.hadoop.mapred;
+
+import java.io.IOException;
+import java.nio.ByteBuffer;
+import java.util.logging.Logger;
+
+import org.apache.hadoop.conf.Configuration;
+import org.apache.hadoop.mapred.JobID;
+import org.apache.hadoop.yarn.api.records.ApplicationId;
+import org.apache.hadoop.yarn.server.api.ApplicationInitializationContext;
+import org.apache.hadoop.yarn.server.api.ApplicationTerminationContext;
+import org.apache.hadoop.yarn.server.api.AuxiliaryService;
+
+public class UdaShuffleHandler extends AuxiliaryService {
+
+    private static final Logger LOG =
+            Logger.getLogger(UdaShuffleHandler.class.getName());
+
+    public static final String MAPREDUCE_RDMA_SHUFFLE_SERVICEID =
+            "uda.shuffle";
+
+    private Configuration config;
+    private UdaPluginSH rdmaChannel;
+
+    public UdaShuffleHandler() {
+        super("uda_shuffle");
+    }
+
+    @Override
+    public synchronized void init(Configuration conf) {
+        LOG.info("init of UdaShuffleHandler");
+        this.config = conf;
+        super.init(new Configuration(conf));
+    }
+
+    @Override
+    public synchronized void start() {
+        LOG.info("start of UdaShuffleHandler");
+        try {
+            rdmaChannel = new UdaPluginSH(config);
+        } catch (IOException e) {
+            throw new UdaRuntimeException(
+                    "failed to start the UDA supplier channel", e);
+        }
+        super.start();
+    }
+
+    @Override
+    public synchronized void stop() {
+        LOG.info("stop of UdaShuffleHandler");
+        if (rdmaChannel != null) {
+            rdmaChannel.close();
+        }
+        super.stop();
+    }
+
+    @Override
+    public void initializeApplication(
+            ApplicationInitializationContext context) {
+        ApplicationId appId = context.getApplicationId();
+        JobID jobId = new JobID(
+                Long.toString(appId.getClusterTimestamp()), appId.getId());
+        rdmaChannel.addJob(context.getUser(), jobId);
+    }
+
+    @Override
+    public void stopApplication(ApplicationTerminationContext context) {
+        ApplicationId appId = context.getApplicationId();
+        JobID jobId = new JobID(
+                Long.toString(appId.getClusterTimestamp()), appId.getId());
+        rdmaChannel.removeJob(jobId);
+    }
+
+    @Override
+    public synchronized ByteBuffer getMetaData() {
+        // empty, not null (YARN-1256)
+        return ByteBuffer.allocate(0);
+    }
+}

@@ -1,0 +1,74 @@
+// VENDORED COMPILE-TIME STUB — NOT Hadoop code and never deployed.
+//
+// The build image carries no Hadoop jars, so the uda_tpu plugin layer
+// (com.mellanox.hadoop.mapred.*) compiles against this minimal shape
+// of the Hadoop API instead. Signatures follow hadoop-2.x so the same
+// plugin sources compile unchanged against a real hadoop-common jar
+// (exclude java/hadoop-stubs from the sourcepath there). Behavior here
+// is the least that the plugin + tests need: a string map.
+package org.apache.hadoop.conf;
+
+import java.util.HashMap;
+import java.util.Map;
+
+public class Configuration {
+
+    private final Map<String, String> props = new HashMap<>();
+
+    public Configuration() {
+    }
+
+    public Configuration(Configuration other) {
+        props.putAll(other.props);
+    }
+
+    public String get(String name) {
+        return props.get(name);
+    }
+
+    public String get(String name, String defaultValue) {
+        String v = props.get(name);
+        return v == null ? defaultValue : v;
+    }
+
+    public void set(String name, String value) {
+        props.put(name, value);
+    }
+
+    public boolean getBoolean(String name, boolean defaultValue) {
+        String v = props.get(name);
+        return v == null ? defaultValue : Boolean.parseBoolean(v.trim());
+    }
+
+    public void setBoolean(String name, boolean value) {
+        props.put(name, Boolean.toString(value));
+    }
+
+    public int getInt(String name, int defaultValue) {
+        String v = props.get(name);
+        return v == null ? defaultValue : Integer.parseInt(v.trim());
+    }
+
+    public long getLong(String name, long defaultValue) {
+        String v = props.get(name);
+        return v == null ? defaultValue : Long.parseLong(v.trim());
+    }
+
+    public float getFloat(String name, float defaultValue) {
+        String v = props.get(name);
+        return v == null ? defaultValue : Float.parseFloat(v.trim());
+    }
+
+    /** Comma-separated values, trimmed; null when unset. */
+    public String[] getTrimmedStrings(String name) {
+        String v = props.get(name);
+        if (v == null || v.trim().isEmpty()) {
+            return new String[0];
+        }
+        String[] parts = v.split(",");
+        for (int i = 0; i < parts.length; i++) {
+            parts[i] = parts[i].trim();
+        }
+        return parts;
+    }
+}

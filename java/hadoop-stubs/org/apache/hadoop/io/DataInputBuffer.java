@@ -1,0 +1,65 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.io;
+
+import java.io.ByteArrayInputStream;
+import java.io.DataInputStream;
+
+public class DataInputBuffer extends DataInputStream {
+
+    private static final class Buffer extends ByteArrayInputStream {
+        Buffer() {
+            super(new byte[0]);
+        }
+
+        void reset(byte[] input, int start, int length) {
+            this.buf = input;
+            this.pos = start;
+            this.count = Math.min(start + length, input.length);
+            this.mark = start;
+        }
+
+        byte[] data() {
+            return buf;
+        }
+
+        int position() {
+            return pos;
+        }
+
+        int length() {
+            return count;
+        }
+    }
+
+    private final Buffer buffer;
+
+    public DataInputBuffer() {
+        this(new Buffer());
+    }
+
+    private DataInputBuffer(Buffer buffer) {
+        super(buffer);
+        this.buffer = buffer;
+    }
+
+    public void reset(byte[] input, int length) {
+        buffer.reset(input, 0, length);
+    }
+
+    public void reset(byte[] input, int start, int length) {
+        buffer.reset(input, start, length);
+    }
+
+    public byte[] getData() {
+        return buffer.data();
+    }
+
+    public int getPosition() {
+        return buffer.position();
+    }
+
+    /** End of the valid region (start + length of the last reset). */
+    public int getLength() {
+        return buffer.length();
+    }
+}

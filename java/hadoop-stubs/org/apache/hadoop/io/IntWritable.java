@@ -1,0 +1,22 @@
+// VENDORED COMPILE-TIME STUB — key-class marker; see Configuration.java.
+package org.apache.hadoop.io;
+
+public class IntWritable {
+
+    private int value;
+
+    public IntWritable() {
+    }
+
+    public IntWritable(int value) {
+        this.value = value;
+    }
+
+    public int get() {
+        return value;
+    }
+
+    public void set(int value) {
+        this.value = value;
+    }
+}

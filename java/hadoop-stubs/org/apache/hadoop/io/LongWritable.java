@@ -1,0 +1,5 @@
+// VENDORED COMPILE-TIME STUB — key-class marker; see Configuration.java.
+package org.apache.hadoop.io;
+
+public class LongWritable {
+}

@@ -1,0 +1,6 @@
+// VENDORED COMPILE-TIME STUB — key-class marker so
+// JobConf.getOutputKeyClass() resolves; see Configuration.java.
+package org.apache.hadoop.io;
+
+public class Text {
+}

@@ -1,0 +1,72 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+// The VInt/VLong codec is byte-exact with Hadoop's zero-compressed
+// format (the same contract as uda_tpu/utils/vint.py and
+// uda_tpu/native/vlong.h, reference src/CommUtils/IOUtility.cc:167-397).
+package org.apache.hadoop.io;
+
+import java.io.DataInput;
+import java.io.DataOutput;
+import java.io.IOException;
+
+public final class WritableUtils {
+
+    private WritableUtils() {
+    }
+
+    public static long readVLong(DataInput in) throws IOException {
+        byte first = in.readByte();
+        int len = decodeVIntSize(first);
+        if (len == 1) {
+            return first;
+        }
+        long v = 0;
+        for (int i = 0; i < len - 1; i++) {
+            v = (v << 8) | (in.readByte() & 0xff);
+        }
+        return isNegativeVInt(first) ? ~v : v;
+    }
+
+    public static int readVInt(DataInput in) throws IOException {
+        long v = readVLong(in);
+        if (v < Integer.MIN_VALUE || v > Integer.MAX_VALUE) {
+            throw new IOException("VInt out of int range: " + v);
+        }
+        return (int) v;
+    }
+
+    public static int decodeVIntSize(byte value) {
+        if (value >= -112) {
+            return 1;
+        }
+        return value >= -120 ? -111 - value : -119 - value;
+    }
+
+    public static boolean isNegativeVInt(byte value) {
+        return value < -120 || (value >= -112 && value < 0);
+    }
+
+    public static void writeVLong(DataOutput out, long v) throws IOException {
+        if (v >= -112 && v <= 127) {
+            out.writeByte((byte) v);
+            return;
+        }
+        int tag = -112;
+        long u = v;
+        if (v < 0) {
+            u = ~u;
+            tag = -120;
+        }
+        int body = 0;
+        for (long t = u; t != 0; t >>>= 8) {
+            body++;
+        }
+        out.writeByte((byte) (tag - body));
+        for (int i = body - 1; i >= 0; i--) {
+            out.writeByte((byte) (u >>> (8 * i)));
+        }
+    }
+
+    public static void writeVInt(DataOutput out, int v) throws IOException {
+        writeVLong(out, v);
+    }
+}

@@ -1,0 +1,44 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.mapred;
+
+import org.apache.hadoop.conf.Configuration;
+
+public class JobConf extends Configuration {
+
+    public JobConf() {
+    }
+
+    public JobConf(Configuration conf) {
+        super(conf);
+    }
+
+    public Class<?> getOutputKeyClass() {
+        String name = get("mapreduce.job.output.key.class",
+                "org.apache.hadoop.io.Text");
+        try {
+            return Class.forName(name);
+        } catch (ClassNotFoundException e) {
+            throw new IllegalArgumentException("unknown key class " + name, e);
+        }
+    }
+
+    public boolean getCompressMapOutput() {
+        return getBoolean("mapreduce.map.output.compress",
+                getBoolean("mapred.compress.map.output", false));
+    }
+
+    public String[] getLocalDirs() {
+        return getTrimmedStrings("mapreduce.cluster.local.dir").length > 0
+                ? getTrimmedStrings("mapreduce.cluster.local.dir")
+                : getTrimmedStrings("mapred.local.dir");
+    }
+
+    public boolean getSpeculativeExecution() {
+        return getBoolean("mapreduce.map.speculative", false)
+                || getBoolean("mapreduce.reduce.speculative", false);
+    }
+
+    public int getNumMapTasks() {
+        return getInt("mapreduce.job.maps", 1);
+    }
+}

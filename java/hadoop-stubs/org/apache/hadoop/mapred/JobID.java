@@ -1,0 +1,42 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.mapred;
+
+public class JobID {
+
+    private final String jtIdentifier;
+    private final int id;
+
+    public JobID(String jtIdentifier, int id) {
+        this.jtIdentifier = jtIdentifier;
+        this.id = id;
+    }
+
+    public static JobID forName(String s) {
+        // job_<jtIdentifier>_<id>
+        String[] parts = s.split("_");
+        return new JobID(parts[1], Integer.parseInt(parts[2]));
+    }
+
+    public String getJtIdentifier() {
+        return jtIdentifier;
+    }
+
+    public int getId() {
+        return id;
+    }
+
+    @Override
+    public String toString() {
+        return String.format("job_%s_%04d", jtIdentifier, id);
+    }
+
+    @Override
+    public boolean equals(Object o) {
+        return o instanceof JobID && toString().equals(o.toString());
+    }
+
+    @Override
+    public int hashCode() {
+        return toString().hashCode();
+    }
+}

@@ -1,0 +1,22 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.mapred;
+
+public class MapTaskCompletionEventsUpdate {
+
+    private final TaskCompletionEvent[] events;
+    private final boolean reset;
+
+    public MapTaskCompletionEventsUpdate(TaskCompletionEvent[] events,
+                                         boolean reset) {
+        this.events = events;
+        this.reset = reset;
+    }
+
+    public TaskCompletionEvent[] getMapTaskCompletionEvents() {
+        return events;
+    }
+
+    public boolean shouldReset() {
+        return reset;
+    }
+}

@@ -1,0 +1,19 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.mapred;
+
+import java.io.IOException;
+
+import org.apache.hadoop.io.DataInputBuffer;
+import org.apache.hadoop.util.Progress;
+
+public interface RawKeyValueIterator {
+    DataInputBuffer getKey() throws IOException;
+
+    DataInputBuffer getValue() throws IOException;
+
+    boolean next() throws IOException;
+
+    void close() throws IOException;
+
+    Progress getProgress();
+}

@@ -1,0 +1,8 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.mapred;
+
+public interface Reporter {
+    void progress();
+
+    void setStatus(String status);
+}

@@ -1,0 +1,47 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+// The hadoop-2.x pluggable-shuffle SPI (MAPREDUCE-4049): the class a
+// job's mapreduce.job.reduce.shuffle.consumer.plugin.class must
+// implement for the ReduceTask to load it.
+package org.apache.hadoop.mapred;
+
+import java.io.IOException;
+
+public interface ShuffleConsumerPlugin<K, V> {
+
+    void init(Context<K, V> context);
+
+    RawKeyValueIterator run() throws IOException, InterruptedException;
+
+    void close();
+
+    class Context<K, V> {
+        private final TaskAttemptID reduceId;
+        private final JobConf jobConf;
+        private final Reporter reporter;
+        private final TaskUmbilicalProtocol umbilical;
+
+        public Context(TaskAttemptID reduceId, JobConf jobConf,
+                       Reporter reporter, TaskUmbilicalProtocol umbilical) {
+            this.reduceId = reduceId;
+            this.jobConf = jobConf;
+            this.reporter = reporter;
+            this.umbilical = umbilical;
+        }
+
+        public TaskAttemptID getReduceId() {
+            return reduceId;
+        }
+
+        public JobConf getJobConf() {
+            return jobConf;
+        }
+
+        public Reporter getReporter() {
+            return reporter;
+        }
+
+        public TaskUmbilicalProtocol getUmbilical() {
+            return umbilical;
+        }
+    }
+}

@@ -1,0 +1,43 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+// String-backed ids: attempt_<jt>_<job>_<m|r>_<task>_<attempt>.
+package org.apache.hadoop.mapred;
+
+public class TaskAttemptID {
+
+    private final String id;
+
+    public TaskAttemptID(String id) {
+        this.id = id;
+    }
+
+    public static TaskAttemptID forName(String s) {
+        return new TaskAttemptID(s);
+    }
+
+    public TaskID getTaskID() {
+        int us = id.lastIndexOf('_');
+        String task = id.startsWith("attempt_")
+                ? "task_" + id.substring("attempt_".length(), us)
+                : id.substring(0, us);
+        return new TaskID(task);
+    }
+
+    public JobID getJobID() {
+        return getTaskID().getJobID();
+    }
+
+    @Override
+    public String toString() {
+        return id;
+    }
+
+    @Override
+    public boolean equals(Object o) {
+        return o instanceof TaskAttemptID && id.equals(((TaskAttemptID) o).id);
+    }
+
+    @Override
+    public int hashCode() {
+        return id.hashCode();
+    }
+}

@@ -1,0 +1,30 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.mapred;
+
+public class TaskCompletionEvent {
+
+    public enum Status { SUCCEEDED, FAILED, KILLED, OBSOLETE, TIPFAILED }
+
+    private final Status status;
+    private final TaskAttemptID attemptId;
+    private final String taskTrackerHttp;
+
+    public TaskCompletionEvent(Status status, TaskAttemptID attemptId,
+                               String taskTrackerHttp) {
+        this.status = status;
+        this.attemptId = attemptId;
+        this.taskTrackerHttp = taskTrackerHttp;
+    }
+
+    public Status getTaskStatus() {
+        return status;
+    }
+
+    public TaskAttemptID getTaskAttemptId() {
+        return attemptId;
+    }
+
+    public String getTaskTrackerHttp() {
+        return taskTrackerHttp;
+    }
+}

@@ -1,0 +1,38 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.mapred;
+
+public class TaskID {
+
+    private final String id;  // task_<jt>_<job>_<m|r>_<task>
+
+    public TaskID(String id) {
+        this.id = id;
+    }
+
+    public JobID getJobID() {
+        String[] parts = id.split("_");
+        // task_<jtIdentifier>_<jobId>_<type>_<num>
+        return new JobID(parts[1], Integer.parseInt(parts[2]));
+    }
+
+    /** The task number within the job. */
+    public int getId() {
+        String[] parts = id.split("_");
+        return Integer.parseInt(parts[parts.length - 1]);
+    }
+
+    @Override
+    public String toString() {
+        return id;
+    }
+
+    @Override
+    public boolean equals(Object o) {
+        return o instanceof TaskID && id.equals(((TaskID) o).id);
+    }
+
+    @Override
+    public int hashCode() {
+        return id.hashCode();
+    }
+}

@@ -1,0 +1,10 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.mapred;
+
+import java.io.IOException;
+
+public interface TaskUmbilicalProtocol {
+    MapTaskCompletionEventsUpdate getMapCompletionEvents(
+            JobID jobId, int fromEventId, int maxLocs,
+            TaskAttemptID reduceId) throws IOException;
+}

@@ -1,0 +1,15 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.util;
+
+public class Progress {
+
+    private volatile float progress;
+
+    public void set(float progress) {
+        this.progress = progress;
+    }
+
+    public float get() {
+        return progress;
+    }
+}

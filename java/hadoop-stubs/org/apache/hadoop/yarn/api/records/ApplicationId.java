@@ -1,0 +1,31 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.yarn.api.records;
+
+public class ApplicationId {
+
+    private final long clusterTimestamp;
+    private final int id;
+
+    private ApplicationId(long clusterTimestamp, int id) {
+        this.clusterTimestamp = clusterTimestamp;
+        this.id = id;
+    }
+
+    public static ApplicationId newInstance(long clusterTimestamp, int id) {
+        return new ApplicationId(clusterTimestamp, id);
+    }
+
+    public long getClusterTimestamp() {
+        return clusterTimestamp;
+    }
+
+    public int getId() {
+        return id;
+    }
+
+    @Override
+    public String toString() {
+        return "application_" + clusterTimestamp + "_"
+                + String.format("%04d", id);
+    }
+}

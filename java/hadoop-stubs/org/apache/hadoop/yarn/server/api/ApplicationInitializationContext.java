@@ -1,0 +1,24 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.yarn.server.api;
+
+import org.apache.hadoop.yarn.api.records.ApplicationId;
+
+public class ApplicationInitializationContext {
+
+    private final String user;
+    private final ApplicationId applicationId;
+
+    public ApplicationInitializationContext(String user,
+                                            ApplicationId applicationId) {
+        this.user = user;
+        this.applicationId = applicationId;
+    }
+
+    public String getUser() {
+        return user;
+    }
+
+    public ApplicationId getApplicationId() {
+        return applicationId;
+    }
+}

@@ -1,0 +1,17 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+package org.apache.hadoop.yarn.server.api;
+
+import org.apache.hadoop.yarn.api.records.ApplicationId;
+
+public class ApplicationTerminationContext {
+
+    private final ApplicationId applicationId;
+
+    public ApplicationTerminationContext(ApplicationId applicationId) {
+        this.applicationId = applicationId;
+    }
+
+    public ApplicationId getApplicationId() {
+        return applicationId;
+    }
+}

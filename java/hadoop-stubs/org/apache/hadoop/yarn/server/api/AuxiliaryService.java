@@ -1,0 +1,38 @@
+// VENDORED COMPILE-TIME STUB — see Configuration.java for the rules.
+// The NodeManager auxiliary-service base class a provider plugin must
+// extend to be loaded via yarn.nodemanager.aux-services.
+package org.apache.hadoop.yarn.server.api;
+
+import java.nio.ByteBuffer;
+
+import org.apache.hadoop.conf.Configuration;
+
+public abstract class AuxiliaryService {
+
+    private final String name;
+
+    protected AuxiliaryService(String name) {
+        this.name = name;
+    }
+
+    public String getName() {
+        return name;
+    }
+
+    public void init(Configuration conf) {
+    }
+
+    public void start() {
+    }
+
+    public void stop() {
+    }
+
+    public abstract void initializeApplication(
+            ApplicationInitializationContext initAppContext);
+
+    public abstract void stopApplication(
+            ApplicationTerminationContext stopAppContext);
+
+    public abstract ByteBuffer getMetaData();
+}

@@ -1,0 +1,10 @@
+// Marker class for the framework's RawBytes key type: jobs whose map
+// output keys are unframed byte strings (TeraSort-style fixed-width
+// keys) set mapreduce.job.output.key.class = uda.tpu.RawBytes and the
+// engine maps the name to its raw-memcmp comparator
+// (uda_tpu/utils/comparators.py registry key "uda.tpu.RawBytes").
+// Part of the deployable plugin jar, not a Hadoop stub.
+package uda.tpu;
+
+public class RawBytes {
+}

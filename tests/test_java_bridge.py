@@ -93,3 +93,96 @@ def test_java_sources_present_and_wellformed():
     assert pos == sorted(pos), "uda_callbacks_t member order changed; " \
         "update UdaBridge.buildCallbacks offsets"
     assert "7 * 8L" in src  # ctx + 6 function pointers
+    # the supplier up-calls are BOUND, not NULL slots (getPathUda round
+    # trip, reference UdaBridge.cc:352-438)
+    assert "cbs.set(ADDRESS, 24, getPath)" in src
+    assert "cbs.set(ADDRESS, 32, getConf)" in src
+    # uda_index_record_t: char path[4096] + 3 long longs — the Java
+    # writer must use the same offsets as the C struct
+    assert "char path[4096]" in shim
+    for offset in ("4096", "4104", "4112"):
+        assert f"out.set(JAVA_LONG, {offset}," in src, \
+            f"IndexRecord field offset {offset} drifted"
+
+
+def test_plugin_layer_sources_present():
+    """Always-on: the Hadoop plugin cluster exists with the
+    reference-parity shapes (SURVEY §2.2 J2-J4) — the classes a Hadoop
+    jar loads, not just the FFM binding."""
+    jdir = os.path.join(ROOT, "java", "com", "mellanox", "hadoop",
+                        "mapred")
+    rt = open(os.path.join(jdir, "UdaPluginRT.java")).read()
+    # J2: budget calc, INIT construction, KVBuf ring, J2CQueue
+    assert "mapred.rdma.shuffle.total.size" in rt
+    assert "mapred.job.shuffle.input.buffer.percent" in rt
+    assert "KV_BUF_NUM" in rt and "RECV_READY" in rt
+    assert "class J2CQueue implements RawKeyValueIterator" in rt
+    assert "INIT_COMMAND" in rt
+    # the 1 Hz log-level re-sync (reference UdaPlugin.java:99-143)
+    assert "logLevelTimer.schedule" in rt and "1000, 1000" in rt
+    # J3: shared fallback machinery
+    shared = open(os.path.join(
+        jdir, "UdaShuffleConsumerPluginShared.java")).read()
+    assert "doFallbackInit" in shared
+    assert "mapred.rdma.developer.mode" in shared
+    assert "GetMapEventsThread" in shared
+    assert "shouldReset" in shared
+    # J4: provider plugins + the SPI adapter
+    sh = open(os.path.join(jdir, "UdaPluginSH.java")).read()
+    assert "UdaIndexResolver" in sh and "addJob" in sh
+    handler = open(os.path.join(jdir, "UdaShuffleHandler.java")).read()
+    assert "extends AuxiliaryService" in handler
+    assert "initializeApplication" in handler
+    resolver = open(os.path.join(jdir, "UdaIndexResolver.java")).read()
+    assert "getPathIndex" in resolver and "file.out.index" in resolver
+    spi = open(os.path.join(jdir, "UdaShuffleConsumerPlugin.java")).read()
+    assert "implements ShuffleConsumerPlugin" in spi
+
+
+def _build_java(tmp_path):
+    shim = os.path.join(ROOT, "uda_tpu", "native", "libuda_tpu_bridge.so")
+    if not os.path.exists(shim):
+        rc = subprocess.run(["make", "-C",
+                             os.path.join(ROOT, "uda_tpu", "native"),
+                             "libuda_tpu_bridge.so"]).returncode
+        assert rc == 0, "shim build failed"
+    build = tmp_path / "classes"
+    rc = subprocess.run(["make", "-C", os.path.join(ROOT, "java"),
+                         f"BUILD={build}"]).returncode
+    assert rc == 0, "javac build failed"
+    return shim, build
+
+
+@pytest.mark.skipif(_jdk_version() < 22,
+                    reason="needs a JDK 22+ (java.lang.foreign)")
+@pytest.mark.parametrize("mode", ["dirs", "upcall"])
+def test_jvm_plugin_stack_drives_job(tmp_path, mode):
+    """The FULL Hadoop plugin stack from the JVM: ShuffleConsumerPlugin
+    SPI init/run/close, GetMapEventsThread dedupe + fetch, KVBuf ring +
+    J2CQueue drain — and in 'upcall' mode the supplier-side getPathUda
+    round trip through UdaIndexResolver."""
+    shim, build = _build_java(tmp_path)
+    job = "job_202607_0001"
+    num_maps = 3
+    # Hadoop-real ids: the tree's attempt infix omits the job_ prefix
+    expected = make_mof_tree(str(tmp_path), "202607_0001", num_maps, 1,
+                             30, seed=77)
+    os.rename(tmp_path / "202607_0001", tmp_path / job)
+    out_file = tmp_path / "merged.bin"
+    env = dict(os.environ)
+    env["UDA_TPU_PY_BOOTSTRAP"] = (
+        "import sys; sys.path.insert(0, %r); "
+        "import os; os.environ['JAX_PLATFORMS']='cpu'" % ROOT)
+    proc = subprocess.run(
+        ["java", "--enable-native-access=ALL-UNNAMED", "-cp", str(build),
+         "com.mellanox.hadoop.mapred.UdaJobDriver", shim,
+         str(tmp_path), job, str(num_maps), str(out_file), mode],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "JVM-PLUGIN-OK" in proc.stdout
+
+    got = list(IFileReader(io.BytesIO(out_file.read_bytes())))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    want = sorted(expected[0], key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want

@@ -236,7 +236,21 @@ class UdaBridge:
                 raise ProtocolError(
                     f"INIT needs >= 4 params, got {len(params)}")
             client = self._make_client(local_dirs)
-            self._mm = MergeManager(client, self._key_class, self.cfg)
+            # fetch progress -> fetchOverMessage, the reference cadence:
+            # one up-call per PROGRESS_INTERVAL fetched segments plus one
+            # at fetch completion (MergeManager.cc:124-130); the embedder
+            # counts them against numMaps (UdaPlugin.java:351-364). The
+            # END of the merge STREAM is signaled in-band by the IFile
+            # EOF marker, exactly as the reference's J2CQueue consumed it
+            # — so a bounded staging ring (KVBuf) can apply backpressure
+            # to the emitter without deadlocking fetchOutputs.
+            def _fetch_progress(done: int, total: int) -> None:
+                cb = getattr(self.callable, "fetch_over_message", None)
+                if cb is not None:
+                    cb()
+
+            self._mm = MergeManager(client, self._key_class, self.cfg,
+                                    progress=_fetch_progress)
         elif header == Cmd.FETCH:
             # reference FETCH: host:jobid:attemptid:partition
             # (UdaPlugin.java:322-334); host rides with the attempt so
@@ -391,8 +405,10 @@ class UdaBridge:
         self._client = client
 
     def _merge_main(self, maps: list[str]) -> None:
-        """The merge thread: fetch -> merge -> stream dataFromUda blocks
-        -> fetchOverMessage (merge_thread_main, MergeManager.cc:291-314)."""
+        """The merge thread: fetch (progress -> fetchOverMessage) ->
+        merge -> stream dataFromUda blocks, the last one carrying the
+        IFile EOF marker as the in-band end-of-stream signal
+        (merge_thread_main, MergeManager.cc:291-314)."""
         try:
             def consumer(block: memoryview) -> None:
                 cb = getattr(self.callable, "data_from_uda", None)
@@ -400,9 +416,6 @@ class UdaBridge:
                     cb(block, len(block))
 
             self._mm.run(self._job_id, maps, self._reduce_id, consumer)
-            cb = getattr(self.callable, "fetch_over_message", None)
-            if cb is not None:
-                cb()
         except Exception as e:  # noqa: BLE001 - the fallback boundary
             self._fail(e, in_thread=True)
 
