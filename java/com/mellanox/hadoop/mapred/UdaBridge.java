@@ -134,19 +134,30 @@ public final class UdaBridge {
     // ---- static up-call receivers (the reference's static methods,
     // UdaBridge.java:85-145) -------------------------------------------
 
+    // Every receiver swallows Throwable: an exception unwinding into a
+    // Linker.upcallStub terminates the whole JVM (FFM semantics) — the
+    // embedder surfaces failures through its own channels instead.
     private static void cbFetchOver(MemorySegment ctx) {
-        Callable t = target;
-        if (t != null) t.fetchOverMessage();
+        try {
+            Callable t = target;
+            if (t != null) t.fetchOverMessage();
+        } catch (Throwable t2) {
+            System.err.println("[UdaBridge] fetchOverMessage threw: " + t2);
+        }
     }
 
     private static void cbDataFromUda(MemorySegment ctx, MemorySegment data,
                                       long len) {
-        Callable t = target;
-        if (t == null) return;
-        byte[] out = new byte[(int) len];
-        MemorySegment.copy(data.reinterpret(len), JAVA_BYTE, 0, out, 0,
-                (int) len);
-        t.dataFromUda(out);
+        try {
+            Callable t = target;
+            if (t == null) return;
+            byte[] out = new byte[(int) len];
+            MemorySegment.copy(data.reinterpret(len), JAVA_BYTE, 0, out, 0,
+                    (int) len);
+            t.dataFromUda(out);
+        } catch (Throwable t2) {
+            System.err.println("[UdaBridge] dataFromUda threw: " + t2);
+        }
     }
 
     // uda_index_record_t layout (bridge_shim.cc:41-46):
@@ -204,15 +215,23 @@ public final class UdaBridge {
 
     private static void cbLogTo(MemorySegment ctx, int level,
                                 MemorySegment msg) {
-        Callable t = target;
-        if (t != null) t.logToJava(level,
-                msg.reinterpret(1 << 16).getString(0));
+        try {
+            Callable t = target;
+            if (t != null) t.logToJava(level,
+                    msg.reinterpret(1 << 16).getString(0));
+        } catch (Throwable t2) {
+            System.err.println("[UdaBridge] logToJava threw: " + t2);
+        }
     }
 
     private static void cbFailure(MemorySegment ctx, MemorySegment what) {
-        Callable t = target;
-        if (t != null) t.failureInUda(
-                what.reinterpret(1 << 16).getString(0));
+        try {
+            Callable t = target;
+            if (t != null) t.failureInUda(
+                    what.reinterpret(1 << 16).getString(0));
+        } catch (Throwable t2) {
+            System.err.println("[UdaBridge] failureInUda threw: " + t2);
+        }
     }
 
     private MemorySegment buildCallbacks() throws Throwable {

@@ -71,14 +71,16 @@ public final class UdaBridgeDriver implements UdaBridge.Callable {
         UdaBridge bridge = new UdaBridge(lib, driver);
         bridge.start(true, new String[] {"-w", "8"});
         // short-form INIT: job, reduce_id, num_maps, key_class, dirs
-        bridge.doCommand(cmd("7", new String[] {job, "0",
-                String.valueOf(numMaps), "uda.tpu.RawBytes", root}));
+        bridge.doCommand(UdaCmd.formCmd(UdaCmd.INIT_COMMAND,
+                java.util.List.of(job, "0", String.valueOf(numMaps),
+                        "uda.tpu.RawBytes", root)));
         for (int m = 0; m < numMaps; m++) {
             String attempt = String.format("attempt_%s_m_%06d_0", job, m);
-            bridge.doCommand(cmd("4", new String[] {"localhost", job,
-                    attempt, "0"}));
+            bridge.doCommand(UdaCmd.formCmd(UdaCmd.FETCH_COMMAND,
+                    java.util.List.of("localhost", job, attempt, "0")));
         }
-        bridge.doCommand(cmd("2", new String[] {}));
+        bridge.doCommand(UdaCmd.formCmd(UdaCmd.FINAL_MERGE_COMMAND,
+                java.util.List.of()));
         if (!driver.done.await(120, TimeUnit.SECONDS)) {
             System.err.println("merge timed out");
             System.exit(3);
@@ -91,14 +93,5 @@ public final class UdaBridgeDriver implements UdaBridge.Callable {
         Files.write(Paths.get(out), driver.blocks.toByteArray());
         System.out.println("JVM-MERGE-OK " + driver.blocks.size()
                 + " bytes");
-    }
-
-    /** count:header:params protocol string (reference UdaCmd.formCmd,
-     *  UdaPlugin.java:562-587). */
-    private static String cmd(String header, String[] params) {
-        StringBuilder sb = new StringBuilder();
-        sb.append(params.length).append(':').append(header);
-        for (String p : params) sb.append(':').append(p);
-        return sb.toString();
     }
 }

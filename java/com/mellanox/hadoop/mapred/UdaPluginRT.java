@@ -74,6 +74,10 @@ public class UdaPluginRT<K, V> implements UdaBridge.Callable {
     // reduceExit's merge-thread join cannot deadlock on an abandoned
     // J2CQueue (abnormal close with both buffers REDC_READY)
     private volatile boolean shutdown = false;
+    // engine failure AFTER the fetch phase: the J2CQueue consumer may
+    // be blocked on the ring with no more blocks ever coming — it must
+    // wake and fail the reduce instead of hanging to the task timeout
+    private volatile Throwable queueFailure;
 
     public UdaPluginRT(UdaShuffleConsumerPluginShared<K, V> consumer,
                        TaskAttemptID reduceId, JobConf jobConf,
@@ -365,6 +369,17 @@ public class UdaPluginRT<K, V> implements UdaBridge.Callable {
                         + what));
     }
 
+    /** Wake a consumer blocked on the ring with a terminal failure
+     *  (no more blocks are coming). */
+    void failQueue(Throwable t) {
+        queueFailure = t;
+        for (KVBuf buf : kvBufs) {
+            synchronized (buf) {
+                buf.notifyAll();
+            }
+        }
+    }
+
     Progress getProgress() {
         return progress;
     }
@@ -421,7 +436,8 @@ public class UdaPluginRT<K, V> implements UdaBridge.Callable {
             consumerIdx = (consumerIdx + 1) % KV_BUF_NUM;
             KVBuf next = kvBufs[consumerIdx];
             synchronized (next) {
-                while (next.status != KVBuf.REDC_READY && !closed) {
+                while (next.status != KVBuf.REDC_READY && !closed
+                        && queueFailure == null) {
                     try {
                         next.wait();
                     } catch (InterruptedException e) {
@@ -430,7 +446,11 @@ public class UdaPluginRT<K, V> implements UdaBridge.Callable {
                                 + "merge data");
                     }
                 }
-                if (closed && next.status != KVBuf.REDC_READY) {
+                if (next.status != KVBuf.REDC_READY) {
+                    if (queueFailure != null) {
+                        throw new IOException(
+                                "engine failed mid-stream", queueFailure);
+                    }
                     throw new EOFException("queue closed mid-stream");
                 }
                 if (carry.length == 0) {

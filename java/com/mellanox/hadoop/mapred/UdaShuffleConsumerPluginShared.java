@@ -62,6 +62,10 @@ public class UdaShuffleConsumerPluginShared<K, V> {
     private volatile boolean fetchOutputsCompleted;
     private volatile boolean fallbackFetchOutputsDone;
     private volatile boolean exitGetMapEvents;
+    // a failure for which fallback was impossible (developer mode or
+    // fallback-init failure): stored so the waiter re-raises it LOUDLY
+    // instead of hanging on the fetch lock
+    private volatile Throwable udaFailure;
 
     void notifyFetchCompleted() {
         synchronized (fetchLock) {
@@ -70,17 +74,23 @@ public class UdaShuffleConsumerPluginShared<K, V> {
         }
     }
 
-    /** Usually called from an engine thread (:161-177). */
+    /** Usually called from an engine thread (:161-177). NEVER throws:
+     *  a failure here must wake the fetch waiter, not kill the calling
+     *  thread (or the JVM, when the caller is an FFM upcall stub). */
     void failureInUda(Throwable t) {
         try {
             doFallbackInit(t);
+        } catch (Throwable t2) {
+            udaFailure = new UdaRuntimeException(
+                    "Failure in UDA and failure when trying to fallback "
+                    + "to vanilla", t2);
+        } finally {
             synchronized (fetchLock) {
                 fetchLock.notifyAll();
             }
-        } catch (Throwable t2) {
-            throw new UdaRuntimeException(
-                    "Failure in UDA and failure when trying to fallback "
-                    + "to vanilla", t2);
+            if (rdmaChannel != null) {
+                rdmaChannel.failQueue(udaFailure != null ? udaFailure : t);
+            }
         }
     }
 
@@ -143,7 +153,8 @@ public class UdaShuffleConsumerPluginShared<K, V> {
         events.start();
         LOG.info("fetchOutputs - Using UdaShuffleConsumerPlugin");
         synchronized (fetchLock) {
-            while (!fetchCompleted && fallbackPlugin == null) {
+            while (!fetchCompleted && fallbackPlugin == null
+                    && udaFailure == null) {
                 try {
                     fetchLock.wait();
                 } catch (InterruptedException e) {
@@ -153,6 +164,11 @@ public class UdaShuffleConsumerPluginShared<K, V> {
             }
         }
         exitGetMapEvents = true;
+        if (udaFailure != null) {
+            // developer mode / fallback-impossible: fail the task loudly
+            throw new UdaRuntimeException("UDA failed with no fallback",
+                    udaFailure);
+        }
         if (fallbackPlugin != null) {
             throw new UdaRuntimeException(
                     "another thread has indicated Uda failure");

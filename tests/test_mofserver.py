@@ -124,3 +124,30 @@ def test_data_engine_concurrent(tmp_path):
         t.join()
     engine.stop()
     assert not errors
+
+
+def test_multi_root_resolution_and_per_disk_threads(tmp_path):
+    """Map outputs spread across local dirs resolve (the reference's
+    LocalDirAllocator search) and reader threads scale per disk
+    (AsyncReaderManager.cc:16-50)."""
+    from tests.helpers import make_mof_tree, map_ids
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver, ShuffleRequest
+    from uda_tpu.utils.config import Config
+
+    r1, r2 = tmp_path / "d0", tmp_path / "d1"
+    make_mof_tree(str(r1), "jobMR", 2, 1, 10, seed=31)
+    make_mof_tree(str(r2), "jobMR", 4, 1, 10, seed=31)
+    # keep only maps 2..3 in r2 so each root holds a disjoint subset
+    import shutil
+    for mid in map_ids("jobMR", 2):
+        shutil.rmtree(r2 / "jobMR" / mid)
+    cfg = Config({"mapred.uda.provider.blocked.threads.per.disk": 2})
+    engine = DataEngine(DirIndexResolver([str(r1), str(r2)]), cfg,
+                        num_disks=2)
+    try:
+        assert engine._pool._max_workers == 4  # 2 threads x 2 disks
+        for mid in map_ids("jobMR", 4):
+            res = engine.fetch(ShuffleRequest("jobMR", mid, 0, 0, 1 << 20))
+            assert res.is_last and len(res.data) > 0
+    finally:
+        engine.stop()

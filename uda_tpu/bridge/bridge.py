@@ -134,9 +134,15 @@ class UdaBridge:
         get_logger().set_level(self.cfg.get("uda.log.level"))
         if not is_net_merger:
             # MOFSupplier_main: the data engine serves fetches; paths
-            # resolve through the up-call (the IndexCache round trip)
+            # resolve through the up-call (the IndexCache round trip).
+            # Reader threads scale with the configured disk count
+            # (reference AsyncReaderManager.cc:16-50).
             self._resolver = _UpcallIndexResolver(self.callable)
-            self._engine = DataEngine(self._resolver, self.cfg)
+            dirs = [d for d in str(
+                self.cfg.get("mapred.local.dir", default="")).split(",")
+                if d.strip()]
+            self._engine = DataEngine(self._resolver, self.cfg,
+                                      num_disks=max(1, len(dirs)))
         self.started = True
         log.info(f"uda_tpu bridge started as "
                  f"{'NetMerger' if is_net_merger else 'MOFSupplier'}")
@@ -377,7 +383,11 @@ class UdaBridge:
             return self._client
         if local_dirs:
             from uda_tpu.mofserver import DirIndexResolver
-            engine = DataEngine(DirIndexResolver(local_dirs[0]), self.cfg)
+            # reader threads scale with the disk count, the reference's
+            # per-disk AIO pools (AsyncReaderManager.cc:16-50 sized by
+            # threads.per.disk x local dirs)
+            engine = DataEngine(DirIndexResolver(local_dirs), self.cfg,
+                                num_disks=len(local_dirs))
         else:
             engine = DataEngine(_UpcallIndexResolver(self.callable), self.cfg)
         self._owned_engine = engine

@@ -106,13 +106,24 @@ class IndexResolver:
 class DirIndexResolver(IndexResolver):
     """Default layout resolver: ``<root>/<job>/<map_id>/file.out[.index]``
     (the reference's usercache/appcache layout shape, UdaPluginSH.java:
-    107-144, without the YARN user indirection)."""
+    107-144, without the YARN user indirection). Accepts one root or a
+    list of roots — map outputs spread across local dirs resolve like
+    the reference's LocalDirAllocator search over mapred.local.dir."""
 
-    def __init__(self, root: str):
-        self.root = root
+    def __init__(self, root):
+        self.roots = [root] if isinstance(root, str) else list(root)
+        if not self.roots:
+            raise StorageError("DirIndexResolver needs at least one root")
+        self.root = self.roots[0]  # primary root (writer default)
         super().__init__(self._from_dir)
 
     def map_dir(self, job_id: str, map_id: str) -> str:
+        """First root holding the map output; the primary root when
+        none does (the write-side location)."""
+        for r in self.roots:
+            d = os.path.join(r, job_id, map_id)
+            if os.path.exists(os.path.join(d, "file.out.index")):
+                return d
         return os.path.join(self.root, job_id, map_id)
 
     def _from_dir(self, job_id: str, map_id: str) -> list[IndexRecord]:
@@ -120,5 +131,6 @@ class DirIndexResolver(IndexResolver):
         mof = os.path.join(d, "file.out")
         idx = os.path.join(d, "file.out.index")
         if not os.path.exists(idx):
-            raise StorageError(f"no index file for {job_id}/{map_id} at {idx}")
+            raise StorageError(f"no index file for {job_id}/{map_id} "
+                               f"under {self.roots}")
         return read_index_file(idx, mof)
