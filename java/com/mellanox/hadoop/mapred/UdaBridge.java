@@ -156,7 +156,19 @@ public final class UdaBridge {
                     (int) len);
             t.dataFromUda(out);
         } catch (Throwable t2) {
+            // a dropped block means the stream is unrecoverable: route
+            // into the failure path so consumers wake and fail over
+            // instead of waiting forever for the missing bytes
             System.err.println("[UdaBridge] dataFromUda threw: " + t2);
+            try {
+                Callable t = target;
+                if (t != null) {
+                    t.failureInUda("dataFromUda delivery failed: " + t2);
+                }
+            } catch (Throwable t3) {
+                System.err.println("[UdaBridge] failure relay threw: "
+                        + t3);
+            }
         }
     }
 

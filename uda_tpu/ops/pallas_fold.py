@@ -15,12 +15,19 @@ rows ``[k0, k1, k2, tb]`` — so:
 - strides above h never occur (bitonic strides are powers of two
   below the span, and e XOR j for j < h never crosses the half bit).
 
-Everything else — merge-path windows, per-side alignment rolls, the
-HBM layout between passes (standard keys8 [8, n], one record per
-lane) — is unchanged: kernels fold on entry and unfold on exit with
-static row slices, so the pass bookkeeping (pallas_sort._pass_splits)
-is reused as-is. Requires num_keys <= 3 (keys + tie-break fit the
-4-row slot); the TeraSort keyset is exactly that shape.
+The HBM layout BETWEEN passes is slim: ``[4, n]`` rows
+``[k0, k1, k2, tb]`` — the 8-row keys layout's rows 3..6 are always
+zero for the <= 3-key shapes this engine serves, so carrying them
+through every pass would double the inter-pass HBM traffic and the
+merge-pass DMA windows for nothing. Folding becomes free with this
+layout: a merge kernel DMAs the A window into the lower 4-row slot
+and the B window into the upper one (no in-kernel fold shuffle at
+all). The pass bookkeeping (pallas_sort._pass_splits) is row-count
+generic and reused as-is with ``tb_row=3``. Requires num_keys <= 3
+(keys + tie-break fit the 4-row slot); the TeraSort keyset is exactly
+that shape. ``sort_lanes_folded`` keeps the 8-row in/out contract
+(slims on entry, rebuilds on exit); ``sort_lanes_folded4`` is the
+slim-layout core.
 """
 
 from __future__ import annotations
@@ -37,31 +44,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from uda_tpu.ops.pallas_sort import _LANE, _lex_lt, _pass_splits
 
-__all__ = ["sort_lanes_folded"]
+__all__ = ["sort_lanes_folded", "sort_lanes_folded4"]
 
 _INF = np.uint32(0xFFFFFFFF)
 _SLOT = 4                # rows per element-half: 3 key rows + tie-break
 _TB = 7                  # tie-break row of the standard keys8 layout
-
-
-def _fold(x, h):
-    """[8, 2h] standard keys8 rows -> [8, h] folded (two 4-row slots)."""
-    return jnp.concatenate([x[:3, :h], x[_TB:_TB + 1, :h],
-                            x[:3, h:], x[_TB:_TB + 1, h:]], axis=0)
-
-
-def _slot_to_rows(slot4, h):
-    """One [4, h] slot -> [8, h] standard keys8 rows (rows 3..6 zero)."""
-    return jnp.concatenate(
-        [slot4[:3], jnp.zeros((_TB - 3, h), jnp.uint32), slot4[3:4]],
-        axis=0)
-
-
-def _unfold(F, h):
-    """Inverse of _fold: [8, h] folded -> [8, 2h] standard keys8 rows
-    (rows 3..6 zero)."""
-    return jnp.concatenate([_slot_to_rows(F[:_SLOT], h),
-                            _slot_to_rows(F[_SLOT:], h)], axis=1)
+_TB4 = 3                 # tie-break row of the slim [4, n] layout
 
 
 def _emat(h):
@@ -84,7 +72,7 @@ def _cmp_exchange_folded(F, j: int, asc_mat, num_keys: int, h: int):
         left = jnp.roll(F, -j, axis=1)
         right = jnp.roll(F, j, axis=1)
         other = jnp.where(low, left, right)
-    krl = list(range(num_keys)) + [3]
+    krl = list(range(num_keys)) + [_TB4]
     lt_lo = _lex_lt([F[r] for r in krl],
                     [other[r] for r in krl])[None, :]
     lt_hi = _lex_lt([F[r + _SLOT] for r in krl],
@@ -97,12 +85,14 @@ def _cmp_exchange_folded(F, j: int, asc_mat, num_keys: int, h: int):
 def _tile_sort_kernel_folded(x_ref, o_ref, *, tile, num_keys, alternate):
     t = pl.program_id(0)
     h = tile // 2
-    F = _fold(x_ref[...], h)
+    x = x_ref[...]                       # [4, tile] slim layout
+    # fold: elements [0, h) stay in the lower slot, [h, tile) move up
+    F = jnp.concatenate([x[:, :h], x[:, h:]], axis=0)
     rowi = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
     e = _emat(h)
     # stability: global arrival index into both tie-break rows
     g = (e + t * tile).astype(jnp.uint32)
-    F = jnp.where((rowi == 3) | (rowi == _TB), g, F)
+    F = jnp.where((rowi == _TB4) | (rowi == _TB), g, F)
     if alternate:
         tile_asc = (t % 2) == 0
     else:
@@ -118,7 +108,7 @@ def _tile_sort_kernel_folded(x_ref, o_ref, *, tile, num_keys, alternate):
             F = _cmp_exchange_folded(F, j, asc, num_keys, h)
             j //= 2
         k *= 2
-    o_ref[...] = _unfold(F, h)
+    o_ref[...] = jnp.concatenate([F[:_SLOT], F[_SLOT:]], axis=1)
 
 
 @partial(jax.jit, static_argnames=("tile", "num_keys", "alternate",
@@ -143,10 +133,12 @@ def _merge_pass_kernel_folded(splits_ref, splits_nxt_ref, x_hbm, o_ref,
                               num_keys, split_blk):
     """One output tile of one merge pass, folded: same DMA double
     buffering and window construction as pallas_sort._merge_pass_kernel
-    (see there for the rank bookkeeping), but the 2*tile-element merge
-    network runs on an [8, tile] folded array — the A window in the
-    lower 4-row slot, B in the upper — so every lane stage moves half
-    the data and the first stage (stride=tile) is a row-group swap.
+    (see there for the rank bookkeeping), but over the slim [4, n] HBM
+    layout — each window DMA moves 4 rows, and stacking the A window
+    (lower slot) on the B window (upper slot) IS the folded [8, tile]
+    array, so the 2*tile-element network starts with no fold shuffle;
+    every lane stage moves half the standard layout's data and the
+    first stage (stride=tile) is a row-group swap.
 
     MAINTENANCE: the DMA issue/wait protocol, the splits plumbing, and
     the non-negative-shift pltpu.roll contract are a deliberate mirror
@@ -189,8 +181,8 @@ def _merge_pass_kernel_folded(splits_ref, splits_nxt_ref, x_hbm, o_ref,
     out_asc = splits_ref[s, 6] != 0
 
     r_idx = lax.broadcasted_iota(jnp.int32, (1, tile), 1)
-    rowi = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
-    is_key_row = (rowi < num_keys) | (rowi == _TB)
+    rowi4 = lax.broadcasted_iota(jnp.int32, (_SLOT, 1), 0)
+    is_key_row = (rowi4 < num_keys) | (rowi4 == _TB4)
 
     a_rows = pltpu.roll(a_bufs[slot], shift_a, 1)[:, :tile]
     a_rows = jnp.where(is_key_row & (r_idx >= thr_a),
@@ -199,7 +191,8 @@ def _merge_pass_kernel_folded(splits_ref, splits_nxt_ref, x_hbm, o_ref,
     b_rows = jnp.where(is_key_row & (r_idx < thr_b),
                        jnp.broadcast_to(_INF, b_rows.shape), b_rows)
 
-    F = _fold(jnp.concatenate([a_rows, b_rows], axis=1), tile)
+    # A = elements [0, tile) -> lower slot; B = [tile, 2*tile) -> upper
+    F = jnp.concatenate([a_rows, b_rows], axis=0)
     asc = jnp.broadcast_to(out_asc, (8, tile))
     j = tile
     while j >= 1:
@@ -207,9 +200,8 @@ def _merge_pass_kernel_folded(splits_ref, splits_nxt_ref, x_hbm, o_ref,
         j //= 2
     # ascending output keeps the smallest tile elements = the lower
     # slot; descending keeps positions [tile, 2*tile) = the upper
-    cho = jnp.where(jnp.broadcast_to(out_asc, (_SLOT, tile)),
-                    F[:_SLOT], F[_SLOT:])
-    o_ref[...] = _slot_to_rows(cho, tile)
+    o_ref[...] = jnp.where(jnp.broadcast_to(out_asc, (_SLOT, tile)),
+                           F[:_SLOT], F[_SLOT:])
 
 
 @partial(jax.jit, static_argnames=("tile", "num_keys", "interpret"))
@@ -238,17 +230,18 @@ def _merge_pass_folded(x, splits, tile: int, num_keys: int,
     )(splits, splits_nxt, x)
 
 
-def sort_lanes_folded(x, num_keys: int, tile: int = 1024,
-                      interpret: bool = False):
-    """Drop-in for ``pallas_sort.sort_lanes(x, num_keys, tb_row=7)`` on
-    8-row keys arrays with ``num_keys <= 3``: same output contract
-    (rows 3..6 zeroed, row 7 = arrival index), half the network work.
-    ``tile`` must be a power-of-two multiple of 256 (the folded lane
-    width tile/2 must stay lane-aligned)."""
-    x = jnp.asarray(x, jnp.uint32)
-    rows, n = x.shape
-    if rows != 8:
-        raise ValueError(f"folded cascade needs an 8-row keys array, "
+def sort_lanes_folded4(x4, num_keys: int, tile: int = 1024,
+                       interpret: bool = False):
+    """The slim-layout core: ``x4`` is uint32[4, n] rows
+    ``[k0, k1, k2, tb]`` (row 3 is overwritten with the arrival index);
+    returns the sorted [4, n] array. Half the standard keys8 pipeline's
+    network work AND half its inter-pass HBM traffic / DMA window
+    bytes. ``tile`` must be a power-of-two multiple of 256 (the folded
+    lane width tile/2 must stay lane-aligned)."""
+    x4 = jnp.asarray(x4, jnp.uint32)
+    rows, n = x4.shape
+    if rows != _SLOT:
+        raise ValueError(f"slim folded cascade needs a 4-row array, "
                          f"got {rows} rows")
     if not 0 < num_keys <= 3:
         raise ValueError(f"folded cascade needs num_keys <= 3, got "
@@ -260,16 +253,36 @@ def sort_lanes_folded(x, num_keys: int, tile: int = 1024,
         raise ValueError(f"n={n} must be a power-of-two multiple of "
                          f"tile={tile}")
     levels = int(np.log2(n // tile))
-    x = _tile_sort_folded(x, tile, num_keys, alternate=levels > 0,
-                          interpret=interpret)
+    x4 = _tile_sort_folded(x4, tile, num_keys, alternate=levels > 0,
+                           interpret=interpret)
     if levels == 0:
-        return x
+        return x4
 
-    def body(lvl, x):
+    def body(lvl, x4):
         run_len = jnp.int32(tile) << lvl
         final = lvl == levels - 1
-        splits = _pass_splits(x, run_len, final, tile, num_keys, _TB)
-        return _merge_pass_folded(x, splits, tile, num_keys,
+        splits = _pass_splits(x4, run_len, final, tile, num_keys, _TB4)
+        return _merge_pass_folded(x4, splits, tile, num_keys,
                                   interpret=interpret)
 
-    return lax.fori_loop(0, levels, body, x)
+    return lax.fori_loop(0, levels, body, x4)
+
+
+def sort_lanes_folded(x, num_keys: int, tile: int = 1024,
+                      interpret: bool = False):
+    """Drop-in for ``pallas_sort.sort_lanes(x, num_keys, tb_row=7)`` on
+    8-row keys arrays with ``num_keys <= 3``: same output contract
+    (rows 3..6 zeroed, row 7 = arrival index), half the network work
+    and half the inter-pass HBM traffic (the pipeline itself runs on
+    the slim [4, n] layout — see sort_lanes_folded4)."""
+    x = jnp.asarray(x, jnp.uint32)
+    rows, n = x.shape
+    if rows != 8:
+        raise ValueError(f"folded cascade needs an 8-row keys array, "
+                         f"got {rows} rows")
+    x4 = jnp.concatenate([x[:_TB4], x[_TB:_TB + 1]], axis=0)
+    out4 = sort_lanes_folded4(x4, num_keys, tile=tile,
+                              interpret=interpret)
+    return jnp.concatenate(
+        [out4[:_TB4], jnp.zeros((_TB - _TB4, n), jnp.uint32),
+         out4[_TB4:]], axis=0)

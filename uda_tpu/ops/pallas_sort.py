@@ -439,22 +439,26 @@ def keys8_sort_perm(keyrows, tile: int = 1024, interpret: bool = False,
     k, m = keyrows.shape
     if not 0 < k <= 7:
         raise ValueError(f"keys8 needs 1..7 key rows, got {k}")
-    mat8 = jnp.concatenate(
-        [jnp.asarray(keyrows, jnp.uint32),
-         jnp.zeros((8 - k, m), jnp.uint32)], axis=0)
-    if folded and tile % (2 * _LANE) == 0:
-        # the folded cascade (ops.pallas_fold): half the network work,
+    if folded and k <= 3 and tile % (2 * _LANE) == 0:
+        # the folded cascade (ops.pallas_fold): half the network work
+        # AND half the inter-pass HBM traffic (slim [4, n] layout);
         # needs the compare set to fit a 4-row slot. Tiles below two
         # lane blocks cannot fold (the half width must stay
         # lane-aligned) and quietly use the standard cascade — the
         # output contract is identical.
-        from uda_tpu.ops.pallas_fold import sort_lanes_folded
+        from uda_tpu.ops.pallas_fold import sort_lanes_folded4
 
-        out8 = sort_lanes_folded(mat8, num_keys=k, tile=tile,
-                                 interpret=interpret)
-    else:
-        out8 = sort_lanes(mat8, num_keys=k, tb_row=7, tile=tile,
-                          interpret=interpret)
+        mat4 = jnp.concatenate(
+            [jnp.asarray(keyrows, jnp.uint32),
+             jnp.zeros((4 - k, m), jnp.uint32)], axis=0)
+        out4 = sort_lanes_folded4(mat4, num_keys=k, tile=tile,
+                                  interpret=interpret)
+        return out4[:k], out4[3].astype(jnp.int32)
+    mat8 = jnp.concatenate(
+        [jnp.asarray(keyrows, jnp.uint32),
+         jnp.zeros((8 - k, m), jnp.uint32)], axis=0)
+    out8 = sort_lanes(mat8, num_keys=k, tb_row=7, tile=tile,
+                      interpret=interpret)
     return out8[:k], out8[7].astype(jnp.int32)
 
 

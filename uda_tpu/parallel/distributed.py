@@ -258,18 +258,20 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
                payload_path="carry", interpret=False):
     from uda_tpu.ops.sort import LANES_ENGINES
 
-    # check_vma is disabled ONLY for the Pallas lanes engines on
-    # MULTI-PROCESS meshes: they mix replicated constants (iota tables,
-    # padding fills) with sharded data in ways the strict
-    # varying-manual-axes checker mis-types there (jax suggests this
-    # exact workaround). Single-process meshes pass the check, so they
-    # keep it — as do the lax.sort paths everywhere. Output correctness
-    # of the lanes engines is pinned by the byte-identity tests incl.
-    # the 2-process run.
+    # check_vma is disabled ONLY for the Pallas lanes engines: they mix
+    # replicated constants (iota tables, padding fills) with sharded
+    # data in ways the strict varying-manual-axes checker mis-types
+    # (jax suggests this exact workaround). Gating the bypass on
+    # process_count (r3 advisor suggestion) was tried and REVERTED: on
+    # single-process meshes of >= 16 devices the received buffer spans
+    # multiple sort tiles, the merge-pass fori_loop engages, and the
+    # checker rejects its carry ("apply pcast to loop_carry[1][...]")
+    # — dryrun_multichip(16/32) is the regression case. The lax.sort
+    # paths keep the checker. Output correctness of the lanes engines
+    # is pinned by the byte-identity tests incl. the 2-process run.
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
              out_specs=(P(axis), P(axis), P(axis)),
-             check_vma=(payload_path not in LANES_ENGINES
-                        or jax.process_count() <= 1))
+             check_vma=payload_path not in LANES_ENGINES)
     def _go(w, spl):
         p = lax.psum(1, axis)
         n, wcols = w.shape
