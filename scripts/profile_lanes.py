@@ -93,11 +93,13 @@ if __name__ == "__main__":
           f"{jax.devices()[0].platform}")
     t_gen = time_stage("gen")
     t_tile = time_stage("tilesort", 1024)
+    # keys8f's slim [4, n] layout halves merge-kernel VMEM, so its
+    # sweep extends to 32768 (fewer passes at half the DMA bytes)
     for stage, tiles in (("full", (1024, 2048, 4096)),
                          ("keys8sort", (4096, 8192, 16384)),
-                         ("keys8fsort", (4096, 8192, 16384)),
+                         ("keys8fsort", (4096, 8192, 16384, 32768)),
                          ("keys8", (4096, 8192, 16384)),
-                         ("keys8f", (4096, 8192, 16384))):
+                         ("keys8f", (4096, 8192, 16384, 32768))):
         for tile in tiles:
             if (N % tile) or ((N // tile) & (N // tile - 1)):
                 continue

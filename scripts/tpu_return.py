@@ -18,7 +18,9 @@ mid-sequence still leaves a usable record:
 5. gatherprobe — in-kernel Mosaic gather formulations (exploratory,
                  lanes2 viability) — AFTER the primary artifacts, so a
                  hung variant compile cannot cost them the window
-6. profile     — keys8/lanes tile sweep
+6. profile     — keys8/keys8f/lanes tile sweep
+7. overlap     — overlap-forest vs post-hoc global sort (the
+                 network-levitated perf datum, scripts/bench_overlap.py)
 
 Stage order is the priority order; pass --stop-after N to cut the tail
 (the three take-ramp sizes count separately: --stop-after 5 = take16,
@@ -142,6 +144,7 @@ def main() -> int:
          3600),
         ("gatherprobe", [py, "scripts/probe_gather.py"], 1200),
         ("profile", [py, "scripts/profile_lanes.py"], 3600),
+        ("overlap", [py, "scripts/bench_overlap.py"], 1800),
     ]
 
     def alive(tag: str) -> bool:
