@@ -53,18 +53,22 @@ DISPATCHES = 2
 LANES_TILE = min(4096, 1 << LOG2_RECORDS)
 # the keys8 cascade works on 8-row arrays, so VMEM admits much larger
 # tiles (fewer merge passes); default 8192 pending a hardware sweep
-# (scripts/profile_lanes.py sweeps 4096/8192/16384)
+# (scripts/profile_lanes.py sweeps 4096/8192/16384[/32768 for keys8f])
 KEYS8_TILE = min(int(os.environ.get("UDA_TPU_BENCH_KEYS8_TILE", 8192)),
                  1 << LOG2_RECORDS)
-# per-path timing-tile overrides set by a successful probe RETRY at a
-# smaller tile: only that path's fly-off tile changes, so a keys8f
-# retry can never silently move keys8 to a tile it was not probed at
-_TILE_OVERRIDE: dict = {}
+# keys8f's slim layout halves merge-kernel VMEM, so a much larger tile
+# (= fewer whole merge passes) is in play: when keys8f compiles at
+# KEYS8_TILE, a SECOND fly-off candidate probes at this tile too
+# (0 disables)
+KEYS8F_TILE2 = min(int(os.environ.get("UDA_TPU_BENCH_KEYS8F_TILE2",
+                                      32768)), 1 << LOG2_RECORDS)
+# NB: the fly-off threads each candidate's timing tile explicitly as a
+# (path, tile) tuple; _tile_for only provides the DEFAULT (what the
+# probe subprocess compiles at via env, and what single-candidate runs
+# time at)
 
 
 def _tile_for(path: str) -> int:
-    if path in _TILE_OVERRIDE:
-        return _TILE_OVERRIDE[path]
     return KEYS8_TILE if path in ("keys8", "keys8f") else LANES_TILE
 # run the Pallas kernels in interpret mode (CPU smoke runs of the lanes
 # path; useless on TPU and at full size)
@@ -236,10 +240,19 @@ def main() -> None:
     # compiles, first success wins.
     flyoff_variants = [p for p in PATHS if p in FLYOFF_PATHS]
     fallbacks = [p for p in PATHS if p not in FLYOFF_PATHS]
-    candidates = []
+    candidates: list = []  # (path, tile) pairs
     for p in flyoff_variants:
         if _probe(p, PROBE_TIMEOUT):
-            candidates.append(p)
+            candidates.append((p, _tile_for(p)))
+            if (p == "keys8f" and KEYS8F_TILE2
+                    and KEYS8F_TILE2 != _tile_for(p)
+                    and _probe(p, PROBE_TIMEOUT,
+                               extra_env={"UDA_TPU_BENCH_KEYS8_TILE":
+                                          str(KEYS8F_TILE2)},
+                               log_name=f"keys8f_tile{KEYS8F_TILE2}")):
+                # the big-tile variant joins as its OWN candidate: the
+                # measured fly-off decides, never the guess
+                candidates.append((p, KEYS8F_TILE2))
         elif p in ("keys8", "keys8f") and KEYS8_TILE != LANES_TILE:
             # the bigger keys8 tile is a bet pending the hardware
             # sweep; a failed compile must not drop the engine from
@@ -251,13 +264,12 @@ def main() -> None:
                       extra_env={"UDA_TPU_BENCH_KEYS8_TILE":
                                  str(LANES_TILE)},
                       log_name=f"{p}_tile{LANES_TILE}"):
-                _TILE_OVERRIDE[p] = LANES_TILE
-                candidates.append(p)
+                candidates.append((p, LANES_TILE))
     for path in fallbacks:
         if candidates:
             break
         if _probe(path, PROBE_TIMEOUT):
-            candidates = [path]
+            candidates = [(path, _tile_for(path))]
     if not candidates:
         raise SystemExit("no bench path compiled within budget")
 
@@ -270,7 +282,7 @@ def main() -> None:
     n = 1 << LOG2_RECORDS
     gb_per_dispatch = n * terasort.RECORD_BYTES * ROUNDS_PER_DISPATCH / 1e9
 
-    def timed_dispatch(path, seed):
+    def timed_dispatch(path, seed, tile):
         """One timed dispatch (int() forces host readback — on the
         tunneled axon backend block_until_ready does NOT wait for
         device compute, so all timing synchronizes through a scalar
@@ -278,12 +290,11 @@ def main() -> None:
         t0 = time.perf_counter()
         viol, ck_in, ck_out = terasort.bench_step(jax.random.key(seed), n,
                                                   ROUNDS_PER_DISPATCH,
-                                                  path=path,
-                                                  tile=_tile_for(path),
+                                                  path=path, tile=tile,
                                                   interpret=INTERPRET)
         ok = (int(viol) == 0, np.uint32(ck_in) == np.uint32(ck_out))
         dt = time.perf_counter() - t0
-        assert all(ok), f"validation failed on {path}: {ok}"
+        assert all(ok), f"validation failed on {path}@{tile}: {ok}"
         return dt
 
     if len(candidates) > 1:
@@ -292,23 +303,24 @@ def main() -> None:
         # deserialization, tracing) that would bias against whichever
         # candidate runs first
         timings = {}
-        for p in candidates:
-            timed_dispatch(p, 999)  # warmup
-            timings[p] = timed_dispatch(p, 998)
+        for p, tile in candidates:
+            timed_dispatch(p, 999, tile)  # warmup
+            timings[(p, tile)] = timed_dispatch(p, 998, tile)
         chosen = min(timings, key=timings.get)
-        for p, dt in timings.items():
-            print(f"# fly-off {p}: {gb_per_dispatch/dt:.3f} GB/s",
+        for (p, tile), dt in timings.items():
+            print(f"# fly-off {p}@{tile}: {gb_per_dispatch/dt:.3f} GB/s",
                   file=sys.stderr)
     else:
         chosen = candidates[0]
-        timed_dispatch(chosen, 999)  # warmup (compile cache hit)
+        timed_dispatch(chosen[0], 999, chosen[1])  # warmup (cache hit)
 
     # UDA_TPU_XPROF=<dir> captures a device profile of the timed
     # dispatches (no-op otherwise)
     from uda_tpu.utils.metrics import device_trace
 
     with device_trace():
-        best = min(timed_dispatch(chosen, i) for i in range(DISPATCHES))
+        best = min(timed_dispatch(chosen[0], i, chosen[1])
+                   for i in range(DISPATCHES))
     gbps = gb_per_dispatch / best
     print(json.dumps({
         "metric": "terasort_singlechip_shuffle_merge_gbps",

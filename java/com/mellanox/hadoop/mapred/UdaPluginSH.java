@@ -56,6 +56,15 @@ public class UdaPluginSH implements UdaBridge.Callable {
 
     public void removeJob(JobID jobId) {
         resolver.removeJob(jobId);
+        try {
+            // engine-side cache hygiene: JOB_OVER invalidates the
+            // supplier's cached index records for the job (the
+            // reference's mof_downcall JOB_OVER path)
+            bridge.doCommand(UdaCmd.formCmd(UdaCmd.JOB_OVER_COMMAND,
+                    java.util.List.of(jobId.toString())));
+        } catch (Throwable t) {
+            LOG.warning("JOB_OVER for " + jobId + " failed: " + t);
+        }
     }
 
     public void close() {
