@@ -145,6 +145,16 @@ def test_kway_small_buffers_span_records(tmp_path):
     assert out == _oracle_bytes(paths, kt)
 
 
+def test_kway_many_cursors(tmp_path):
+    """A deep non-power-of-two loser tree (k=67) stays byte-identical."""
+    kt = get_key_type("uda.tpu.RawBytes")
+    runs = _sorted_runs(kt, n_runs=67, n_recs=25,
+                        keygen=lambda rng: rng.bytes(
+                            int(rng.integers(0, 8))), seed=13)
+    paths = _spill(tmp_path, runs)
+    assert _native_bytes(paths, kt) == _oracle_bytes(paths, kt)
+
+
 def test_kway_missing_eof_marker(tmp_path):
     kt = get_key_type("uda.tpu.RawBytes")
     p = str(tmp_path / "trunc")
