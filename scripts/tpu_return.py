@@ -12,20 +12,23 @@ mid-sequence still leaves a usable record:
                  keys8 viability CHEAPLY before any full-size compile;
                  each size is its own subprocess so a pathological
                  compile costs one budget, not the window)
-3. bench       — python bench.py (the official JSON line; its fly-off
-                 probes keys8/lanes2/lanes itself with per-path budgets)
-4. regression  — the ambient workload ladder artifact
-5. gatherprobe — in-kernel Mosaic gather formulations (exploratory,
+3. bench_lanes — bench.py restricted to the r3-hardware-validated
+                 "lanes" engine: ONE cheap compile = a quality number
+                 even if the window dies mid-fly-off
+4. bench       — python bench.py (the official JSON line; its fly-off
+                 probes keys8f/keys8/lanes2/... with per-path budgets)
+5. regression  — the ambient workload ladder artifact
+6. gatherprobe — in-kernel Mosaic gather formulations (exploratory,
                  lanes2 viability) — AFTER the primary artifacts, so a
                  hung variant compile cannot cost them the window
-6. profile     — keys8/keys8f/lanes tile sweep
-7. overlap     — overlap-forest vs post-hoc global sort (the
+7. profile     — keys8/keys8f/lanes tile sweep
+8. overlap     — overlap-forest vs post-hoc global sort (the
                  network-levitated perf datum, scripts/bench_overlap.py)
 
 Stage order is the priority order; pass --stop-after N to cut the tail
-(the three take-ramp sizes count separately: --stop-after 5 = take16,
-take19, take22, bench, regression — the primary artifacts, skipping
-the exploratory stages).
+(the three take-ramp sizes count separately: --stop-after 6 = take16,
+take19, take22, bench_lanes, bench, regression — the primary
+artifacts, skipping the exploratory stages).
 
 Discipline encoded here (learned from the 2026-07-30 wedges):
 stages run strictly sequentially; a timed-out stage is killed as a
@@ -92,7 +95,8 @@ print(f"take[23,2^{log2}]: best {{best*1e3:.1f}} ms = "
 
 
 def run_stage(name: str, argv: list[str], budget_s: float,
-              log_dir: str) -> tuple[bool, bool]:
+              log_dir: str, extra_env: dict | None = None
+              ) -> tuple[bool, bool]:
     """One subprocess stage -> (ok, timed_out). Output streams directly
     to <log_dir>/<name>.log (stdout+stderr interleaved; nothing is lost
     if the stage is killed). On budget overrun the stage's whole
@@ -105,7 +109,8 @@ def run_stage(name: str, argv: list[str], budget_s: float,
         proc = subprocess.Popen(
             argv, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
             start_new_session=True,
-            env=dict(os.environ, JAX_TRACEBACK_FILTERING="off"))
+            env=dict(os.environ, JAX_TRACEBACK_FILTERING="off",
+                     **(extra_env or {})))
         try:
             rc = proc.wait(timeout=budget_s)
         except subprocess.TimeoutExpired:
@@ -134,17 +139,25 @@ def main() -> int:
     py = sys.executable
 
     stages = [
-        ("take16", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=16)], 900),
-        ("take19", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=19)], 900),
-        ("take22", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=22)], 1200),
-        ("bench", [py, "bench.py"], 3600),
+        ("take16", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=16)], 900,
+         None),
+        ("take19", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=19)], 900,
+         None),
+        ("take22", [py, "-c", TAKE_RAMP.format(repo=REPO, log2=22)], 1200,
+         None),
+        # a number FIRST: the r3-hardware-validated engine alone, one
+        # cheap compile — a short window that dies mid-fly-off still
+        # leaves a committed-quality figure in bench_lanes.log
+        ("bench_lanes", [py, "bench.py"], 1500,
+         {"UDA_TPU_BENCH_PATHS": "lanes"}),
+        ("bench", [py, "bench.py"], 3600, None),
         ("regression", [py, "scripts/regression/run_regression.py",
                         "--platform", "ambient", "--size", "small",
                         "--out", os.path.join(args.log_dir, "ambient")],
-         3600),
-        ("gatherprobe", [py, "scripts/probe_gather.py"], 1200),
-        ("profile", [py, "scripts/profile_lanes.py"], 3600),
-        ("overlap", [py, "scripts/bench_overlap.py"], 1800),
+         3600, None),
+        ("gatherprobe", [py, "scripts/probe_gather.py"], 1200, None),
+        ("profile", [py, "scripts/profile_lanes.py"], 3600, None),
+        ("overlap", [py, "scripts/bench_overlap.py"], 1800, None),
     ]
 
     def alive(tag: str) -> bool:
@@ -155,10 +168,10 @@ def main() -> int:
         print("pool wedged; aborting sequence", flush=True)
         return 1
     done = 0
-    for name, argv, budget in stages:
+    for name, argv, budget, env in stages:
         if done >= args.stop_after:
             break
-        ok, timed_out = run_stage(name, argv, budget, args.log_dir)
+        ok, timed_out = run_stage(name, argv, budget, args.log_dir, env)
         done += 1
         if timed_out and not alive(f"liveness_after_{name}"):
             # a killed-mid-compile client is the documented wedge
