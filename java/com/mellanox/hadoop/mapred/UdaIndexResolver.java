@@ -31,17 +31,30 @@ public class UdaIndexResolver implements UdaBridge.PathResolver {
 
     private static final int INDEX_CACHE_ENTRIES = 1024;
 
+    /** One cached map output: its MOF path + per-reduce index triples
+     *  (caching the path too keeps cache hits free of per-root file
+     *  stats). */
+    private static final class Entry {
+        final String mofPath;
+        final long[][] triples;
+
+        Entry(String mofPath, long[][] triples) {
+            this.mofPath = mofPath;
+            this.triples = triples;
+        }
+    }
+
     private final JobConf jobConf;
     private final Map<String, String> userByJob =
             new ConcurrentHashMap<>();
-    // (job, map) -> index triples; LRU-bounded like the reference's
+    // (job, map) -> cached output; LRU-bounded like the reference's
     // mapreduce.tasktracker.indexcache.mb budget
-    private final Map<String, long[][]> indexCache =
+    private final Map<String, Entry> indexCache =
             java.util.Collections.synchronizedMap(
                     new LinkedHashMap<>(64, 0.75f, true) {
                         @Override
                         protected boolean removeEldestEntry(
-                                Map.Entry<String, long[][]> eldest) {
+                                Map.Entry<String, Entry> eldest) {
                             return size() > INDEX_CACHE_ENTRIES;
                         }
                     });
@@ -90,39 +103,37 @@ public class UdaIndexResolver implements UdaBridge.PathResolver {
     public UdaBridge.IndexRecord getPathIndex(String jobId, String mapId,
                                               int reduce) {
         String cacheKey = jobId + "/" + mapId;
-        long[][] triples = indexCache.get(cacheKey);
-        File mof = null;
-        for (String root : roots()) {
-            File dir = mapDir(root.trim(), jobId, mapId);
-            File candidate = new File(dir, "file.out");
-            if (candidate.isFile()) {
-                mof = candidate;
-                if (triples == null) {
+        Entry entry = indexCache.get(cacheKey);
+        if (entry == null) {
+            for (String root : roots()) {
+                File dir = mapDir(root.trim(), jobId, mapId);
+                File mof = new File(dir, "file.out");
+                if (mof.isFile()) {
                     try {
-                        triples = readIndexFile(
-                                new File(dir, "file.out.index"));
-                        indexCache.put(cacheKey, triples);
+                        entry = new Entry(mof.getPath(), readIndexFile(
+                                new File(dir, "file.out.index")));
                     } catch (IOException e) {
                         LOG.severe("got an exception while retrieving the "
                                 + "index info: " + e);
                         return null;
                     }
+                    indexCache.put(cacheKey, entry);
+                    break;
                 }
-                break;
             }
         }
-        if (mof == null || triples == null) {
+        if (entry == null) {
             LOG.severe("no MOF for " + jobId + "/" + mapId
                     + " under local dirs");
             return null;
         }
-        if (reduce < 0 || reduce >= triples.length) {
+        if (reduce < 0 || reduce >= entry.triples.length) {
             LOG.severe("reduce " + reduce + " out of range for " + mapId
-                    + " (" + triples.length + " partitions)");
+                    + " (" + entry.triples.length + " partitions)");
             return null;
         }
-        long[] t = triples[reduce];
-        return new UdaBridge.IndexRecord(mof.getPath(), t[0], t[1], t[2]);
+        long[] t = entry.triples[reduce];
+        return new UdaBridge.IndexRecord(entry.mofPath, t[0], t[1], t[2]);
     }
 
     /** Hadoop spill index: (start, raw, part) 8-byte BE triples

@@ -28,8 +28,8 @@ public class JobConf extends Configuration {
     }
 
     public String[] getLocalDirs() {
-        return getTrimmedStrings("mapreduce.cluster.local.dir").length > 0
-                ? getTrimmedStrings("mapreduce.cluster.local.dir")
+        String[] modern = getTrimmedStrings("mapreduce.cluster.local.dir");
+        return modern.length > 0 ? modern
                 : getTrimmedStrings("mapred.local.dir");
     }
 

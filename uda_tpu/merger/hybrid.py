@@ -30,7 +30,7 @@ from typing import Callable, Sequence
 
 from uda_tpu import native
 from uda_tpu.ops import merge as merge_ops
-from uda_tpu.utils.ifile import iter_file_records
+from uda_tpu.utils.ifile import iter_file_records, native_enabled
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -133,8 +133,6 @@ def run_hybrid(mm, job_id: str, map_ids: Sequence, reduce_id: int,
     # (byte-identical either way, tests/test_native.py).
     try:
         with metrics.timer("rpq_phase"):
-            from uda_tpu.utils.ifile import native_enabled
-
             if (native_enabled() and native.kway_supported(mm.key_type)
                     and native.build()):
                 log.info(f"RPQ: native loser-tree merge of "
