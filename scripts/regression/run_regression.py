@@ -49,7 +49,7 @@ def _add_repo_to_path() -> None:
 _add_repo_to_path()
 
 SIZES = {
-    # per-workload scale knobs: (small, medium, large)
+    # per-workload scale knobs: (small, medium, large[, xlarge])
     "wordcount_bytes": (1 << 16, 1 << 20, 1 << 24),
     "terasort_records": (1 << 12, 1 << 16, 1 << 20),
     "secsort_groups": (10, 60, 300),
@@ -59,11 +59,23 @@ SIZES = {
     "sort_records": (1 << 10, 1 << 13, 1 << 16),
     "pi_points_per_map": (500, 5000, 50000),
     "dfsio_bytes_per_file": (1 << 18, 1 << 22, 1 << 26),
+    # engine-direct shuffle lanes (100-byte TeraSort records through
+    # fetch -> merge -> framed emit, no Python map phase): total records
+    # across all maps. xlarge = the >=1 GB rung of the reference's
+    # cluster regression (reference scripts/regression/
+    # executeTerasort.sh:22-80 scale intent)
+    "shuffle_records": (1 << 14, 1 << 17, 1 << 20, 10_500_000),
 }
+
+# workloads that exist to be run at the xlarge rung (the engine-scale
+# gate); everything else tops out at large
+XLARGE_WORKLOADS = ("terasort_shuffle_hybrid", "terasort_shuffle_streaming")
 
 
 def _size(name: str, size: str) -> int:
-    return SIZES[name][{"small": 0, "medium": 1, "large": 2}[size]]
+    idx = {"small": 0, "medium": 1, "large": 2, "xlarge": 3}[size]
+    knobs = SIZES[name]
+    return knobs[min(idx, len(knobs) - 1)]
 
 
 class Sampler:
@@ -255,6 +267,129 @@ def wl_mesh_shuffle(size: str, work_dir: str) -> dict:
     return {"input_bytes": len(text), "distinct_words": len(want)}
 
 
+def _make_terasort_mofs(root: str, job: str, num_maps: int,
+                        records_per_map: int, seed: int = 17) -> None:
+    """Vectorized TeraSort MOF generator: per-map sorted 10B-key/90B-value
+    records, native-framed straight to disk (no per-record Python) —
+    the xlarge rungs measure the ENGINE, not a Python map phase."""
+    import numpy as np
+
+    from uda_tpu import native
+    from uda_tpu.mofserver.index import write_index_file
+    from uda_tpu.utils.ifile import EOF_MARKER, RecordBatch
+
+    for m in range(num_maps):
+        rng = np.random.default_rng(seed + m)
+        n = records_per_map
+        keys = rng.integers(0, 256, (n, 10), dtype=np.uint8)
+        keys = keys[np.lexsort(tuple(keys[:, c] for c in range(9, -1, -1)))]
+        vals = rng.integers(0, 256, (n, 90), dtype=np.uint8)
+        buf = np.concatenate([keys.reshape(-1), vals.reshape(-1)])
+        batch = RecordBatch(
+            buf,
+            np.arange(n, dtype=np.int64) * 10, np.full(n, 10, np.int64),
+            n * 10 + np.arange(n, dtype=np.int64) * 90,
+            np.full(n, 90, np.int64))
+        d = os.path.join(root, job, f"attempt_{job}_m_{m:06d}_0")
+        os.makedirs(d, exist_ok=True)
+        mof = os.path.join(d, "file.out")
+        with open(mof, "wb") as f:
+            for piece in native.iter_framed_chunks(batch, write_eof=True):
+                f.write(piece)
+        size = os.path.getsize(mof)
+        write_index_file(mof + ".index", [(0, size, size)])
+
+
+def _verify_sorted_stream(path: str, expected_records: int) -> None:
+    """Vectorized sortedness + count gate over a framed 100B-record
+    output stream (the terasortAnallizer role) with bounded memory."""
+    import numpy as np
+
+    from uda_tpu.utils.ifile import crack_partial
+
+    prev_tail = None
+    total = 0
+    carry = b""
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(64 << 20)
+            if not chunk:
+                break
+            data = carry + chunk
+            batch, consumed, saw_eof = crack_partial(data)
+            carry = data[consumed:]
+            n = batch.num_records
+            if n == 0:
+                continue
+            total += n
+            assert np.all(batch.key_len == 10), "key width drifted"
+            keys = batch.data[
+                batch.key_off[:, None] + np.arange(10)[None, :]]
+            # pad to 16B, view as 2 big-endian u64 for vector compare
+            padded = np.zeros((n, 16), np.uint8)
+            padded[:, :10] = keys
+            w = padded.reshape(-1).tobytes()
+            u = np.frombuffer(w, dtype=">u8").reshape(n, 2)
+            a, b = u[:-1], u[1:]
+            ok = (a[:, 0] < b[:, 0]) | ((a[:, 0] == b[:, 0])
+                                        & (a[:, 1] <= b[:, 1]))
+            assert bool(np.all(ok)), "output stream not sorted"
+            if prev_tail is not None:
+                pa, pb = prev_tail, u[0]
+                assert (pa[0] < pb[0]) or (pa[0] == pb[0]
+                                           and pa[1] <= pb[1]), \
+                    "output not sorted across chunk boundary"
+            prev_tail = u[-1]
+    assert carry in (b"", b"\xff\xff"), "trailing garbage after records"
+    assert total == expected_records, \
+        f"record count {total} != {expected_records}"
+
+
+def _terasort_shuffle(size: str, work_dir: str, mode: str) -> dict:
+    """1-reducer shuffle of TeraSort MOFs through the real engine path
+    (DataEngine -> fetch window -> merge -> framed emit), hybrid or
+    streaming-online, with the sortedness gate on the emitted stream."""
+    from uda_tpu.merger import LocalFetchClient, MergeManager
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.utils import comparators
+    from uda_tpu.utils.config import Config
+
+    total = _size("shuffle_records", size)
+    num_maps = max(4, min(64, total // 160_000 or 4))
+    per_map = (total + num_maps - 1) // num_maps
+    job = f"shuf{mode}"
+    _make_terasort_mofs(work_dir, job, num_maps, per_map)
+    cfg = Config({
+        "mapred.netmerger.merge.approach": 2 if mode == "hybrid" else 1,
+        "uda.tpu.online.streaming": mode == "streaming",
+        "uda.tpu.spill.dirs": os.path.join(work_dir, "spill"),
+        "mapred.rdma.wqe.per.conn": 8,
+    })
+    engine = DataEngine(DirIndexResolver(work_dir), cfg)
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    out_path = os.path.join(work_dir, "reduce.out")
+    try:
+        mm = MergeManager(LocalFetchClient(engine), kt, cfg)
+        with open(out_path, "wb") as out:
+            emitted = mm.run(
+                job, [f"attempt_{job}_m_{m:06d}_0" for m in range(num_maps)],
+                0, lambda mv: out.write(mv))
+    finally:
+        engine.stop()
+    _verify_sorted_stream(out_path, num_maps * per_map)
+    shuffled = num_maps * per_map * 100
+    return {"mode": mode, "maps": num_maps, "records": num_maps * per_map,
+            "shuffle_bytes": shuffled, "emitted_bytes": emitted}
+
+
+def wl_terasort_shuffle_hybrid(size: str, work_dir: str) -> dict:
+    return _terasort_shuffle(size, work_dir, "hybrid")
+
+
+def wl_terasort_shuffle_streaming(size: str, work_dir: str) -> dict:
+    return _terasort_shuffle(size, work_dir, "streaming")
+
+
 def wl_pi(size: str, work_dir: str) -> dict:
     from uda_tpu.models.pi import run_pi
 
@@ -283,6 +418,8 @@ WORKLOADS = {
     "mesh_shuffle": wl_mesh_shuffle,
     "pi": wl_pi,
     "dfsio": wl_dfsio,
+    "terasort_shuffle_hybrid": wl_terasort_shuffle_hybrid,
+    "terasort_shuffle_streaming": wl_terasort_shuffle_streaming,
 }
 
 
@@ -327,9 +464,11 @@ def _run_single(name: str, size: str, platform: str, out_dir: str,
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--size", choices=("small", "medium", "large"),
+    ap.add_argument("--size", choices=("small", "medium", "large", "xlarge"),
                     default="small")
-    ap.add_argument("--workloads", default=",".join(WORKLOADS))
+    ap.add_argument("--workloads", default="",
+                    help="comma list; default = all (xlarge: the engine "
+                         "shuffle lanes only)")
     ap.add_argument("--reps", type=int, default=1)
     ap.add_argument("--out", default="")
     ap.add_argument("--platform", choices=("cpu", "ambient"), default="cpu")
@@ -341,7 +480,12 @@ def main() -> int:
         return _run_single(args.single, args.size, args.platform,
                            args.out or tempfile.gettempdir(), args.rep)
 
-    names = [w.strip() for w in args.workloads.split(",") if w.strip()]
+    if args.workloads:
+        names = [w.strip() for w in args.workloads.split(",") if w.strip()]
+    elif args.size == "xlarge":
+        names = list(XLARGE_WORKLOADS)
+    else:
+        names = list(WORKLOADS)
     unknown = [w for w in names if w not in WORKLOADS]
     if unknown:
         print(f"unknown workloads: {unknown}", file=sys.stderr)

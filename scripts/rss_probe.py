@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Peak-RSS probe for the online merge: multi-GB shuffle, O(window) host?
+
+Drives MergeManager over a SYNTHETIC transport that manufactures each
+fetch chunk on the fly (deterministic per (map, offset)), so the input
+shuffle never exists in host memory or on disk — whatever RSS the
+process reaches is the merge engine's own footprint. This is the
+evidence harness for the bounded-memory claim of
+``uda.tpu.online.streaming`` (the reference's staging-loop memory model,
+reference src/Merger/StreamRW.cc:151-225, MergeManager.cc:155-182): the
+streaming path must hold O(fetch window), not O(shuffle).
+
+Prints one JSON line:
+  {"mode": ..., "shuffle_bytes": N, "peak_rss_bytes": N, "wall_s": ...}
+
+Run it in a fresh subprocess per mode (RU_MAXRSS is a process high-water
+mark); ``--compare`` forks one child per mode and asserts the bound.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _force_cpu() -> None:
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+class SyntheticClient:
+    """InputClient manufacturing sorted IFile partitions chunk by chunk.
+
+    Each map's partition is ``records`` fixed-size records (key_bytes
+    key, val_bytes value) with keys drawn from a per-map seeded
+    Philox stream and PRE-SORTED — generated lazily per chunk request,
+    cached only for the duration of that map's fetch."""
+
+    def __init__(self, records: int, key_bytes: int, val_bytes: int,
+                 cache_slots: int = 12):
+        self.records = records
+        self.key_bytes = key_bytes
+        self.val_bytes = val_bytes
+        self.cache_slots = cache_slots  # ~fetch window; keep the probe's
+        self._cache: dict[str, bytes] = {}  # own memory out of the result
+
+    def _partition(self, map_id: str) -> bytes:
+        # one map's framed partition; cached so the 2-3 chunk fetches of
+        # the same map don't regenerate it, evicted when another map is
+        # requested (fetch windows interleave, so keep a small LRU)
+        data = self._cache.get(map_id)
+        if data is None:
+            import numpy as np
+
+            from uda_tpu.utils.ifile import RecordBatch
+            from uda_tpu import native
+
+            seed = abs(hash(map_id)) % (2**31)
+            rng = np.random.default_rng(seed)
+            keys = rng.integers(0, 256, (self.records, self.key_bytes),
+                                dtype=np.uint8)
+            keys = keys[np.lexsort(
+                tuple(keys[:, c] for c in range(self.key_bytes - 1, -1, -1)))]
+            vals = rng.integers(0, 256, (self.records, self.val_bytes),
+                                dtype=np.uint8)
+            buf = np.concatenate(
+                [keys.reshape(-1), vals.reshape(-1)]).astype(np.uint8)
+            n = self.records
+            batch = RecordBatch(
+                buf,
+                np.arange(n, dtype=np.int64) * self.key_bytes,
+                np.full(n, self.key_bytes, np.int64),
+                n * self.key_bytes + np.arange(n, dtype=np.int64)
+                * self.val_bytes,
+                np.full(n, self.val_bytes, np.int64))
+            data = b"".join(native.iter_framed_chunks(batch, write_eof=True))
+            if len(self._cache) >= self.cache_slots:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[map_id] = data
+        return data
+
+    def start_fetch(self, req, on_complete) -> None:
+        from uda_tpu.mofserver.data_engine import FetchResult
+
+        data = self._partition(req.map_id)
+        chunk = data[req.offset:req.offset + req.chunk_size]
+        last = req.offset + len(chunk) >= len(data)
+        if last:
+            self._cache.pop(req.map_id, None)
+        on_complete(FetchResult(chunk, len(data), len(data), req.offset,
+                                "synthetic", last))
+
+    def stop(self) -> None:
+        self._cache.clear()
+
+
+def run_probe(mode: str, maps: int, records: int, key_bytes: int,
+              val_bytes: int) -> dict:
+    _force_cpu()
+    from uda_tpu.merger.merge_manager import MergeManager
+    from uda_tpu.utils.comparators import get_key_type
+    from uda_tpu.utils.config import Config
+
+    cfg = Config({
+        "uda.tpu.online.streaming": mode == "streaming",
+        "mapred.netmerger.merge.approach": 2 if mode == "hybrid" else 1,
+        "mapred.rdma.wqe.per.conn": 4,
+    })
+    client = SyntheticClient(records, key_bytes, val_bytes)
+    mm = MergeManager(client, get_key_type("uda.tpu.RawBytes"), cfg)
+    emitted = 0
+    last_tail = b""
+
+    def consumer(mv) -> None:
+        nonlocal emitted, last_tail
+        emitted += len(mv)
+        last_tail = bytes(mv[-2:])
+
+    t0 = time.monotonic()
+    total = mm.run("rssjob", [f"m{i:05d}" for i in range(maps)], 0, consumer)
+    wall = time.monotonic() - t0
+    assert total == emitted and last_tail == b"\xff\xff", \
+        (total, emitted, last_tail)
+    shuffle = maps * records * (key_bytes + val_bytes)
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    # on the CPU probe the forest key rows live in HOST rss (the "host"
+    # merge engine); on TPU they are HBM-resident — report the surrogate
+    # so the host-side bound is judged on record bytes, as deployed
+    kw = 16 // 4  # default uda.tpu.key.width
+    rows_surrogate = maps * records * (kw + 3) * 4
+    return {"mode": mode, "maps": maps, "records_per_map": records,
+            "shuffle_bytes": shuffle, "emitted_bytes": emitted,
+            "peak_rss_bytes": peak,
+            "device_rows_surrogate_bytes": rows_surrogate,
+            "wall_s": round(wall, 2)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["streaming", "inmem", "hybrid"],
+                    default="streaming")
+    ap.add_argument("--maps", type=int, default=80)
+    ap.add_argument("--records", type=int, default=50_000,
+                    help="records per map")
+    ap.add_argument("--key-bytes", type=int, default=10)
+    ap.add_argument("--val-bytes", type=int, default=1014,
+                    help="default sizes a 4 GB shuffle whose device-row "
+                         "surrogate is <2%% of it (see run_probe note)")
+    ap.add_argument("--compare", action="store_true",
+                    help="fork a child per mode; assert streaming stays "
+                         "bounded while inmem scales with the shuffle")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    if not args.compare:
+        print(json.dumps(run_probe(args.mode, args.maps, args.records,
+                                   args.key_bytes, args.val_bytes)))
+        return 0
+
+    results = {}
+    for mode in ("streaming", "inmem"):
+        cmd = [sys.executable, os.path.abspath(__file__), "--mode", mode,
+               "--maps", str(args.maps), "--records", str(args.records),
+               "--key-bytes", str(args.key_bytes),
+               "--val-bytes", str(args.val_bytes)]
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=3600)
+        if out.returncode != 0:
+            print(out.stdout + out.stderr, file=sys.stderr)
+            return 1
+        results[mode] = json.loads(out.stdout.strip().splitlines()[-1])
+    shuffle = results["streaming"]["shuffle_bytes"]
+    verdict = {
+        "shuffle_bytes": shuffle,
+        "streaming_peak": results["streaming"]["peak_rss_bytes"],
+        "inmem_peak": results["inmem"]["peak_rss_bytes"],
+        "streaming_frac": round(
+            results["streaming"]["peak_rss_bytes"] / shuffle, 3),
+        "inmem_frac": round(
+            results["inmem"]["peak_rss_bytes"] / shuffle, 3),
+        "wall_streaming_s": results["streaming"]["wall_s"],
+        "wall_inmem_s": results["inmem"]["wall_s"],
+        # the claim: streaming holds O(window) of record bytes, far
+        # below the shuffle (quarter-shuffle bound at the 4 GB default)
+        "bounded": results["streaming"]["peak_rss_bytes"] < shuffle // 4,
+    }
+    print(json.dumps(verdict))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"results": results, "verdict": verdict}, f, indent=1)
+    return 0 if verdict["bounded"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

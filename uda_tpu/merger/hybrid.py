@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import math
 import os
-import tempfile
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
@@ -75,9 +74,9 @@ def run_hybrid(mm, job_id: str, map_ids: Sequence, reduce_id: int,
     lpqs = num_lpqs_for(num_maps, cfg.get("mapred.netmerger.hybrid.lpq.size"))
     group = math.ceil(num_maps / lpqs)
     parallel = cfg.get("mapred.rdma.num.parallel.lpqs") or 3
-    spill_dirs = [d for d in str(
-        cfg.get("uda.tpu.spill.dirs", default=tempfile.gettempdir())
-    ).split(",") if d] or [tempfile.gettempdir()]
+    from uda_tpu.merger.streaming import spill_dirs as _spill_dirs
+
+    spill_dirs = _spill_dirs(cfg)
 
     groups = [list(map_ids[i:i + group]) for i in range(0, num_maps, group)]
     log.info(f"hybrid merge: {num_maps} maps -> {len(groups)} LPQs of <= "

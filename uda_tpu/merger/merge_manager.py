@@ -169,14 +169,30 @@ class MergeManager:
         if approach == 2:
             from uda_tpu.merger.hybrid import run_hybrid
             return run_hybrid(self, job_id, map_ids, reduce_id, consumer)
-        if not self.cfg.get("uda.tpu.merge.overlap"):
+        streaming = bool(self.cfg.get("uda.tpu.online.streaming"))
+        if not streaming and not self.cfg.get("uda.tpu.merge.overlap"):
             segments = self.fetch_all(job_id, map_ids, reduce_id)
             merged = self.merge_segments(segments)
             return self.emit_framed(merged, consumer)
 
         from uda_tpu.merger.overlap import OverlappedMerger
 
-        om = OverlappedMerger(self.key_type, self.key_width)
+        store = None
+        if streaming:
+            # bounded-host-memory online mode (uda.tpu.online.streaming):
+            # segments spool to sorted runs + release their bytes; the
+            # bounded feed queue keeps pending segments at O(window);
+            # emission interleaves the runs with sequential cursors —
+            # no shuffle-sized host allocation anywhere (the reference's
+            # staging-loop memory model, StreamRW.cc:151-225)
+            from uda_tpu.merger.streaming import RunStore, spill_dirs
+
+            store = RunStore(spill_dirs(self.cfg),
+                             tag=f"{job_id}.r{reduce_id}")
+        om = OverlappedMerger(
+            self.key_type, self.key_width, run_store=store,
+            max_pending=self.window if streaming else 0,
+            stagers=self.cfg.get("uda.tpu.online.stagers"))
         self._active_overlap = om  # observability (tests/diagnostics)
         try:
             # feed the Segment itself: record_batch() (a full concat of
@@ -185,11 +201,16 @@ class MergeManager:
             segments = self.fetch_all(job_id, map_ids, reduce_id,
                                       on_segment=om.feed)
         except Exception:
-            om.abort()
+            om.abort()  # also cleans up the run store
             raise
-        with metrics.timer("merge"):
-            merged = om.finish([s.record_batch() for s in segments])
-        return self.emit_framed(merged, consumer)
+        # the "merge" timer covers drain + forest carry inside the
+        # finish paths; emission stays under the emitter's "emit" timer
+        if streaming:
+            return om.finish_streaming(
+                self.emitter, consumer,
+                expected_records=sum(s.num_records for s in segments))
+        return om.emit_stream([s.record_batch() for s in segments],
+                              self.emitter, consumer)
 
     def stop(self) -> None:
         self._stop.set()

@@ -39,8 +39,10 @@ always stay on the fast path.
 
 from __future__ import annotations
 
+import functools
 import queue
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -52,7 +54,7 @@ from uda_tpu.ops import packing
 from uda_tpu.ops.pallas_merge import merge_sorted_pair
 from uda_tpu.utils.comparators import KeyType
 from uda_tpu.utils.errors import MergeError
-from uda_tpu.utils.ifile import RecordBatch
+from uda_tpu.utils.ifile import EOF_MARKER, RecordBatch
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -111,7 +113,8 @@ class OverlappedMerger:
     CPU, pallas elsewhere).
     """
 
-    def __init__(self, key_type: KeyType, width: int, engine: str = "auto"):
+    def __init__(self, key_type: KeyType, width: int, engine: str = "auto",
+                 run_store=None, max_pending: int = 0, stagers: int = 0):
         self.key_type = key_type
         self.width = width
         if engine == "auto":
@@ -121,55 +124,112 @@ class OverlappedMerger:
         self.engine = engine
         # off-TPU, a forced pallas engine runs in interpret mode
         self.interpret = jax.default_backend() == "cpu"
-        self._q: "queue.Queue" = queue.Queue()
+        # streaming mode (uda.tpu.online.streaming): segments spool to
+        # sorted run files and release their bytes after staging; the
+        # bounded queue is the credit backpressure that keeps
+        # completed-but-unstaged segments at O(window)
+        self.run_store = run_store
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
+        self._aborted = False
         self._forest: dict[int, _Run] = {}   # capacity -> run
+        self._forest_lock = threading.Lock()
+        self._state_lock = threading.Lock()  # counters/overflow flag
         self._overflow = False
         self._error: Optional[Exception] = None
         self._merges = 0
         self._staged = 0
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="uda-overlap-merge")
-        self._thread.start()
+        # staging pool (uda.tpu.online.stagers): pack+sort+spool of
+        # DIFFERENT segments parallelize; forest carries serialize under
+        # _forest_lock (the merge chain itself is one run at a time
+        # anyway). One thread when unset — the r4 behavior.
+        self._threads = [
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"uda-overlap-merge-{i}")
+            for i in range(max(1, stagers))]
+        for t in self._threads:
+            t.start()
 
     # -- producer side (fetch completion callbacks, any thread) -------------
 
     def feed(self, seg_index: int, source) -> None:
-        """Stage one completed segment's records (non-blocking; safe to
-        call from a transport completion thread). ``source`` is either a
-        RecordBatch or an object with a ``record_batch()`` method (a
-        Segment) — materialization happens on the merge thread."""
-        self._q.put((seg_index, source))
+        """Stage one completed segment's records (safe to call from a
+        transport completion thread). ``source`` is either a RecordBatch
+        or an object with a ``record_batch()`` method (a Segment) —
+        materialization happens on the merge thread. With a bounded
+        queue (streaming mode) this call BLOCKS when staging lags, which
+        is the intended backpressure: the transport thread holds off
+        until host memory frees (the reference's RDMA credit-flow
+        posture, MergeManager.cc:47-63)."""
+        if self._q.maxsize <= 0:
+            self._q.put((seg_index, source))
+            return
+        while not self._aborted:
+            try:
+                self._q.put((seg_index, source), timeout=0.1)
+                return
+            except queue.Full:
+                continue
 
     # -- merge thread --------------------------------------------------------
 
     def _loop(self) -> None:
         while True:
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=0.25)
+            except queue.Empty:
+                if self._aborted:
+                    return  # abort() without a reachable poison pill
+                continue
             if item is None:
                 return
-            if self._error is not None:
+            if self._error is not None or self._aborted:
                 continue  # drain; finish() will surface the error
             try:
                 self._stage(*item)
             except Exception as e:  # surfaced at finish()
                 self._error = e
 
+    @staticmethod
+    def _release(source) -> None:
+        """Free a staged segment's raw bytes (streaming mode only: the
+        sorted run on disk is now the record source of truth)."""
+        release = getattr(source, "release", None)
+        if release is not None:
+            release()
+
     def _stage(self, seg_index: int, source) -> None:
-        if self._overflow:
+        streaming = self.run_store is not None
+        if self._overflow and not streaming:
             return  # fast path already disabled; finish() re-sorts all
         batch = (source if isinstance(source, RecordBatch)
                  else source.record_batch())
         if batch.num_records == 0:
+            if streaming:
+                self._release(source)
             return
         with metrics.timer("overlap_pack"):
             packed = packing.pack_keys(batch, self.key_type, self.width)
+        n = batch.num_records
+        kw = packed.key_words.shape[1]
         if int(np.max(packed.key_lens, initial=0)) > self.width:
             # rank-bearing keys: cross-run rank consistency needs the
             # global view; disable the fast path (see module docstring)
             self._overflow = True
+            if not streaming:
+                return
+            # streaming keeps spooling: this run is ordered by the FULL
+            # comparator (rare, per-record Python), so finish falls back
+            # to the comparator-level k-way merge over the run files —
+            # still O(window) host memory
+            cmp = self.key_type.compare
+            keys = [batch.key(i) for i in range(n)]
+            order = np.asarray(sorted(range(n), key=functools.cmp_to_key(
+                lambda i, j: cmp(keys[i], keys[j]) or (i - j))), np.int64)
+            self.run_store.write_run(seg_index, batch, order)
+            with self._state_lock:
+                self._staged += 1
+            self._release(source)
             return
-        n = batch.num_records
-        kw = packed.key_words.shape[1]
         # device runs pad to a power-of-two capacity (bounded set of
         # kernel shapes); host runs stay exact-sized
         cap = _next_pow2(n) if self.engine == "pallas" else n
@@ -182,18 +242,28 @@ class OverlappedMerger:
         # composite; row index column is already arrival order)
         order = np.lexsort(tuple(rows[:n, c] for c in range(kw, -1, -1)))
         rows[:n] = rows[:n][order]
-        self._staged += 1
+        if streaming:
+            self.run_store.write_run(seg_index, batch,
+                                     order.astype(np.int64))
+            self._release(source)
+        with self._state_lock:
+            self._staged += 1
+        if self._overflow:
+            return  # forest output won't be consumed; runs are enough
         with metrics.timer("overlap_stage"):
             if self.engine == "pallas":
                 rows = jax.device_put(rows)
             self._insert(_Run(rows, n, _next_pow2(n)))
 
     def _insert(self, run: _Run) -> None:
-        # binary-counter carry: equal size classes merge immediately
-        while run.bucket in self._forest:
-            other = self._forest.pop(run.bucket)
-            run = self._merge(other, run)
-        self._forest[run.bucket] = run
+        # binary-counter carry: equal size classes merge immediately.
+        # The lock serializes carries across the staging pool (pack/
+        # sort/spool of other segments proceed concurrently).
+        with self._forest_lock:
+            while run.bucket in self._forest:
+                other = self._forest.pop(run.bucket)
+                run = self._merge(other, run)
+            self._forest[run.bucket] = run
 
     def _merge(self, a: _Run, b: _Run) -> _Run:
         bucket = 2 * max(a.bucket, b.bucket)
@@ -210,7 +280,8 @@ class OverlappedMerger:
                 merged = merge_sorted_pair(a.rows, b.rows,
                                            num_keys=int(a.rows.shape[1]),
                                            interpret=self.interpret)
-        self._merges += 1
+        with self._state_lock:
+            self._merges += 1
         return _Run(merged, a.valid + b.valid, bucket)
 
     # -- consumer side -------------------------------------------------------
@@ -222,32 +293,24 @@ class OverlappedMerger:
         return {"device_merges": self._merges, "staged_runs": self._staged,
                 "pending": self._q.qsize(), "overflow": self._overflow}
 
-    def finish(self, batches: Sequence[RecordBatch]) -> RecordBatch:
-        """Drain, merge the leftover forest, and materialize the sorted
-        batch. ``batches`` must be ALL segments' batches in original
-        segment-index order (the indices fed to :meth:`feed`)."""
-        self._q.put(None)
-        self._thread.join()
+    def _drain(self) -> None:
+        """Signal end of input and wait for staging to finish."""
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join()
         if self._error is not None:
             raise self._error
-        if self._overflow:
-            log.warn("overlap fast path disabled (oversize keys); "
-                     "falling back to global device re-sort")
-            return merge_ops.merge_batches(batches, self.key_type,
-                                           self.width)
-        cat = RecordBatch.concat(list(batches))
+
+    def _merge_leftovers(self) -> Optional[_Run]:
+        """Merge the O(log k) leftover forest runs, smallest-first; on
+        the pallas engine, pad the smaller run up to the larger capacity
+        first (padding rows sort last, so the validity prefix is
+        preserved) — capacities stay powers of two, so kernel shapes
+        stay in the O(log) compiled set. Returns None when nothing was
+        staged."""
         if not self._forest:
-            if cat.num_records:
-                # records exist but nothing was ever staged: the caller
-                # skipped feed() — returning cat here would silently
-                # emit UNSORTED data as the merge result
-                raise MergeError(
-                    f"overlap merge fed 0 of {cat.num_records} records")
-            return cat  # all segments legitimately empty
-        # merge leftovers smallest-first; on the pallas engine, pad the
-        # smaller run up to the larger capacity first (padding rows sort
-        # last, so the validity prefix is preserved) — capacities stay
-        # powers of two, so kernel shapes stay in the O(log) compiled set
+            return None
         runs = [self._forest[c] for c in sorted(self._forest)]
         self._forest = {}  # release device-resident runs when done
         acc = runs[0]
@@ -259,6 +322,42 @@ class OverlappedMerger:
                     [acc.rows, jax.device_put(pad)], axis=0), acc.valid,
                     acc.bucket)
             acc = self._merge(acc, nxt)
+        return acc
+
+    def _warn_overflow(self, fallback: str) -> None:
+        log.warn(f"overlap fast path disabled (oversize keys); "
+                 f"falling back to {fallback}")
+
+    def _check_accounting(self, acc: Optional[_Run], total: int) -> bool:
+        """Lost-records guard shared by every finish variant. Returns
+        False when nothing was staged AND nothing should have been (the
+        all-empty case); raises when records went missing — silently
+        emitting an incomplete or unsorted merge result is the one
+        unforgivable failure mode."""
+        if acc is None:
+            if total:
+                raise MergeError(
+                    f"overlap merge fed 0 of {total} records")
+            return False
+        if acc.valid != total:
+            raise MergeError(
+                f"overlap merge lost records: {acc.valid} of {total} "
+                f"(segments fed != segments finished?)")
+        return True
+
+    def finish(self, batches: Sequence[RecordBatch]) -> RecordBatch:
+        """Drain, merge the leftover forest, and materialize the sorted
+        batch. ``batches`` must be ALL segments' batches in original
+        segment-index order (the indices fed to :meth:`feed`)."""
+        self._drain()
+        if self._overflow:
+            self._warn_overflow("global device re-sort")
+            return merge_ops.merge_batches(batches, self.key_type,
+                                           self.width)
+        cat = RecordBatch.concat(list(batches))
+        acc = self._merge_leftovers()
+        if not self._check_accounting(acc, cat.num_records):
+            return cat  # all segments legitimately empty
         rows = np.asarray(acc.rows)[:acc.valid]
         kw = rows.shape[1] - 3
         seg_col = rows[:, kw + 1].astype(np.int64)
@@ -266,13 +365,114 @@ class OverlappedMerger:
         sizes = np.asarray([b.num_records for b in batches], np.int64)
         offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
         perm = offsets[seg_col] + row_col
-        if perm.shape[0] != cat.num_records:
-            raise MergeError(
-                f"overlap merge lost records: {perm.shape[0]} of "
-                f"{cat.num_records} (segments fed != segments finished?)")
         return cat.take(perm)
 
+    def emit_stream(self, batches: Sequence[RecordBatch], emitter,
+                    consumer) -> int:
+        """In-memory streaming emission: the same result bytes as
+        ``emitter.emit_batch(self.finish(batches))`` but without ever
+        concatenating the shuffle — each output slab's bytes are
+        gathered straight from the per-segment batches and framed
+        natively, so transient host memory is one slab (the reference's
+        staging-loop memory model over memory-resident segments)."""
+        from uda_tpu.merger import streaming as stream_mod
+
+        with metrics.timer("merge"):
+            self._drain()
+            merged = None
+            if self._overflow:
+                self._warn_overflow("global device re-sort")
+                merged = merge_ops.merge_batches(batches, self.key_type,
+                                                 self.width)
+            else:
+                total = sum(b.num_records for b in batches)
+                acc = self._merge_leftovers()
+        if merged is not None:
+            return emitter.emit_batch(merged, consumer)
+        if not self._check_accounting(acc, total):
+            return emitter.emit_framed(iter([EOF_MARKER]), consumer)
+        kw = int(acc.rows.shape[1]) - 3
+
+        def pieces():
+            from uda_tpu import native
+
+            for rows in stream_mod.iter_row_slabs(acc.rows, acc.valid):
+                seg = rows[:, kw + 1].astype(np.int64)
+                row = rows[:, kw + 2].astype(np.int64)
+                sub = stream_mod.slab_batch(batches, seg, row)
+                yield native.frame_batch(sub, write_eof=False)
+            yield EOF_MARKER
+
+        return emitter.emit_framed(pieces(), consumer)
+
+    def finish_streaming(self, emitter, consumer,
+                         expected_records: Optional[int] = None) -> int:
+        """Streaming-mode finish: drain staging, then emit the merged
+        stream straight from the sorted run files — the permutation-
+        driven k-way interleave (uda_tpu.merger.streaming). Host memory
+        is one slab + one read buffer per run; no shuffle-sized
+        allocation exists on this path. Cleans up the run store."""
+        from uda_tpu import native
+        from uda_tpu.merger import streaming as stream_mod
+        from uda_tpu.utils.ifile import iter_file_records, native_enabled
+
+        store = self.run_store
+        if store is None:
+            raise MergeError("finish_streaming without a run store")
+        try:
+            with metrics.timer("merge"):
+                self._drain()
+                acc = None if self._overflow else self._merge_leftovers()
+            total = store.total_records
+            if expected_records is not None and total != expected_records:
+                raise MergeError(
+                    f"staged {total} of {expected_records} records")
+            if total == 0:
+                return emitter.emit_framed(iter([EOF_MARKER]), consumer)
+            if self._overflow:
+                # every run is comparator-sorted (oversize segments were
+                # ordered by the full comparator at staging), so the
+                # fallback is a comparator-level k-way merge over the
+                # run FILES — bounded memory, like the hybrid RPQ
+                self._warn_overflow("k-way merge over run files")
+                paths = [store.run_path(s) for s in sorted(store.counts)]
+                if (native_enabled() and native.kway_supported(self.key_type)
+                        and native.build()):
+                    return emitter.emit_framed(
+                        native.kway_merge_paths(paths, self.key_type),
+                        consumer)
+                streams = [iter_file_records(p) for p in paths]
+                return emitter.emit(
+                    merge_ops.merge_record_streams(streams, self.key_type),
+                    consumer)
+            self._check_accounting(acc, total)  # total>0: raises on loss
+            kw = int(acc.rows.shape[1]) - 3
+            slabs = stream_mod.iter_row_slabs(acc.rows, acc.valid)
+            return emitter.emit_framed(
+                stream_mod.interleave_runs(slabs, store, kw), consumer)
+        finally:
+            store.cleanup()
+
     def abort(self) -> None:
-        """Stop the merge thread without producing output."""
-        self._q.put(None)
-        self._thread.join(timeout=5.0)
+        """Stop the staging threads without producing output. Safe with
+        a bounded queue: ``_aborted`` unblocks any transport thread
+        waiting in feed() and makes the stager loops drain-and-exit even
+        if no poison pill can land (they poll the flag on an empty
+        queue). The run store is only cleaned once every stager has
+        stopped — never under a concurrent write_run."""
+        self._aborted = True
+        try:
+            self._q.put_nowait(None)  # best effort: wake one instantly
+        except queue.Full:
+            pass
+        deadline = 10.0
+        for t in self._threads:
+            t0 = time.monotonic()
+            t.join(timeout=max(0.1, deadline))
+            deadline -= time.monotonic() - t0
+        if self.run_store is not None:
+            if any(t.is_alive() for t in self._threads):
+                log.warn("overlap abort: stager still running; leaving "
+                         "scratch runs for it to fail safely")
+            else:
+                self.run_store.cleanup()

@@ -142,8 +142,10 @@ class Segment:
         self.chunk_size = chunk_size
         self.host = host
         self.batches: list[RecordBatch] = []
+        self.num_records = 0  # monotone fetch-side record count
         self.raw_length: Optional[int] = None
         self.on_done = None  # callback fired once when fetch finishes
+        self._released = False
         self._carry = b""
         self._next_offset = 0
         self._retries_left = max(0, retries)
@@ -214,6 +216,7 @@ class Segment:
                     if retry:
                         self._retries_left -= 1
                         self.batches = []
+                        self.num_records = 0
                         self._carry = b""
                         self._next_offset = 0
                 if not retry:
@@ -262,6 +265,7 @@ class Segment:
                 batch, consumed, _ = crack_partial(data, expect_eof=last)
                 if batch.num_records:
                     self.batches.append(batch)
+                    self.num_records += batch.num_records
                 self._carry = data[consumed:] if not last else b""
                 self._next_offset = res.offset + len(res.data)
                 metrics.add("fetched_bytes", len(res.data))
@@ -285,10 +289,22 @@ class Segment:
         overlap staging thread, then the finish pass) pay for it once."""
         self.wait()
         with self._lock:
+            if self._released:
+                raise MergeError(
+                    f"segment {self.map_id} bytes were released "
+                    f"(streaming mode spooled them to a sorted run)")
             if len(self.batches) == 1:
                 return self.batches[0]
             cat = RecordBatch.concat(self.batches)
             self.batches = [cat]
             return cat
+
+    def release(self) -> None:
+        """Drop the fetched bytes (streaming online mode: the sorted run
+        file is now the source of truth; ``num_records`` survives for
+        accounting). record_batch() raises after this."""
+        with self._lock:
+            self.batches = []
+            self._released = True
 
 

@@ -1,0 +1,336 @@
+"""Streaming bounded-memory emission for the online merge.
+
+The reference's online merge never materialized the shuffle on the host:
+records flowed RDMA chunk buffers -> k-way heap -> 2 x 1 MB staging
+buffers -> consumer (reference src/Merger/MergeManager.cc:155-182,
+src/Merger/StreamRW.cc:151-225), so host memory stayed at
+O(fetch window), independent of shuffle size. The TPU-native online path
+computes the global sort permutation on device instead of running a
+comparison heap — which is faster, but naively needs every segment's
+bytes resident for the final gather. This module restores the
+reference's memory model around the device permutation:
+
+- **Sorted run spooling** (:class:`RunStore`): as each segment's fetch
+  completes, its records are written to local disk *in per-segment
+  sorted order* as an IFile-framed run plus an ``.off`` sidecar of
+  cumulative framed-record end offsets; the raw fetched bytes are then
+  released. Host memory during fetch = the in-flight window.
+- **Permutation-driven interleave** (:func:`interleave_runs`): the
+  merged device rows already encode, for every output position, which
+  segment supplies the next record. Because each run is sorted, every
+  run is consumed strictly *sequentially* — the emit phase is k
+  buffered file cursors and one output slab, no comparisons, no random
+  access ever (the property that let the reference emit from 1 MB
+  staging buffers, MergeQueue.h:276-427).
+- **Slab gather** (:func:`slab_batch`): the in-memory twin used when
+  streaming is off — gathers each output slab's bytes directly from the
+  per-segment batches, so even the memory-resident path never
+  concatenates the whole shuffle a second time.
+
+Everything is vectorized numpy; the only per-record work is done by the
+native framer when runs are written.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from uda_tpu import native
+from uda_tpu.utils.errors import MergeError, StorageError
+from uda_tpu.utils.ifile import EOF_MARKER, RecordBatch
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["RunStore", "framed_lengths", "interleave_runs", "slab_batch",
+           "iter_row_slabs", "SLAB_RECORDS"]
+
+log = get_logger()
+
+# records per emission slab: bounds transient host memory at emit to one
+# slab's bytes (the streaming analogue of the reference's staging loop)
+SLAB_RECORDS = 1 << 16
+
+
+def _vlong_sizes(values: np.ndarray) -> np.ndarray:
+    """Vectorized ``vint.vlong_size`` for non-negative lengths."""
+    v = np.asarray(values, dtype=np.int64)
+    if np.any(v < 0):
+        raise MergeError("negative record length")
+    # 1 byte for <=127; else 1 tag byte + minimal big-endian body
+    nbits = np.zeros_like(v)
+    nz = v > 0
+    # number of bits via log2 on float64 is exact for lengths < 2^53
+    nbits[nz] = np.floor(np.log2(v[nz])).astype(np.int64) + 1
+    body = (nbits + 7) // 8
+    return np.where(v <= 127, 1, body + 1)
+
+
+def framed_lengths(key_len: np.ndarray, val_len: np.ndarray) -> np.ndarray:
+    """Per-record IFile framed byte length: VInt(klen) VInt(vlen) key
+    value (the ``write_kv_to_stream`` framing, StreamRW.cc:151-225)."""
+    return (_vlong_sizes(key_len) + _vlong_sizes(val_len)
+            + np.asarray(key_len, np.int64) + np.asarray(val_len, np.int64))
+
+
+def _expand_spans(off: np.ndarray, length: np.ndarray) -> np.ndarray:
+    """Flat int64 indices covering [off_i, off_i + length_i) for every i,
+    concatenated in order — the vectorized byte-gather index."""
+    length = np.asarray(length, np.int64)
+    total = int(length.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    ends = np.cumsum(length)
+    starts = ends - length
+    return np.repeat(np.asarray(off, np.int64) - starts, length) + np.arange(
+        total, dtype=np.int64)
+
+
+def _group_ranks(seg: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """For a slab's segment-index column, return (unique_segs,
+    per-record rank within its segment group, per-seg counts) — the
+    sequential-cursor positions each record consumes."""
+    unique, inverse, counts = np.unique(seg, return_inverse=True,
+                                        return_counts=True)
+    # rank of each occurrence within its group, preserving slab order
+    order = np.argsort(inverse, kind="stable")
+    ranks_sorted = np.arange(seg.shape[0], dtype=np.int64) - np.repeat(
+        np.cumsum(counts) - counts, counts)
+    ranks = np.empty(seg.shape[0], np.int64)
+    ranks[order] = ranks_sorted
+    return unique, ranks, counts
+
+
+def spill_dirs(cfg) -> list[str]:
+    """Parse ``uda.tpu.spill.dirs`` into a rotation list (shared by the
+    hybrid LPQ spiller and the streaming run store); empty = system
+    tmp."""
+    dirs = [d for d in str(cfg.get("uda.tpu.spill.dirs")).split(",") if d]
+    return dirs or [tempfile.gettempdir()]
+
+
+class RunStore:
+    """Per-segment sorted run files + offset sidecars in scratch dirs.
+
+    One run per staged segment: ``run-SSSSS.ifile`` holds the segment's
+    records in sorted order with the EOF marker (a complete, valid IFile
+    stream — so the comparator-level k-way merge can consume runs
+    directly on the overflow fallback), and ``run-SSSSS.off`` holds
+    int64 cumulative end offsets of each framed record (EOF excluded),
+    letting the interleave slice records without parsing framing.
+    Multiple base dirs rotate per segment (the reference's local-dir
+    rotation the hybrid spiller also follows). Thread-safe: a staging
+    pool may spool different segments concurrently.
+    """
+
+    def __init__(self, base_dirs=None, tag: str = "online"):
+        if isinstance(base_dirs, str):
+            base_dirs = [base_dirs]
+        roots = list(base_dirs) if base_dirs else [tempfile.gettempdir()]
+        self.dirs = []
+        for root in roots:
+            os.makedirs(root, exist_ok=True)
+            self.dirs.append(
+                tempfile.mkdtemp(prefix=f"uda.{tag}.runs.", dir=root))
+        self.counts: dict[int, int] = {}   # seg index -> record count
+        self.bytes: dict[int, int] = {}    # seg index -> framed bytes (no EOF)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    @property
+    def dir(self) -> str:
+        """Primary scratch dir (single-dir stores; tests)."""
+        return self.dirs[0]
+
+    def _paths(self, seg_index: int) -> tuple[str, str]:
+        stem = os.path.join(self.dirs[seg_index % len(self.dirs)],
+                            f"run-{seg_index:05d}")
+        return stem + ".ifile", stem + ".off"
+
+    def run_path(self, seg_index: int) -> str:
+        return self._paths(seg_index)[0]
+
+    @property
+    def total_records(self) -> int:
+        return sum(self.counts.values())
+
+    def write_run(self, seg_index: int, batch: RecordBatch,
+                  order: np.ndarray) -> None:
+        """Spool ``batch`` in ``order`` as this segment's sorted run.
+        Streams framed chunks (native framer) — peak memory is one
+        chunk, never the whole segment twice."""
+        with self._lock:
+            if seg_index in self.counts:
+                raise MergeError(f"segment {seg_index} staged twice")
+            self.counts[seg_index] = -1  # reserve (pool-safe)
+        sub = batch.take(order)
+        run_path, off_path = self._paths(seg_index)
+        lens = framed_lengths(sub.key_len, sub.val_len)
+        ends = np.cumsum(lens)
+        total = int(ends[-1]) if len(ends) else 0
+        with metrics.timer("run_spool"):
+            with open(run_path, "wb") as f:
+                for piece in native.iter_framed_chunks(sub, write_eof=True):
+                    f.write(piece)
+            wrote = os.path.getsize(run_path)
+            if wrote != total + len(EOF_MARKER):
+                raise StorageError(
+                    f"run {seg_index}: framed {wrote} bytes, offsets "
+                    f"predict {total + len(EOF_MARKER)}")
+            with open(off_path, "wb") as f:
+                ends.astype("<i8").tofile(f)
+        with self._lock:
+            self.counts[seg_index] = sub.num_records
+            self.bytes[seg_index] = total
+        metrics.add("run_spooled_bytes", total)
+
+    def cleanup(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            segs = list(self.counts)
+        for seg in segs:
+            for p in self._paths(seg):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        for d in self.dirs:
+            try:
+                os.rmdir(d)
+            except OSError:
+                pass
+
+
+class _RunCursor:
+    """Sequential reader over one run: hands out the byte span covering
+    the next ``count`` records (both files read strictly forward)."""
+
+    __slots__ = ("run_f", "off_f", "consumed_bytes", "consumed_records")
+
+    def __init__(self, run_path: str, off_path: str):
+        self.run_f = open(run_path, "rb")
+        self.off_f = open(off_path, "rb")
+        self.consumed_bytes = 0
+        self.consumed_records = 0
+
+    def next_span(self, count: int) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (span_bytes, record_lengths) for the next ``count``
+        records."""
+        ends = np.fromfile(self.off_f, dtype="<i8", count=count)
+        if ends.shape[0] != count:
+            raise StorageError("run offset sidecar truncated")
+        lens = np.diff(ends, prepend=np.int64(self.consumed_bytes))
+        span = np.fromfile(self.run_f, dtype=np.uint8,
+                           count=int(ends[-1]) - self.consumed_bytes)
+        if span.shape[0] != int(ends[-1]) - self.consumed_bytes:
+            raise StorageError("run file truncated")
+        self.consumed_bytes = int(ends[-1])
+        self.consumed_records += count
+        return span, lens
+
+    def close(self) -> None:
+        self.run_f.close()
+        self.off_f.close()
+
+
+def iter_row_slabs(rows, valid: int,
+                   slab: int = SLAB_RECORDS) -> Iterator[np.ndarray]:
+    """Yield the merged composite-key rows in bounded host slabs (the
+    rows may be device-resident; each slice transfers one slab)."""
+    for start in range(0, valid, slab):
+        stop = min(start + slab, valid)
+        yield np.asarray(rows[start:stop])
+
+
+def interleave_runs(slabs: Iterator[np.ndarray], store: RunStore,
+                    num_key_words: int) -> Iterator[bytes]:
+    """Permutation-driven k-way interleave of the sorted runs.
+
+    ``slabs`` yields merged rows whose column ``num_key_words + 1`` is
+    the segment index (the OverlappedMerger row layout). Each slab
+    becomes one framed output piece; runs are read strictly
+    sequentially (2 file handles per segment, like the hybrid RPQ's one
+    cursor per spill). The concatenation of the yielded pieces plus the
+    EOF marker is the complete merged IFile stream.
+    """
+    cursors: dict[int, _RunCursor] = {}
+    try:
+        for rows in slabs:
+            if rows.shape[0] == 0:
+                continue
+            seg = rows[:, num_key_words + 1].astype(np.int64)
+            unique, ranks, counts = _group_ranks(seg)
+            spans: dict[int, np.ndarray] = {}
+            starts: dict[int, np.ndarray] = {}
+            lens: dict[int, np.ndarray] = {}
+            for s, c in zip(unique.tolist(), counts.tolist()):
+                cur = cursors.get(s)
+                if cur is None:
+                    if s not in store.counts:
+                        raise MergeError(
+                            f"merged rows reference unstaged segment {s}")
+                    cur = cursors[s] = _RunCursor(*store._paths(s))
+                span, ln = cur.next_span(c)
+                spans[s] = span
+                lens[s] = ln
+                starts[s] = np.cumsum(ln) - ln
+            # per-record framed length and source offset in its span
+            rec_len = np.empty(seg.shape[0], np.int64)
+            src_off = np.empty(seg.shape[0], np.int64)
+            for s in unique.tolist():
+                m = seg == s
+                rec_len[m] = lens[s][ranks[m]]
+                src_off[m] = starts[s][ranks[m]]
+            out = np.empty(int(rec_len.sum()), np.uint8)
+            dst_end = np.cumsum(rec_len)
+            dst_start = dst_end - rec_len
+            for s in unique.tolist():
+                m = seg == s
+                out[_expand_spans(dst_start[m], rec_len[m])] = (
+                    spans[s][_expand_spans(src_off[m], rec_len[m])])
+            yield out.tobytes()
+    finally:
+        for cur in cursors.values():
+            cur.close()
+    # verify every run was fully consumed (lost-records guard)
+    for s, n in store.counts.items():
+        cur_records = cursors[s].consumed_records if s in cursors else 0
+        if cur_records != n:
+            raise MergeError(
+                f"run {s}: merged rows consumed {cur_records} of {n} records")
+    yield EOF_MARKER
+
+
+def slab_batch(batches: Sequence[RecordBatch], seg: np.ndarray,
+               row: np.ndarray) -> RecordBatch:
+    """Gather one output slab's records from per-segment batches into a
+    compact RecordBatch (its own small data buffer) — the in-memory
+    emission path's bounded gather, replacing whole-shuffle concat."""
+    m = seg.shape[0]
+    k_len = np.empty(m, np.int64)
+    v_len = np.empty(m, np.int64)
+    for s in np.unique(seg).tolist():
+        msk = seg == s
+        b = batches[s]
+        r = row[msk]
+        k_len[msk] = b.key_len[r]
+        v_len[msk] = b.val_len[r]
+    k_total = int(k_len.sum())
+    buf = np.empty(k_total + int(v_len.sum()), np.uint8)
+    k_off = np.cumsum(k_len) - k_len
+    v_off = k_total + np.cumsum(v_len) - v_len
+    for s in np.unique(seg).tolist():
+        msk = seg == s
+        b = batches[s]
+        r = row[msk]
+        buf[_expand_spans(k_off[msk], k_len[msk])] = b.data[
+            _expand_spans(b.key_off[r], k_len[msk])]
+        buf[_expand_spans(v_off[msk], v_len[msk])] = b.data[
+            _expand_spans(b.val_off[r], v_len[msk])]
+    return RecordBatch(buf, k_off, k_len, v_off, v_len)

@@ -92,6 +92,12 @@ def _bind(lib):
     lib.uda_kway_next_block.argtypes = [ctypes.c_void_p, u8p,
                                         ctypes.c_int64, i64p]
     lib.uda_kway_destroy.argtypes = [ctypes.c_void_p]
+    szp = ctypes.POINTER(ctypes.c_size_t)
+    lib.uda_lzo1x_decompress_safe.restype = ctypes.c_int
+    lib.uda_lzo1x_decompress_safe.argtypes = [u8p, ctypes.c_size_t,
+                                              u8p, szp]
+    lib.uda_lzo1x_1_compress.restype = ctypes.c_int
+    lib.uda_lzo1x_1_compress.argtypes = [u8p, ctypes.c_size_t, u8p, szp]
     return lib
 
 

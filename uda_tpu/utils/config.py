@@ -100,6 +100,15 @@ _FLAG_LIST = [
     Flag("uda.tpu.spill.dirs", "", str,
          "comma-separated local dirs for LPQ spill files (round-robin, "
          "like the reference's local-dir rotation); empty = system tmp"),
+    Flag("uda.tpu.online.streaming", False, bool,
+         "online merge spools per-segment sorted runs to local disk and "
+         "streams a permutation-driven interleave at emit, bounding host "
+         "memory to the fetch window (the reference's 1 MB staging-loop "
+         "memory model, StreamRW.cc:151-225); off = keep every segment "
+         "host-resident through emission"),
+    Flag("uda.tpu.online.stagers", 0, int,
+         "overlap staging worker threads (pack+sort+spool per segment); "
+         "0 = single merge thread"),
 ]
 
 FLAGS: Dict[str, Flag] = {f.key: f for f in _FLAG_LIST}
