@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Minimal repro: Pallas INTERPRET mode vs shard_map's check_vma.
+
+History: through round 4 the distributed sort disabled check_vma for the
+lanes engines entirely. Round 5 fixed the one genuine mis-typing in this
+repo (the merge-pass fori_loop carry entered replicated and exited
+varying; ops/pallas_sort.py now pcasts the init to the data's vma), after
+which every lanes engine traces clean with check_vma=True on the REAL
+(interpret=False) path — see parallel/distributed.py.
+
+What remains — and what this script reproduces — is an upstream
+limitation of the Pallas interpreter only: interpret-mode pallas_call
+expands into eval_jaxpr whose grid machinery slices operands with
+REPLICATED block indices. Under shard_map with varying inputs that
+produces
+
+    ValueError: Primitive dynamic_slice requires varying manual axes to
+    match, but got [frozenset({'x'}), frozenset(), frozenset()]
+
+i.e. the emulator's own dynamic_slice mixes a varying operand with
+replicated indices. The compiled (Mosaic) path traces pallas_call as one
+primitive and type-checks fine. Hence the bypass in
+parallel/distributed.py is now scoped to `lanes-engine AND interpret`.
+
+Run (no TPU needed):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/repro_check_vma.py
+Expected output: REAL PATH OK / INTERPRET PATH raises the error above.
+"""
+
+import os
+import sys
+from functools import partial
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from uda_tpu.parallel import distributed as D  # noqa: E402
+from uda_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+def main() -> int:
+    ndev = len(jax.devices())
+    mesh = make_mesh(ndev)
+    axis = list(mesh.axis_names)[0]
+    n = ndev * 4096  # > 1 tile per shard: the merge-pass loop engages
+
+    def build(interpret: bool):
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis),),
+                 out_specs=P(axis), check_vma=True)
+        def go(w):
+            row = jnp.arange(w.shape[0], dtype=jnp.int32)
+            return D._sort_valid_rows(w, row >= 0, 2, "lanes",
+                                      interpret=interpret)
+        return go
+
+    spec = jax.ShapeDtypeStruct((n, 4), jnp.uint32)
+    jax.eval_shape(build(False), spec)
+    print("REAL PATH (interpret=False): check_vma=True traces OK")
+
+    words = jnp.asarray(np.random.default_rng(0).integers(
+        0, 2**32, (n, 4), dtype=np.uint32))
+    try:
+        build(True)(words)
+    except ValueError as e:
+        print("INTERPRET PATH: check_vma=True fails inside the Pallas "
+              "interpreter (upstream):")
+        print("  " + str(e).splitlines()[0])
+        return 0
+    print("INTERPRET PATH: no error — upstream fixed; remove the "
+          "interpret bypass in parallel/distributed.py")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

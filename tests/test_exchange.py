@@ -239,6 +239,38 @@ def test_exchange_record_batches_host():
     ]
 
 
+def test_lanes_engines_type_check_with_check_vma():
+    # the real (interpret=False) lanes path must trace clean under
+    # shard_map's strict varying-manual-axes checker — the r4 wholesale
+    # bypass is now scoped to interpret mode only (the Pallas
+    # interpreter's own grid dynamic_slice mis-types; committed repro:
+    # scripts/repro_check_vma.py). eval_shape runs the vma check at
+    # trace time without compiling any Mosaic kernel, so this pins the
+    # property on CPU.
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from uda_tpu.parallel import distributed as D
+
+    mesh = make_mesh(8, AXIS)
+    n = 8 * 4096  # multiple tiles per shard: the merge fori_loop engages
+    spec = jax.ShapeDtypeStruct((n, 4), jnp.uint32)
+    for eng in ("lanes", "lanes2", "keys8", "keys8f"):
+        @partial(shard_map, mesh=mesh, in_specs=(P(AXIS),),
+                 out_specs=P(AXIS), check_vma=True)
+        def go(w, eng=eng):
+            row = jnp.arange(w.shape[0], dtype=jnp.int32)
+            return D._sort_valid_rows(w, row >= 0, 2, eng,
+                                      interpret=False)
+
+        out = jax.eval_shape(go, spec)
+        assert out.shape == (n, 4)
+
+
 @pytest.mark.slow
 def test_two_axis_dcn_ici_mesh_matches_flat():
     # multi-pod shape: a (dcn=2, shuffle=4) mesh with rows sharded over

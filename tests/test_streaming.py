@@ -71,8 +71,11 @@ def test_streaming_byte_parity_with_inmem(tmp_path):
 
 
 def test_streaming_multi_slab(tmp_path, monkeypatch):
-    # tiny slabs force many interleave rounds + sequential cursor reuse
+    # tiny slabs force many interleave rounds + sequential cursor reuse,
+    # and a 2-cursor fd cap forces suspend/reopen-seek cycles on every
+    # slab (the large-shuffle fd-bound path)
     monkeypatch.setattr(stream_mod, "SLAB_RECORDS", 64)
+    monkeypatch.setattr(stream_mod, "MAX_OPEN_CURSORS", 2)
     a = _merge_once(tmp_path, False, records_per_map=211, num_maps=7)
     b = _merge_once(tmp_path, True, records_per_map=211, num_maps=7)
     assert a == b

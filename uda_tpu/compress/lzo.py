@@ -353,12 +353,17 @@ def _builtin_decompress(data: bytes, uncompressed_len: int) -> bytes:
 
 
 def lzo_codec():
-    """Codec factory: native liblzo2 when loadable, else the pure-Python
-    LZO1X implementation (same stream format either way)."""
+    """Codec factory: native liblzo2 when loadable, else the in-tree
+    C++ codec, else the pure-Python LZO1X implementation (same stream
+    format in all three). The implementation pair is bound ONCE here —
+    per-block calls never re-probe for liblzo2."""
     from uda_tpu.compress import Codec
 
-    if native_lzo_available():
+    source = native_lzo_source()
+    if source == "liblzo2":
         return Codec("lzo", _native_compress, _native_decompress)
+    if source == "builtin":
+        return Codec("lzo", _builtin_compress, _builtin_decompress)
     return Codec("lzo",
                  lzo1x_compress_py,
                  lambda data, length: lzo1x_decompress_py(data, length))

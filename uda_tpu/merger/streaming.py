@@ -207,21 +207,45 @@ class RunStore:
                 pass
 
 
+# open-cursor cap for the interleave: 2 fds per open cursor, kept well
+# under common ulimits however many segments the shuffle has (evicted
+# cursors reopen + seek — reads stay strictly sequential either way)
+MAX_OPEN_CURSORS = 256
+
+
 class _RunCursor:
     """Sequential reader over one run: hands out the byte span covering
-    the next ``count`` records (both files read strictly forward)."""
+    the next ``count`` records. Suspendable: ``suspend()`` closes both
+    file handles and a later read transparently reopens at the consumed
+    position, so an interleave over thousands of runs stays within the
+    process fd limit."""
 
-    __slots__ = ("run_f", "off_f", "consumed_bytes", "consumed_records")
+    __slots__ = ("run_path", "off_path", "run_f", "off_f",
+                 "consumed_bytes", "consumed_records")
 
     def __init__(self, run_path: str, off_path: str):
-        self.run_f = open(run_path, "rb")
-        self.off_f = open(off_path, "rb")
+        self.run_path = run_path
+        self.off_path = off_path
+        self.run_f = None
+        self.off_f = None
         self.consumed_bytes = 0
         self.consumed_records = 0
+
+    @property
+    def is_open(self) -> bool:
+        return self.run_f is not None
+
+    def _ensure_open(self) -> None:
+        if self.run_f is None:
+            self.run_f = open(self.run_path, "rb")
+            self.off_f = open(self.off_path, "rb")
+            self.run_f.seek(self.consumed_bytes)
+            self.off_f.seek(self.consumed_records * 8)
 
     def next_span(self, count: int) -> tuple[np.ndarray, np.ndarray]:
         """Returns (span_bytes, record_lengths) for the next ``count``
         records."""
+        self._ensure_open()
         ends = np.fromfile(self.off_f, dtype="<i8", count=count)
         if ends.shape[0] != count:
             raise StorageError("run offset sidecar truncated")
@@ -234,9 +258,14 @@ class _RunCursor:
         self.consumed_records += count
         return span, lens
 
+    def suspend(self) -> None:
+        if self.run_f is not None:
+            self.run_f.close()
+            self.off_f.close()
+            self.run_f = self.off_f = None
+
     def close(self) -> None:
-        self.run_f.close()
-        self.off_f.close()
+        self.suspend()
 
 
 def iter_row_slabs(rows, valid: int,
@@ -260,6 +289,16 @@ def interleave_runs(slabs: Iterator[np.ndarray], store: RunStore,
     EOF marker is the complete merged IFile stream.
     """
     cursors: dict[int, _RunCursor] = {}
+    open_lru: dict[int, None] = {}  # insertion-ordered set of open segs
+
+    def _touch(s: int, cur: _RunCursor) -> None:
+        open_lru.pop(s, None)
+        open_lru[s] = None
+        while len(open_lru) > MAX_OPEN_CURSORS:
+            victim, _ = next(iter(open_lru.items()))
+            del open_lru[victim]
+            cursors[victim].suspend()
+
     try:
         for rows in slabs:
             if rows.shape[0] == 0:
@@ -277,6 +316,7 @@ def interleave_runs(slabs: Iterator[np.ndarray], store: RunStore,
                             f"merged rows reference unstaged segment {s}")
                     cur = cursors[s] = _RunCursor(*store._paths(s))
                 span, ln = cur.next_span(c)
+                _touch(s, cur)
                 spans[s] = span
                 lens[s] = ln
                 starts[s] = np.cumsum(ln) - ln

@@ -233,6 +233,17 @@ def _pass_splits(x, run_len, final, tile: int, num_keys: int, tb_row: int):
 
     lo = jnp.maximum(0, d_eff - L)
     hi = jnp.minimum(d_eff, L)
+    # under shard_map's strict vma typing the carry must ENTER the loop
+    # varying over the same manual axes it EXITS with: the body compares
+    # against x (device-varying), so (lo, hi) become varying after one
+    # iteration while their iota/run_len-derived inits are replicated.
+    # pcast the inits to x's vma (a no-op outside shard_map, where vma
+    # is empty) — this is what lets the distributed sort run the lanes
+    # engines with check_vma=True (see parallel/distributed._sort_step)
+    vma = tuple(getattr(jax.typeof(x), "vma", ()) or ())
+    if vma:
+        lo = lax.pcast(lo, vma, to="varying")
+        hi = lax.pcast(hi, vma, to="varying")
 
     def body(_, carry):
         lo, hi = carry

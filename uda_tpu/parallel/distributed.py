@@ -258,20 +258,19 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
                payload_path="carry", interpret=False):
     from uda_tpu.ops.sort import LANES_ENGINES
 
-    # check_vma is disabled ONLY for the Pallas lanes engines: they mix
-    # replicated constants (iota tables, padding fills) with sharded
-    # data in ways the strict varying-manual-axes checker mis-types
-    # (jax suggests this exact workaround). Gating the bypass on
-    # process_count (r3 advisor suggestion) was tried and REVERTED: on
-    # single-process meshes of >= 16 devices the received buffer spans
-    # multiple sort tiles, the merge-pass fori_loop engages, and the
-    # checker rejects its carry ("apply pcast to loop_carry[1][...]")
-    # — dryrun_multichip(16/32) is the regression case. The lax.sort
-    # paths keep the checker. Output correctness of the lanes engines
-    # is pinned by the byte-identity tests incl. the 2-process run.
+    # check_vma now runs on the REAL lanes path too: the merge-pass
+    # fori_loop carry is pcast to the data's vma at init
+    # (ops/pallas_sort.py _pass_splits), which was the only mis-typing
+    # in our own code — all four lanes engines trace clean with
+    # check_vma=True and interpret=False (r5; previously bypassed
+    # wholesale). The one REMAINING bypass is interpret mode: the
+    # Pallas interpreter expands pallas_call into eval_jaxpr whose
+    # grid-machinery dynamic_slice mixes replicated block indices with
+    # varying operands — an emulator limitation, not a property of the
+    # compiled kernel (minimal repro: scripts/repro_check_vma.py).
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
              out_specs=(P(axis), P(axis), P(axis)),
-             check_vma=payload_path not in LANES_ENGINES)
+             check_vma=not (payload_path in LANES_ENGINES and interpret))
     def _go(w, spl):
         p = lax.psum(1, axis)
         n, wcols = w.shape
@@ -404,10 +403,10 @@ def _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,
 
     from uda_tpu.ops.sort import LANES_ENGINES
 
-    # same lanes-engine-only checker gate as _sort_step
+    # same interpret-mode-only checker gate as _sort_step
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
              out_specs=P(axis),
-             check_vma=payload_path not in LANES_ENGINES)
+             check_vma=not (payload_path in LANES_ENGINES and interpret))
     def _go(a, nv):
         row = jnp.arange(a.shape[0], dtype=jnp.int32)
         return _sort_valid_rows(a, row < nv[0], num_keys, payload_path,
