@@ -74,4 +74,20 @@ res2.check()
 nv2 = multihost.allgather(res2.valid_counts).reshape(-1)
 assert nv2[0] == per_proc * nprocs and nv2[1:].sum() == 0, nv2.tolist()
 
+# the deployment-shaped 2-axis mesh ACROSS processes: process boundary
+# = DCN axis, local devices = ICI axis (the v5p-64 topology the
+# PARITY.md roofline models). Must be byte-identical to the flat mesh.
+mesh2ax = multihost.global_mesh_2axis()
+assert mesh2ax.devices.shape == (nprocs, P // nprocs)
+words_2ax = multihost.shard_rows(local, mesh2ax, axis=("dcn", "shuffle"))
+res4 = distributed_sort_step(words_2ax, uniform_splitters(P), mesh2ax,
+                             ("dcn", "shuffle"),
+                             capacity=2 * per_proc * nprocs // P,
+                             num_keys=2)
+res4.check()
+assert np.array_equal(multihost.allgather(res4.words), out), \
+    "2-axis (dcn, ici) mesh diverges from the flat mesh across processes"
+assert np.array_equal(multihost.allgather(res4.valid_counts).reshape(-1),
+                      nvalid), "2-axis valid counts diverge"
+
 print(f"MULTIHOST-OK p{pid}", flush=True)

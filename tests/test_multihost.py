@@ -21,7 +21,7 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.parametrize("nprocs", [2])
+@pytest.mark.parametrize("nprocs", [2, 4])
 def test_multiprocess_cpu_exchange(nprocs):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = os.path.join(root, "tests", "multihost_worker.py")

@@ -74,6 +74,23 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _rows_sorted(rows: np.ndarray) -> bool:
+    """Vectorized lexicographic monotonicity of uint32 rows: True when
+    every adjacent pair is non-decreasing under column-major priority
+    (O(n·k), the already-sorted fast path of _stage)."""
+    n = rows.shape[0]
+    if n < 2:
+        return True
+    a, b = rows[:-1], rows[1:]
+    # decided: a prior column already ordered the pair strictly
+    lt = a[:, 0] < b[:, 0]
+    eq = a[:, 0] == b[:, 0]
+    for c in range(1, rows.shape[1]):
+        lt = lt | (eq & (a[:, c] < b[:, c]))
+        eq = eq & (a[:, c] == b[:, c])
+    return bool(np.all(lt | eq))
+
+
 class _Run:
     """One sorted run of the forest.
 
@@ -138,6 +155,16 @@ class OverlappedMerger:
         self._error: Optional[Exception] = None
         self._merges = 0
         self._staged = 0
+        if self.engine == "host":
+            # the host merge path dispatches to the native row merge;
+            # trigger the one-time build() HERE so a cold .so compiles
+            # before any carry runs under _forest_lock (a make inside
+            # the lock would stall the whole staging pool)
+            from uda_tpu import native
+            from uda_tpu.utils.ifile import native_enabled
+
+            if native_enabled():
+                native.build()
         # staging pool (uda.tpu.online.stagers): pack+sort+spool of
         # DIFFERENT segments parallelize; forest carries serialize under
         # _forest_lock (the merge chain itself is one run at a time
@@ -238,10 +265,20 @@ class OverlappedMerger:
         rows[:n, kw] = packed.key_lens.astype(np.uint32)
         rows[:n, kw + 1] = np.uint32(seg_index)
         rows[:n, kw + 2] = np.arange(n, dtype=np.uint32)
-        # per-segment sort on host key order (vectorized lexsort over the
-        # composite; row index column is already arrival order)
-        order = np.lexsort(tuple(rows[:n, c] for c in range(kw, -1, -1)))
-        rows[:n] = rows[:n][order]
+        # per-segment sort on host key order. Hadoop map outputs arrive
+        # ALREADY comparator-sorted (the map-side sort contract the
+        # reference's merge leaned on — it never re-sorted segments,
+        # MergeManager.cc:47-63), and for within-width keys comparator
+        # order == (words, len) order, so an O(n·k) monotonicity check
+        # usually replaces the O(n log n) lexsort — the staging hot
+        # path collapses to pack+spool at memory bandwidth. Unsorted
+        # input (exchange-path buckets, foreign writers) still sorts.
+        if _rows_sorted(rows[:n, :kw + 1]):
+            order = np.arange(n, dtype=np.int64)
+        else:
+            order = np.lexsort(tuple(rows[:n, c]
+                                     for c in range(kw, -1, -1)))
+            rows[:n] = rows[:n][order]
         if streaming:
             self.run_store.write_run(seg_index, batch,
                                      order.astype(np.int64))
@@ -269,10 +306,24 @@ class OverlappedMerger:
         bucket = 2 * max(a.bucket, b.bucket)
         with metrics.timer("overlap_device_merge"):
             if self.engine == "host":
-                rows = np.concatenate([a.rows[:a.valid], b.rows[:b.valid]])
-                order = np.lexsort(tuple(
-                    rows[:, c] for c in range(rows.shape[1] - 1, -1, -1)))
-                merged = rows[order]
+                # linear two-pointer native merge when built (ties to
+                # `a` = the earlier run, preserving the composite-key
+                # stability); lexsort of the concatenation otherwise
+                from uda_tpu import native
+                from uda_tpu.utils.ifile import native_enabled
+
+                merged = None
+                if native_enabled() and native.build():
+                    merged = native.merge_rows_native(
+                        np.asarray(a.rows[:a.valid]),
+                        np.asarray(b.rows[:b.valid]))
+                if merged is None:
+                    rows = np.concatenate(
+                        [a.rows[:a.valid], b.rows[:b.valid]])
+                    order = np.lexsort(tuple(
+                        rows[:, c]
+                        for c in range(rows.shape[1] - 1, -1, -1)))
+                    merged = rows[order]
             else:
                 # every column is part of the composite key (words, len,
                 # seg, row) — rows are totally ordered, so the kernel's

@@ -158,11 +158,32 @@ class RunStore:
     def total_records(self) -> int:
         return sum(self.counts.values())
 
+    @staticmethod
+    def _contiguous_framed_span(batch: RecordBatch,
+                                lens: np.ndarray) -> Optional[tuple]:
+        """When the batch's records sit back-to-back in its data buffer
+        in their original framing (the shape every cracked segment has),
+        return the (start, end) byte span — the run file can then be
+        written straight from the fetched bytes, skipping re-framing."""
+        n = batch.num_records
+        if n == 0:
+            return None
+        head = framed_lengths(batch.key_len, batch.val_len) \
+            - batch.key_len - batch.val_len  # both VInt header bytes
+        starts = batch.key_off - head
+        ends = batch.val_off + batch.val_len
+        if (int(starts[0]) >= 0 and np.all(starts[1:] == ends[:-1])
+                and np.array_equal(lens, ends - starts)):
+            return int(starts[0]), int(ends[-1])
+        return None
+
     def write_run(self, seg_index: int, batch: RecordBatch,
                   order: np.ndarray) -> None:
         """Spool ``batch`` in ``order`` as this segment's sorted run.
         Streams framed chunks (native framer) — peak memory is one
-        chunk, never the whole segment twice."""
+        chunk, never the whole segment twice. Identity order over a
+        contiguously framed batch (the already-sorted Hadoop MOF case)
+        writes the fetched bytes verbatim."""
         with self._lock:
             if seg_index in self.counts:
                 raise MergeError(f"segment {seg_index} staged twice")
@@ -172,10 +193,20 @@ class RunStore:
         lens = framed_lengths(sub.key_len, sub.val_len)
         ends = np.cumsum(lens)
         total = int(ends[-1]) if len(ends) else 0
+        identity = (order.shape[0] > 0
+                    and np.array_equal(order,
+                                       np.arange(order.shape[0])))
+        span = self._contiguous_framed_span(batch, lens) \
+            if identity else None
         with metrics.timer("run_spool"):
             with open(run_path, "wb") as f:
-                for piece in native.iter_framed_chunks(sub, write_eof=True):
-                    f.write(piece)
+                if span is not None:
+                    f.write(memoryview(batch.data[span[0]:span[1]]))
+                    f.write(EOF_MARKER)
+                else:
+                    for piece in native.iter_framed_chunks(
+                            sub, write_eof=True):
+                        f.write(piece)
             wrote = os.path.getsize(run_path)
             if wrote != total + len(EOF_MARKER):
                 raise StorageError(

@@ -98,6 +98,10 @@ def _bind(lib):
                                               u8p, szp]
     lib.uda_lzo1x_1_compress.restype = ctypes.c_int
     lib.uda_lzo1x_1_compress.argtypes = [u8p, ctypes.c_size_t, u8p, szp]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.uda_merge_rows.restype = None
+    lib.uda_merge_rows.argtypes = [u32p, ctypes.c_int64, u32p,
+                                   ctypes.c_int64, ctypes.c_int32, u32p]
     return lib
 
 
@@ -339,6 +343,27 @@ def kway_merge_paths(paths, kt, block_bytes: int = 1 << 20,
             yield EOF_MARKER
     finally:
         lib.uda_kway_destroy(h)
+
+
+def merge_rows_native(a: np.ndarray, b: np.ndarray) -> Optional[np.ndarray]:
+    """Linear lexicographic merge of two sorted uint32 row matrices
+    (ties to ``a``): the host-engine twin of the Pallas merge-path
+    kernel, used by the overlap run forest's CPU fallback. Returns None
+    when the native library isn't available (caller re-lexsorts)."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, np.uint32)
+    b = np.ascontiguousarray(b, np.uint32)
+    assert a.ndim == 2 and b.ndim == 2 and a.shape[1] == b.shape[1]
+    out = np.empty((a.shape[0] + b.shape[0], a.shape[1]), np.uint32)
+
+    def u32(arr):
+        return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+    lib.uda_merge_rows(u32(a), a.shape[0], u32(b), b.shape[0],
+                       a.shape[1], u32(out))
+    return out
 
 
 class ReadPool:

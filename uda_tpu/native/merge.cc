@@ -293,3 +293,38 @@ void uda_kway_destroy(void* h) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Row merge: two sorted uint32-row matrices -> one, lexicographic by all
+// columns, ties to A (stability). The host-engine twin of the Pallas
+// merge-path kernel for the overlap run forest (uda_tpu/merger/overlap.py):
+// a linear two-pointer pass instead of re-lexsorting the concatenation.
+// ---------------------------------------------------------------------------
+
+extern "C" void uda_merge_rows(const uint32_t* a, int64_t na,
+                               const uint32_t* b, int64_t nb,
+                               int32_t k, uint32_t* out) {
+  const size_t row = (size_t)k * sizeof(uint32_t);
+  int64_t i = 0, j = 0;
+  while (i < na && j < nb) {
+    const uint32_t* pa = a + (size_t)i * k;
+    const uint32_t* pb = b + (size_t)j * k;
+    bool a_le_b = true;
+    for (int32_t c = 0; c < k; ++c) {
+      if (pa[c] != pb[c]) { a_le_b = pa[c] < pb[c]; break; }
+    }
+    if (a_le_b) {
+      std::memcpy(out, pa, row);
+      ++i;
+    } else {
+      std::memcpy(out, pb, row);
+      ++j;
+    }
+    out += k;
+  }
+  if (i < na) {
+    std::memcpy(out, a + (size_t)i * k, (size_t)(na - i) * row);
+  } else if (j < nb) {
+    std::memcpy(out, b + (size_t)j * k, (size_t)(nb - j) * row);
+  }
+}

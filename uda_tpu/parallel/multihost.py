@@ -38,8 +38,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uda_tpu.parallel.mesh import SHUFFLE_AXIS
 
-__all__ = ["initialize", "global_mesh", "shard_rows", "replicate",
-           "allgather", "put_global", "put_rows", "zeros_global"]
+__all__ = ["initialize", "global_mesh", "global_mesh_2axis", "shard_rows",
+           "replicate", "allgather", "put_global", "put_rows",
+           "zeros_global"]
 
 
 def initialize(coordinator_address: str, num_processes: int,
@@ -58,6 +59,32 @@ def global_mesh(axis: str = SHUFFLE_AXIS) -> Mesh:
     """1-D shuffle mesh over every device of every process, in global
     device order (process-major, so each process's row block is local)."""
     return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def global_mesh_2axis(dcn_axis: str = "dcn",
+                      ici_axis: str = SHUFFLE_AXIS) -> Mesh:
+    """The deployment-shaped 2-axis mesh: the PROCESS boundary is the
+    outer (DCN) axis, each process's local devices the inner (ICI)
+    axis — exactly the v5p multi-host topology where collectives ride
+    ICI within a host/pod and DCN across (the roofline shape in
+    PARITY.md). Devices arrive process-major from jax.devices(), so the
+    reshape puts every row of the inner axis on one process."""
+    # jax.devices() does not guarantee process-contiguous ordering:
+    # sort by (process_index, id) so each outer row IS one process,
+    # and verify — a mixed row would silently break the DCN semantics
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    nproc = jax.process_count()
+    if len(devs) % nproc:
+        raise ValueError(f"{len(devs)} devices not divisible by "
+                         f"{nproc} processes")
+    grid = np.asarray(devs).reshape(nproc, len(devs) // nproc)
+    for row in grid:
+        owners = {d.process_index for d in row}
+        if len(owners) != 1:
+            raise ValueError(f"devices of processes {sorted(owners)} "
+                             "share one dcn row; uneven per-process "
+                             "device counts are not supported")
+    return Mesh(grid, (dcn_axis, ici_axis))
 
 
 def put_global(arr: np.ndarray, sharding: NamedSharding) -> jax.Array:
@@ -100,9 +127,11 @@ def zeros_global(shape, dtype, sharding: NamedSharding) -> jax.Array:
 
 
 def shard_rows(local_rows: np.ndarray, mesh: Mesh,
-               axis: str = SHUFFLE_AXIS) -> jax.Array:
+               axis=SHUFFLE_AXIS) -> jax.Array:
     """Global row-sharded array from each process's LOCAL row block
-    (every process passes its own rows; global row count = sum)."""
+    (every process passes its own rows; global row count = sum).
+    ``axis`` may be a tuple for 2-axis meshes (rows shard over the
+    linearized (dcn, ici) device order)."""
     sharding = NamedSharding(mesh, P(axis))
     if sharding.is_fully_addressable:
         return jax.device_put(local_rows, sharding)
