@@ -82,10 +82,8 @@ def bench_stage(batches, stagers: int, spool: bool, tmp: str) -> float:
     t0 = time.monotonic()
     for i, b in enumerate(batches):
         om.feed(i, b)
-    om._drain()
+    om._drain()  # raises any staging error
     wall = time.monotonic() - t0
-    if om._error is not None:
-        raise om._error
     if store is not None:
         assert store.total_records == sum(b.num_records for b in batches)
         store.cleanup()
@@ -99,7 +97,6 @@ def bench_fetch(segs: int, seg_bytes: int, tmp: str) -> float:
     from uda_tpu.utils.comparators import get_key_type
     from uda_tpu.utils.config import Config
 
-    sys.path.insert(0, REPO)
     from scripts.regression.run_regression import _make_terasort_mofs
 
     root = os.path.join(tmp, "mofs")
@@ -132,7 +129,15 @@ def main() -> int:
     seg_bytes = args.seg_mb << 20
     total_mb = args.segs * args.seg_mb
     tmp = tempfile.mkdtemp(prefix="uda_stagebench_")
+    try:
+        return _run(args, seg_bytes, total_mb, tmp)
+    finally:
+        import shutil
 
+        shutil.rmtree(tmp, ignore_errors=True)  # ~4 GB of MOFs at defaults
+
+
+def _run(args, seg_bytes: int, total_mb: int, tmp: str) -> int:
     fetch_s = bench_fetch(args.segs, seg_bytes, tmp)
     result = {"segs": args.segs, "seg_mb": args.seg_mb,
               "total_mb": total_mb,

@@ -112,6 +112,76 @@ def test_streaming_oversize_python_heap_fallback(tmp_path):
     assert a == b
 
 
+def test_streaming_over_compressed_fetch(tmp_path):
+    # streaming online mode composed with the decompressing transport:
+    # chunks decompress, crack, stage to runs, release — output matches
+    # the in-memory path byte for byte
+    import functools
+
+    from uda_tpu.compress import DecompressingClient, get_codec
+    from uda_tpu.mofserver.writer import MOFWriter
+
+    codec = get_codec("lzo")
+    rng = np.random.default_rng(77)
+    expected = []
+    job = "jobZ"
+    writer = MOFWriter(str(tmp_path), job, codec=codec)
+    for m in range(4):
+        recs = sorted((rng.bytes(8), rng.bytes(40)) for _ in range(120))
+        expected += recs
+        writer.write(f"attempt_{job}_m_{m:06d}_0", [recs])
+    out = {}
+    for streaming in (False, True):
+        cfg = Config({"mapred.rdma.buf.size": 1,
+                      "uda.tpu.online.streaming": streaming})
+        engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+        try:
+            client = DecompressingClient(LocalFetchClient(engine), codec)
+            mm = MergeManager(client, "uda.tpu.RawBytes", cfg)
+            blocks = []
+            mm.run(job, writer.map_ids, 0,
+                   lambda b: blocks.append(bytes(b)))
+        finally:
+            engine.stop()
+        out[streaming] = b"".join(blocks)
+    assert out[False] == out[True]
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    got = list(IFileReader(io.BytesIO(out[True])))
+    want = sorted(expected, key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want
+
+
+def test_streaming_over_host_routing_client(tmp_path):
+    # streaming mode over the per-host lazy transport table (the
+    # reference's connect-per-host client, RDMAClient.cc:498-527)
+    from uda_tpu.merger.segment import HostRoutingClient
+
+    root = str(tmp_path)
+    make_mof_tree(root, "jobH", 6, 1, 80, seed=11)
+    cfg = Config({"uda.tpu.online.streaming": True})
+    engines = {}
+
+    def connect(host):
+        engines[host] = DataEngine(DirIndexResolver(root), cfg)
+        return LocalFetchClient(engines[host])
+
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    try:
+        mm = MergeManager(HostRoutingClient(connect), kt, cfg)
+        mids = [(f"host{m % 2}", mid)
+                for m, mid in enumerate(map_ids("jobH", 6))]
+        blocks = []
+        total = mm.run("jobH", mids, 0, lambda b: blocks.append(bytes(b)))
+    finally:
+        for e in engines.values():
+            e.stop()
+    assert len(engines) == 2  # one lazy transport per host
+    recs = list(IFileReader(io.BytesIO(b"".join(blocks))))
+    keys = [k for k, _ in recs]
+    assert len(recs) == 480 and keys == sorted(keys) and total > 0
+
+
 def test_streaming_releases_segment_bytes(tmp_path):
     root = str(tmp_path)
     make_mof_tree(root, "jobR", 4, 1, 60, seed=2)

@@ -155,16 +155,18 @@ class OverlappedMerger:
         self._error: Optional[Exception] = None
         self._merges = 0
         self._staged = 0
+        self._native_rows_merge = None
         if self.engine == "host":
             # the host merge path dispatches to the native row merge;
-            # trigger the one-time build() HERE so a cold .so compiles
-            # before any carry runs under _forest_lock (a make inside
-            # the lock would stall the whole staging pool)
+            # resolve it ONCE here so a cold .so compiles before any
+            # carry runs under _forest_lock (a make inside the lock
+            # would stall the whole staging pool) and the per-merge hot
+            # path pays no imports
             from uda_tpu import native
             from uda_tpu.utils.ifile import native_enabled
 
-            if native_enabled():
-                native.build()
+            if native_enabled() and native.build():
+                self._native_rows_merge = native.merge_rows_native
         # staging pool (uda.tpu.online.stagers): pack+sort+spool of
         # DIFFERENT segments parallelize; forest carries serialize under
         # _forest_lock (the merge chain itself is one run at a time
@@ -309,12 +311,9 @@ class OverlappedMerger:
                 # linear two-pointer native merge when built (ties to
                 # `a` = the earlier run, preserving the composite-key
                 # stability); lexsort of the concatenation otherwise
-                from uda_tpu import native
-                from uda_tpu.utils.ifile import native_enabled
-
                 merged = None
-                if native_enabled() and native.build():
-                    merged = native.merge_rows_native(
+                if self._native_rows_merge is not None:
+                    merged = self._native_rows_merge(
                         np.asarray(a.rows[:a.valid]),
                         np.asarray(b.rows[:b.valid]))
                 if merged is None:
