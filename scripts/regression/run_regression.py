@@ -63,8 +63,10 @@ SIZES = {
     # fetch -> merge -> framed emit, no Python map phase): total records
     # across all maps. xlarge = the >=1 GB rung of the reference's
     # cluster regression (reference scripts/regression/
-    # executeTerasort.sh:22-80 scale intent)
-    "shuffle_records": (1 << 14, 1 << 17, 1 << 20, 10_500_000),
+    # executeTerasort.sh:22-80 scale intent); xxlarge = the full
+    # BASELINE config-2 scale (TeraSort 10 GB)
+    "shuffle_records": (1 << 14, 1 << 17, 1 << 20, 10_500_000,
+                        105_000_000),
 }
 
 # workloads that exist to be run at the xlarge rung (the engine-scale
@@ -73,7 +75,8 @@ XLARGE_WORKLOADS = ("terasort_shuffle_hybrid", "terasort_shuffle_streaming")
 
 
 def _size(name: str, size: str) -> int:
-    idx = {"small": 0, "medium": 1, "large": 2, "xlarge": 3}[size]
+    idx = {"small": 0, "medium": 1, "large": 2, "xlarge": 3,
+           "xxlarge": 4}[size]
     knobs = SIZES[name]
     return knobs[min(idx, len(knobs) - 1)]
 
@@ -464,7 +467,8 @@ def _run_single(name: str, size: str, platform: str, out_dir: str,
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--size", choices=("small", "medium", "large", "xlarge"),
+    ap.add_argument("--size", choices=("small", "medium", "large", "xlarge",
+                                       "xxlarge"),
                     default="small")
     ap.add_argument("--workloads", default="",
                     help="comma list; default = all (xlarge: the engine "
@@ -482,7 +486,7 @@ def main() -> int:
 
     if args.workloads:
         names = [w.strip() for w in args.workloads.split(",") if w.strip()]
-    elif args.size == "xlarge":
+    elif args.size in ("xlarge", "xxlarge"):
         names = list(XLARGE_WORKLOADS)
     else:
         names = list(WORKLOADS)

@@ -315,6 +315,52 @@ def test_spill_dir_rotation(tmp_path):
     assert os.listdir(d1) == [] and os.listdir(d2) == []
 
 
+@pytest.mark.slow
+def test_staging_pool_stress_parity(tmp_path):
+    # adversarial pool schedule: 64 segments of random sizes (empty,
+    # tiny, big, oversize-key mix) staged by 4 workers with random
+    # per-stage delays must produce byte-identical output to the
+    # single-threaded run — the forest-carry and run-store locking
+    # under real interleaving
+    import random as _random
+    import time
+
+    from uda_tpu.merger.emitter import FramedEmitter
+
+    rng = np.random.default_rng(31337)
+    batches = []
+    for s in range(64):
+        n = int(rng.integers(0, 400))
+        recs = sorted((rng.bytes(int(rng.integers(1, 12))),
+                       rng.bytes(int(rng.integers(0, 30))))
+                      for _ in range(n))
+        batches.append(crack(write_records(recs)))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    outs = {}
+    for stagers in (0, 4):
+        store = RunStore(str(tmp_path), tag=f"stress{stagers}")
+        om = OverlappedMerger(kt, 16, run_store=store, max_pending=8,
+                              stagers=stagers)
+        if stagers:
+            orig = om._stage
+            delay = _random.Random(7)
+
+            def jitter_stage(i, src, _orig=orig, _d=delay):
+                time.sleep(_d.random() * 0.004)
+                _orig(i, src)
+
+            om._stage = jitter_stage
+        for s, b in enumerate(batches):
+            om.feed(s, b)
+        blocks = []
+        emitter = FramedEmitter(1 << 14)
+        om.finish_streaming(
+            emitter, lambda mv: blocks.append(bytes(mv)),
+            expected_records=sum(b.num_records for b in batches))
+        outs[stagers] = b"".join(blocks)
+    assert outs[0] == outs[4]
+
+
 def test_abort_with_full_queue_does_not_deadlock(tmp_path):
     kt = comparators.get_key_type("uda.tpu.RawBytes")
     store = RunStore(str(tmp_path))

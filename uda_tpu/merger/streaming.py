@@ -79,7 +79,8 @@ def framed_lengths(key_len: np.ndarray, val_len: np.ndarray) -> np.ndarray:
 
 def _expand_spans(off: np.ndarray, length: np.ndarray) -> np.ndarray:
     """Flat int64 indices covering [off_i, off_i + length_i) for every i,
-    concatenated in order — the vectorized byte-gather index."""
+    concatenated in order — the vectorized byte-gather index (the
+    pure-numpy fallback of :func:`_gather_spans`)."""
     length = np.asarray(length, np.int64)
     total = int(length.sum())
     if total == 0:
@@ -88,6 +89,29 @@ def _expand_spans(off: np.ndarray, length: np.ndarray) -> np.ndarray:
     starts = ends - length
     return np.repeat(np.asarray(off, np.int64) - starts, length) + np.arange(
         total, dtype=np.int64)
+
+
+_gather_impl = None  # resolved once on first use (hot-path dispatch)
+
+
+def _gather_spans(src: np.ndarray, src_off: np.ndarray, lens: np.ndarray,
+                  dst: np.ndarray, dst_off: np.ndarray) -> None:
+    """dst[dst_off_i : +len_i] = src[src_off_i : +len_i] per record —
+    native memcpy loop when built (8x less memory traffic than the
+    expand-index fallback, the streaming emit hot path). Dispatch is
+    resolved once per process, like the overlap merger's row merge."""
+    global _gather_impl
+    if _gather_impl is None:
+        from uda_tpu import native
+        from uda_tpu.utils.ifile import native_enabled
+
+        if native_enabled() and native.build() and native.available():
+            _gather_impl = native.gather_spans_native
+        else:
+            _gather_impl = False
+    if _gather_impl and _gather_impl(src, src_off, lens, dst, dst_off):
+        return
+    dst[_expand_spans(dst_off, lens)] = src[_expand_spans(src_off, lens)]
 
 
 def _group_ranks(seg: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -363,8 +387,8 @@ def interleave_runs(slabs: Iterator[np.ndarray], store: RunStore,
             dst_start = dst_end - rec_len
             for s in unique.tolist():
                 m = seg == s
-                out[_expand_spans(dst_start[m], rec_len[m])] = (
-                    spans[s][_expand_spans(src_off[m], rec_len[m])])
+                _gather_spans(spans[s], src_off[m], rec_len[m],
+                              out, dst_start[m])
             yield out.tobytes()
     finally:
         for cur in cursors.values():
@@ -400,8 +424,6 @@ def slab_batch(batches: Sequence[RecordBatch], seg: np.ndarray,
         msk = seg == s
         b = batches[s]
         r = row[msk]
-        buf[_expand_spans(k_off[msk], k_len[msk])] = b.data[
-            _expand_spans(b.key_off[r], k_len[msk])]
-        buf[_expand_spans(v_off[msk], v_len[msk])] = b.data[
-            _expand_spans(b.val_off[r], v_len[msk])]
+        _gather_spans(b.data, b.key_off[r], k_len[msk], buf, k_off[msk])
+        _gather_spans(b.data, b.val_off[r], v_len[msk], buf, v_off[msk])
     return RecordBatch(buf, k_off, k_len, v_off, v_len)

@@ -102,6 +102,9 @@ def _bind(lib):
     lib.uda_merge_rows.restype = None
     lib.uda_merge_rows.argtypes = [u32p, ctypes.c_int64, u32p,
                                    ctypes.c_int64, ctypes.c_int32, u32p]
+    lib.uda_gather_spans.restype = None
+    lib.uda_gather_spans.argtypes = [u8p, i64p, i64p, ctypes.c_int64,
+                                     u8p, i64p]
     return lib
 
 
@@ -343,6 +346,34 @@ def kway_merge_paths(paths, kt, block_bytes: int = 1 << 20,
             yield EOF_MARKER
     finally:
         lib.uda_kway_destroy(h)
+
+
+def gather_spans_native(src: np.ndarray, src_off: np.ndarray,
+                        lens: np.ndarray, dst: np.ndarray,
+                        dst_off: np.ndarray) -> bool:
+    """Per-record memcpy gather: dst[dst_off_i:+len_i] = src[src_off_i:
+    +len_i]. The byte-movement core of the streaming interleave / slab
+    gather (the numpy expand-index fallback moves 8 bytes of index per
+    byte of payload). Returns False when the library isn't available."""
+    lib = _load()
+    if lib is None:
+        return False
+    # dst is written through its raw pointer: coercion would write into
+    # a discarded copy, so demand the right layout outright; the C loop
+    # is bounds-unchecked, so offset arrays must agree on n
+    if dst.dtype != np.uint8 or not dst.flags["C_CONTIGUOUS"]:
+        raise ValueError("gather destination must be contiguous uint8")
+    n = src_off.shape[0]
+    if lens.shape[0] != n or dst_off.shape[0] != n:
+        raise ValueError(f"span arrays disagree: {n} offsets, "
+                         f"{lens.shape[0]} lengths, "
+                         f"{dst_off.shape[0]} destinations")
+    src = np.ascontiguousarray(src, np.uint8)
+    lib.uda_gather_spans(
+        _u8ptr(src), _i64ptr(np.ascontiguousarray(src_off, np.int64)),
+        _i64ptr(np.ascontiguousarray(lens, np.int64)), n,
+        _u8ptr(dst), _i64ptr(np.ascontiguousarray(dst_off, np.int64)))
+    return True
 
 
 def merge_rows_native(a: np.ndarray, b: np.ndarray) -> Optional[np.ndarray]:
