@@ -331,7 +331,9 @@ def test_staging_pool_stress_parity(tmp_path):
     batches = []
     for s in range(64):
         n = int(rng.integers(0, 400))
-        recs = sorted((rng.bytes(int(rng.integers(1, 12))),
+        # key lengths straddle the width (16): the oversize-key
+        # overflow branch runs under real pool interleaving too
+        recs = sorted((rng.bytes(int(rng.integers(1, 25))),
                        rng.bytes(int(rng.integers(0, 30))))
                       for _ in range(n))
         batches.append(crack(write_records(recs)))

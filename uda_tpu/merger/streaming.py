@@ -42,7 +42,7 @@ import numpy as np
 
 from uda_tpu import native
 from uda_tpu.utils.errors import MergeError, StorageError
-from uda_tpu.utils.ifile import EOF_MARKER, RecordBatch
+from uda_tpu.utils.ifile import EOF_MARKER, RecordBatch, native_enabled
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -91,25 +91,24 @@ def _expand_spans(off: np.ndarray, length: np.ndarray) -> np.ndarray:
         total, dtype=np.int64)
 
 
-_gather_impl = None  # resolved once on first use (hot-path dispatch)
+_gather_impl = None  # resolved build/availability, cached per process
 
 
 def _gather_spans(src: np.ndarray, src_off: np.ndarray, lens: np.ndarray,
                   dst: np.ndarray, dst_off: np.ndarray) -> None:
     """dst[dst_off_i : +len_i] = src[src_off_i : +len_i] per record —
     native memcpy loop when built (8x less memory traffic than the
-    expand-index fallback, the streaming emit hot path). Dispatch is
-    resolved once per process, like the overlap merger's row merge."""
+    expand-index fallback, the streaming emit hot path). Library
+    availability is resolved once per process; the ``uda.tpu.use.native``
+    kill switch stays LIVE (re-read per call, like frame_batch)."""
     global _gather_impl
     if _gather_impl is None:
         from uda_tpu import native
-        from uda_tpu.utils.ifile import native_enabled
 
-        if native_enabled() and native.build() and native.available():
-            _gather_impl = native.gather_spans_native
-        else:
-            _gather_impl = False
-    if _gather_impl and _gather_impl(src, src_off, lens, dst, dst_off):
+        _gather_impl = (native.gather_spans_native
+                        if native.build() and native.available() else False)
+    if (_gather_impl and native_enabled()
+            and _gather_impl(src, src_off, lens, dst, dst_off)):
         return
     dst[_expand_spans(dst_off, lens)] = src[_expand_spans(src_off, lens)]
 

@@ -368,6 +368,14 @@ def gather_spans_native(src: np.ndarray, src_off: np.ndarray,
         raise ValueError(f"span arrays disagree: {n} offsets, "
                          f"{lens.shape[0]} lengths, "
                          f"{dst_off.shape[0]} destinations")
+    # the C loop is a bounds-unchecked memcpy: corrupt spans (e.g. a
+    # non-monotonic run offset sidecar producing negative lengths) must
+    # fail HERE like the numpy fallback would, not scribble memory
+    if n and (int(lens.min()) < 0
+              or int((src_off + lens).max()) > src.size
+              or int(src_off.min()) < 0 or int(dst_off.min()) < 0
+              or int((dst_off + lens).max()) > dst.size):
+        raise ValueError("gather spans out of bounds")
     src = np.ascontiguousarray(src, np.uint8)
     lib.uda_gather_spans(
         _u8ptr(src), _i64ptr(np.ascontiguousarray(src_off, np.int64)),

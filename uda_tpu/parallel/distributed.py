@@ -22,6 +22,7 @@ TeraSort.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -121,6 +122,21 @@ class DistributedSortResult:
             raise TransportError(
                 f"exchange capacity overflow{detail} ({total} records); "
                 "raise capacity or use the multi-round path")
+
+
+def _vma_check_on(payload_path: str, interpret: bool) -> bool:
+    """shard_map varying-manual-axes checker gate: ON everywhere except
+    lanes engines under INTERPRET mode (the Pallas interpreter's grid
+    machinery mis-types; scripts/repro_check_vma.py is the committed
+    repro — the compiled path traces clean since the _pass_splits carry
+    pcast). UDA_TPU_FORCE_NO_CHECK_VMA=1 is the operational escape
+    hatch for a first-hardware-run surprise; using it should be
+    reported back into the repro script."""
+    from uda_tpu.ops.sort import LANES_ENGINES
+
+    if os.environ.get("UDA_TPU_FORCE_NO_CHECK_VMA") == "1":
+        return False
+    return not (payload_path in LANES_ENGINES and interpret)
 
 
 def _sort_valid_rows(flat, valid, num_keys, payload_path, interpret=False):
@@ -256,8 +272,6 @@ def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
                                    "payload_path", "interpret"))
 def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
                payload_path="carry", interpret=False):
-    from uda_tpu.ops.sort import LANES_ENGINES
-
     # check_vma now runs on the REAL lanes path too: the merge-pass
     # fori_loop carry is pcast to the data's vma at init
     # (ops/pallas_sort.py _pass_splits), which was the only mis-typing
@@ -270,7 +284,7 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
     # compiled kernel (minimal repro: scripts/repro_check_vma.py).
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
              out_specs=(P(axis), P(axis), P(axis)),
-             check_vma=not (payload_path in LANES_ENGINES and interpret))
+             check_vma=_vma_check_on(payload_path, interpret))
     def _go(w, spl):
         p = lax.psum(1, axis)
         n, wcols = w.shape
@@ -401,12 +415,10 @@ def _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,
     valid flag) reproduces exactly the fused single-round program's
     equal-key order."""
 
-    from uda_tpu.ops.sort import LANES_ENGINES
-
     # same interpret-mode-only checker gate as _sort_step
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
              out_specs=P(axis),
-             check_vma=not (payload_path in LANES_ENGINES and interpret))
+             check_vma=_vma_check_on(payload_path, interpret))
     def _go(a, nv):
         row = jnp.arange(a.shape[0], dtype=jnp.int32)
         return _sort_valid_rows(a, row < nv[0], num_keys, payload_path,
