@@ -102,9 +102,7 @@ def _gather_spans(src: np.ndarray, src_off: np.ndarray, lens: np.ndarray,
     availability is resolved once per process; the ``uda.tpu.use.native``
     kill switch stays LIVE (re-read per call, like frame_batch)."""
     global _gather_impl
-    if _gather_impl is None:
-        from uda_tpu import native
-
+    if _gather_impl is None and native_enabled():
         _gather_impl = (native.gather_spans_native
                         if native.build() and native.available() else False)
     if (_gather_impl and native_enabled()

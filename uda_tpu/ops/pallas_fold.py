@@ -77,7 +77,11 @@ def _cmp_exchange_folded(F, j: int, asc_mat, num_keys: int, h: int):
                     [other[r] for r in krl])[None, :]
     lt_hi = _lex_lt([F[r + _SLOT] for r in krl],
                     [other[r + _SLOT] for r in krl])[None, :]
-    lt = jnp.where(rowi < _SLOT, lt_lo, lt_hi)
+    # mask logic, not select: Mosaic lowers select-on-i1 operands via an
+    # i8->i1 trunci it rejects ("Unsupported target bitwidth for
+    # truncation" at [8, tile] on v5e); &/| on masks lower natively
+    is_lo = rowi < _SLOT
+    lt = (is_lo & lt_lo) | (~is_lo & lt_hi)
     keep_self = (asc_mat == low) == lt
     return jnp.where(keep_self, F, other)
 

@@ -378,10 +378,16 @@ def _merge_pass_kernel(splits_ref, splits_nxt_ref, x_hbm, o_ref, a_bufs,
         net = _cmp_exchange(net, j, asc_mask, key_rows_idx)
         j //= 2
     if two_phase:
-        # select the kept half's indices BEFORE gathering: the gather is
-        # this path's cost center, no point moving lanes we discard
-        idx = jnp.where(out_asc, net[pos_row, :tile], net[pos_row, tile:])
-        o_ref[...] = jnp.take(seq, idx.astype(jnp.int32), axis=1)
+        # Mosaic's gather rule requires input == indices == output
+        # shape, so a narrowing take([32, 2T] by [T]) does not lower:
+        # gather the full 2T window with the broadcast permutation row,
+        # then slice the kept half (2x the gather traffic, but it's the
+        # only formulation the lowering accepts — scripts/probe_gather)
+        perm = jnp.broadcast_to(net[pos_row].astype(jnp.int32)[None, :],
+                                seq.shape)
+        gathered = jnp.take_along_axis(seq, perm, axis=1)
+        o_ref[...] = jnp.where(out_asc, gathered[:, :tile],
+                               gathered[:, tile:])
     else:
         o_ref[...] = jnp.where(out_asc, net[:, :tile], net[:, tile:])
 
