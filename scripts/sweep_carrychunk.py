@@ -1,0 +1,147 @@
+"""Post-fly-off tuning sweep for the carrychunk champion engine.
+
+The r5 hardware fly-off crowned carrychunk (narrow lax.sort perm +
+chunked operand-carry apply) at 3.1 GB/s. Its apply step moves
+``nchunks + VALUE_WORDS`` words per record through sort networks, so
+larger ``chunk_cols`` strictly reduces network traffic — bounded by
+XLA's superlinear variadic-sort compile time (the "carry" pathology).
+This sweep times chunk_cols candidates, each compile+measure in its own
+budgeted subprocess (a pathological compile costs one budget, not the
+window), strictly sequentially (the pool serves ONE device claim).
+
+Also re-probes the two engines whose Mosaic compile failures were fixed
+post-fly-off (keys8f select-on-i1, lanes2 narrowing gather) — compile
+evidence plus a timing if they lower.
+
+Usage: python scripts/sweep_carrychunk.py [--log-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+from uda_tpu.utils.compile_cache import PLATFORM_PRELUDE  # noqa: E402
+
+LIVENESS = (PLATFORM_PRELUDE +
+            "import jax.numpy as jnp, numpy as np; "
+            "print('ALIVE', int(jnp.asarray(np.arange(8)).sum()))")
+
+# one candidate: compile bench_step at the official shape, then two
+# timed dispatches with fresh seeds (the relay serves identical-input
+# repeats from a cache; block_until_ready does not wait on this
+# backend, so timing syncs via scalar readback)
+CANDIDATE = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from uda_tpu.utils import compile_cache
+compile_cache.apply_platform_env()
+compile_cache.enable()
+import jax, numpy as np
+from uda_tpu.models import terasort
+
+n = 1 << {log2}
+k = {rounds}
+kw = dict(path={path!r}, tile={tile}, chunk_cols={cc})
+gb = n * terasort.RECORD_BYTES * k / 1e9
+
+def once(seed):
+    t0 = time.perf_counter()
+    viol, ck_in, ck_out = terasort.bench_step(jax.random.key(seed), n, k,
+                                              **kw)
+    assert int(viol) == 0, "order violations"
+    assert np.uint32(ck_in) == np.uint32(ck_out), "checksum mismatch"
+    return time.perf_counter() - t0
+
+t0 = time.perf_counter()
+once(999)
+print(f"compile+first: {{time.perf_counter()-t0:.1f}}s", flush=True)
+best = min(once(998), once(997))
+print(f"RESULT {path!r} tile={tile} cc={cc}: "
+      f"{{gb/best:.3f}} GB/s ({{best:.3f}}s)", flush=True)
+"""
+
+
+def run_stage(name, argv, budget_s, log_dir):
+    log = os.path.join(log_dir, f"{name}.log")
+    t0 = time.perf_counter()
+    timed_out = False
+    with open(log, "w") as f:
+        proc = subprocess.Popen(
+            argv, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env=dict(os.environ, JAX_TRACEBACK_FILTERING="off"))
+        try:
+            rc = proc.wait(timeout=budget_s)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            rc = -9
+            f.write(f"\n--- TIMEOUT after {budget_s:.0f}s ---\n")
+    ok = rc == 0
+    print(f"[{name}] {'ok' if ok else 'FAIL'} in "
+          f"{time.perf_counter() - t0:.0f}s -> {log}", flush=True)
+    return ok, timed_out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log-dir",
+                    default=os.path.join(REPO, ".sweep_carrychunk"))
+    ap.add_argument("--log2", type=int, default=23)
+    ap.add_argument("--rounds", type=int, default=4)
+    args = ap.parse_args()
+    os.makedirs(args.log_dir, exist_ok=True)
+    py = sys.executable
+
+    def cand(path, cc=6, tile=4096):
+        return CANDIDATE.format(repo=REPO, log2=args.log2,
+                                rounds=args.rounds, path=path, tile=tile,
+                                cc=cc)
+
+    stages = [
+        # chunk_cols ladder over the 23 value words: cc=6 -> 4 sorts
+        # (27 operand-words/record), cc=8 -> 3 (26), cc=12 -> 2 (25),
+        # cc=23 -> the single-sort extreme, 1 sort of 24 operands
+        # (compile risk is exactly what the per-stage budget is for)
+        ("cc6", [py, "-c", cand("carrychunk", 6)], 1200),
+        ("cc8", [py, "-c", cand("carrychunk", 8)], 1200),
+        ("cc12", [py, "-c", cand("carrychunk", 12)], 1500),
+        ("cc23", [py, "-c", cand("carrychunk", 23)], 1800),
+        # fixed-kernel re-probes (evidence the Mosaic fixes lower)
+        ("keys8f_8192", [py, "-c", cand("keys8f", tile=8192)], 1200),
+        ("lanes2_4096", [py, "-c", cand("lanes2", tile=4096)], 1500),
+    ]
+
+    def alive(tag):
+        ok, _ = run_stage(tag, [py, "-c", LIVENESS], 300, args.log_dir)
+        return ok
+
+    if not alive("liveness"):
+        print("pool wedged; aborting", flush=True)
+        return 1
+    done = 0
+    for name, argv, budget in stages:
+        ok, timed_out = run_stage(name, argv, budget, args.log_dir)
+        done += 1
+        if timed_out and not alive(f"liveness_after_{name}"):
+            print(f"pool wedged after {name}; aborting", flush=True)
+            return 1
+    print(json.dumps({"stages_run": done, "log_dir": args.log_dir}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -254,9 +254,11 @@ def _violations_cols(k0, k1, k2) -> jax.Array:
     return jnp.sum(gt.astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("n", "k", "path", "tile", "interpret"))
+@partial(jax.jit, static_argnames=("n", "k", "path", "tile", "interpret",
+                                   "chunk_cols"))
 def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
-               tile: int = 1024, interpret: bool = False):
+               tile: int = 1024, interpret: bool = False,
+               chunk_cols: int | None = None):
     """Sustained-throughput benchmark kernel: k independent
     teragen->sort->validate rounds inside ONE device program (one host
     dispatch), so per-call host/RPC latency amortizes away and the
@@ -344,7 +346,8 @@ def bench_step(seed: jax.Array, n: int, k: int, path: str = "lanes",
         k0, k1, k2, perm = lax.sort((x[0], x[1], x[2], iota),
                                     num_keys=KEY_WORDS, is_stable=True)
         cols = apply_perm_chunked(
-            perm, [x[r] for r in range(KEY_WORDS, RECORD_WORDS)])
+            perm, [x[r] for r in range(KEY_WORDS, RECORD_WORDS)],
+            chunk_cols=chunk_cols)
         out_cols = (k0, k1, k2, *cols)
         ck_out = ck_out + _checksum_cols(out_cols)
         viol = viol + _violations_cols(k0, k1, k2)

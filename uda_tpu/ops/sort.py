@@ -25,6 +25,7 @@ per job (reference src/Merger/reducer.cc:56-133).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Sequence
 
@@ -62,6 +63,10 @@ __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
 # 4-row slot, so it is a narrow-key specialization (<= 3 compare rows
 # + tie-break; the TeraSort flagship shape) and joins the bench
 # fly-off but not the general-purpose engine set.
+# carrychunk's payload-chunk width; overridable for deployment tuning
+# (resolved once at import — see apply_perm_chunked)
+DEFAULT_CHUNK_COLS = int(os.environ.get("UDA_TPU_CHUNK_COLS", "6"))
+
 LANES_ENGINES = ("lanes", "lanes2", "keys8", "keys8f")
 FLYOFF_ENGINES = ("lanes", "lanes2", "keys8", "gather2", "carrychunk")
 BENCH_FLYOFF = FLYOFF_ENGINES + ("keys8f",)
@@ -95,14 +100,23 @@ def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
     return path
 
 
-def apply_perm_chunked(perm, cols, chunk_cols: int = 6) -> list:
+def apply_perm_chunked(perm, cols, chunk_cols: int | None = None) -> list:
     """Apply ``perm`` to columns WITHOUT gathers: ``out[c][j] ==
     cols[c][perm[j]]``. Inverts the permutation with a 2-operand sort
     (iota carried through a sort BY perm lands at the inverse), then
     re-sorts payload chunks of ``chunk_cols`` columns by it — every
     sort stays far below the operand count where XLA's variadic-sort
     compile time blows up. The single implementation behind the
-    "carrychunk" engine (terasort bench and the distributed step)."""
+    "carrychunk" engine (terasort bench and the distributed step).
+
+    ``chunk_cols=None`` resolves ``UDA_TPU_CHUNK_COLS`` so a
+    sweep-tuned value reaches every production call site at once
+    (scripts/sweep_carrychunk.py produces the datum). The env var is
+    read ONCE at import (module constant), never inside a jitted
+    trace — a trace-time read would bake into the jit cache without
+    being part of its key."""
+    if chunk_cols is None:
+        chunk_cols = DEFAULT_CHUNK_COLS
     n = perm.shape[0]
     iota = lax.iota(jnp.int32, n)
     # perm keys are distinct, so unstable sorts are exact
