@@ -152,13 +152,14 @@ def single_chip_sort(words: jax.Array, path: str = "auto",
     reference's k-way PQ merge, src/Merger/MergeQueue.h:276-427).
 
     Payload-movement strategy (see bench_step for the full trade-off):
-    the lanes engines ("lanes"/"lanes2"/"keys8" — the TPU default via
-    "auto") run the Pallas bitonic pipeline with bounded compile;
-    "carry" rides the 23 value words through a ``lax.sort`` network
-    (fast at runtime, pathological compile on TPU remote-compile
-    backends — the CPU default); "gather"/"gather2"/"carrychunk" apply
-    a narrow-sort permutation (per-column gathers / one minor-dim
-    gather / chunked carry sorts). "auto" resolves per the ambient
+    the lanes engines ("lanes"/"lanes2"/"keys8") run the Pallas
+    bitonic pipeline with bounded compile; "carry" rides the 23 value
+    words through a ``lax.sort`` network (fast at runtime, pathological
+    compile on TPU remote-compile backends — the CPU default);
+    "gather"/"gather2"/"carrychunk" apply a narrow-sort permutation
+    (per-column gathers / one minor-dim gather / chunked carry sorts —
+    "carrychunk" is the TPU default via "auto": measured fly-off
+    champion, BENCH_HW_r05.json). "auto" resolves per the ambient
     backend at call time (resolve_sort_path).
     """
     path = resolve_sort_path(path, lanes_ok=True)

@@ -90,9 +90,12 @@ def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
         if backend == "cpu":
             path = "carry"
         elif lanes_ok and backend == "tpu":
-            # the lanes pipeline is Mosaic-TPU only; any other
-            # accelerator gets the universally-lowerable gather path
-            path = "lanes"
+            # measured champion on v5e (BENCH_HW_r05.json fly-off:
+            # carrychunk 3.04 GB/s vs lanes 1.22 / keys8 1.30) with
+            # bounded compile (no sort exceeds chunk_cols+1 operands)
+            # and no record-width limit; the Pallas lanes pipeline
+            # stays available explicitly and via bench.py's fly-off
+            path = "carrychunk"
         else:
             path = "gather"
     if path not in valid:
