@@ -1,0 +1,66 @@
+"""Shared helpers for the staged TPU-pool drivers (tpu_return,
+sweep_carrychunk, pool_watch).
+
+Discipline encoded here (learned from the 2026-07-30 pool wedges):
+stages run strictly sequentially; a timed-out stage is killed as a
+whole PROCESS GROUP (bench/regression spawn their own subprocesses —
+killing only the direct child leaves a grandchild holding the pool's
+single device claim, i.e. a concurrent client); stage output streams
+straight to a log file (no pipes: nothing to lose on a kill, nothing
+to block on).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+from uda_tpu.utils.compile_cache import PLATFORM_PRELUDE  # noqa: E402
+
+# One tiny device op: fails fast (rc!=0 / timeout) when the pool is
+# wedged, prints ALIVE when it answers.
+LIVENESS = (PLATFORM_PRELUDE +
+            "import jax.numpy as jnp, numpy as np; "
+            "print('ALIVE', int(jnp.asarray(np.arange(8)).sum()))")
+
+
+def run_stage(name: str, argv: list[str], budget_s: float,
+              log_dir: str, extra_env: dict | None = None
+              ) -> tuple[bool, bool]:
+    """One subprocess stage -> (ok, timed_out). Output streams directly
+    to <log_dir>/<name>.log (stdout+stderr interleaved; nothing is lost
+    if the stage is killed). On budget overrun the stage's whole
+    process group is killed so no grandchild survives to hold the
+    device claim."""
+    log = os.path.join(log_dir, f"{name}.log")
+    t0 = time.perf_counter()
+    timed_out = False
+    with open(log, "w") as f:
+        proc = subprocess.Popen(
+            argv, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env=dict(os.environ, JAX_TRACEBACK_FILTERING="off",
+                     **(extra_env or {})))
+        try:
+            rc = proc.wait(timeout=budget_s)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            rc = -9
+            f.write(f"\n--- TIMEOUT: killed process group after "
+                    f"{budget_s:.0f}s ---\n")
+    ok = rc == 0
+    dt = time.perf_counter() - t0
+    print(f"[{name}] {'ok' if ok else 'FAIL'} in {dt:.0f}s -> {log}",
+          flush=True)
+    return ok, timed_out

@@ -21,19 +21,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import signal
-import subprocess
 import sys
-import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-sys.path.insert(0, REPO)
-from uda_tpu.utils.compile_cache import PLATFORM_PRELUDE  # noqa: E402
-
-LIVENESS = (PLATFORM_PRELUDE +
-            "import jax.numpy as jnp, numpy as np; "
-            "print('ALIVE', int(jnp.asarray(np.arange(8)).sum()))")
+sys.path.insert(0, HERE)
+from stagelib import LIVENESS, run_stage  # noqa: E402
 
 # one candidate: compile bench_step at the official shape, then two
 # timed dispatches with fresh seeds (the relay serves identical-input
@@ -68,32 +61,6 @@ best = min(once(998), once(997))
 print(f"RESULT {path!r} tile={tile} cc={cc}: "
       f"{{gb/best:.3f}} GB/s ({{best:.3f}}s)", flush=True)
 """
-
-
-def run_stage(name, argv, budget_s, log_dir):
-    log = os.path.join(log_dir, f"{name}.log")
-    t0 = time.perf_counter()
-    timed_out = False
-    with open(log, "w") as f:
-        proc = subprocess.Popen(
-            argv, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
-            start_new_session=True,
-            env=dict(os.environ, JAX_TRACEBACK_FILTERING="off"))
-        try:
-            rc = proc.wait(timeout=budget_s)
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            proc.wait()
-            rc = -9
-            f.write(f"\n--- TIMEOUT after {budget_s:.0f}s ---\n")
-    ok = rc == 0
-    print(f"[{name}] {'ok' if ok else 'FAIL'} in "
-          f"{time.perf_counter() - t0:.0f}s -> {log}", flush=True)
-    return ok, timed_out
 
 
 def main() -> int:

@@ -75,26 +75,26 @@ ALL_SORT_PATHS = ("carry", "gather") + BENCH_FLYOFF
 
 def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
     """Resolve a payload-movement strategy name. "auto" picks
-    operand-carry on CPU (compile is cheap there) and, on accelerators,
-    the Pallas lanes pipeline when the caller supports it (``lanes_ok``)
-    or permutation+gather otherwise — XLA's variadic-sort compile time
-    grows superlinearly in operand count, and on TPU remote-compile
-    backends a wide carry sort can take hours to compile, while the
-    lanes pipeline is two Mosaic kernels regardless of width. Resolution
-    happens EAGERLY, never inside a jitted trace: a trace-time choice
-    would be baked into the jit cache and survive a later platform
-    switch."""
-    valid = ALL_SORT_PATHS if lanes_ok else ("carry", "gather")
+    operand-carry on CPU (compile is cheap there) and "carrychunk" on
+    TPU — the measured fly-off champion (BENCH_HW_r05.json: 3.04 GB/s
+    vs lanes 1.22 / keys8 1.30) with bounded compile (no sort exceeds
+    chunk_cols+1 operands; XLA's variadic-sort compile time grows
+    superlinearly in operand count, and on remote-compile backends a
+    wide carry sort can take hours) and no record-width limit.
+    ``lanes_ok`` additionally admits the Pallas-pipeline engines
+    (LANES_ENGINES) for callers that implement them; the pure-XLA
+    strategies (carry/gather/gather2/carrychunk) are valid everywhere.
+    Resolution happens EAGERLY, never inside a jitted trace: a
+    trace-time choice would be baked into the jit cache and survive a
+    later platform switch."""
+    valid = (ALL_SORT_PATHS if lanes_ok
+             else tuple(p for p in ALL_SORT_PATHS
+                        if p not in LANES_ENGINES))
     if path == "auto":
         backend = jax.default_backend()
         if backend == "cpu":
             path = "carry"
-        elif lanes_ok and backend == "tpu":
-            # measured champion on v5e (BENCH_HW_r05.json fly-off:
-            # carrychunk 3.04 GB/s vs lanes 1.22 / keys8 1.30) with
-            # bounded compile (no sort exceeds chunk_cols+1 operands)
-            # and no record-width limit; the Pallas lanes pipeline
-            # stays available explicitly and via bench.py's fly-off
+        elif backend == "tpu":
             path = "carrychunk"
         else:
             path = "gather"

@@ -58,20 +58,18 @@ def _lanes_interpret(payload_path: str, mesh: Mesh) -> bool:
 
 
 def _resolve_payload_path(path: str, wcols: int, num_keys: int) -> str:
-    """resolve_sort_path with the lanes option, plus the width gate:
-    "auto" only picks lanes when the record fits the 32-row lanes layout
-    (num_keys masked keys + invalid flag + wcols payload + tie-break);
-    wider records fall back to gather instead of failing later. An
-    EXPLICIT "lanes" request is passed through and fails loudly in
-    _sort_valid_rows_lanes if too wide."""
-    from uda_tpu.ops import pallas_sort
-    from uda_tpu.ops.sort import LANES_ENGINES, resolve_sort_path
+    """resolve_sort_path with the lanes engines admitted. "auto" never
+    resolves to a lanes engine anymore (TPU auto = carrychunk, the
+    fly-off champion, which has no record-width limit — see
+    resolve_sort_path), so no width gate is needed here; an EXPLICIT
+    lanes-engine request is passed through and fails loudly in
+    _sort_valid_rows_lanes if the record exceeds the 32-row layout.
+    ``wcols``/``num_keys`` stay in the signature for that error path's
+    callers and for any future auto policy that reconsiders lanes."""
+    del wcols, num_keys  # no auto path needs the width today
+    from uda_tpu.ops.sort import resolve_sort_path
 
-    resolved = resolve_sort_path(path, lanes_ok=True)
-    if (resolved in LANES_ENGINES and path == "auto"
-            and num_keys + 1 + wcols > pallas_sort.TB_ROW_DEFAULT):
-        return "gather"
-    return resolved
+    return resolve_sort_path(path, lanes_ok=True)
 
 
 def uniform_splitters(num_partitions: int) -> np.ndarray:
@@ -340,10 +338,10 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
     the flat single-axis mesh of the same device order.
     ``capacity``: per-(src, dst) records per round — the credit window.
     ``payload_path``: how the local sort moves value columns ("auto":
-    operand-carry on CPU meshes, the Pallas lanes pipeline on
-    accelerators — bounded compile AND streaming payload movement; see
-    _sort_valid_rows for the trade-offs and the "carry"/"gather"
-    fallbacks).
+    operand-carry on CPU meshes, chunked operand-carry ("carrychunk",
+    the measured fly-off champion — bounded compile, no record-width
+    limit) on TPU; the Pallas lanes engines and the gather paths stay
+    available explicitly — see _sort_valid_rows for the trade-offs).
     ``multiround``: skew completion policy. "auto" (default) runs the
     fused single-round program and, if any (src, dst) bucket overflowed
     the credit window, re-runs the shuffle through the windowed
