@@ -40,12 +40,17 @@ fi
 echo "-- unit + engine tests" | tee -a "$ART/ci.log"
 python -m pytest tests/ -q 2>&1 | tee "$ART/pytest.log" | tail -2
 
+# CPU-only gates run with the accelerator-pool env stripped: the pool's
+# sitecustomize otherwise dials the pool from every spawned interpreter
+# and can hang at startup while the pool is wedged (pytest strips it
+# itself via tests/conftest.py's re-exec).
 echo "-- workload-ladder regression" | tee -a "$ART/ci.log"
-python scripts/regression/run_regression.py --size small \
-  --out "$ART/regression" 2>&1 | tee -a "$ART/ci.log" | tail -3
+env -u PALLAS_AXON_POOL_IPS python scripts/regression/run_regression.py \
+  --size small --out "$ART/regression" 2>&1 | tee -a "$ART/ci.log" | tail -3
 
 echo "-- multi-chip dryrun" | tee -a "$ART/ci.log"
-XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+env -u PALLAS_AXON_POOL_IPS \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
   2>&1 | tee -a "$ART/ci.log" | tail -1
 

@@ -192,3 +192,43 @@ def test_empty_batch():
     assert order.shape == (0,)
     merged = merge.merge_batches([batch, batch], _raw(), width=8)
     assert merged.num_records == 0
+
+
+def test_apply_perm_chunked_all_sweep_widths():
+    # every chunk width the hardware sweep times (scripts/
+    # sweep_carrychunk.py: cc=6/8/12/23) plus the degenerate and
+    # over-wide extremes must be a pure refactoring of the same
+    # permutation apply — byte-identical outputs per column
+    import jax
+    import numpy as np
+
+    from uda_tpu.ops.sort import apply_perm_chunked
+
+    rng = np.random.default_rng(7)
+    n, ncols = 257, 23
+    cols = [rng.integers(0, 1 << 32, n, dtype=np.uint32)
+            for _ in range(ncols)]
+    perm = rng.permutation(n).astype(np.int32)
+    want = [c[perm] for c in cols]
+    for cc in (1, 2, 6, 8, 12, 23, 40):
+        got = jax.jit(lambda p, cs: apply_perm_chunked(p, cs, cc))(
+            perm, [np.asarray(c) for c in cols])
+        assert len(got) == ncols, cc
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, np.asarray(g), err_msg=str(cc))
+
+
+def test_bench_step_carrychunk_sweep_widths_validate():
+    # the sweep drives bench_step with explicit chunk_cols; the
+    # in-graph validation (order + checksum) must hold at every width
+    import jax
+    import numpy as np
+
+    from uda_tpu.models import terasort
+
+    for cc in (6, 12, 23):
+        viol, ck_in, ck_out = terasort.bench_step(
+            jax.random.key(11), 1024, 1, path="carrychunk", tile=256,
+            chunk_cols=cc)
+        assert int(viol) == 0, cc
+        assert np.uint32(ck_in) == np.uint32(ck_out), cc
