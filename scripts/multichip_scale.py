@@ -1,9 +1,10 @@
-"""Multichip scaling evidence: run the full dryrun at 8/16/32 virtual
+"""Multichip scaling evidence: run the full dryrun at 8/16/32/64 virtual
 devices (each in a FRESH interpreter — the device count locks at
 backend init) and write the aggregated exchange-round/byte accounting
 plus the v5p-64 ICI roofline extrapolation to MULTICHIP_SCALE_r{N}.json.
 
-Usage: python scripts/multichip_scale.py [--out FILE] [--sizes 8,16,32]
+Usage: python scripts/multichip_scale.py [--out FILE] [--sizes 8,16,32,64]
+       [--per-size-timeout S]   # 64 devices compiles for a while on 1 core
 """
 
 from __future__ import annotations
@@ -55,8 +56,9 @@ def roofline() -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
-        REPO, "MULTICHIP_SCALE_r04.json"))
-    ap.add_argument("--sizes", default="8,16,32")
+        REPO, "MULTICHIP_SCALE_r05.json"))
+    ap.add_argument("--sizes", default="8,16,32,64")
+    ap.add_argument("--per-size-timeout", type=float, default=3600)
     args = ap.parse_args()
     sizes = [int(s) for s in args.sizes.split(",")]
 
@@ -66,10 +68,14 @@ def main() -> int:
         t0 = time.perf_counter()
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    XLA_FLAGS=f"--xla_force_host_platform_device_count={n}")
+        # pool-free children: the accelerator-pool sitecustomize dials
+        # the pool from every interpreter and can hang at startup while
+        # the pool is wedged; these runs are pure CPU by construction
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", CHILD.format(repo=REPO, n=n)],
-                capture_output=True, text=True, timeout=1800, env=env,
+                capture_output=True, text=True, timeout=args.per_size_timeout, env=env,
                 cwd=REPO)
             rc, stdout, stderr = proc.returncode, proc.stdout, proc.stderr
         except subprocess.TimeoutExpired as e:
