@@ -10,7 +10,6 @@ Prints which formulations compile + run correctly on the ambient
 backend, and a rough per-call timing.
 """
 
-import sys
 import time
 from functools import partial
 
@@ -19,7 +18,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 ROWS, N = 32, 2048
 

@@ -279,7 +279,7 @@ def _make_terasort_mofs(root: str, job: str, num_maps: int,
 
     from uda_tpu import native
     from uda_tpu.mofserver.index import write_index_file
-    from uda_tpu.utils.ifile import EOF_MARKER, RecordBatch
+    from uda_tpu.utils.ifile import RecordBatch
 
     for m in range(num_maps):
         rng = np.random.default_rng(seed + m)

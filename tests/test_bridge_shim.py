@@ -7,7 +7,6 @@ dispatch), but asserting the FULL reduce flow end-to-end."""
 import os
 import shutil
 import subprocess
-import sys
 
 import pytest
 

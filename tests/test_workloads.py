@@ -2,10 +2,8 @@
 
 import collections
 import re
-import struct
 
 import numpy as np
-import pytest
 
 from uda_tpu.models import grep, inverted_index, secondary_sort, wordcount
 from uda_tpu.models.pipeline import MapReduceJob, grouped_reduce

@@ -33,12 +33,12 @@ from __future__ import annotations
 import threading
 from typing import Optional, Protocol, Sequence
 
-from uda_tpu.bridge.protocol import Cmd, form_cmd, parse_cmd
+from uda_tpu.bridge.protocol import Cmd, parse_cmd
 from uda_tpu.merger import LocalFetchClient, MergeManager
 from uda_tpu.merger.segment import InputClient
 from uda_tpu.mofserver import DataEngine, IndexRecord, IndexResolver
 from uda_tpu.utils.config import Config
-from uda_tpu.utils.errors import FallbackSignal, ProtocolError, UdaError
+from uda_tpu.utils.errors import ProtocolError, UdaError
 from uda_tpu.utils.logging import LogLevel, get_logger
 
 __all__ = ["UdaCallable", "UdaBridge"]

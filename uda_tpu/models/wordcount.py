@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import re
 import struct
-from typing import Iterable, Optional, Sequence
+from typing import Iterable, Optional
 
 from uda_tpu.models.pipeline import MapReduceJob, Record
 from uda_tpu.utils import vint

@@ -33,7 +33,7 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 
-from uda_tpu.mofserver.index import IndexRecord, IndexResolver
+from uda_tpu.mofserver.index import IndexResolver
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import StorageError
 from uda_tpu.utils.logging import get_logger

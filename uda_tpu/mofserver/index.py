@@ -29,7 +29,7 @@ import dataclasses
 import os
 import struct
 import threading
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, Sequence
 
 from uda_tpu.utils.errors import StorageError
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import io
 import os
-from typing import Callable, Iterable, Sequence, Tuple
+from typing import Iterable, Sequence, Tuple
 
 from uda_tpu.mofserver.index import write_index_file
 from uda_tpu.utils.ifile import IFileWriter

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import os
 from functools import partial
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
