@@ -493,3 +493,66 @@ def test_fetch_sync_raise_fails_segment_not_transport_thread(tmp_path):
     seg.start()
     with pytest.raises(StorageError):
         seg.wait(timeout=30)
+
+
+def test_auto_approach_picks_by_size_estimate(tmp_path):
+    # approach=0: the transport's size estimate routes small partitions
+    # to hybrid and large ones to bounded streaming online — assert the
+    # PATH taken (the two are byte-identical by design, so output
+    # equality alone would not catch an inverted comparison), then the
+    # output itself
+    import io as _io
+
+    from uda_tpu.utils.ifile import IFileReader as Reader
+
+    expected = make_mof_tree(str(tmp_path), "jobAuto", 4, 1, 60, seed=2)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    try:
+        for threshold_mb, want_streaming in ((1 << 20, False), (0, True)):
+            cfg = Config({"mapred.netmerger.merge.approach": 0,
+                          "uda.tpu.auto.approach.threshold.mb":
+                          threshold_mb})
+            mm = MergeManager(LocalFetchClient(engine), kt, cfg)
+            blocks = []
+            mm.run("jobAuto", map_ids("jobAuto", 4), 0,
+                   lambda b: blocks.append(bytes(b)))
+            took_streaming = getattr(mm, "_active_overlap", None) is not None
+            assert took_streaming == want_streaming, threshold_mb
+            got = list(Reader(_io.BytesIO(b"".join(blocks))))
+            assert got == sorted(expected[0]), threshold_mb
+    finally:
+        engine.stop()
+
+
+def test_auto_approach_unknown_size_defaults_to_streaming(tmp_path):
+    # a transport without a size estimate must land on the
+    # bounded-memory path, not the host-resident one
+    import io as _io
+
+    from uda_tpu.merger.merge_manager import MergeManager as MM
+    from uda_tpu.merger.segment import InputClient
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.utils.ifile import IFileReader as Reader
+
+    expected = make_mof_tree(str(tmp_path), "jobU", 4, 1, 50, seed=3)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+
+    class Blind(LocalFetchClient):
+        def estimate_partition_bytes(self, job_id, mids, reduce_id):
+            return InputClient.estimate_partition_bytes(
+                self, job_id, mids, reduce_id)  # None
+
+    cfg = Config({"mapred.netmerger.merge.approach": 0})
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    mm = MM(Blind(engine), kt, cfg)
+    blocks = []
+    try:
+        mm.run("jobU", map_ids("jobU", 4), 0,
+               lambda b: blocks.append(bytes(b)))
+        # the streaming path goes through the overlapped merger
+        assert getattr(mm, "_active_overlap", None) is not None
+    finally:
+        engine.stop()
+    got = list(Reader(_io.BytesIO(b"".join(blocks))))
+    assert got == sorted(expected[0])

@@ -166,10 +166,31 @@ class MergeManager:
         overlap restores the network-levitated property — see
         uda_tpu.merger.overlap)."""
         approach = self.cfg.get("mapred.netmerger.merge.approach")
+        streaming = bool(self.cfg.get("uda.tpu.online.streaming"))
+        if approach == 0:
+            # Auto policy (beyond the reference, which made the user
+            # pick via mapred.netmerger.merge.approach): choose by the
+            # transport's size estimate using the measured crossover —
+            # hybrid LPQ/RPQ is fastest at small/mid scale (1.05 GB:
+            # 102 s vs streaming 192 s) while streaming online wins at
+            # scale with O(window) host memory (10.24 GB: 579 s vs
+            # 866 s at a third of the RSS) — REGRESSION_cpu_
+            # x{,x}large_r05.json. Unknown size -> streaming: bounded
+            # memory is the only safe default for an unbounded input.
+            est = self.client.estimate_partition_bytes(
+                job_id, map_ids, reduce_id)
+            threshold = (self.cfg.get("uda.tpu.auto.approach.threshold.mb")
+                         * (1 << 20))
+            if est is not None and est <= threshold:
+                approach = 2
+            else:
+                approach, streaming = 1, True
+            log.info(f"auto merge approach: estimate="
+                     f"{'unknown' if est is None else est} bytes -> "
+                     f"{'hybrid' if approach == 2 else 'streaming online'}")
         if approach == 2:
             from uda_tpu.merger.hybrid import run_hybrid
             return run_hybrid(self, job_id, map_ids, reduce_id, consumer)
-        streaming = bool(self.cfg.get("uda.tpu.online.streaming"))
         if not streaming and not self.cfg.get("uda.tpu.merge.overlap"):
             segments = self.fetch_all(job_id, map_ids, reduce_id)
             merged = self.merge_segments(segments)

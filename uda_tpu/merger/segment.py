@@ -40,6 +40,14 @@ class InputClient(abc.ABC):
     def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
         """Async fetch; ``on_complete(FetchResult | Exception)``."""
 
+    def estimate_partition_bytes(self, job_id: str, map_ids,
+                                 reduce_id: int):
+        """Best-effort on-disk size of this reduce partition across
+        ``map_ids``, or None when the transport cannot know it without
+        fetching (the auto merge-approach policy then defaults to the
+        bounded-memory path — see MergeManager.run)."""
+        return None
+
     def stop(self) -> None:
         pass
 
@@ -59,6 +67,24 @@ class LocalFetchClient(InputClient):
             on_complete(err if err is not None else f.result())
 
         fut.add_done_callback(_done)
+
+    def estimate_partition_bytes(self, job_id: str, map_ids,
+                                 reduce_id: int):
+        """Sum of part_length over the map outputs (the spill-index
+        triples the supplier serves from; resolution is cached by the
+        engine's resolver). Exact-or-unknown: ANY unresolvable map makes
+        the whole estimate None — a partial sum is a lower bound, and a
+        lower bound could steer the auto policy onto the host-resident
+        path for a partition that is actually huge. Fetch itself still
+        fails loudly on a truly missing MOF."""
+        total = 0
+        for mid in map_ids:
+            try:
+                total += int(self.engine.resolver.resolve(
+                    job_id, mid, reduce_id).part_length)
+            except Exception:
+                return None
+        return total
 
 
 class HostRoutingClient(InputClient):

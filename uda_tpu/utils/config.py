@@ -47,7 +47,10 @@ _FLAG_LIST = [
     Flag("mapred.rdma.cma.port", 9011, int,
          "control-plane port (reference rdma_cm port)", "r"),
     Flag("mapred.netmerger.merge.approach", 1, int,
-         "1=online in-memory merge, 2=hybrid LPQ/RPQ merge", "a"),
+         "1=online in-memory merge, 2=hybrid LPQ/RPQ merge, 0=auto "
+         "(hybrid when the transport's size estimate is under "
+         "uda.tpu.auto.approach.threshold.mb, bounded-memory streaming "
+         "online otherwise or when the size is unknown)", "a"),
     Flag("uda.log.dir", "", str, "private log directory", "g"),
     Flag("uda.log.level", 4, int, "log severity 0..6 (lsNONE..lsTRACE)", "t"),
     Flag("mapred.rdma.buf.size", 1024, int,
@@ -109,6 +112,12 @@ _FLAG_LIST = [
     Flag("uda.tpu.online.stagers", 0, int,
          "overlap staging worker threads (pack+sort+spool per segment); "
          "0 = single merge thread"),
+    Flag("uda.tpu.auto.approach.threshold.mb", 2048, int,
+         "auto merge-approach crossover: partitions at most this many "
+         "MB take the hybrid LPQ/RPQ path (fastest at small/mid scale), "
+         "larger or unknown sizes take bounded-memory streaming online "
+         "(measured crossover between the 1 GB and 10 GB regression "
+         "rungs, REGRESSION_cpu_x{,x}large_r05.json)"),
 ]
 
 FLAGS: Dict[str, Flag] = {f.key: f for f in _FLAG_LIST}
