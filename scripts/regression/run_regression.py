@@ -362,8 +362,9 @@ def _terasort_shuffle(size: str, work_dir: str, mode: str) -> dict:
     per_map = (total + num_maps - 1) // num_maps
     job = f"shuf{mode}"
     _make_terasort_mofs(work_dir, job, num_maps, per_map)
+    approach = {"hybrid": 2, "streaming": 1, "auto": 0}[mode]
     cfg = Config({
-        "mapred.netmerger.merge.approach": 2 if mode == "hybrid" else 1,
+        "mapred.netmerger.merge.approach": approach,
         "uda.tpu.online.streaming": mode == "streaming",
         "uda.tpu.spill.dirs": os.path.join(work_dir, "spill"),
         "mapred.rdma.wqe.per.conn": 8,
@@ -391,6 +392,12 @@ def wl_terasort_shuffle_hybrid(size: str, work_dir: str) -> dict:
 
 def wl_terasort_shuffle_streaming(size: str, work_dir: str) -> dict:
     return _terasort_shuffle(size, work_dir, "streaming")
+
+
+def wl_terasort_shuffle_auto(size: str, work_dir: str) -> dict:
+    # approach=0: the size-estimate policy picks the mode (hybrid at
+    # regression sizes; the xlarge/xxlarge rungs cross the threshold)
+    return _terasort_shuffle(size, work_dir, "auto")
 
 
 def wl_pi(size: str, work_dir: str) -> dict:
@@ -423,6 +430,7 @@ WORKLOADS = {
     "dfsio": wl_dfsio,
     "terasort_shuffle_hybrid": wl_terasort_shuffle_hybrid,
     "terasort_shuffle_streaming": wl_terasort_shuffle_streaming,
+    "terasort_shuffle_auto": wl_terasort_shuffle_auto,
 }
 
 
