@@ -92,10 +92,15 @@ PROBE_TIMEOUT = float(os.environ.get("UDA_TPU_BENCH_PROBE_TIMEOUT", 600))
 # the whole cascade on an 8-row keys-only array + ONE global XLA
 # payload gather (the same idea with the gather hoisted out of Mosaic —
 # it lowers everywhere).
-PATHS = (("lanes2", "keys8f", "keys8", "gather2", "carrychunk", "lanes",
+# Probe order = risk order: carrychunk FIRST — the measured champion
+# (BENCH_HW_r05.json: 3.04 GB/s) with bounded compile — so a pool
+# window that dies mid-sequence has already warmed the guaranteed-
+# number engine's cache; gather2 (always-compilable runner-up) next;
+# then the speculative Mosaic engines whose probes may burn budget.
+PATHS = (("carrychunk", "gather2", "keys8f", "lanes2", "keys8", "lanes",
           "carry", "gather")
          if os.environ.get("UDA_TPU_BENCH_TRY_CARRY") == "1"
-         else ("lanes2", "keys8f", "keys8", "gather2", "carrychunk",
+         else ("carrychunk", "gather2", "keys8f", "lanes2", "keys8",
                "lanes", "gather"))
 # explicit candidate-list override (comma-separated), e.g. a short pool
 # window where only the known-good path should be timed:
