@@ -1,0 +1,60 @@
+"""scripts/stagelib.py: the budgeted-subprocess runner shared by the
+staged pool drivers (tpu_return / sweep_carrychunk / pool_watch). The
+kill discipline matters: a timed-out stage must die as a whole process
+group (a surviving grandchild holding the pool's single device claim is
+the documented wedge trigger)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+from stagelib import run_stage  # noqa: E402
+
+
+def test_ok_stage_writes_log(tmp_path):
+    ok, timed_out = run_stage(
+        "hello", [sys.executable, "-c", "print('from-stage')"],
+        30, str(tmp_path))
+    assert ok and not timed_out
+    assert "from-stage" in (tmp_path / "hello.log").read_text()
+
+
+def test_failing_stage_reports_not_ok(tmp_path):
+    ok, timed_out = run_stage(
+        "boom", [sys.executable, "-c", "raise SystemExit(3)"],
+        30, str(tmp_path))
+    assert not ok and not timed_out
+
+
+def test_timeout_kills_whole_process_group(tmp_path):
+    # the stage spawns a GRANDCHILD that would outlive a naive
+    # child-only kill; both must be dead right after run_stage returns
+    pidfile = tmp_path / "grandchild.pid"
+    prog = (
+        "import subprocess, sys, time\n"
+        f"p = subprocess.Popen([sys.executable, '-c', "
+        f"'import time; time.sleep(60)'])\n"
+        f"open({str(pidfile)!r}, 'w').write(str(p.pid))\n"
+        "time.sleep(60)\n"
+    )
+    t0 = time.perf_counter()
+    ok, timed_out = run_stage("hang", [sys.executable, "-c", prog],
+                              2, str(tmp_path))
+    assert not ok and timed_out
+    assert time.perf_counter() - t0 < 15
+    assert "TIMEOUT" in (tmp_path / "hang.log").read_text()
+    gc_pid = int(pidfile.read_text())
+    # the grandchild shared the stage's session; killpg must have
+    # reached it (allow a moment for reaping by init)
+    for _ in range(50):
+        try:
+            os.kill(gc_pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        os.kill(gc_pid, 9)  # clean up before failing
+        raise AssertionError("grandchild survived the process-group kill")
