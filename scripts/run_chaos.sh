@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Chaos tier: run the fault-marked tests under a randomized-but-seeded
+# failpoint schedule (uda_tpu.utils.failpoints.chaos_spec). The seed is
+# printed first — reproduce any failure exactly with:
+#
+#   CHAOS_SEED=<seed> scripts/run_chaos.sh
+#
+# The schedule is recoverable by construction (transport errors, delays,
+# truncations — no undetectable corruption), so a failure here means the
+# retry/backoff/penalty/carry machinery regressed, not that the dice
+# came up wrong. Extra pytest args pass through ("$@").
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED="${CHAOS_SEED:-$RANDOM}"
+SPEC="$(python -c "from uda_tpu.utils.failpoints import chaos_spec; print(chaos_spec(${SEED}))")"
+echo "chaos seed:          ${SEED}"
+echo "failpoint schedule:  ${SPEC}"
+
+exec env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    --continue-on-collection-errors "$@"

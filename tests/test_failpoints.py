@@ -1,0 +1,547 @@
+"""Failpoint framework + retrying fetch path: injection grammar and
+triggers, backoff/timeout/deadline policy, per-chunk CRC, the penalty
+box, and the FallbackSignal contract — the failure scenarios the
+reference could only reach on a broken cluster (SURVEY §4.5), now
+reachable, injectable, and survived."""
+
+import functools
+import io
+import threading
+import time
+
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.bridge import Cmd, UdaBridge, form_cmd
+from uda_tpu.merger import (HostRoutingClient, LocalFetchClient,
+                            MergeManager, PenaltyBox, Segment)
+from uda_tpu.mofserver import DataEngine, DirIndexResolver, FetchResult
+from uda_tpu.utils import comparators
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import (ConfigError, FallbackSignal, StorageError,
+                                  TransportError, UdaError)
+from uda_tpu.utils.failpoints import (FailpointRegistry, chaos_spec,
+                                      failpoint, failpoints)
+from uda_tpu.utils.ifile import IFileReader, write_records
+from uda_tpu.utils.metrics import metrics
+from uda_tpu.utils.retry import RetryPolicy
+
+
+# -- spec grammar + triggers -------------------------------------------------
+
+
+def test_spec_parse_and_every_trigger():
+    r = FailpointRegistry()
+    r.arm("s.site", "error:every:3")
+    for i in range(1, 10):
+        if i % 3 == 0:
+            with pytest.raises(UdaError) as ei:
+                r.evaluate("s.site", None, "")
+            assert "s.site" in str(ei.value)
+            assert ei.value.failpoint_site == "s.site"
+        else:
+            assert r.evaluate("s.site", None, "") is None
+    assert r.hits["s.site"] == 3
+
+
+def test_once_and_match_triggers():
+    r = FailpointRegistry()
+    r.arm("s", "error:once:match:m_0002")
+    assert r.evaluate("s", None, "m_0001/0") is None  # key mismatch
+    with pytest.raises(UdaError):
+        r.evaluate("s", None, "m_0002/0")
+    assert r.evaluate("s", None, "m_0002/0") is None  # one-shot spent
+    assert r.hits["s"] == 1
+
+
+def test_prob_trigger_is_seeded_deterministic():
+    def fires(reg):
+        out = []
+        for _ in range(50):
+            try:
+                reg.evaluate("p", None, "")
+                out.append(False)
+            except UdaError:
+                out.append(True)
+        return out
+
+    a, b = FailpointRegistry(), FailpointRegistry()
+    a.arm("p", "error:prob:0.3:seed:7")
+    b.arm("p", "error:prob:0.3:seed:7")
+    pattern = fires(a)
+    assert pattern == fires(b)
+    assert 0 < sum(pattern) < 50
+
+
+def test_truncate_and_corrupt_actions():
+    r = FailpointRegistry()
+    r.arm("t", "truncate:4")
+    assert r.evaluate("t", b"abcdefgh", "") == b"abcd"
+    assert r.evaluate("t", b"ab", "") == b"a"  # never truncates to empty
+    r.arm("c", "corrupt:2:seed:5")
+    data = b"x" * 64
+    out = r.evaluate("c", data, "")
+    assert len(out) == 64 and out != data
+    # data-less sites pass truncate/corrupt through untouched
+    assert r.evaluate("t", None, "") is None
+
+
+def test_error_kind_override_and_delay():
+    r = FailpointRegistry()
+    r.arm("k", "error:transport")
+    with pytest.raises(TransportError):
+        r.evaluate("k", None, "")
+    r.arm("d", "delay:30")
+    t0 = time.monotonic()
+    r.evaluate("d", None, "")
+    assert time.monotonic() - t0 >= 0.02
+
+
+def test_arm_spec_scoped_and_bad_specs():
+    with failpoints.scoped("a.b=error:every:2,c.d=delay:1"):
+        assert set(failpoints.active()) >= {"a.b", "c.d"}
+        assert failpoints.active()["a.b"] == "error:every:2"
+    assert "a.b" not in failpoints.active()
+    for bad in ("a.b", "a.b=nonsense", "a.b=error:every",
+                "a.b=delay", "a.b=error:bogus_tok"):
+        with pytest.raises(ConfigError):
+            failpoints.arm_spec(bad)
+
+
+def test_chaos_spec_reproducible_and_parseable():
+    assert chaos_spec(123) == chaos_spec(123)
+    r = FailpointRegistry()
+    for seed in range(20):
+        r.arm_spec(chaos_spec(seed))  # every generated schedule parses
+
+
+# -- retry policy ------------------------------------------------------------
+
+
+def test_backoff_exponential_capped_and_jittered():
+    p = RetryPolicy(backoff_ms=10, backoff_max_ms=50, jitter=0.0)
+    assert [p.backoff(a) for a in (1, 2, 3, 4)] == \
+        [0.010, 0.020, 0.040, 0.050]
+    assert RetryPolicy().backoff(3) == 0.0  # default: immediate retry
+    import random as _r
+    pj = RetryPolicy(backoff_ms=100, jitter=0.5)
+    vals = {pj.backoff(1, _r.Random(i)) for i in range(10)}
+    assert len(vals) > 1
+    assert all(0.05 <= v <= 0.15 for v in vals)
+
+
+class _DropFirst:
+    """Transport that never completes its first fetch (a wedged
+    supplier), then serves normally."""
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.calls = 0
+        self.dropped = []
+
+    def start_fetch(self, req, on_complete):
+        self.calls += 1
+        if self.calls == 1:
+            self.dropped.append(on_complete)  # black hole
+            return
+        n = len(self.payload)
+        on_complete(FetchResult(self.payload, n, n, 0, "p", last=True))
+
+
+def test_attempt_timeout_retries_and_drops_stale_completion():
+    payload = write_records([(b"k1", b"v1"), (b"k2", b"v2")])
+    client = _DropFirst(payload)
+    seg = Segment(client, "j", "m", 0, 1 << 20,
+                  policy=RetryPolicy(retries=2, attempt_timeout_ms=60))
+    before = metrics.snapshot()
+    seg.start()
+    seg.wait(timeout=10)
+    assert seg.num_records == 2 and client.calls == 2
+    assert metrics.get("fetch.timeouts") > before.get("fetch.timeouts", 0)
+    # the wedged attempt finally "completes": it must be dropped as
+    # stale, not double-ingested into the finished segment
+    n = len(payload)
+    client.dropped[0](FetchResult(payload, n, n, 0, "p", last=True))
+    assert seg.num_records == 2
+    assert metrics.get("fetch.stale_completions") > \
+        before.get("fetch.stale_completions", 0)
+
+
+def test_deadline_gives_up_before_retry_budget():
+    class AlwaysFail:
+        calls = 0
+
+        def start_fetch(self, req, on_complete):
+            AlwaysFail.calls += 1
+            on_complete(ConnectionError("down"))
+
+    before = metrics.get("fetch.deadline_exceeded")
+    seg = Segment(AlwaysFail(), "j", "m", 0, 1024,
+                  policy=RetryPolicy(retries=10_000, backoff_ms=20,
+                                     backoff_max_ms=40, jitter=0.0,
+                                     deadline_ms=150))
+    t0 = time.monotonic()
+    seg.start()
+    with pytest.raises(ConnectionError):
+        seg.wait(timeout=10)
+    assert time.monotonic() - t0 < 5.0
+    assert AlwaysFail.calls < 100  # deadline cut the budget short
+    assert metrics.get("fetch.deadline_exceeded") > before
+
+
+def test_backoff_does_not_block_completion_thread():
+    # the retry must be re-issued from a timer, so the thread that
+    # delivered the failure is free immediately (a transport worker
+    # blocked in a sleeping retry is the pool-deadlock shape)
+    threads = []
+
+    class FailOnce:
+        calls = 0
+
+        def __init__(self, payload):
+            self.payload = payload
+
+        def start_fetch(self, req, on_complete):
+            FailOnce.calls += 1
+            if FailOnce.calls == 1:
+                on_complete(ConnectionError("transient"))
+                return
+            threads.append(threading.current_thread().name)
+            n = len(self.payload)
+            on_complete(FetchResult(self.payload, n, n, 0, "p", last=True))
+
+    payload = write_records([(b"k", b"v")])
+    seg = Segment(FailOnce(payload), "j", "m", 0, 1 << 20,
+                  policy=RetryPolicy(retries=3, backoff_ms=20, jitter=0.0))
+    t0 = time.monotonic()
+    seg.start()
+    assert time.monotonic() - t0 < 0.015  # start() returned pre-backoff
+    seg.wait(timeout=10)
+    assert seg.num_records == 1
+
+
+# -- penalty box -------------------------------------------------------------
+
+
+def test_penalty_box_threshold_expiry_forgive():
+    box = PenaltyBox(threshold=2, penalty_s=0.05)
+    assert not box.punish("h")          # first fault: under threshold
+    assert not box.penalized("h")
+    assert box.punish("h")              # second fault: boxed
+    assert box.penalized("h") and box.boxed == ["h"]
+    time.sleep(0.06)
+    assert not box.penalized("h")       # parole
+    assert box.punish("h")              # one more fault re-boxes
+    box.forgive("h")
+    assert not box.penalized("h") and not box.punish("h")
+
+
+def test_penalty_box_deprioritizes_sick_supplier(tmp_path):
+    """A host whose fetches fault gets its remaining maps rotated to the
+    back of the schedule; the run still completes correctly."""
+    root = str(tmp_path)
+    expected = make_mof_tree(root, "jobP", 6, 1, 30, seed=3)
+    engine = DataEngine(DirIndexResolver(root), Config())
+    faulted = []
+    lock = threading.Lock()
+
+    class FlakyB(LocalFetchClient):
+        """Faults the first fetch of every map, inline (so the box is
+        set before the scheduler's next pick), and delivers successful
+        completions late (so forgiveness cannot race the scheduler out
+        of ever observing a penalized head)."""
+
+        def start_fetch(self, req, on_complete):
+            with lock:
+                first = req.map_id not in faulted
+                if first:
+                    faulted.append(req.map_id)
+            if first:
+                on_complete(TransportError(f"hostB flake {req.map_id}"))
+                return
+
+            def late(res):
+                t = threading.Timer(0.05, on_complete, args=(res,))
+                t.daemon = True
+                t.start()
+
+            super().start_fetch(req, late)
+
+    hosts = {"hostA": LocalFetchClient(engine), "hostB": FlakyB(engine)}
+    router = HostRoutingClient(lambda h: hosts[h])
+    cfg = Config({"mapred.rdma.wqe.per.conn": 2,
+                  "uda.tpu.fetch.penalty.threshold": 1,
+                  "uda.tpu.fetch.penalty.ms": 60_000})
+    mids = map_ids("jobP", 6)
+    maps = [("hostA", m) for m in mids[:2]] + [("hostB", m) for m in mids[2:]]
+    before = metrics.snapshot()
+    try:
+        mm = MergeManager(router, "uda.tpu.RawBytes", cfg)
+        blocks = []
+        mm.run("jobP", maps, 0, lambda b: blocks.append(bytes(b)))
+    finally:
+        engine.stop()
+    assert metrics.get("fetch.penalties") > before.get("fetch.penalties", 0)
+    assert metrics.get("fetch.deprioritized") > \
+        before.get("fetch.deprioritized", 0)
+    got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    want = sorted(expected[0], key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want
+
+
+# -- acceptance: faulted runs survive or fall back cleanly -------------------
+
+
+def _sorted_expected(expected, kt):
+    return sorted(expected, key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+
+
+def test_every_third_pread_fails_run_is_byte_identical(tmp_path):
+    """The ISSUE acceptance scenario: data_engine.pread armed to fail
+    every 3rd call, >= 8 segments, byte-identical output vs the
+    unfaulted run, fetch.retries > 0."""
+    root = str(tmp_path)
+    make_mof_tree(root, "jobFp", 8, 1, 50, seed=21)
+    cfg = Config({"uda.tpu.fetch.retries": 10,
+                  "mapred.rdma.wqe.per.conn": 2})
+    engine = DataEngine(DirIndexResolver(root), cfg)
+
+    def run_once():
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes", cfg)
+        blocks = []
+        mm.run("jobFp", map_ids("jobFp", 8), 0,
+               lambda b: blocks.append(bytes(b)))
+        return b"".join(blocks)
+
+    try:
+        clean = run_once()
+        before = metrics.get("fetch.retries")
+        hits0 = failpoints.hits["data_engine.pread"]
+        with failpoints.scoped("data_engine.pread=error:every:3"):
+            faulted = run_once()
+            assert failpoints.hits["data_engine.pread"] > hits0
+    finally:
+        engine.stop()
+    assert faulted == clean
+    assert metrics.get("fetch.retries") > before
+
+
+def test_permanent_supplier_fault_raises_fallback_signal(tmp_path):
+    """Retries exhausted on one supplier: FallbackSignal whose cause
+    names the failing site; no hang, no partial output."""
+    root = str(tmp_path)
+    make_mof_tree(root, "jobPerm", 8, 1, 30, seed=22)
+    engine = DataEngine(DirIndexResolver(root), Config())
+    blocks = []
+    try:
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes")
+        with failpoints.scoped("data_engine.pread=error:match:m_000002"):
+            t0 = time.monotonic()
+            with pytest.raises(FallbackSignal) as ei:
+                mm.run("jobPerm", map_ids("jobPerm", 8), 0,
+                       lambda b: blocks.append(bytes(b)))
+            assert time.monotonic() - t0 < 60
+    finally:
+        engine.stop()
+    assert blocks == []  # no partial output reached the consumer
+    assert isinstance(ei.value.cause, StorageError)
+    assert "data_engine.pread" in str(ei.value.cause)
+    assert ei.value.__cause__ is ei.value.cause  # backtrace chain intact
+    assert ei.value.cause.backtrace
+
+
+def test_crc_catches_corruption_and_refetches(tmp_path):
+    root = str(tmp_path)
+    expected = make_mof_tree(root, "jobCrc", 4, 1, 40, seed=23)
+    cfg = Config({"uda.tpu.fetch.crc": True})
+    engine = DataEngine(DirIndexResolver(root), cfg)
+    before = metrics.snapshot()
+    try:
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes", cfg)
+        blocks = []
+        with failpoints.scoped("data_engine.pread=corrupt:8:once"):
+            mm.run("jobCrc", map_ids("jobCrc", 4), 0,
+                   lambda b: blocks.append(bytes(b)))
+    finally:
+        engine.stop()
+    assert metrics.get("fetch.crc_refetch") > \
+        before.get("fetch.crc_refetch", 0)
+    got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    assert got == _sorted_expected(expected[0], kt)
+
+
+def test_crc_persistent_corruption_falls_back(tmp_path):
+    # corruption on EVERY read of one map: the one-refetch grace and the
+    # whole-segment retry budget both exhaust -> FallbackSignal whose
+    # cause is the CRC failure
+    root = str(tmp_path)
+    make_mof_tree(root, "jobCrc2", 3, 1, 20, seed=24)
+    cfg = Config({"uda.tpu.fetch.crc": True})
+    engine = DataEngine(DirIndexResolver(root), cfg)
+    try:
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes", cfg)
+        with failpoints.scoped(
+                "data_engine.pread=corrupt:4:match:m_000001"):
+            with pytest.raises(FallbackSignal) as ei:
+                mm.run("jobCrc2", map_ids("jobCrc2", 3), 0, lambda b: None)
+    finally:
+        engine.stop()
+    assert "CRC mismatch" in str(ei.value.cause)
+
+
+def test_crc_validates_compressed_wire_chunks(tmp_path):
+    # with compression the CRC covers the COMPRESSED chunk, so the
+    # DecompressingClient validates it at the wire layer; a corrupted
+    # chunk becomes a transport error the whole-segment retry absorbs
+    from uda_tpu.compress import DecompressingClient, get_codec
+    from uda_tpu.mofserver.writer import MOFWriter
+
+    import numpy as np
+    codec = get_codec("zlib")
+    rng = np.random.default_rng(55)
+    expected = []
+    writer = MOFWriter(str(tmp_path), "jobCz", codec=codec)
+    for m in range(3):
+        recs = sorted((rng.bytes(8), rng.bytes(40)) for _ in range(50))
+        expected += recs
+        writer.write(f"attempt_jobCz_m_{m:06d}_0", [recs])
+    cfg = Config({"uda.tpu.fetch.crc": True})
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    before = metrics.get("fetch.retries")
+    try:
+        client = DecompressingClient(LocalFetchClient(engine), codec)
+        mm = MergeManager(client, "uda.tpu.RawBytes", cfg)
+        blocks = []
+        with failpoints.scoped("data_engine.pread=corrupt:4:once"):
+            mm.run("jobCz", writer.map_ids, 0,
+                   lambda b: blocks.append(bytes(b)))
+    finally:
+        engine.stop()
+    assert metrics.get("fetch.retries") > before  # mismatch was caught
+    got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    assert got == _sorted_expected(expected, kt)
+
+
+def test_compressed_fetch_attempt_timeout_drops_stale_completion(tmp_path):
+    # the finding-shaped race: a slow first chunk times out, the segment
+    # re-issues from offset 0, and the LATE completion of the superseded
+    # attempt must not mutate the DecompressingClient's stream state the
+    # new attempt depends on (token guard) — output stays byte-correct
+    from uda_tpu.compress import DecompressingClient, get_codec
+    from uda_tpu.mofserver.writer import MOFWriter
+
+    import numpy as np
+    codec = get_codec("zlib")
+    rng = np.random.default_rng(56)
+    recs = sorted((rng.bytes(8), rng.bytes(40)) for _ in range(60))
+    writer = MOFWriter(str(tmp_path), "jobSt", codec=codec)
+    writer.write("attempt_jobSt_m_000000_0", [recs])
+    # 2 reader threads: the retry must run WHILE the wedged read still
+    # sleeps, so its late completion races the new attempt for real
+    cfg = Config({"mapred.rdma.fetch.attempt.timeout.ms": 80,
+                  "uda.tpu.fetch.retries": 4,
+                  "mapred.uda.provider.blocked.threads.per.disk": 2})
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    before = metrics.snapshot()
+    try:
+        client = DecompressingClient(LocalFetchClient(engine), codec)
+        mm = MergeManager(client, "uda.tpu.RawBytes", cfg)
+        blocks = []
+        with failpoints.scoped("data_engine.pread=delay:500:once"):
+            mm.run("jobSt", writer.map_ids, 0,
+                   lambda b: blocks.append(bytes(b)))
+    finally:
+        engine.stop()  # waits out the wedged read's late delivery
+    assert metrics.get("fetch.timeouts") > before.get("fetch.timeouts", 0)
+    assert metrics.get("fetch.stale_completions") > \
+        before.get("fetch.stale_completions", 0)
+    got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    assert got == _sorted_expected(recs, kt)
+
+
+def test_bridge_reports_root_cause_with_backtrace(tmp_path):
+    """The fallback boundary: the embedder's failure_in_uda receives the
+    root UdaError (unwrapped from FallbackSignal) with its captured
+    backtrace — the original failure point survives the trip."""
+    root = str(tmp_path)
+    make_mof_tree(root, "jobBr", 2, 1, 10, seed=25)
+    failures = []
+    fell_back = threading.Event()
+
+    class H:
+        def get_conf_data(self, name, default):
+            return ""
+
+        def failure_in_uda(self, error):
+            failures.append(error)
+            fell_back.set()
+
+    bridge = UdaBridge()
+    bridge.start(True, [], H())
+    with failpoints.scoped("data_engine.pread=error"):
+        bridge.do_command(form_cmd(
+            Cmd.INIT, ["jobBr", "0", "2", "uda.tpu.RawBytes", root]))
+        for mid in map_ids("jobBr", 2):
+            bridge.do_command(form_cmd(
+                Cmd.FETCH, ["h", "jobBr", mid, "0"]))
+        bridge.do_command(form_cmd(Cmd.FINAL, []))
+        assert fell_back.wait(timeout=30)
+    bridge.reduce_exit()
+    assert bridge.failed
+    (err,) = failures
+    assert isinstance(err, StorageError)      # root cause, not the signal
+    assert not isinstance(err, FallbackSignal)
+    assert "data_engine.pread" in str(err)
+    assert err.backtrace                      # origin backtrace preserved
+
+
+def test_exchange_round_failpoint_site():
+    # the exchange-plane site raises a TransportError without touching
+    # any mesh machinery (disarmed evaluation is what the hot loop pays)
+    with failpoints.scoped("exchange.round=error:once"):
+        with pytest.raises(TransportError) as ei:
+            failpoint("exchange.round", key="round0")
+        assert "exchange.round" in str(ei.value)
+    assert failpoint("exchange.round", key="round1") is None
+
+
+# -- chaos tier (scripts/run_chaos.sh arms UDA_FAILPOINTS) -------------------
+
+
+@pytest.mark.faults
+def test_chaos_schedule_survives_end_to_end(tmp_path):
+    """Runs under whatever failpoint schedule the environment armed
+    (scripts/run_chaos.sh exports a seeded chaos_spec; disarmed in the
+    plain tier this is a clean-run parity check). The merge must absorb
+    every injected fault and produce exactly the expected sorted
+    records."""
+    active = failpoints.active()
+    print(f"chaos schedule: {active or 'disarmed'}")
+    root = str(tmp_path)
+    expected = make_mof_tree(root, "jobChaos", 8, 2, 60, seed=31)
+    cfg = Config({"uda.tpu.fetch.retries": 25,
+                  "mapred.rdma.fetch.retry.backoff.ms": 1,
+                  "mapred.rdma.fetch.retry.backoff.max.ms": 20,
+                  "mapred.rdma.wqe.per.conn": 4})
+    engine = DataEngine(DirIndexResolver(root), cfg)
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    try:
+        for r in range(2):
+            mm = MergeManager(LocalFetchClient(engine),
+                              "uda.tpu.RawBytes", cfg)
+            blocks = []
+            mm.run("jobChaos", map_ids("jobChaos", 8), r,
+                   lambda b: blocks.append(bytes(b)))
+            got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+            assert got == _sorted_expected(expected[r], kt), \
+                f"reducer {r} diverged under schedule {active}"
+    finally:
+        engine.stop()
+    if active:
+        print(f"failpoint hits: {dict(failpoints.hits)}")

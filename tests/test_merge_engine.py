@@ -556,3 +556,39 @@ def test_auto_approach_unknown_size_defaults_to_streaming(tmp_path):
         engine.stop()
     got = list(Reader(_io.BytesIO(b"".join(blocks))))
     assert got == sorted(expected[0])
+
+
+def test_truncated_chunk_rejoins_split_record(tmp_path):
+    """A truncation failpoint cuts chunks mid-record (satellite of the
+    reference's switch_mem/join contract, StreamRW.cc:542-590): the
+    carry buffer must re-join each split record with the re-fetched
+    remainder exactly — output byte-identical to the unfaulted run."""
+    from uda_tpu.utils.failpoints import failpoints
+
+    cfg = Config({"mapred.rdma.buf.size": 1})  # 1 KB chunks
+    job = "jobTr"
+    expected = make_mof_tree(str(tmp_path), job, 3, 1, 40, seed=51)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+
+    def run_once():
+        mm = MergeManager(LocalFetchClient(engine), kt, cfg)
+        blocks = []
+        mm.run(job, map_ids(job, 3), 0, lambda b: blocks.append(bytes(b)))
+        return b"".join(blocks)
+
+    try:
+        clean = run_once()
+        # 37 is coprime to the 57-byte framed record: every truncation
+        # lands mid-record, forcing the carry/join path on each re-fetch
+        hits0 = failpoints.hits["data_engine.pread"]
+        with failpoints.scoped("data_engine.pread=truncate:37:every:2"):
+            faulted = run_once()
+            assert failpoints.hits["data_engine.pread"] > hits0
+    finally:
+        engine.stop()
+    assert faulted == clean
+    got = list(IFileReader(io.BytesIO(faulted)))
+    want = sorted(expected[0], key=functools.cmp_to_key(
+        lambda a, b: kt.compare(a[0], b[0])))
+    assert got == want

@@ -151,3 +151,40 @@ def test_multi_root_resolution_and_per_disk_threads(tmp_path):
             assert res.is_last and len(res.data) > 0
     finally:
         engine.stop()
+
+
+def test_chained_fetches_under_delay_failpoint_no_deadlock(tmp_path):
+    """DataEngine.submit's docstring warns that blocking in completion
+    callbacks can deadlock the pool. The fetch path's chained re-issue
+    (a Segment's completion callback submitting its next chunk) must
+    therefore stay non-blocking: with ONE pool thread, multi-chunk
+    segments and a delay failpoint slowing every read, the whole fetch
+    must still complete inside a bounded wall clock — a wedge here is
+    the deadlock shape the warning describes."""
+    from uda_tpu.merger import LocalFetchClient, MergeManager
+    from uda_tpu.utils.failpoints import failpoints
+
+    make_mof_tree(str(tmp_path), "jobDl", num_maps=4, num_reducers=1,
+                  records_per_map=60, seed=41)
+    cfg = Config({"mapred.uda.provider.blocked.threads.per.disk": 1,
+                  "mapred.rdma.buf.size": 1,       # 1 KB -> many chunks
+                  "mapred.rdma.wqe.per.conn": 4})  # window > pool threads
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    done = threading.Event()
+    out = {}
+
+    def fetch_everything():
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes", cfg)
+        out["segs"] = mm.fetch_all("jobDl", map_ids("jobDl", 4), 0)
+        done.set()
+
+    t = threading.Thread(target=fetch_everything, daemon=True)
+    try:
+        with failpoints.scoped("data_engine.pread=delay:5"):
+            t.start()
+            assert done.wait(timeout=60), \
+                "chained fetches deadlocked the 1-thread pool"
+    finally:
+        engine.stop()
+    assert all(s.ready for s in out["segs"])
+    assert sum(s.num_records for s in out["segs"]) == 240

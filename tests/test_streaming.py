@@ -377,3 +377,20 @@ def test_abort_with_full_queue_does_not_deadlock(tmp_path):
     om.abort()  # must return promptly and clean the store
     gate.set()
     assert not os.path.exists(store.dir)
+
+
+def test_streaming_byte_parity_under_truncation_failpoint(tmp_path):
+    # chunks truncated mid-record by an armed failpoint: the carry
+    # buffer re-joins every split record from the re-fetched remainder,
+    # and the streaming run stays byte-identical to the unfaulted
+    # in-memory run (the spooled runs never see the damage)
+    from uda_tpu.utils.failpoints import failpoints
+
+    a = _merge_once(tmp_path, False, records_per_map=90,
+                    extra_cfg={"mapred.rdma.buf.size": 1})
+    hits0 = failpoints.hits["data_engine.pread"]
+    with failpoints.scoped("data_engine.pread=truncate:23:every:2"):
+        b = _merge_once(tmp_path, True, records_per_map=90,
+                        extra_cfg={"mapred.rdma.buf.size": 1})
+        assert failpoints.hits["data_engine.pread"] > hits0
+    assert a == b

@@ -38,7 +38,8 @@ from uda_tpu.merger import LocalFetchClient, MergeManager
 from uda_tpu.merger.segment import InputClient
 from uda_tpu.mofserver import DataEngine, IndexRecord, IndexResolver
 from uda_tpu.utils.config import Config
-from uda_tpu.utils.errors import ProtocolError, UdaError
+from uda_tpu.utils.errors import FallbackSignal, ProtocolError, UdaError
+from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.logging import LogLevel, get_logger
 
 __all__ = ["UdaCallable", "UdaBridge"]
@@ -421,6 +422,7 @@ class UdaBridge:
         (merge_thread_main, MergeManager.cc:291-314)."""
         try:
             def consumer(block: memoryview) -> None:
+                failpoint("bridge.upcall", key="data_from_uda")
                 cb = getattr(self.callable, "data_from_uda", None)
                 if cb is not None:
                     cb(block, len(block))
@@ -462,7 +464,14 @@ class UdaBridge:
         failure_in_uda still fires so waiters wake; the embedder must
         not treat it as a fallback request in developer mode (the
         reference aborts the process outright there, :210-217 — an
-        embedded library cannot)."""
+        embedded library cannot).
+
+        The embedder is reported the ROOT CAUSE: a FallbackSignal from
+        the engine is unwrapped to its ``cause``, whose captured
+        backtrace (UdaError.backtrace) and ``__traceback__`` ride along
+        on the exception object — the original failure point is never
+        lost at the fallback boundary."""
+        root = error.cause if isinstance(error, FallbackSignal) else error
         if self.cfg.get("mapred.rdma.developer.mode"):
             if not in_thread:
                 raise error
@@ -472,10 +481,13 @@ class UdaBridge:
                       f"re-raise on next call): {error}")
         else:
             self._failed = True
-            log.error(f"engine failure, requesting fallback: {error}")
+            log.error(f"engine failure, requesting fallback: {root}")
+            bt = getattr(root, "backtrace", "")
+            if bt:
+                log.debug(f"failure origin backtrace:\n{bt}")
         cb = getattr(self.callable, "failure_in_uda", None)
         if cb is not None:
-            cb(error)
+            cb(root)
 
     @property
     def failed(self) -> bool:

@@ -30,7 +30,7 @@ from typing import Callable, Dict, Optional
 
 from uda_tpu.merger.segment import InputClient
 from uda_tpu.mofserver.data_engine import FetchResult, ShuffleRequest
-from uda_tpu.utils.errors import CompressionError
+from uda_tpu.utils.errors import CompressionError, StorageError
 from uda_tpu.utils.logging import get_logger
 
 __all__ = ["Codec", "get_codec", "register_codec", "compress_block_stream",
@@ -179,15 +179,24 @@ def decompress_block_stream(data: bytes, codec: Codec) -> bytes:
 
 
 class _StreamState:
-    """Sequential decompression state for one partition fetch."""
+    """Sequential decompression state for one partition fetch.
 
-    __slots__ = ("comp_offset", "carry", "delivered", "part_length")
+    ``mu`` serializes attempt issue and chunk ingest per stream;
+    ``token`` identifies the stream's CURRENT fetch attempt, so a
+    completion from a superseded attempt (the segment's per-attempt
+    timeout fired and it re-issued) can never mutate state the new
+    attempt depends on."""
+
+    __slots__ = ("comp_offset", "carry", "delivered", "part_length",
+                 "mu", "token")
 
     def __init__(self) -> None:
         self.comp_offset = 0
         self.carry = b""
         self.delivered = 0
         self.part_length: Optional[int] = None
+        self.mu = threading.Lock()
+        self.token: Optional[object] = None
 
 
 class DecompressingClient(InputClient):
@@ -217,6 +226,7 @@ class DecompressingClient(InputClient):
 
     def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
         key = (req.job_id, req.map_id, req.reduce_id)
+        tok = object()
         with self._lock:
             st = self._streams.get(key)
             # new stream, or a restart after progress (a retrying
@@ -225,28 +235,68 @@ class DecompressingClient(InputClient):
             if st is None or (req.offset == 0 and st.delivered != 0):
                 st = _StreamState()
                 self._streams[key] = st
-        if st is None or req.offset != st.delivered:
-            on_complete(CompressionError(
-                f"non-sequential compressed fetch at {req.offset} "
-                f"(expected {st.delivered if st else 0})"))
-            return
+        with st.mu:
+            # claim the stream for THIS attempt; any still-in-flight
+            # older attempt's completion is now stale by token. The
+            # ordering is safe either way: if that completion wins the
+            # mutex first it ingests (it was still the owner) and this
+            # attempt sees the advanced state below; if this claim wins,
+            # the old completion is dropped without touching the state.
+            st.token = tok
+            err = None
+            if req.offset != st.delivered:
+                err = CompressionError(
+                    f"non-sequential compressed fetch at {req.offset} "
+                    f"(expected {st.delivered})")
+            comp_offset = st.comp_offset
+        if err is not None:
+            on_complete(err)  # outside st.mu: the segment may re-issue
+            return            # from this callback (same thread)
         inner_req = ShuffleRequest(req.job_id, req.map_id, req.reduce_id,
-                                   st.comp_offset,
+                                   comp_offset,
                                    self.comp_chunk_size or req.chunk_size,
                                    host=req.host)
 
         def _done(res) -> None:
-            if isinstance(res, Exception):
+            # decide + mutate under st.mu, deliver after releasing it
+            # (the segment chains its next fetch from this callback on
+            # the same thread — holding st.mu across it would deadlock)
+            with st.mu:
                 with self._lock:
-                    self._streams.pop(key, None)  # clean slate for retries
-                on_complete(res)
-                return
-            try:
-                on_complete(self._ingest(key, st, req, res))
-            except Exception as e:  # noqa: BLE001 - surfaced to segment
-                with self._lock:
-                    self._streams.pop(key, None)
-                on_complete(e)
+                    stale = (st.token is not tok
+                             or self._streams.get(key) is not st)
+                if stale:
+                    # a superseded attempt must neither mutate nor pop
+                    # the current owner's state; the segment's epoch
+                    # guard drops this delivery as stale
+                    res = CompressionError(
+                        "stale compressed fetch completion "
+                        "(attempt superseded)")
+                elif isinstance(res, Exception):
+                    with self._lock:
+                        self._streams.pop(key, None)  # clean slate
+                else:
+                    crc = getattr(res, "crc", None)
+                    if crc is not None and \
+                            zlib.crc32(res.data) & 0xFFFFFFFF != crc:
+                        # wire-domain integrity (uda.tpu.fetch.crc): the
+                        # CRC covers the COMPRESSED chunk, so it must be
+                        # validated here, not on the decompressed result;
+                        # the segment recovers via whole-segment retry,
+                        # which resets this stream cleanly
+                        with self._lock:
+                            self._streams.pop(key, None)
+                        res = StorageError(
+                            f"compressed chunk CRC mismatch at "
+                            f"{req.map_id}:{res.offset}")
+                    else:
+                        try:
+                            res = self._ingest(key, st, req, res)
+                        except Exception as e:  # noqa: BLE001 - to segment
+                            with self._lock:
+                                self._streams.pop(key, None)
+                            res = e
+            on_complete(res)
 
         self.inner.start_fetch(inner_req, _done)
 

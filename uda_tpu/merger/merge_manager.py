@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import random
 import threading
+import time
+from collections import deque
 from typing import Callable, Optional, Sequence
 
 from uda_tpu.merger.emitter import FramedEmitter
@@ -35,16 +37,72 @@ from uda_tpu.merger.segment import InputClient, Segment
 from uda_tpu.ops import merge as merge_ops
 from uda_tpu.utils.comparators import KeyType, get_key_type
 from uda_tpu.utils.config import Config
-from uda_tpu.utils.errors import MergeError
+from uda_tpu.utils.errors import FallbackSignal, MergeError, UdaError
+from uda_tpu.utils.failpoints import failpoints
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
+from uda_tpu.utils.retry import RetryPolicy
 
-__all__ = ["MergeManager", "PROGRESS_INTERVAL"]
+__all__ = ["MergeManager", "PenaltyBox", "PROGRESS_INTERVAL"]
 
 log = get_logger()
 
 PROGRESS_INTERVAL = 20  # segments per progress report (MergeManager.cc:44)
+
+
+class PenaltyBox:
+    """Per-supplier fault tracker: a supplier whose fetches keep failing
+    is *deprioritized* — its remaining maps rotate to the back of the
+    fetch schedule instead of burning the window on a sick host (the
+    dynamic counterpart of the reference's randomized fetch list, which
+    only spread load statically, MergeManager.cc:58-63). Suppliers leave
+    the box on a successful fetch or when the penalty expires; boxing is
+    never exclusion — when every pending supplier is boxed the scheduler
+    proceeds anyway (progress beats politeness)."""
+
+    def __init__(self, threshold: int = 2, penalty_s: float = 1.0):
+        self.threshold = max(1, threshold)
+        self.penalty_s = penalty_s
+        self._lock = threading.Lock()
+        self._faults: dict[str, int] = {}
+        self._until: dict[str, float] = {}
+
+    def punish(self, key: str) -> bool:
+        """Record one fault; returns True when this fault boxed the
+        supplier (crossing the threshold, or extending an active box)."""
+        with self._lock:
+            n = self._faults.get(key, 0) + 1
+            self._faults[key] = n
+            if n < self.threshold:
+                return False
+            self._until[key] = time.monotonic() + self.penalty_s
+        metrics.add("fetch.penalties")
+        return True
+
+    def forgive(self, key: str) -> None:
+        """A successful fetch clears the supplier's record entirely."""
+        with self._lock:
+            self._faults.pop(key, None)
+            self._until.pop(key, None)
+
+    def penalized(self, key: str) -> bool:
+        with self._lock:
+            t = self._until.get(key)
+            if t is None:
+                return False
+            if time.monotonic() >= t:
+                # parole: out of the box, but one more fault re-boxes
+                del self._until[key]
+                self._faults[key] = self.threshold - 1
+                return False
+            return True
+
+    @property
+    def boxed(self) -> list[str]:
+        with self._lock:
+            now = time.monotonic()
+            return [k for k, t in self._until.items() if t > now]
 
 
 class MergeManager:
@@ -65,6 +123,13 @@ class MergeManager:
         self.progress = progress
         self.seed = seed
         self.emitter = FramedEmitter(self.chunk_size)
+        self.retry_policy = RetryPolicy.from_config(self.cfg)
+        self.penalty_box = PenaltyBox(
+            threshold=self.cfg.get("uda.tpu.fetch.penalty.threshold"),
+            penalty_s=self.cfg.get("uda.tpu.fetch.penalty.ms") / 1e3)
+        spec = self.cfg.get("uda.tpu.failpoints")
+        if spec:
+            failpoints.arm_spec(spec)
         self._stop = threading.Event()
 
     # -- fetch phase --------------------------------------------------------
@@ -85,13 +150,17 @@ class MergeManager:
         completion, from the transport's completion thread — the hook
         the overlapped merge uses to stage runs while later fetches are
         still in flight.
+
+        Fault feedback: every transport fault reports the segment's
+        supplier to the penalty box; maps of a boxed supplier rotate to
+        the back of the pending schedule (see :class:`PenaltyBox`).
         """
         # entries are "map_id" or ("host", "map_id") — the latter routes
         # through a per-host transport (HostRoutingClient)
         entries = [m if isinstance(m, tuple) else ("", m) for m in map_ids]
-        retries = self.cfg.get("uda.tpu.fetch.retries")
         segs = [Segment(self.client, job_id, mid, reduce_id,
-                        self.chunk_size, host=host, retries=retries)
+                        self.chunk_size, host=host,
+                        policy=self.retry_policy)
                 for host, mid in entries]
         index_of = {id(s): i for i, s in enumerate(segs)}
         order = list(range(len(segs)))
@@ -101,9 +170,21 @@ class MergeManager:
         done = 0
         all_notified = threading.Event()  # ALL on_done callbacks returned
         cb_errors: list[Exception] = []
+        box = self.penalty_box
+
+        def supplier_of(seg) -> str:
+            # single-host transports (host == "") degrade to per-map
+            return seg.host or seg.map_id
+
+        def on_fault(seg, exc) -> None:
+            if box.punish(supplier_of(seg)):
+                log.warn(f"supplier {supplier_of(seg)!r} penalized "
+                         f"after repeated fetch faults ({exc})")
 
         def on_done(seg) -> None:
             nonlocal done
+            if seg.ready:
+                box.forgive(supplier_of(seg))
             credits.release()
             try:
                 if on_segment is not None and seg.ready:
@@ -120,11 +201,14 @@ class MergeManager:
                 self.progress(d, len(segs))
 
         with metrics.timer("fetch"):
-            for i in order:
+            pending = deque(order)
+            while pending:
                 credits.acquire()
                 if self._stop.is_set():
                     raise MergeError("merge manager stopped during fetch")
+                i = self._next_fetch_index(pending, segs, supplier_of)
                 segs[i].on_done = on_done
+                segs[i].on_fault = on_fault
                 segs[i].start()
             for s in segs:
                 s.wait()
@@ -139,6 +223,18 @@ class MergeManager:
         if self.progress:
             self.progress(len(segs), len(segs))
         return segs
+
+    def _next_fetch_index(self, pending: deque, segs, supplier_of) -> int:
+        """Penalty-box-aware pick: the first pending segment whose
+        supplier is not boxed; boxed ones rotate to the back. When every
+        pending supplier is boxed, take the head anyway — the box
+        deprioritizes, it never starves."""
+        for _ in range(len(pending) - 1):
+            if not self.penalty_box.penalized(supplier_of(segs[pending[0]])):
+                break
+            pending.rotate(-1)
+            metrics.add("fetch.deprioritized")
+        return pending.popleft()
 
     # -- merge phase --------------------------------------------------------
 
@@ -164,7 +260,26 @@ class MergeManager:
         """The full online merge: fetch overlapped with device merge ->
         emit (reference merge_online, MergeManager.cc:184-193; the
         overlap restores the network-levitated property — see
-        uda_tpu.merger.overlap)."""
+        uda_tpu.merger.overlap).
+
+        Failure contract: a terminal engine error (retries exhausted,
+        merge invariant violation, spill failure — any ``UdaError``)
+        is re-raised as :class:`FallbackSignal` carrying the root cause,
+        so the consumer falls back to its vanilla path instead of
+        crashing on an internal type (the reference's ``failureInUda``
+        flip, UdaBridge.cc:506-530). Non-UdaError exceptions (embedder
+        bugs, injected foreign errors) propagate unwrapped."""
+        try:
+            return self._run(job_id, map_ids, reduce_id, consumer)
+        except FallbackSignal:
+            raise
+        except UdaError as e:
+            metrics.add("fallback.signals")
+            log.error(f"merge failed terminally, requesting fallback: {e}")
+            raise FallbackSignal(e) from e
+
+    def _run(self, job_id: str, map_ids: Sequence, reduce_id: int,
+             consumer: Callable[[memoryview], None]) -> int:
         approach = self.cfg.get("mapred.netmerger.merge.approach")
         streaming = bool(self.cfg.get("uda.tpu.online.streaming"))
         if approach == 0:

@@ -18,14 +18,19 @@ and the mesh exchange client (uda_tpu.parallel).
 from __future__ import annotations
 
 import abc
+import random
 import threading
+import time
+import zlib
 from typing import Optional
 
 from uda_tpu.mofserver.data_engine import DataEngine, FetchResult, ShuffleRequest
-from uda_tpu.utils.errors import MergeError
+from uda_tpu.utils.errors import MergeError, StorageError, TransportError
+from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.ifile import RecordBatch, crack_partial
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
+from uda_tpu.utils.retry import RetryPolicy
 
 log = get_logger()
 
@@ -160,7 +165,7 @@ class Segment:
 
     def __init__(self, client: InputClient, job_id: str, map_id: str,
                  reduce_id: int, chunk_size: int, host: str = "",
-                 retries: int = 3):
+                 retries: int = 3, policy: Optional[RetryPolicy] = None):
         self.client = client
         self.job_id = job_id
         self.map_id = map_id
@@ -171,12 +176,22 @@ class Segment:
         self.num_records = 0  # monotone fetch-side record count
         self.raw_length: Optional[int] = None
         self.on_done = None  # callback fired once when fetch finishes
+        self.on_fault = None  # callback fired on EVERY transport fault
+        # (retried or terminal) — the penalty-box feedback channel
+        self.policy = policy or RetryPolicy(retries=max(0, retries))
         self._released = False
         self._carry = b""
         self._next_offset = 0
-        self._retries_left = max(0, retries)
+        self._retries_left = max(0, self.policy.retries)
+        self._deadline: Optional[float] = None
+        self._crc_refetched: set[int] = set()  # offsets re-fetched once
+        self._rng = random.Random((self.policy.seed or 0)
+                                  ^ zlib.crc32(map_id.encode()))
         self._issuing = False
         self._inline = self._PENDING
+        self._epoch = 0          # attempt id of the outstanding fetch
+        self._epoch_settled = True  # its completion has been accepted
+        self._timeout_timer: Optional[threading.Timer] = None
         self._done = threading.Event()
         self._error: Optional[Exception] = None
         self._lock = threading.Lock()
@@ -191,6 +206,8 @@ class Segment:
     _PENDING = object()  # sentinel: no inline completion delivered
 
     def start(self) -> None:
+        if self.policy.deadline_ms > 0:
+            self._deadline = time.monotonic() + self.policy.deadline_ms / 1e3
         self._drive(self._try_issue(0))
 
     def _try_issue(self, offset: int):
@@ -200,31 +217,88 @@ class Segment:
         synchronously / invoked the callback inline — the caller's
         _drive loop then processes it WITHOUT recursing, so a transport
         that fails inline (e.g. a router's connect error) cannot
-        overflow the stack however large the retry budget is."""
+        overflow the stack however large the retry budget is.
+
+        Each issue opens a new attempt epoch; completions (real,
+        injected, or timeout-generated) carry their epoch and only the
+        FIRST one for the current epoch is accepted — a late completion
+        racing its own attempt timeout is dropped as stale instead of
+        double-driving the state machine."""
         req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
                              offset, self.chunk_size, host=self.host)
         with self._lock:
             self._inline = self._PENDING
             self._issuing = True
+            self._epoch += 1
+            self._epoch_settled = False
+            epoch = self._epoch
         try:
-            self.client.start_fetch(req, self._on_complete)
+            # the failpoint is inside the try: an injected raise takes
+            # the same sync-failure path as a stopped transport
+            failpoint("segment.fetch", key=self.map_id)
+            self.client.start_fetch(
+                req, lambda res, e=epoch: self._on_complete(res, e))
         except Exception as e:  # noqa: BLE001 - a sync raise must fail
             # the segment, never escape into the transport's thread
             with self._lock:
                 self._issuing = False
+                self._epoch_settled = True
             return e
         with self._lock:
             self._issuing = False
             r = self._inline
             self._inline = self._PENDING
+            if r is self._PENDING and not self._epoch_settled:
+                self._arm_timeout(epoch)  # only for an async in-flight fetch
         return None if r is self._PENDING else r
 
-    def _on_complete(self, result) -> None:
+    def _arm_timeout(self, epoch: int) -> None:
+        """Arm the per-attempt timeout (caller holds self._lock)."""
+        timeout = self.policy.attempt_timeout_ms
+        if timeout <= 0:
+            return
+        t = threading.Timer(timeout / 1e3, self._on_timeout, args=(epoch,))
+        t.daemon = True
+        self._timeout_timer = t
+        t.start()
+
+    def _cancel_timeout(self) -> None:
         with self._lock:
+            t, self._timeout_timer = self._timeout_timer, None
+        if t is not None:
+            t.cancel()
+
+    def _on_timeout(self, epoch: int) -> None:
+        with self._lock:
+            if epoch != self._epoch or self._epoch_settled:
+                return  # the attempt completed first
+        metrics.add("fetch.timeouts")
+        self._on_complete(TransportError(
+            f"fetch of {self.map_id} attempt timed out after "
+            f"{self.policy.attempt_timeout_ms:g} ms"), epoch)
+
+    def _on_complete(self, result, epoch: int) -> None:
+        with self._lock:
+            if epoch != self._epoch or self._epoch_settled:
+                metrics.add("fetch.stale_completions")
+                return  # superseded attempt (timed out or re-issued)
+            self._epoch_settled = True
             if self._issuing:  # inline completion: hand back to _drive
                 self._inline = result
                 return
+        self._cancel_timeout()
         self._drive(result)
+
+    def _notify_fault(self, exc: Exception) -> None:
+        """Fire the on_fault hook (penalty-box feedback). The hook must
+        never decide the segment's fate: its own errors are logged and
+        swallowed."""
+        hook = self.on_fault
+        if hook is not None:
+            try:
+                hook(self, exc)
+            except Exception as e:  # noqa: BLE001
+                log.warn(f"on_fault hook failed for {self.map_id}: {e}")
 
     def _drive(self, result) -> None:
         """Iterative fetch state machine (one outstanding fetch at a
@@ -237,23 +311,66 @@ class Segment:
                 # WHOLE segment from offset 0 — re-fetch-the-MOF
                 # granularity, which also resets any decompressing
                 # wrapper's stream state cleanly
+                deadline_hit = False
                 with self._lock:
                     retry = self._retries_left > 0
+                    if retry and self._deadline is not None \
+                            and time.monotonic() >= self._deadline:
+                        retry, deadline_hit = False, True
                     if retry:
                         self._retries_left -= 1
                         self.batches = []
                         self.num_records = 0
                         self._carry = b""
                         self._next_offset = 0
+                        self._crc_refetched.clear()
+                    attempt = self.policy.retries - self._retries_left
+                self._notify_fault(result)
                 if not retry:
+                    if deadline_hit:
+                        metrics.add("fetch.deadline_exceeded")
+                        log.warn(f"fetch of {self.map_id} gave up: "
+                                 f"deadline passed with retries left")
                     self._error = result
                     self._done.set()
                     self._notify_done()
                     return
                 log.warn(f"fetch of {self.map_id} failed ({result}); "
                          f"retrying ({self._retries_left} left)")
-                metrics.add("fetch_retries")
+                metrics.add("fetch.retries")
+                delay = self.policy.backoff(attempt, self._rng)
+                if self._deadline is not None:
+                    delay = min(delay,
+                                max(0.0, self._deadline - time.monotonic()))
+                if delay > 0:
+                    # back off without blocking the completion thread
+                    # (it may be a transport worker the retry needs)
+                    metrics.add("fetch.backoff_seconds", delay)
+                    t = threading.Timer(
+                        delay, lambda: self._drive(self._try_issue(0)))
+                    t.daemon = True
+                    t.start()
+                    return
                 result = self._try_issue(0)
+                continue
+            crc = getattr(result, "crc", None)
+            if crc is not None and \
+                    zlib.crc32(result.data) & 0xFFFFFFFF != crc:
+                # integrity layer (uda.tpu.fetch.crc): one re-fetch per
+                # offset; a second mismatch at the same offset becomes a
+                # transport-level error and consumes the retry budget
+                metrics.add("fetch.crc_mismatch")
+                off = result.offset
+                if off not in self._crc_refetched:
+                    self._crc_refetched.add(off)
+                    metrics.add("fetch.crc_refetch")
+                    log.warn(f"chunk CRC mismatch at {self.map_id}:{off}; "
+                             f"re-fetching once")
+                    result = self._try_issue(off)
+                    continue
+                result = StorageError(
+                    f"chunk CRC mismatch at {self.map_id}:{off} persists "
+                    f"after re-fetch")
                 continue
             try:
                 last = self._ingest(result)

@@ -30,12 +30,14 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 
 from uda_tpu.mofserver.index import IndexResolver
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import StorageError
+from uda_tpu.utils.failpoints import failpoint, failpoints
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -79,6 +81,8 @@ class FetchResult:
     path: str
     last: bool           # required: a defaulted value silently truncated
                          # multi-chunk streams once; producers must decide
+    crc: Optional[int] = None  # CRC32 of the chunk as read from disk
+                               # (uda.tpu.fetch.crc); None = unchecked
 
     @property
     def is_last(self) -> bool:
@@ -190,6 +194,10 @@ class DataEngine:
         threads = max(1, cfg.get("mapred.uda.provider.blocked.threads.per.disk")) \
             * max(1, num_disks)
         self.chunk_size_default = cfg.get("mapred.rdma.buf.size") * 1024
+        self._crc = bool(cfg.get("uda.tpu.fetch.crc"))
+        spec = cfg.get("uda.tpu.failpoints")
+        if spec:
+            failpoints.arm_spec(spec)
         self.resolver = resolver
         self._pool = ThreadPoolExecutor(max_workers=threads,
                                         thread_name_prefix="uda-data-engine")
@@ -215,7 +223,11 @@ class DataEngine:
     def submit(self, req: ShuffleRequest) -> Future:
         """Async fetch; the Future resolves to a FetchResult. Never
         blocks (see module docstring on backpressure); safe to call from
-        completion callbacks."""
+        completion callbacks. Blocking IN a completion callback can
+        still deadlock the pool — chained fetch re-issue must stay
+        non-blocking (regression-tested under a delay failpoint:
+        tests/test_mofserver.py::test_chained_fetches_under_delay_
+        failpoint_no_deadlock)."""
         if self._stopped:
             raise StorageError("DataEngine is stopped")
         return self._pool.submit(self._serve, req)
@@ -246,10 +258,17 @@ class DataEngine:
                 raise StorageError(
                     f"short read {len(data)}/{want} at {rec.path}:"
                     f"{rec.start_offset + req.offset}")
+            # CRC stamped from the bytes as read, BEFORE the failpoint
+            # can mangle them — injected truncation/corruption then looks
+            # exactly like wire damage to the validating Segment
+            crc = zlib.crc32(data) & 0xFFFFFFFF if self._crc else None
+            data = failpoint("data_engine.pread", data=data,
+                             key=f"{req.map_id}/{req.reduce_id}")
             metrics.add("supplier_bytes", len(data))
             return FetchResult(data, rec.raw_length, rec.part_length,
                                req.offset, rec.path,
-                               last=req.offset + len(data) >= served)
+                               last=req.offset + len(data) >= served,
+                               crc=crc)
 
     def stop(self) -> None:
         self._stopped = True

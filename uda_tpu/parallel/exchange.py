@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uda_tpu.parallel.multihost import allgather, put_rows
 from uda_tpu.utils.errors import TransportError
+from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.metrics import metrics
 
@@ -169,6 +170,9 @@ def shuffle_exchange(words, dest, mesh: Mesh, axis: str,
             f"{capacity} x {max_rounds}); raise capacity or max_rounds")
     results = []
     for r in range(rounds):
+        # injection site for exchange-plane faults (a failed collective
+        # surfaces as TransportError, like a reference WC error)
+        failpoint("exchange.round", key=f"round{r}")
         results.append(exchange_round(layout, capacity, r))
         metrics.add("exchange_rounds")
     return results, layout

@@ -112,6 +112,37 @@ _FLAG_LIST = [
     Flag("uda.tpu.online.stagers", 0, int,
          "overlap staging worker threads (pack+sort+spool per segment); "
          "0 = single merge thread"),
+    # --- failure-domain knobs (failpoints + retrying fetch path) ---
+    Flag("mapred.rdma.fetch.retry.backoff.ms", 0, int,
+         "base exponential backoff between fetch retries in ms, doubling "
+         "per attempt (0 = immediate retry, the reference's behavior)"),
+    Flag("mapred.rdma.fetch.retry.backoff.max.ms", 2000, int,
+         "exponential backoff cap in ms"),
+    Flag("mapred.rdma.fetch.retry.jitter", 0.2, float,
+         "+/- fraction of jitter applied to each backoff so failed "
+         "segments do not re-issue in lockstep"),
+    Flag("mapred.rdma.fetch.attempt.timeout.ms", 0, int,
+         "per-attempt chunk fetch timeout in ms; a fetch the transport "
+         "never completes is failed and retried (0 = wait forever)"),
+    Flag("mapred.rdma.fetch.deadline.ms", 0, int,
+         "overall per-segment fetch deadline in ms across all retries "
+         "and backoffs (0 = none)"),
+    Flag("uda.tpu.fetch.crc", False, bool,
+         "supplier stamps each chunk with a CRC32 computed before any "
+         "fault can mangle it; Segment validates and re-fetches a "
+         "mismatched chunk once per offset before failing (compressed "
+         "fetches validate the wire chunk inside DecompressingClient "
+         "and recover via whole-segment retry)"),
+    Flag("uda.tpu.fetch.penalty.threshold", 2, int,
+         "transport faults before a supplier enters the penalty box "
+         "(its remaining fetches are deprioritized in the schedule)"),
+    Flag("uda.tpu.fetch.penalty.ms", 1000, int,
+         "how long a penalized supplier stays deprioritized before it "
+         "gets another chance"),
+    Flag("uda.tpu.failpoints", "", str,
+         "failpoint arming spec, same syntax as UDA_FAILPOINTS: "
+         "comma-separated site=action[:arg][:trigger...] entries "
+         "(uda_tpu.utils.failpoints)"),
     Flag("uda.tpu.auto.approach.threshold.mb", 2048, int,
          "auto merge-approach crossover: partitions at most this many "
          "MB take the hybrid LPQ/RPQ path (fastest at small/mid scale), "

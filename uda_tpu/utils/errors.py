@@ -72,10 +72,17 @@ class CompressionError(UdaError):
 class FallbackSignal(Exception):
     """Raised to the embedding application to request fallback-to-vanilla.
 
-    Wraps the originating ``UdaError``. Matches the contract of
+    Wraps the originating ``UdaError`` as ``cause`` — the root-cause
+    error the consumer should report when it falls back (its message
+    names the failing site for injected faults) — and carries the
+    cause's captured backtrace so the failure point survives the trip
+    across the fallback boundary. Raise it ``from cause`` so
+    ``__cause__``/``__traceback__`` chain too. Matches the contract of
     ``UdaBridge_exceptionInNativeThread`` -> Java ``failureInUda``
     (reference src/UdaBridge.cc:506-530)."""
 
     def __init__(self, cause: UdaError):
         self.cause = cause
-        super().__init__(f"uda_tpu failure, fallback requested: {cause}")
+        self.backtrace = getattr(cause, "backtrace", "")
+        super().__init__(f"uda_tpu failure, fallback requested: "
+                         f"[{type(cause).__name__}] {cause}")

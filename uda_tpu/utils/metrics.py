@@ -6,6 +6,13 @@ src/Merger/reducer.h:80-90, accumulated in StreamRW.cc:555-569) and the
 AIO on-air counters (src/CommUtils/AIOHandler.cc:129-141). The reference
 had no dedicated tracer (SURVEY §5); here we add a lightweight span/trace
 export so profiles can be correlated with device profiles.
+
+Failure-domain counters (dotted namespace, maintained by the fetch
+recovery layer and the failpoint framework): ``fetch.retries``,
+``fetch.timeouts``, ``fetch.stale_completions``, ``fetch.backoff_seconds``,
+``fetch.deadline_exceeded``, ``fetch.crc_mismatch``, ``fetch.crc_refetch``,
+``fetch.penalties``, ``fetch.deprioritized``, ``fallback.signals`` and
+``failpoint.<site>`` per armed injection site.
 """
 
 from __future__ import annotations
@@ -43,6 +50,11 @@ class Metrics:
                 if self.record_spans:
                     self.spans.append({"name": name, "ts": t0, "dur": dt,
                                        "tid": threading.get_ident()})
+
+    def get(self, name: str) -> float:
+        """One counter's current value (0.0 when never incremented)."""
+        with self._lock:
+            return self.counters.get(name, 0.0)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
