@@ -227,7 +227,35 @@ def _backend_alive(timeout: float = 180.0) -> bool:
         return False
 
 
+_USAGE = """\
+usage: python bench.py [--help] [--probe <path>]
+
+Single-chip TeraSort shuffle+merge benchmark. Prints ONE JSON line:
+
+  {"metric": "terasort_singlechip_shuffle_merge_gbps",
+   "value": <GB/s>, "unit": "GB/s", "vs_baseline": <value/6.8>,
+   "telemetry": {"counters": {...}, "gauges": {...},
+                 "histograms": {<name>: {"count","sum","min","max",
+                                         "p50","p95","p99"}, ...}}}
+
+The "telemetry" block is the final metrics snapshot of the bench
+process (uda_tpu.utils.stats.telemetry_block): counters always include
+the reference-parity per-task trio total_wait_mem_time /
+total_fetch_time / total_merge_time; histogram percentiles appear when
+the run recorded samples (UDA_TPU_STATS=1 enables histograms+spans).
+BENCH_*.json files across rounds stay directly diffable on this block.
+
+env knobs: UDA_TPU_BENCH_LOG2 (records=2^N), UDA_TPU_BENCH_PATHS,
+UDA_TPU_BENCH_PROBE_TIMEOUT, UDA_TPU_BENCH_INTERPRET=1,
+UDA_TPU_BENCH_TRY_CARRY=1, UDA_TPU_XPROF=<dir> (device trace),
+UDA_TPU_STATS=1 (host-side histograms/spans in the telemetry block).
+"""
+
+
 def main() -> None:
+    if len(sys.argv) >= 2 and sys.argv[1] in ("--help", "-h"):
+        print(_USAGE, end="")
+        return
     if len(sys.argv) >= 3 and sys.argv[1] == "--probe":
         _compile_and_check(sys.argv[2])
         return
@@ -327,11 +355,14 @@ def main() -> None:
         best = min(timed_dispatch(chosen[0], i, chosen[1])
                    for i in range(DISPATCHES))
     gbps = gb_per_dispatch / best
+    from uda_tpu.utils.stats import telemetry_block
+
     print(json.dumps({
         "metric": "terasort_singlechip_shuffle_merge_gbps",
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        "telemetry": telemetry_block(),
     }))
 
 

@@ -9,14 +9,41 @@
 # truncations — no undetectable corruption), so a failure here means the
 # retry/backoff/penalty/carry machinery regressed, not that the dice
 # came up wrong. Extra pytest args pass through ("$@").
+#
+# Telemetry: the run accumulates the session's fault/recovery counters
+# (tests/conftest.py) and writes CHAOS_TELEMETRY.json — the same
+# comparable "telemetry" block bench.py embeds — wrapped with the seed
+# and schedule so chaos rounds diff against each other.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 SEED="${CHAOS_SEED:-$RANDOM}"
 SPEC="$(python -c "from uda_tpu.utils.failpoints import chaos_spec; print(chaos_spec(${SEED}))")"
+OUT="${CHAOS_TELEMETRY_JSON:-CHAOS_TELEMETRY.json}"
+COUNTERS="$(mktemp)"
+trap 'rm -f "${COUNTERS}"' EXIT
 echo "chaos seed:          ${SEED}"
 echo "failpoint schedule:  ${SPEC}"
 
-exec env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" \
+rc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_CHAOS_TELEMETRY="${COUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
-    --continue-on-collection-errors "$@"
+    --continue-on-collection-errors "$@" || rc=$?
+
+python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" <<'EOF'
+import json, sys
+seed, spec, counters_path, out, rc = sys.argv[1:6]
+try:
+    with open(counters_path) as f:
+        telemetry = json.load(f)
+except Exception:
+    telemetry = {"counters": {}}
+with open(out, "w") as f:
+    json.dump({"chaos_seed": int(seed), "schedule": spec,
+               "pytest_exit": int(rc), "telemetry": telemetry},
+              f, indent=1, sort_keys=True)
+    f.write("\n")
+print(f"chaos telemetry:     {out}")
+EOF
+exit "${rc}"

@@ -80,3 +80,40 @@ if not _REEXEC:
     from uda_tpu.utils import compile_cache
 
     compile_cache.enable()
+
+
+# -- metrics hygiene + chaos telemetry ---------------------------------------
+# Every test ends with a pristine global Metrics (reset() also restores
+# span/histogram enablement to the env default, so a test that called
+# enable_spans() cannot leak recording into the next test). The
+# per-test snapshots accumulate into a session-level counter sum that
+# pytest_sessionfinish dumps as a telemetry JSON when
+# UDA_TPU_CHAOS_TELEMETRY names a path (scripts/run_chaos.sh does),
+# giving chaos runs the same comparable telemetry block bench.py emits.
+
+import collections  # noqa: E402
+
+import pytest  # noqa: E402
+
+_SESSION_COUNTERS: dict = collections.defaultdict(float)
+
+
+@pytest.fixture(autouse=True)
+def _metrics_hygiene():
+    yield
+    from uda_tpu.utils.metrics import metrics
+
+    for name, value in metrics.snapshot().items():
+        _SESSION_COUNTERS[name] += value
+    metrics.reset()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    path = os.environ.get("UDA_TPU_CHAOS_TELEMETRY")
+    if not path or _REEXEC:
+        return
+    import json
+
+    with open(path, "w") as f:
+        json.dump({"counters": dict(sorted(_SESSION_COUNTERS.items()))},
+                  f, indent=1, sort_keys=True)

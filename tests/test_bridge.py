@@ -158,6 +158,54 @@ def test_unexpected_role_command_fails_softly(tmp_path):
     assert bridge.failed and harness.failures
 
 
+def test_get_stats_round_trip(tmp_path):
+    # GET_STATS is role-independent (like set_log_level) and returns a
+    # JSON string from do_command — the on-demand stats pull channel
+    import json
+
+    job = "jobGS"
+    expected, results = _drive_reduce_with_stats(tmp_path, job)
+    assert results  # the merge completed
+
+    # supplier role answers too
+    make_mof_tree(str(tmp_path), "jobGS2", 1, 1, 5, seed=17)
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(False, [], harness)
+    out = bridge.do_command(form_cmd(Cmd.GET_STATS, []))
+    stats = json.loads(out)
+    assert "counters" in stats and "gauges" in stats
+    bridge.do_command(form_cmd(Cmd.EXIT, []))
+
+
+def _drive_reduce_with_stats(tmp_path, job):
+    """One reduce task; pulls GET_STATS mid-run and asserts the fetch
+    counters round-trip."""
+    import json
+
+    expected = make_mof_tree(str(tmp_path), job, 3, 1, 20, seed=16)
+    harness = Harness(str(tmp_path))
+    bridge = UdaBridge()
+    bridge.start(True, ["-w", "4"], harness)
+    bridge.do_command(form_cmd(
+        Cmd.INIT, [job, "0", "3", "uda.tpu.RawBytes"]))
+    for mid in map_ids(job, 3):
+        bridge.do_command(form_cmd(Cmd.FETCH, ["localhost", job, mid, "0"]))
+    bridge.do_command(form_cmd(Cmd.FINAL, []))
+    assert harness.fetch_over.wait(timeout=30)
+    bridge.reduce_exit()
+    assert not harness.failures, harness.failures
+    out = bridge.do_command(form_cmd(Cmd.GET_STATS, []))
+    assert isinstance(out, str)
+    stats = json.loads(out)
+    assert stats["counters"]["fetch.bytes"] > 0
+    assert stats["counters"]["emit.bytes"] > 0
+    # non-stats commands still return None
+    assert bridge.do_command(form_cmd(Cmd.EXIT, [])) is None
+    return expected, {0: list(IFileReader(
+        io.BytesIO(b"".join(harness.blocks))))}
+
+
 def test_log_upcall_sink(tmp_path):
     harness = Harness(str(tmp_path))
     bridge = UdaBridge()

@@ -291,6 +291,61 @@ def test_penalty_box_deprioritizes_sick_supplier(tmp_path):
     assert got == want
 
 
+@pytest.mark.faults
+def test_retry_and_penalty_counters_labeled_by_supplier(tmp_path):
+    """Observability over the PR-1 recovery layer: retries and penalties
+    appear as per-supplier labeled series (and the labeled series sum to
+    the unlabeled totals the older tests assert on)."""
+    root = str(tmp_path)
+    make_mof_tree(root, "jobLab", 4, 1, 20, seed=5)
+    engine = DataEngine(DirIndexResolver(root), Config())
+    faulted = set()
+    lock = threading.Lock()
+
+    class FlakySick(LocalFetchClient):
+        """hostSick faults every map's first fetch; hostOk never."""
+
+        def start_fetch(self, req, on_complete):
+            with lock:
+                first = req.map_id not in faulted
+                faulted.add(req.map_id)
+            if first:
+                on_complete(TransportError(f"sick {req.map_id}"))
+                return
+            super().start_fetch(req, on_complete)
+
+    hosts = {"hostOk": LocalFetchClient(engine), "hostSick": FlakySick(engine)}
+    router = HostRoutingClient(lambda h: hosts[h])
+    cfg = Config({"mapred.rdma.wqe.per.conn": 2,
+                  "uda.tpu.fetch.penalty.threshold": 1,
+                  "uda.tpu.fetch.penalty.ms": 50})
+    mids = map_ids("jobLab", 4)
+    maps = [("hostOk", m) for m in mids[:2]] + \
+           [("hostSick", m) for m in mids[2:]]
+    blocks = []
+    try:
+        mm = MergeManager(router, "uda.tpu.RawBytes", cfg)
+        mm.run("jobLab", maps, 0, lambda b: blocks.append(bytes(b)))
+    finally:
+        engine.stop()
+    assert blocks
+    # the sick supplier's series carries its retries and penalties...
+    assert metrics.get("fetch.retries", supplier="hostSick") >= 2
+    assert metrics.get("fetch.penalties", supplier="hostSick") >= 1
+    # ...the healthy one's carries none...
+    assert metrics.get("fetch.retries", supplier="hostOk") == 0
+    assert metrics.get("fetch.penalties", supplier="hostOk") == 0
+    # ...and series sum to the totals the PR-1 assertions read
+    snap = metrics.snapshot()
+    for base in ("fetch.retries", "fetch.penalties"):
+        series = [v for k, v in snap.items()
+                  if k.startswith(base + "{")]
+        assert sum(series) == snap[base]
+    # labeled fetch.bytes exists for both suppliers (the data did move)
+    assert metrics.get("fetch.bytes", supplier="hostOk") > 0
+    assert metrics.get("fetch.bytes", supplier="hostSick") > 0
+
+
 # -- acceptance: faulted runs survive or fall back cleanly -------------------
 
 

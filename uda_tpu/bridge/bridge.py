@@ -30,6 +30,7 @@ bind to.
 
 from __future__ import annotations
 
+import json
 import threading
 from typing import Optional, Protocol, Sequence
 
@@ -41,6 +42,9 @@ from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import FallbackSignal, ProtocolError, UdaError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.logging import LogLevel, get_logger
+from uda_tpu.utils.metrics import metrics, stats_enabled_from_env
+from uda_tpu.utils.stats import (StatsReporter, reporter_output_from_env,
+                                 telemetry_block)
 
 __all__ = ["UdaCallable", "UdaBridge"]
 
@@ -119,6 +123,8 @@ class UdaBridge:
         self._engine: Optional[DataEngine] = None
         self._resolver: Optional[IndexResolver] = None
         self._owned_engine: Optional[DataEngine] = None
+        # observability
+        self._stats: Optional[StatsReporter] = None
 
     # -- down-calls ---------------------------------------------------------
 
@@ -144,9 +150,27 @@ class UdaBridge:
                 if d.strip()]
             self._engine = DataEngine(self._resolver, self.cfg,
                                       num_disks=max(1, len(dirs)))
+        self._start_stats()
         self.started = True
         log.info(f"uda_tpu bridge started as "
                  f"{'NetMerger' if is_net_merger else 'MOFSupplier'}")
+
+    def _start_stats(self) -> None:
+        """Observability wiring (UDA_TPU_STATS=1 / uda.tpu.stats.enable):
+        switch the optional metrics layers on and run a StatsReporter
+        for the life of the bridge role. Off by default — zero threads,
+        no histogram/span recording."""
+        if self._stats is not None:  # re-start(): recycle the reporter
+            self._stats.stop(final=False)
+            self._stats = None
+        if not (stats_enabled_from_env()
+                or self.cfg.get("uda.tpu.stats.enable")):
+            return
+        metrics.enable_stats()
+        self._stats = StatsReporter(
+            interval_s=self.cfg.get("uda.tpu.stats.interval.ms") / 1e3,
+            out=reporter_output_from_env(
+                str(self.cfg.get("uda.tpu.stats.jsonl", default="")))).start()
 
     def _fresh_cfg(self) -> Config:
         """Config rebuilt from the start-time argv + conf up-call. Each
@@ -168,17 +192,21 @@ class UdaBridge:
             raise UdaError("bridge not started as MOFSupplier")
         return self._engine
 
-    def do_command(self, cmd: str) -> None:
-        """doCommandNative: dispatch by role (UdaBridge.cc:266-295)."""
+    def do_command(self, cmd: str) -> Optional[str]:
+        """doCommandNative: dispatch by role (UdaBridge.cc:266-295).
+        Most commands return None; GET_STATS returns the current stats
+        record as a JSON string."""
         if not self.started:
             raise UdaError("bridge not started")
         if self._dev_error is not None:
             raise self._dev_error  # developer mode: surface the stored
             # background failure loudly on the next synchronous call
         if self._failed:
-            return  # inert after failure (Java has fallen back to vanilla)
+            return None  # inert after failure (Java fell back to vanilla)
         try:
             header, params = parse_cmd(cmd)
+            if header == Cmd.GET_STATS:  # role-independent, like
+                return json.dumps(self.get_stats())  # set_log_level
             if self.is_net_merger:
                 self._reduce_downcall(header, params)
             else:
@@ -187,6 +215,15 @@ class UdaBridge:
             # flow through the fallback contract (e.g. a ValueError from
             # a malformed INIT param), not escape into the embedder
             self._fail(e)
+        return None
+
+    def get_stats(self) -> dict:
+        """The on-demand stats pull (the GET_STATS command body): the
+        reporter's latest record when one is running, else a one-shot
+        telemetry block computed directly from the metrics hub."""
+        if self._stats is not None:
+            return self._stats.latest()
+        return telemetry_block()
 
     def reduce_exit(self) -> None:
         """reduceExitMsgNative: synchronous teardown of the reduce task
@@ -201,6 +238,12 @@ class UdaBridge:
             self._owned_engine.stop()
             self._owned_engine = None
         self._merge_thread = None
+        if self._stats is not None:
+            # the per-reduce-task aggregate record (the reference's
+            # teardown-time counter trio, StreamRW.cc:555-569): one
+            # final-flagged JSONL record; the reporter keeps running for
+            # a possible re-INIT on the same bridge
+            self._stats.report_once(final=True)
         if self._dev_error is not None:
             # developer mode: a failure that happened on the merge thread
             # must not vanish with the thread — teardown re-raises it
@@ -276,7 +319,10 @@ class UdaBridge:
                 name="uda-merge-thread")
             self._merge_thread.start()
         elif header == Cmd.EXIT:
-            self.reduce_exit()
+            self.reduce_exit()  # emits the final-flagged stats record
+            if self._stats is not None:
+                self._stats.stop(final=False)
+                self._stats = None
         else:
             raise ProtocolError(f"unexpected command {header.name} for "
                                 "NetMerger role")
@@ -445,6 +491,9 @@ class UdaBridge:
             if self._engine is not None:
                 self._engine.stop()
                 self._engine = None
+            if self._stats is not None:
+                self._stats.stop(final=True)
+                self._stats = None
         else:
             raise ProtocolError(f"unexpected command {header.name} for "
                                 "MOFSupplier role")

@@ -29,6 +29,10 @@ class Cmd(enum.IntEnum):
     INIT = 7
     MORE = 8
     RT_LAUNCHED = 9
+    # uda_tpu extension (not in the reference enum): pull the current
+    # stats record — do_command returns it as a JSON string. Valid for
+    # BOTH roles, like set_log_level.
+    GET_STATS = 10
 
 
 def form_cmd(header: Cmd, params: list[str]) -> str:

@@ -32,6 +32,7 @@ from uda_tpu.merger.segment import InputClient
 from uda_tpu.mofserver.data_engine import FetchResult, ShuffleRequest
 from uda_tpu.utils.errors import CompressionError, StorageError
 from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
 
 __all__ = ["Codec", "get_codec", "register_codec", "compress_block_stream",
            "decompress_block_stream", "DecompressingClient",
@@ -316,6 +317,8 @@ class DecompressingClient(InputClient):
             out += self.codec.decompress(body, raw_len)
             pos += BLOCK_HEADER.size + comp_len
         st.carry = bytes(data[pos:])
+        if out:
+            metrics.add("decompress.bytes", len(out))
         comp_done = st.comp_offset >= (st.part_length or 0)
         if comp_done and st.carry:
             raise CompressionError(

@@ -91,6 +91,7 @@ class BufferArena:
                     if not self._cv.wait(timeout=timeout):
                         raise MergeError("timed out waiting for a staging slot")
                 slot = self._free.pop()
+        metrics.gauge_add("arena.slots_in_use", 1)
         slot.state = SlotState.FETCH_READY
         slot.length = 0
         slot.owner = owner
@@ -101,12 +102,14 @@ class BufferArena:
             if not self._free:
                 return None
             slot = self._free.pop()
+        metrics.gauge_add("arena.slots_in_use", 1)
         slot.state = SlotState.FETCH_READY
         slot.length = 0
         slot.owner = owner
         return slot
 
     def release(self, slot: BufferSlot) -> None:
+        metrics.gauge_add("arena.slots_in_use", -1)
         slot.state = SlotState.INIT
         slot.owner = None
         slot.length = 0

@@ -92,7 +92,7 @@ class FramedEmitter:
             # task-lifetime (a leaked slot deadlocks the next emit)
             for slot in held:
                 self.arena.release(slot)
-        metrics.add("emitted_bytes", total)
+        metrics.add("emit.bytes", total)
         return total
 
     def emit_framed(self, pieces: Iterable[bytes],
@@ -121,7 +121,7 @@ class FramedEmitter:
         finally:
             for slot in held:
                 self.arena.release(slot)
-        metrics.add("emitted_bytes", total)
+        metrics.add("emit.bytes", total)
         return total
 
     def emit_batch(self, batch: RecordBatch,

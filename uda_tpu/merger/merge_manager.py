@@ -77,7 +77,7 @@ class PenaltyBox:
             if n < self.threshold:
                 return False
             self._until[key] = time.monotonic() + self.penalty_s
-        metrics.add("fetch.penalties")
+        metrics.add("fetch.penalties", supplier=key)
         return True
 
     def forgive(self, key: str) -> None:
@@ -130,6 +130,8 @@ class MergeManager:
         spec = self.cfg.get("uda.tpu.failpoints")
         if spec:
             failpoints.arm_spec(spec)
+        if self.cfg.get("uda.tpu.stats.enable"):
+            metrics.enable_stats()
         self._stop = threading.Event()
 
     # -- fetch phase --------------------------------------------------------
@@ -174,7 +176,7 @@ class MergeManager:
 
         def supplier_of(seg) -> str:
             # single-host transports (host == "") degrade to per-map
-            return seg.host or seg.map_id
+            return seg.supplier
 
         def on_fault(seg, exc) -> None:
             if box.punish(supplier_of(seg)):
@@ -241,6 +243,7 @@ class MergeManager:
     def merge_segments(self, segments: Sequence[Segment]) -> RecordBatch:
         """Device-merge all fetched segments into one sorted batch."""
         batches = [s.record_batch() for s in segments]
+        metrics.add("merge.records", sum(b.num_records for b in batches))
         with metrics.timer("merge"):
             return merge_ops.merge_batches(batches, self.key_type,
                                            self.key_width)
@@ -270,7 +273,11 @@ class MergeManager:
         flip, UdaBridge.cc:506-530). Non-UdaError exceptions (embedder
         bugs, injected foreign errors) propagate unwrapped."""
         try:
-            return self._run(job_id, map_ids, reduce_id, consumer)
+            # the trace root: every phase timer and per-segment fetch
+            # span below hangs off this reduce-task span
+            with metrics.span("reduce_task", job=job_id, reduce=reduce_id,
+                              maps=len(map_ids)):
+                return self._run(job_id, map_ids, reduce_id, consumer)
         except FallbackSignal:
             raise
         except UdaError as e:

@@ -146,6 +146,10 @@ class OverlappedMerger:
         # bounded queue is the credit backpressure that keeps
         # completed-but-unstaged segments at O(window)
         self.run_store = run_store
+        # staging threads adopt the constructing thread's span (the
+        # reduce-task root) so their pack/stage/merge timers land in the
+        # right trace subtree
+        self._parent_span = metrics.current_span()
         self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
         self._aborted = False
         self._forest: dict[int, _Run] = {}   # capacity -> run
@@ -202,21 +206,31 @@ class OverlappedMerger:
     # -- merge thread --------------------------------------------------------
 
     def _loop(self) -> None:
-        while True:
-            try:
-                item = self._q.get(timeout=0.25)
-            except queue.Empty:
-                if self._aborted:
-                    return  # abort() without a reachable poison pill
-                continue
-            if item is None:
-                return
-            if self._error is not None or self._aborted:
-                continue  # drain; finish() will surface the error
-            try:
-                self._stage(*item)
-            except Exception as e:  # surfaced at finish()
-                self._error = e
+        with metrics.use_span(self._parent_span):
+            wait_t0 = time.perf_counter()
+            while True:
+                try:
+                    item = self._q.get(timeout=0.25)
+                except queue.Empty:
+                    if self._aborted:
+                        return  # abort() without a reachable poison pill
+                    continue
+                if item is None:
+                    return
+                # merge-wait: how long this stager idled for a completed
+                # segment (the fetch-bound signal; its complement is the
+                # feed() backpressure block, the staging-bound signal)
+                metrics.observe(
+                    "merge.wait_ms",
+                    (time.perf_counter() - wait_t0) * 1e3)
+                if self._error is not None or self._aborted:
+                    wait_t0 = time.perf_counter()
+                    continue  # drain; finish() will surface the error
+                try:
+                    self._stage(*item)
+                except Exception as e:  # surfaced at finish()
+                    self._error = e
+                wait_t0 = time.perf_counter()
 
     @staticmethod
     def _release(source) -> None:
@@ -257,6 +271,7 @@ class OverlappedMerger:
             self.run_store.write_run(seg_index, batch, order)
             with self._state_lock:
                 self._staged += 1
+            metrics.add("merge.records", n)
             self._release(source)
             return
         # device runs pad to a power-of-two capacity (bounded set of
@@ -287,6 +302,7 @@ class OverlappedMerger:
             self._release(source)
         with self._state_lock:
             self._staged += 1
+        metrics.add("merge.records", n)
         if self._overflow:
             return  # forest output won't be consumed; runs are enough
         with metrics.timer("overlap_stage"):

@@ -179,6 +179,13 @@ class Segment:
         self.on_fault = None  # callback fired on EVERY transport fault
         # (retried or terminal) — the penalty-box feedback channel
         self.policy = policy or RetryPolicy(retries=max(0, retries))
+        # observability: the supplier label for this segment's metric
+        # series (host when routed per host, else the map id), and the
+        # trace span opened by start() as a child of the caller's
+        # current span (the reduce task's fetch phase)
+        self.supplier = host or map_id
+        self.trace_span = None
+        self._issue_t0 = 0.0
         self._released = False
         self._carry = b""
         self._next_offset = 0
@@ -197,6 +204,10 @@ class Segment:
         self._lock = threading.Lock()
 
     def _notify_done(self) -> None:
+        span = self.trace_span
+        if span is not None:
+            err = self._error
+            span.end(**({"error": type(err).__name__} if err else {}))
         cb = self.on_done
         if cb is not None:
             cb(self)
@@ -208,6 +219,11 @@ class Segment:
     def start(self) -> None:
         if self.policy.deadline_ms > 0:
             self._deadline = time.monotonic() + self.policy.deadline_ms / 1e3
+        # child of the caller's current span (the fetch phase of the
+        # reduce-task trace); ended by _notify_done on ANY terminal path
+        self.trace_span = metrics.start_span(
+            "fetch.segment", map=self.map_id, supplier=self.supplier,
+            reduce=self.reduce_id)
         self._drive(self._try_issue(0))
 
     def _try_issue(self, offset: int):
@@ -231,7 +247,12 @@ class Segment:
             self._issuing = True
             self._epoch += 1
             self._epoch_settled = False
+            self._issue_t0 = time.perf_counter()
             epoch = self._epoch
+        # on-air accounting (reference AIOHandler on-air counters):
+        # +1 per attempt epoch, -1 when that epoch settles (accepted
+        # completion, timeout-generated completion, or sync raise)
+        metrics.gauge_add("fetch.on_air", 1)
         try:
             # the failpoint is inside the try: an injected raise takes
             # the same sync-failure path as a stopped transport
@@ -243,6 +264,7 @@ class Segment:
             with self._lock:
                 self._issuing = False
                 self._epoch_settled = True
+            metrics.gauge_add("fetch.on_air", -1)
             return e
         with self._lock:
             self._issuing = False
@@ -272,7 +294,7 @@ class Segment:
         with self._lock:
             if epoch != self._epoch or self._epoch_settled:
                 return  # the attempt completed first
-        metrics.add("fetch.timeouts")
+        metrics.add("fetch.timeouts", supplier=self.supplier)
         self._on_complete(TransportError(
             f"fetch of {self.map_id} attempt timed out after "
             f"{self.policy.attempt_timeout_ms:g} ms"), epoch)
@@ -283,9 +305,12 @@ class Segment:
                 metrics.add("fetch.stale_completions")
                 return  # superseded attempt (timed out or re-issued)
             self._epoch_settled = True
-            if self._issuing:  # inline completion: hand back to _drive
+            inline = self._issuing
+            if inline:  # inline completion: hand back to _drive
                 self._inline = result
-                return
+        metrics.gauge_add("fetch.on_air", -1)
+        if inline:
+            return
         self._cancel_timeout()
         self._drive(result)
 
@@ -337,7 +362,7 @@ class Segment:
                     return
                 log.warn(f"fetch of {self.map_id} failed ({result}); "
                          f"retrying ({self._retries_left} left)")
-                metrics.add("fetch.retries")
+                metrics.add("fetch.retries", supplier=self.supplier)
                 delay = self.policy.backoff(attempt, self._rng)
                 if self._deadline is not None:
                     delay = min(delay,
@@ -411,7 +436,13 @@ class Segment:
                     self.num_records += batch.num_records
                 self._carry = data[consumed:] if not last else b""
                 self._next_offset = res.offset + len(res.data)
-                metrics.add("fetched_bytes", len(res.data))
+            issue_t0 = self._issue_t0
+        metrics.add("fetch.bytes", len(res.data), supplier=self.supplier)
+        metrics.add("fetch.chunks", supplier=self.supplier)
+        metrics.observe("fetch.latency_ms",
+                        (time.perf_counter() - issue_t0) * 1e3,
+                        supplier=self.supplier)
+        metrics.observe("fetch.chunk.bytes", len(res.data))
         return last
 
     # -- consumption --------------------------------------------------------

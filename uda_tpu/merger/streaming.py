@@ -238,7 +238,7 @@ class RunStore:
         with self._lock:
             self.counts[seg_index] = sub.num_records
             self.bytes[seg_index] = total
-        metrics.add("run_spooled_bytes", total)
+        metrics.add("spool.bytes", total)
 
     def cleanup(self) -> None:
         with self._lock:

@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
@@ -230,12 +231,26 @@ class DataEngine:
         failpoint_no_deadlock)."""
         if self._stopped:
             raise StorageError("DataEngine is stopped")
-        return self._pool.submit(self._serve, req)
+        metrics.gauge_add("supplier.reads.on_air", 1)
+        try:
+            return self._pool.submit(self._serve, req)
+        except BaseException:  # pool shutdown race: undo the on-air count
+            metrics.gauge_add("supplier.reads.on_air", -1)
+            raise
 
     def fetch(self, req: ShuffleRequest) -> FetchResult:
         return self.submit(req).result()
 
     def _serve(self, req: ShuffleRequest) -> FetchResult:
+        t0 = time.perf_counter()
+        try:
+            return self._serve_inner(req)
+        finally:
+            metrics.gauge_add("supplier.reads.on_air", -1)
+            metrics.observe("supplier.read.latency_ms",
+                            (time.perf_counter() - t0) * 1e3)
+
+    def _serve_inner(self, req: ShuffleRequest) -> FetchResult:
         with metrics.timer("supplier_read"):
             rec = self.resolver.resolve(req.job_id, req.map_id, req.reduce_id)
             served = rec.part_length  # the on-disk domain
@@ -264,7 +279,7 @@ class DataEngine:
             crc = zlib.crc32(data) & 0xFFFFFFFF if self._crc else None
             data = failpoint("data_engine.pread", data=data,
                              key=f"{req.map_id}/{req.reduce_id}")
-            metrics.add("supplier_bytes", len(data))
+            metrics.add("supplier.bytes", len(data))
             return FetchResult(data, rec.raw_length, rec.part_length,
                                req.offset, rec.path,
                                last=req.offset + len(data) >= served,

@@ -126,8 +126,7 @@ def _tile_sort_folded(x, tile: int, num_keys: int, alternate: bool,
         grid=(n // tile,),
         in_specs=[pl.BlockSpec((rows, tile), lambda t: (0, t))],
         out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
-        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
-                                       vma=jax.typeof(x).vma),
+        out_shape=_uint32_struct((rows, n), x),
         interpret=interpret,
     )(x)
 
@@ -228,8 +227,7 @@ def _merge_pass_folded(x, splits, tile: int, num_keys: int,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
-                                       vma=jax.typeof(x).vma),
+        out_shape=_uint32_struct((rows, n), x),
         interpret=interpret,
     )(splits, splits_nxt, x)
 

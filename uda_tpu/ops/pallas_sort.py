@@ -169,6 +169,26 @@ def _tile_sort_kernel(x_ref, o_ref, *, tile, num_keys, tb_row, alternate,
         o_ref[...] = net
 
 
+def _vma_of(x):
+    """The shard_map varying-manual-axes set of ``x`` on JAX versions
+    that type it (jax.typeof(...).vma); empty elsewhere — old releases
+    have no vma typing, so there is nothing to propagate."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return ()
+    return tuple(getattr(typeof(x), "vma", ()) or ())
+
+
+def _uint32_struct(shape, x):
+    """uint32 out_shape struct carrying ``x``'s vma so Pallas pipelines
+    work as-is inside distributed shard_map bodies (a plain struct on
+    JAX versions without vma typing)."""
+    vma = _vma_of(x)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, jnp.uint32, vma=vma)
+    return jax.ShapeDtypeStruct(shape, jnp.uint32)
+
+
 @partial(jax.jit, static_argnames=("tile", "num_keys", "tb_row",
                                    "alternate", "interpret", "two_phase"))
 def _tile_sort(x, tile: int, num_keys: int, tb_row: int, alternate: bool,
@@ -182,8 +202,7 @@ def _tile_sort(x, tile: int, num_keys: int, tb_row: int, alternate: bool,
         out_specs=pl.BlockSpec((rows, tile), lambda t: (0, t)),
         # vma propagates the caller's shard_map varying-axes set, so the
         # pipeline works as-is inside distributed shard_map bodies
-        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
-                                       vma=jax.typeof(x).vma),
+        out_shape=_uint32_struct((rows, n), x),
         interpret=interpret,
     )(x)
 
@@ -240,7 +259,7 @@ def _pass_splits(x, run_len, final, tile: int, num_keys: int, tb_row: int):
     # pcast the inits to x's vma (a no-op outside shard_map, where vma
     # is empty) — this is what lets the distributed sort run the lanes
     # engines with check_vma=True (see parallel/distributed._sort_step)
-    vma = tuple(getattr(jax.typeof(x), "vma", ()) or ())
+    vma = _vma_of(x)
     if vma:
         lo = lax.pcast(lo, vma, to="varying")
         hi = lax.pcast(hi, vma, to="varying")
@@ -422,8 +441,7 @@ def _merge_pass(x, splits, tile: int, num_keys: int, tb_row: int,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint32,
-                                       vma=jax.typeof(x).vma),
+        out_shape=_uint32_struct((rows, n), x),
         interpret=interpret,
     )(splits, splits_nxt, x)
 

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from uda_tpu.parallel import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uda_tpu.parallel.multihost import put_global, put_rows, zeros_global
@@ -130,9 +130,15 @@ def _vma_check_on(payload_path: str, interpret: bool) -> bool:
     hatch for a first-hardware-run surprise; using it should be
     reported back into the repro script."""
     from uda_tpu.ops.sort import LANES_ENGINES
+    from uda_tpu.parallel import SHARD_MAP_NATIVE_VMA
 
     if os.environ.get("UDA_TPU_FORCE_NO_CHECK_VMA") == "1":
         return False
+    if not SHARD_MAP_NATIVE_VMA:
+        # pre-vma JAX: the legacy check_rep checker has no pallas_call
+        # replication rule, so any lanes engine would fail to trace;
+        # the property is only checkable on native-vma releases
+        return payload_path not in LANES_ENGINES
     return not (payload_path in LANES_ENGINES and interpret)
 
 
@@ -474,7 +480,7 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
         acc = _round_scatter(layout.words, layout.dest, layout.pos, acc,
                              colbase_dev, jnp.int32(r), mesh, axis,
                              capacity)
-        metrics.add("exchange_rounds")
+        metrics.add("exchange.rounds")
     nvalid = put_global(per_dst.astype(np.int32), spec)
     out = _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,
                       interpret=_lanes_interpret(payload_path, mesh))

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from uda_tpu.parallel import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uda_tpu.parallel.multihost import allgather, put_rows
@@ -174,7 +174,7 @@ def shuffle_exchange(words, dest, mesh: Mesh, axis: str,
         # surfaces as TransportError, like a reference WC error)
         failpoint("exchange.round", key=f"round{r}")
         results.append(exchange_round(layout, capacity, r))
-        metrics.add("exchange_rounds")
+        metrics.add("exchange.rounds")
     return results, layout
 
 

@@ -143,6 +143,16 @@ _FLAG_LIST = [
          "failpoint arming spec, same syntax as UDA_FAILPOINTS: "
          "comma-separated site=action[:arg][:trigger...] entries "
          "(uda_tpu.utils.failpoints)"),
+    # --- observability knobs (metrics / tracing / stats reporter) ---
+    Flag("uda.tpu.stats.enable", False, bool,
+         "turn on the optional observability layers (histograms, span "
+         "tracing, the StatsReporter thread); UDA_TPU_STATS=1 is the "
+         "env equivalent"),
+    Flag("uda.tpu.stats.interval.ms", 1000, int,
+         "StatsReporter snapshot/report interval in ms"),
+    Flag("uda.tpu.stats.jsonl", "", str,
+         "path for the JSON-lines stats stream (appended); empty = "
+         "UDA_TPU_STATS_JSONL env, else stderr"),
     Flag("uda.tpu.auto.approach.threshold.mb", 2048, int,
          "auto merge-approach crossover: partitions at most this many "
          "MB take the hybrid LPQ/RPQ path (fastest at small/mid scale), "

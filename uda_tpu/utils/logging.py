@@ -10,18 +10,25 @@ plugins/shared/.../UdaPlugin.java:99-143; here ``set_level`` is just
 called directly by the bridge's SET_LOG_LEVEL command).
 
 Every message carries a ``(file:line)`` suffix like the reference
-(IOUtility.cc:514-536).
+(IOUtility.cc:514-536). The frame walk that computes it runs only when
+the message actually emits (behind the level check) and caches the
+per-file basename, so hot call sites pay one ``sys._getframe`` walk per
+EMITTED message and nothing at a silenced level.
+
+Named loggers: ``get_logger("uda.stats")`` returns a child logger that
+shares the root's sink/file but owns its OWN level, so subsystems (the
+StatsReporter progress stream) can be silenced independently of the
+engine log. A child with no explicit level inherits the root's.
 """
 
 from __future__ import annotations
 
 import enum
-import inspect
 import os
 import sys
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 __all__ = ["LogLevel", "Logger", "get_logger", "log"]
 
@@ -37,21 +44,60 @@ class LogLevel(enum.IntEnum):
     TRACE = 6
 
 
+_THIS_FILE = __file__
+_BASENAME_CACHE: Dict[str, str] = {}
+
+
+def _caller_suffix() -> str:
+    """`` (file:line)`` of the first frame outside this module, whatever
+    the call depth (direct .log(), level helpers, or module-level
+    log()). Only called for messages that will actually emit."""
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename == _THIS_FILE:
+        f = f.f_back
+    if f is None:
+        return ""
+    fn = f.f_code.co_filename
+    base = _BASENAME_CACHE.get(fn)
+    if base is None:
+        base = _BASENAME_CACHE[fn] = os.path.basename(fn)
+    return f" ({base}:{f.f_lineno})"
+
+
 class Logger:
     """Process-wide logger with an optional up-call sink.
 
     ``sink`` receives ``(level, message)``; when unset, messages go to a
-    file (if ``open_file`` was called) or stderr.
+    file (if ``open_file`` was called) or stderr. Child loggers (named,
+    created via :func:`get_logger`) delegate output to the root and only
+    carry their own level override.
     """
 
-    def __init__(self) -> None:
-        self.level = LogLevel.INFO
+    def __init__(self, name: str = "uda_tpu",
+                 parent: Optional["Logger"] = None) -> None:
+        self.name = name
+        self.parent = parent
+        # root default INFO; children inherit until set_level is called
+        self.level: Optional[LogLevel] = None if parent else LogLevel.INFO
         self.sink: Optional[Callable[[int, str], None]] = None
         self._file = None
         self._lock = threading.Lock()
 
+    def effective_level(self) -> LogLevel:
+        node: Optional[Logger] = self
+        while node is not None:
+            if node.level is not None:
+                return node.level
+            node = node.parent
+        return LogLevel.INFO
+
     def set_level(self, level: int) -> None:
         self.level = LogLevel(max(0, min(6, int(level))))
+
+    def clear_level(self) -> None:
+        """Child loggers only: drop the override, inherit the root's."""
+        if self.parent is not None:
+            self.level = None
 
     def set_sink(self, sink: Optional[Callable[[int, str], None]]) -> None:
         self.sink = sink
@@ -71,27 +117,31 @@ class Logger:
                 self._file.close()
                 self._file = None
 
+    def _emitter(self) -> "Logger":
+        """The logger whose sink/file actually writes (the root, unless
+        this logger was given its own sink/file)."""
+        node: Logger = self
+        while node.parent is not None and node.sink is None \
+                and node._file is None:
+            node = node.parent
+        return node
+
     def log(self, level: LogLevel, msg: str) -> None:
-        if level > self.level or self.level == LogLevel.NONE:
+        eff = self.effective_level()
+        if level > eff or eff == LogLevel.NONE:
             return
-        # attribute to the first frame outside this module, whatever the
-        # call depth (direct .log(), level helpers, or module-level log())
-        caller = inspect.currentframe()
-        this_file = __file__
-        while caller is not None and caller.f_code.co_filename == this_file:
-            caller = caller.f_back
-        where = ""
-        if caller:
-            where = f" ({os.path.basename(caller.f_code.co_filename)}:{caller.f_lineno})"
-        text = f"{msg}{where}"
-        if self.sink is not None:
-            self.sink(int(level), text)
+        # file:line attribution is computed only on this emit path (a
+        # silenced message costs just the level check above)
+        text = f"{msg}{_caller_suffix()}"
+        out = self._emitter()
+        if out.sink is not None:
+            out.sink(int(level), text)
             return
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
-        line = f"{stamp} {level.name:5s} uda_tpu: {text}\n"
-        with self._lock:
-            out = self._file or sys.stderr
-            out.write(line)
+        line = f"{stamp} {level.name:5s} {self.name}: {text}\n"
+        with out._lock:
+            stream = out._file or sys.stderr
+            stream.write(line)
 
     def fatal(self, msg: str) -> None:
         self.log(LogLevel.FATAL, msg)
@@ -113,10 +163,20 @@ class Logger:
 
 
 _LOGGER = Logger()
+_NAMED: Dict[str, Logger] = {}
+_NAMED_LOCK = threading.Lock()
 
 
-def get_logger() -> Logger:
-    return _LOGGER
+def get_logger(name: Optional[str] = None) -> Logger:
+    """The root logger (no name, back-compat) or a named child sharing
+    the root's output but with an independently settable level."""
+    if name is None or name == _LOGGER.name:
+        return _LOGGER
+    with _NAMED_LOCK:
+        lg = _NAMED.get(name)
+        if lg is None:
+            lg = _NAMED[name] = Logger(name, parent=_LOGGER)
+        return lg
 
 
 def log(level: LogLevel, msg: str) -> None:

@@ -1,45 +1,427 @@
-"""Per-phase timers and counters.
+"""Labeled metrics + span-tree tracing.
 
 Equivalent of the reference's per-task counters (``total_wait_mem_time``,
 ``total_fetch_time``, ``total_merge_time``, reference
 src/Merger/reducer.h:80-90, accumulated in StreamRW.cc:555-569) and the
-AIO on-air counters (src/CommUtils/AIOHandler.cc:129-141). The reference
-had no dedicated tracer (SURVEY §5); here we add a lightweight span/trace
-export so profiles can be correlated with device profiles.
+AIO on-air counters (src/CommUtils/AIOHandler.cc:129-141), grown into a
+full observability layer (the reference had no tracer at all, SURVEY §5):
 
-Failure-domain counters (dotted namespace, maintained by the fetch
-recovery layer and the failpoint framework): ``fetch.retries``,
-``fetch.timeouts``, ``fetch.stale_completions``, ``fetch.backoff_seconds``,
-``fetch.deadline_exceeded``, ``fetch.crc_mismatch``, ``fetch.crc_refetch``,
-``fetch.penalties``, ``fetch.deprioritized``, ``fallback.signals`` and
-``failpoint.<site>`` per armed injection site.
+- **counters** (``metrics.add``): monotone sums, optionally labeled —
+  ``metrics.add("fetch.bytes", n, supplier=sid)`` accumulates BOTH the
+  unlabeled total ``fetch.bytes`` and the per-label series
+  ``fetch.bytes{supplier=sid}``;
+- **gauges** (``metrics.gauge`` / ``metrics.gauge_add``): point-in-time
+  levels — on-air fetches, arena occupancy — mirroring the reference's
+  AIO on-air counters;
+- **histograms** (``metrics.observe``): fixed power-of-two buckets with
+  p50/p95/p99 estimation; recorded only while stats are enabled
+  (``UDA_TPU_STATS=1`` / ``uda.tpu.stats.enable`` /
+  :meth:`Metrics.enable_stats`), a no-op otherwise;
+- **spans**: a tree tracer — every span carries trace/span/parent ids
+  and free-form attributes (reduce task, supplier, map id, offset,
+  attempt), propagates through threads either implicitly (contextvar)
+  or explicitly (``start_span(parent=...)``), and exports to Chrome
+  trace-event format with ``args`` so host lanes line up with
+  ``device_trace`` Xprof captures. Off by default; idempotent
+  ``enable_spans()``/``disable_spans()``.
+
+Metric names use a dotted ``domain.metric`` namespace and must appear in
+:data:`METRICS_REGISTRY` (or start with a :data:`REGISTRY_PREFIXES`
+prefix) — linted by ``scripts/check_metrics_names.py``, which runs in
+tier-1 via ``tests/test_metrics.py``.
+
+Counter reference parity: :meth:`Metrics.snapshot` aliases the timer
+sums ``wait_mem_time`` / ``fetch_time`` / ``merge_time`` under the
+reference's exact per-task names ``total_wait_mem_time`` /
+``total_fetch_time`` / ``total_merge_time`` (reducer.h:80-90).
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import contextvars
 import json
+import os
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, Iterator
+from typing import Dict, Iterator, Optional
 
-__all__ = ["Metrics", "metrics", "device_trace"]
+__all__ = ["Metrics", "Span", "metrics", "device_trace",
+           "METRICS_REGISTRY", "REGISTRY_PREFIXES", "NAME_RE",
+           "PARITY_ALIASES", "stats_enabled_from_env"]
+
+# Dotted namespace every metrics.add/gauge/observe name must match
+# (scripts/check_metrics_names.py enforces this over uda_tpu/).
+NAME_RE = r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+"
+
+# The metrics registry: every statically-named counter/gauge/histogram
+# call site in uda_tpu/ must be listed here (kind, what it measures,
+# labels if any). scripts/check_metrics_names.py greps the call sites
+# and fails on names missing from this table.
+METRICS_REGISTRY: Dict[str, tuple] = {
+    # -- counters: fetch path (reduce side) ------------------------------
+    "fetch.bytes": ("counter", "record bytes fetched [labels: supplier]"),
+    "fetch.chunks": ("counter", "chunks fetched [labels: supplier]"),
+    "fetch.retries": ("counter", "whole-segment re-fetches after a "
+                                 "transport fault [labels: supplier]"),
+    "fetch.timeouts": ("counter", "per-attempt fetch timeouts "
+                                  "[labels: supplier]"),
+    "fetch.stale_completions": ("counter", "completions dropped as stale "
+                                           "(superseded attempt epoch)"),
+    "fetch.backoff_seconds": ("counter", "seconds spent in retry backoff"),
+    "fetch.deadline_exceeded": ("counter", "segments abandoned at the "
+                                           "per-segment deadline"),
+    "fetch.crc_mismatch": ("counter", "chunk CRC validation failures"),
+    "fetch.crc_refetch": ("counter", "single-chunk CRC re-fetches"),
+    "fetch.penalties": ("counter", "suppliers boxed after repeated "
+                                   "faults [labels: supplier]"),
+    "fetch.deprioritized": ("counter", "schedule rotations past a boxed "
+                                       "supplier"),
+    "fallback.signals": ("counter", "terminal engine failures converted "
+                                    "to FallbackSignal"),
+    # -- counters: supplier / emit / merge / exchange --------------------
+    "supplier.bytes": ("counter", "bytes served by the DataEngine"),
+    "emit.bytes": ("counter", "framed bytes handed to the consumer"),
+    "merge.records": ("counter", "records through the merge "
+                                 "(staged or device-merged)"),
+    "spool.bytes": ("counter", "bytes spooled to sorted run files "
+                               "(streaming online mode)"),
+    "exchange.rounds": ("counter", "all-to-all exchange rounds executed"),
+    "decompress.bytes": ("counter", "uncompressed bytes produced by the "
+                                    "decompressing fetch client"),
+    # -- gauges ----------------------------------------------------------
+    "fetch.on_air": ("gauge", "fetch attempts currently in flight "
+                              "(reference AIO on-air counter)"),
+    "supplier.reads.on_air": ("gauge", "DataEngine reads currently "
+                                       "queued or executing"),
+    "arena.slots_in_use": ("gauge", "staging-arena slots currently "
+                                    "acquired"),
+    # -- histograms (recorded only while stats are enabled) --------------
+    "fetch.latency_ms": ("histogram", "per-chunk fetch latency "
+                                      "[labels: supplier]"),
+    "fetch.chunk.bytes": ("histogram", "fetched chunk sizes"),
+    "supplier.read.latency_ms": ("histogram", "DataEngine chunk read+"
+                                              "resolve latency"),
+    "merge.wait_ms": ("histogram", "staging-thread wait for the next "
+                                   "completed segment"),
+}
+
+# Dynamically-named families (f-string call sites): the static prefix
+# must be listed here.
+REGISTRY_PREFIXES = ("failpoint.",)
+
+# snapshot() aliases for the reference's per-reduce-task aggregate trio
+# (reducer.h:80-90): alias name -> source timer counter.
+PARITY_ALIASES = {
+    "total_wait_mem_time": "wait_mem_time",
+    "total_fetch_time": "fetch_time",
+    "total_merge_time": "merge_time",
+}
+
+# Fixed histogram buckets: powers of two from 1/16 to 2^30, shared by
+# every histogram (latencies in ms and sizes in bytes both fit; fixed
+# buckets keep observe() O(log buckets) with no per-histogram config).
+_BUCKET_EDGES = tuple(float(2.0 ** e) for e in range(-4, 31))
+
+
+class _Hist:
+    """One fixed-bucket histogram series (caller holds the metrics
+    lock)."""
+
+    __slots__ = ("counts", "count", "total", "vmin", "vmax")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(_BUCKET_EDGES) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(_BUCKET_EDGES, value)] += 1
+        self.count += 1
+        self.total += value
+        self.vmin = min(self.vmin, value)
+        self.vmax = max(self.vmax, value)
+
+    def percentile(self, p: float) -> float:
+        """Bucket-interpolated percentile estimate (exact min/max at the
+        tails; linear within the containing bucket)."""
+        if self.count == 0:
+            return 0.0
+        target = self.count * p / 100.0
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= target:
+                lo = _BUCKET_EDGES[i - 1] if i > 0 else 0.0
+                hi = (_BUCKET_EDGES[i] if i < len(_BUCKET_EDGES)
+                      else self.vmax)
+                frac = (target - seen) / c
+                return min(max(lo + (hi - lo) * frac, self.vmin), self.vmax)
+            seen += c
+        return self.vmax
+
+    def summary(self) -> Dict[str, float]:
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0}
+        return {"count": self.count, "sum": self.total,
+                "min": self.vmin, "max": self.vmax,
+                "p50": self.percentile(50), "p95": self.percentile(95),
+                "p99": self.percentile(99)}
+
+
+def _series_key(name: str, labels: dict) -> str:
+    """Stable series key: ``name{k=v,...}`` with sorted label keys."""
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = \
+    contextvars.ContextVar("uda_tpu_current_span", default=None)
+
+
+class Span:
+    """One span of the trace tree. ``end()`` records it (idempotent);
+    attributes may be added at end time (e.g. error status). A span is
+    safe to end from a different thread than the one that started it —
+    the recorded ``tid`` is the *starting* thread (that's the lane the
+    work queued on)."""
+
+    __slots__ = ("_metrics", "name", "trace_id", "span_id", "parent_id",
+                 "t0", "attrs", "tid", "_ended")
+
+    def __init__(self, metrics_obj: "Metrics", name: str,
+                 trace_id: int, span_id: int, parent_id: Optional[int],
+                 attrs: dict):
+        self._metrics = metrics_obj
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = time.perf_counter()
+        self.attrs = attrs
+        self.tid = threading.get_ident()
+        self._ended = False
+
+    def end(self, **attrs) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        dur = time.perf_counter() - self.t0
+        if attrs:
+            self.attrs.update(attrs)
+        self._metrics._record_span(self, dur)
+
+
+class _NoopSpan:
+    """Returned by start_span while spans are disabled: absorbing
+    end()/attrs at zero recording cost."""
+
+    __slots__ = ()
+    name = ""
+    trace_id = span_id = parent_id = None
+    attrs: dict = {}
+
+    def end(self, **attrs) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
 
 
 class Metrics:
-    def __init__(self) -> None:
+    """Process-wide metrics hub. Counters and gauges are always live
+    (two dict writes under one lock); histograms and spans cost nothing
+    until enabled."""
+
+    def __init__(self, stats: Optional[bool] = None) -> None:
         self._lock = threading.Lock()
         self.counters: Dict[str, float] = defaultdict(float)
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, _Hist] = {}
         self.spans: list[dict] = []
-        self.record_spans = False
+        # construction-time default, restored by reset(): the global
+        # instance takes it from UDA_TPU_STATS so a whole process can be
+        # switched on from the environment
+        self._default_stats = (stats_enabled_from_env() if stats is None
+                               else bool(stats))
+        self._hist_enabled = self._default_stats
+        self._spans_enabled = self._default_stats
+        self._next_id = 0
 
-    def add(self, name: str, value: float = 1.0) -> None:
+    # -- enablement ---------------------------------------------------------
+
+    def enable_stats(self) -> None:
+        """Turn on the optional layers (histograms + spans). Idempotent."""
+        self._hist_enabled = True
+        self._spans_enabled = True
+
+    def disable_stats(self) -> None:
+        self._hist_enabled = False
+        self._spans_enabled = False
+
+    def enable_spans(self) -> None:
+        """Idempotent: span recording on (histograms untouched)."""
+        self._spans_enabled = True
+
+    def disable_spans(self) -> None:
+        self._spans_enabled = False
+
+    @property
+    def stats_enabled(self) -> bool:
+        return self._hist_enabled
+
+    @property
+    def record_spans(self) -> bool:
+        # legacy attribute-style toggle, kept as a property so existing
+        # `m.record_spans = True` call sites still work
+        return self._spans_enabled
+
+    @record_spans.setter
+    def record_spans(self, on: bool) -> None:
+        self._spans_enabled = bool(on)
+
+    # -- counters -----------------------------------------------------------
+
+    def add(self, name: str, value: float = 1.0, **labels) -> None:
+        """Accumulate a counter. With labels, BOTH the unlabeled total
+        ``name`` and the series ``name{k=v,...}`` advance, so existing
+        total-based assertions and dashboards keep working."""
+        if labels:
+            skey = _series_key(name, labels)
+            with self._lock:
+                self.counters[name] += value
+                self.counters[skey] += value
+        else:
+            with self._lock:
+                self.counters[name] += value
+
+    # -- gauges -------------------------------------------------------------
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        """Set a gauge to an absolute level."""
+        key = _series_key(name, labels) if labels else name
         with self._lock:
-            self.counters[name] += value
+            self.gauges[key] = value
+
+    def gauge_add(self, name: str, delta: float, **labels) -> None:
+        """Adjust a gauge by ``delta`` (the on-air increment/decrement
+        idiom of the reference's AIO counters)."""
+        key = _series_key(name, labels) if labels else name
+        with self._lock:
+            self.gauges[key] = self.gauges.get(key, 0.0) + delta
+
+    # -- histograms ---------------------------------------------------------
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Record one histogram sample. No-op until stats are enabled —
+        the disabled fast path is a single attribute check."""
+        if not self._hist_enabled:
+            return
+        keys = [name]
+        if labels:
+            keys.append(_series_key(name, labels))
+        with self._lock:
+            for key in keys:
+                h = self.histograms.get(key)
+                if h is None:
+                    h = self.histograms[key] = _Hist()
+                h.observe(value)
+
+    def histogram_summaries(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: h.summary() for k, h in self.histograms.items()}
+
+    # -- spans --------------------------------------------------------------
+
+    def _new_ids(self, parent: Optional[Span]) -> tuple[int, int, Optional[int]]:
+        with self._lock:
+            self._next_id += 1
+            sid = self._next_id
+        if parent is not None and parent.span_id is not None:
+            return parent.trace_id, sid, parent.span_id
+        return sid, sid, None  # root: trace id = own span id
+
+    def start_span(self, name: str, parent: Optional[Span] = None,
+                   **attrs) -> Span:
+        """Begin a span. ``parent`` defaults to the calling thread's
+        current span (contextvar); pass an explicit parent to propagate
+        the tree across threads (e.g. a transport completion thread
+        ending work that a merge-thread span fathered). Returns a no-op
+        span while recording is disabled."""
+        if not self._spans_enabled:
+            return _NOOP_SPAN
+        if parent is None:
+            parent = _current_span.get()
+        trace_id, span_id, parent_id = self._new_ids(parent)
+        return Span(self, name, trace_id, span_id, parent_id, attrs)
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: Optional[Span] = None,
+             **attrs) -> Iterator[Span]:
+        """Context-managed span that also becomes the thread's current
+        span for the duration, so nested spans/timers parent under it."""
+        s = self.start_span(name, parent=parent, **attrs)
+        if s is _NOOP_SPAN:
+            yield s
+            return
+        token = _current_span.set(s)
+        try:
+            yield s
+        finally:
+            _current_span.reset(token)
+            s.end()
+
+    @contextlib.contextmanager
+    def use_span(self, span: Optional[Span]) -> Iterator[None]:
+        """Make an existing span the current one on THIS thread (without
+        ending it on exit) — the cross-thread propagation shim: a worker
+        adopts the span its work item was fathered under."""
+        if span is None or isinstance(span, _NoopSpan) \
+                or not self._spans_enabled:
+            yield
+            return
+        token = _current_span.set(span)
+        try:
+            yield
+        finally:
+            _current_span.reset(token)
+
+    def current_span(self) -> Optional[Span]:
+        """The calling thread's innermost open span (None outside any)."""
+        if not self._spans_enabled:
+            return None
+        return _current_span.get()
+
+    def _record_span(self, span: Span, dur: float) -> None:
+        rec = {"name": span.name, "ts": span.t0, "dur": dur,
+               "tid": span.tid, "trace": span.trace_id, "id": span.span_id,
+               "parent": span.parent_id}
+        if span.attrs:
+            rec["attrs"] = dict(span.attrs)
+        with self._lock:
+            if self._spans_enabled:  # disabled mid-flight: drop
+                self.spans.append(rec)
 
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:
+        """Phase timer: accumulates ``<name>_time`` seconds and (when
+        spans are on) records a span parented under the thread's current
+        span."""
+        if self._spans_enabled:
+            with self.span(name):
+                t0 = time.perf_counter()
+                try:
+                    yield
+                finally:
+                    dt = time.perf_counter() - t0
+                    with self._lock:
+                        self.counters[name + "_time"] += dt
+            return
         t0 = time.perf_counter()
         try:
             yield
@@ -47,34 +429,77 @@ class Metrics:
             dt = time.perf_counter() - t0
             with self._lock:
                 self.counters[name + "_time"] += dt
-                if self.record_spans:
-                    self.spans.append({"name": name, "ts": t0, "dur": dt,
-                                       "tid": threading.get_ident()})
 
-    def get(self, name: str) -> float:
-        """One counter's current value (0.0 when never incremented)."""
+    # -- reads --------------------------------------------------------------
+
+    def get(self, name: str, **labels) -> float:
+        """One counter's current value (0.0 when never incremented);
+        with labels, the labeled series' value."""
+        key = _series_key(name, labels) if labels else name
         with self._lock:
-            return self.counters.get(name, 0.0)
+            return self.counters.get(key, 0.0)
+
+    def get_gauge(self, name: str, **labels) -> float:
+        key = _series_key(name, labels) if labels else name
+        with self._lock:
+            return self.gauges.get(key, 0.0)
 
     def snapshot(self) -> Dict[str, float]:
+        """Counters (labeled series included), plus the reference-parity
+        per-task aggregate aliases (PARITY_ALIASES) whenever their
+        source timers have fired."""
         with self._lock:
-            return dict(self.counters)
+            snap = dict(self.counters)
+        for alias, source in PARITY_ALIASES.items():
+            if source in snap:
+                snap[alias] = snap[source]
+        return snap
+
+    def gauges_snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.gauges)
 
     def reset(self) -> None:
+        """Restore a fully pristine state: counters, gauges, histograms
+        and spans cleared; histogram/span enablement back to the
+        construction-time default (so a test that called enable_spans()
+        cannot leak recording into the next test)."""
         with self._lock:
             self.counters.clear()
+            self.gauges.clear()
+            self.histograms.clear()
             self.spans.clear()
+            self._hist_enabled = self._default_stats
+            self._spans_enabled = self._default_stats
+
+    # -- export -------------------------------------------------------------
 
     def export_chrome_trace(self, path: str) -> None:
-        """Write spans in Chrome trace-event format (load in perfetto)."""
+        """Write spans in Chrome trace-event format (load in Perfetto).
+        Span attributes plus trace/span/parent ids ride in ``args`` so
+        host lanes can be correlated with ``device_trace`` captures and
+        the tree reconstructed."""
         with self._lock:
-            events = [
-                {"name": s["name"], "ph": "X", "pid": 0, "tid": s["tid"],
-                 "ts": s["ts"] * 1e6, "dur": s["dur"] * 1e6}
-                for s in self.spans
-            ]
+            spans = list(self.spans)
+        events = []
+        for s in spans:
+            args = dict(s.get("attrs") or {})
+            for k, arg in (("trace", "trace_id"), ("id", "span_id"),
+                           ("parent", "parent_id")):
+                if s.get(k) is not None:
+                    args[arg] = s[k]
+            events.append({"name": s["name"], "ph": "X", "pid": 0,
+                           "tid": s["tid"], "ts": s["ts"] * 1e6,
+                           "dur": s["dur"] * 1e6, "args": args})
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
+
+
+def stats_enabled_from_env() -> bool:
+    """UDA_TPU_STATS=1 (or true/yes/on) turns the optional layers on for
+    the whole process."""
+    return os.environ.get("UDA_TPU_STATS", "").strip().lower() in (
+        "1", "true", "yes", "on")
 
 
 @contextlib.contextmanager
@@ -86,8 +511,6 @@ def device_trace(log_dir: str | None = None) -> Iterator[None]:
     backend does not support jax.profiler, e.g. relay backends — the
     failure is logged, never raised: profiling must not take down the
     job)."""
-    import os
-
     d = log_dir or os.environ.get("UDA_TPU_XPROF")
     if not d:
         yield

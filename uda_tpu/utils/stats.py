@@ -1,0 +1,201 @@
+"""Live stats reporting over the metrics hub.
+
+The reference exposed its per-task aggregates only post-mortem (the
+counter trio logged at reduce teardown, reference StreamRW.cc:555-569);
+there was no way to watch a running shuffle. :class:`StatsReporter` is
+the missing live channel: a background thread that snapshots counters
+and gauges every interval, computes deltas and rates (fetch MB/s, merge
+records/s, retry rate), and emits
+
+- one **JSON-lines record** per interval (machine-readable stream —
+  schema below), and
+- one **human one-liner** through the dedicated ``uda.stats`` logger
+  (silence it independently with
+  ``get_logger("uda.stats").set_level(0)``).
+
+The final record (``"final": true``, emitted by ``stop()`` or the
+bridge's ``reduce_exit``) carries the reference-parity per-task trio
+``total_wait_mem_time`` / ``total_fetch_time`` / ``total_merge_time``
+plus histogram p50/p95/p99 summaries — the same block ``bench.py``
+embeds in its JSON output (``telemetry_block``).
+
+JSON-lines schema (one object per line)::
+
+    {"ts": <unix seconds>, "uptime_s": ..., "interval_s": ...,
+     "counters": {<name or name{label=v}>: <total>, ...},
+     "gauges": {...},
+     "rates": {"fetch_mb_s": ..., "merge_records_s": ...,
+               "retry_per_s": ..., "emit_mb_s": ...},
+     "histograms": {<name>: {"count","sum","min","max","p50","p95","p99"}},
+     "final": true}            # last record only
+
+Configuration: ``uda.tpu.stats.enable`` / ``UDA_TPU_STATS=1`` switch the
+whole observability layer on; ``uda.tpu.stats.interval.ms`` paces the
+reporter; ``uda.tpu.stats.jsonl`` / ``UDA_TPU_STATS_JSONL`` name the
+JSON-lines destination (stderr when unset).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import PARITY_ALIASES, Metrics
+from uda_tpu.utils.metrics import metrics as global_metrics
+
+__all__ = ["StatsReporter", "telemetry_block"]
+
+# (rate key, source counter, scale) — rate = delta(counter)/dt/scale
+_RATES = (
+    ("fetch_mb_s", "fetch.bytes", 1e6),
+    ("emit_mb_s", "emit.bytes", 1e6),
+    ("merge_records_s", "merge.records", 1.0),
+    ("retry_per_s", "fetch.retries", 1.0),
+)
+
+
+def telemetry_block(m: Optional[Metrics] = None) -> Dict:
+    """One comparable snapshot block: counters (with the parity trio),
+    gauges, and histogram percentile summaries. Embedded in bench JSON,
+    chaos-run telemetry and the reporter's final record so BENCH_*.json
+    files across rounds stay directly diffable."""
+    m = m or global_metrics
+    counters = m.snapshot()
+    for alias in PARITY_ALIASES:
+        counters.setdefault(alias, 0.0)
+    return {"counters": counters, "gauges": m.gauges_snapshot(),
+            "histograms": m.histogram_summaries()}
+
+
+class StatsReporter:
+    """Periodic snapshot/delta/rate reporter over a :class:`Metrics`.
+
+    ``clock`` is injectable for tests (defaults to ``time.monotonic``);
+    ``out`` is a path (appended, line-buffered), a file-like object, or
+    None for stderr. ``report_once()`` is the single-step core the
+    background thread loops on — callable directly with a fake clock."""
+
+    def __init__(self, metrics_obj: Optional[Metrics] = None,
+                 interval_s: float = 1.0, out=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 logger_name: str = "uda.stats"):
+        self.metrics = metrics_obj or global_metrics
+        self.interval_s = max(0.05, float(interval_s))
+        self.clock = clock
+        self.log = get_logger(logger_name)
+        self._out = out
+        self._own_file = None
+        if isinstance(out, str):
+            self._own_file = open(out, "a", buffering=1)
+        self._t0 = clock()
+        self._last_t = self._t0
+        self._last_counters: Dict[str, float] = self.metrics.snapshot()
+        self._latest: Dict = {}
+        self._stop = threading.Event()
+        self._stopped_final = False
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "StatsReporter":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="uda-stats-reporter")
+            self._thread.start()
+        return self
+
+    def stop(self, final: bool = True) -> None:
+        """Stop the loop; with ``final`` emit one last record flagged
+        ``"final": true``. Idempotent: a second stop neither emits
+        another final record nor writes past the closed JSONL file."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        if final and not self._stopped_final:
+            self._stopped_final = True
+            self.report_once(final=True)
+        if self._own_file is not None:
+            self._own_file.close()
+            self._own_file = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=self.interval_s):
+            try:
+                self.report_once()
+            except Exception as e:  # noqa: BLE001 - reporting must never
+                # take down the job it watches
+                self.log.warn(f"stats report failed: {e}")
+
+    # -- the report itself --------------------------------------------------
+
+    def report_once(self, final: bool = False) -> Dict:
+        """Snapshot, diff against the previous snapshot, emit one JSONL
+        record + one progress line. Returns the record (also kept as
+        ``latest()`` for the bridge's GET_STATS)."""
+        with self._lock:
+            now = self.clock()
+            dt = max(now - self._last_t, 1e-9)
+            counters = self.metrics.snapshot()
+            rates = {key: round((counters.get(src, 0.0)
+                                 - self._last_counters.get(src, 0.0))
+                                / dt / scale, 6)
+                     for key, src, scale in _RATES}
+            self._last_t = now
+            self._last_counters = counters
+            record: Dict = {
+                "ts": round(time.time(), 3),
+                "uptime_s": round(now - self._t0, 3),
+                "interval_s": round(dt, 3),
+                "counters": counters,
+                "gauges": self.metrics.gauges_snapshot(),
+                "rates": rates,
+                "histograms": self.metrics.histogram_summaries(),
+            }
+            if final:
+                record["final"] = True
+                for alias in PARITY_ALIASES:
+                    record["counters"].setdefault(alias, 0.0)
+            self._latest = record
+            self._write_jsonl(record)
+        self._progress_line(record)
+        return record
+
+    def latest(self) -> Dict:
+        """Most recent record (computed on demand when none exists yet —
+        the GET_STATS pull path)."""
+        with self._lock:
+            latest = dict(self._latest)
+        return latest or self.report_once()
+
+    def _write_jsonl(self, record: Dict) -> None:
+        line = json.dumps(record, sort_keys=True)
+        out = self._own_file or self._out or sys.stderr
+        try:
+            out.write(line + "\n")
+        except ValueError:  # closed stream (interpreter teardown)
+            pass
+
+    def _progress_line(self, record: Dict) -> None:
+        r = record["rates"]
+        g = record["gauges"]
+        c = record["counters"]
+        self.log.info(
+            f"shuffle stats: fetch {r['fetch_mb_s']:.2f} MB/s, emit "
+            f"{r['emit_mb_s']:.2f} MB/s, merge {r['merge_records_s']:.0f} "
+            f"rec/s, retries {r['retry_per_s']:.2f}/s "
+            f"(total {c.get('fetch.retries', 0):.0f}), on-air "
+            f"{g.get('fetch.on_air', 0):.0f}")
+
+
+def reporter_output_from_env(cfg_path: str = "") -> Optional[str]:
+    """Resolve the JSONL destination: explicit config path wins, then
+    UDA_TPU_STATS_JSONL, else None (stderr)."""
+    return cfg_path or os.environ.get("UDA_TPU_STATS_JSONL") or None
