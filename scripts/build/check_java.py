@@ -180,6 +180,96 @@ def check_file(path: str, rel: str, known_classes: set[str],
                           "in the tree")
 
 
+def check_callback_table(java_root: str, errors: list[str]) -> None:
+    """Callback-name resolution across the three bridge layers
+    (VERDICT.md ask #7): the up-call table must agree between
+
+    - ``bridge_shim.cc``'s ``uda_callbacks_t`` struct (the C ABI: one
+      ``ctx`` plus N ordered function-pointer fields) and its
+      ``fw_methods`` Python-name table (what the engine calls),
+    - ``UdaBridge.java``'s ``buildCallbacks`` (the stubs it binds via
+      ``findStatic`` and the 8-byte slots it writes them into), and
+    - ``bridge/bridge.py``'s ``UdaCallable`` protocol.
+
+    A renamed, re-ordered, added or dropped up-call in ANY of the three
+    fails the gate instead of dereferencing the wrong slot at runtime.
+    Java receiver naming rule: slot i's bound method must be ``cb`` +
+    a CamelCase prefix of the C field name (cbFetchOver ->
+    fetch_over_message), which catches renames while allowing the
+    established abbreviations."""
+    shim = os.path.join(REPO, "uda_tpu", "native", "bridge_shim.cc")
+    jbridge = os.path.join(java_root, "com", "mellanox", "hadoop",
+                           "mapred", "UdaBridge.java")
+    pybridge = os.path.join(REPO, "uda_tpu", "bridge", "bridge.py")
+    if not (os.path.exists(shim) and os.path.exists(jbridge)
+            and os.path.exists(pybridge)):
+        return  # damaged-tree tests run on a copied java/ only
+    shim_src = open(shim, encoding="utf-8").read()
+    jsrc = open(jbridge, encoding="utf-8").read()
+    pysrc = open(pybridge, encoding="utf-8").read()
+
+    # 1. ordered function-pointer fields of uda_callbacks_t
+    m = re.search(r"typedef\s+struct\s+uda_callbacks\s*\{(.*?)\}",
+                  shim_src, re.S)
+    if not m:
+        errors.append("bridge_shim.cc: uda_callbacks_t struct not found")
+        return
+    fields = re.findall(r"\(\s*\*\s*(\w+)\s*\)", m.group(1))
+    if not fields:
+        errors.append("bridge_shim.cc: uda_callbacks_t has no function "
+                      "pointers")
+        return
+
+    # 2. fw_methods table names match the struct fields exactly, in order
+    fw = re.search(r"PyMethodDef\s+fw_methods\[\]\s*=\s*\{(.*?)\};",
+                   shim_src, re.S)
+    fw_names = re.findall(r'\{\s*"(\w+)"', fw.group(1)) if fw else []
+    if fw_names != fields:
+        errors.append(f"bridge_shim.cc: fw_methods {fw_names} != "
+                      f"uda_callbacks_t fields {fields}")
+
+    # 3. every shim method name is a UdaCallable protocol method
+    for name in fields:
+        if not re.search(rf"def\s+{name}\s*\(", pysrc):
+            errors.append(f"bridge_shim.cc: up-call {name!r} has no "
+                          f"UdaCallable method in bridge/bridge.py")
+
+    # 4. the Java slot table: local stub var -> bound static method ...
+    stub_of = {}
+    for sm in re.finditer(
+            r"MemorySegment\s+(\w+)\s*=\s*LINKER\.upcallStub\(\s*"
+            r"l\.findStatic\(UdaBridge\.class,\s*\"(\w+)\"", jsrc):
+        stub_of[sm.group(1)] = sm.group(2)
+    # ... and each cbs.set slot (offset -> var); ctx sits at offset 0
+    slots = {}
+    for sm in re.finditer(r"cbs\.set\(ADDRESS,\s*(\d+)L?,\s*(\w+)\)", jsrc):
+        slots[int(sm.group(1))] = sm.group(2)
+    want_offsets = [8 * (i + 1) for i in range(len(fields))]
+    if sorted(k for k in slots if k != 0) != want_offsets:
+        errors.append(
+            f"UdaBridge.java: callback slots {sorted(slots)} do not "
+            f"cover ctx + {len(fields)} pointers (want 0 and "
+            f"{want_offsets})")
+        return
+    for i, field in enumerate(fields):
+        var = slots[8 * (i + 1)]
+        method = stub_of.get(var)
+        if method is None:
+            errors.append(f"UdaBridge.java: slot {8 * (i + 1)} var "
+                          f"{var!r} is not an upcallStub/findStatic "
+                          f"binding")
+            continue
+        if not re.search(rf"static\s+\w+(?:\.\w+)*\s+{method}\s*\(", jsrc):
+            errors.append(f"UdaBridge.java: findStatic names {method!r} "
+                          f"but no such static method exists")
+        camel = "cb" + "".join(w.capitalize() for w in field.split("_"))
+        if not camel.startswith(method) or len(method) <= 2:
+            errors.append(
+                f"UdaBridge.java: slot {8 * (i + 1)} binds {method!r} "
+                f"but the shim field there is {field!r} (expected a "
+                f"prefix of {camel!r}) — renamed or re-ordered up-call")
+
+
 def main(java_root: str = "") -> int:
     java_root = java_root or (sys.argv[1] if len(sys.argv) > 1
                               else JAVA_ROOT)
@@ -197,6 +287,7 @@ def main(java_root: str = "") -> int:
     errors: list[str] = []
     for f in sorted(files):
         check_file(f, os.path.relpath(f, REPO), known, known_dirs, errors)
+    check_callback_table(java_root, errors)
     for e in errors:
         print(e, file=sys.stderr)
     print(f"checked {len(files)} java files: "

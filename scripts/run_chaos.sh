@@ -31,19 +31,41 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
     --continue-on-collection-errors "$@" || rc=$?
 
-python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" <<'EOF'
+# Memory-pressure rung: the same faults tier again under a schedule
+# biased toward supplier delays (reads hold their admission bytes
+# longer), exercising the budget layer's graceful-reroute guarantees —
+# tiny budgets + armed failpoints must degrade (streaming, bounded
+# device, watchdog rescue), never crash or wedge. The pressure tests
+# themselves pin tiny uda.tpu.*.budget knobs (tests/test_budget.py).
+PSPEC="data_engine.pread=delay:$((SEED % 20 + 5)):prob:0.3:seed:${SEED},segment.fetch=delay:$((SEED % 8 + 1)):prob:0.15:seed:${SEED}"
+PCOUNTERS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}"' EXIT
+echo "pressure schedule:   ${PSPEC}"
+prc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_CHAOS_TELEMETRY="${PCOUNTERS}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    -k "pressure or watchdog or budget" \
+    --continue-on-collection-errors "$@" || prc=$?
+
+python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
+    "${PSPEC}" "${PCOUNTERS}" "${prc}" <<'EOF'
 import json, sys
-seed, spec, counters_path, out, rc = sys.argv[1:6]
-try:
-    with open(counters_path) as f:
-        telemetry = json.load(f)
-except Exception:
-    telemetry = {"counters": {}}
+seed, spec, counters_path, out, rc, pspec, pcounters, prc = sys.argv[1:9]
+def load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return {"counters": {}}
 with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
-               "pytest_exit": int(rc), "telemetry": telemetry},
+               "pytest_exit": int(rc), "telemetry": load(counters_path),
+               "pressure": {"schedule": pspec, "pytest_exit": int(prc),
+                            "telemetry": load(pcounters)}},
               f, indent=1, sort_keys=True)
     f.write("\n")
 print(f"chaos telemetry:     {out}")
 EOF
+if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 exit "${rc}"

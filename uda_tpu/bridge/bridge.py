@@ -38,6 +38,7 @@ from uda_tpu.bridge.protocol import Cmd, parse_cmd
 from uda_tpu.merger import LocalFetchClient, MergeManager
 from uda_tpu.merger.segment import InputClient
 from uda_tpu.mofserver import DataEngine, IndexRecord, IndexResolver
+from uda_tpu.utils.budget import MemoryBudget
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import FallbackSignal, ProtocolError, UdaError
 from uda_tpu.utils.failpoints import failpoint
@@ -285,6 +286,14 @@ class UdaBridge:
             else:
                 raise ProtocolError(
                     f"INIT needs >= 4 params, got {len(params)}")
+            # INIT-time admission: the fetch-window + staging working
+            # set must fit the host budget (the reducer.cc:56-133
+            # buffer validation, generalized). Over budget either
+            # shrinks the window in cfg with a warning (enforce=
+            # reroute) or raises -> the fallback contract (enforce=
+            # reject); an unfittable chunk always raises. Runs BEFORE
+            # the MergeManager reads the window.
+            MemoryBudget.from_config(self.cfg).validate_init(self.cfg)
             client = self._make_client(local_dirs)
             # fetch progress -> fetchOverMessage, the reference cadence:
             # one up-call per PROGRESS_INTERVAL fetched segments plus one

@@ -20,12 +20,16 @@ from __future__ import annotations
 
 import enum
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import numpy as np
 
 from uda_tpu.utils.errors import MergeError
+from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
+
+log = get_logger()
 
 __all__ = ["SlotState", "BufferSlot", "BufferArena"]
 
@@ -74,7 +78,9 @@ class BufferArena:
     (reducer.cc:100-133).
     """
 
-    def __init__(self, num_slots: int, slot_size: int):
+    def __init__(self, num_slots: int, slot_size: int,
+                 on_pressure: Optional[Callable[[float], None]] = None,
+                 pressure_after_s: float = 1.0):
         if num_slots <= 0 or slot_size <= 0:
             raise MergeError("arena needs positive slot count and size")
         self.slot_size = slot_size
@@ -83,19 +89,67 @@ class BufferArena:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self.num_slots = num_slots
+        # soft-pressure hook: an acquire that waits past the threshold
+        # reports the exhaustion ONCE per acquire (uda.tpu.arena.
+        # pressure.s) — the signal a budget/stats layer uses to observe
+        # "free slots stay exhausted" without ever blocking the arena
+        self.on_pressure = on_pressure
+        self.pressure_after_s = max(0.0, pressure_after_s)
 
     def acquire(self, owner=None, timeout: Optional[float] = None) -> BufferSlot:
+        """Block until a slot frees. ``timeout`` is a TOTAL monotonic
+        deadline across every wakeup — a notify/spurious wakeup that
+        finds the free list empty resumes the SAME deadline instead of
+        restarting the clock (the pre-fix bug: each loop iteration
+        re-waited the full timeout, so a caller racing busy releasers
+        could wait far longer than requested)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        t0 = time.monotonic()
+        pressured = False
         with metrics.timer("wait_mem"):
             with self._cv:
                 while not self._free:
-                    if not self._cv.wait(timeout=timeout):
-                        raise MergeError("timed out waiting for a staging slot")
+                    now = time.monotonic()
+                    remaining = (None if deadline is None
+                                 else deadline - now)
+                    if remaining is not None and remaining <= 0:
+                        raise MergeError(
+                            f"timed out waiting for a staging slot "
+                            f"({timeout:g} s total deadline)")
+                    wait_s = remaining
+                    if (not pressured and self.on_pressure is not None):
+                        to_pressure = self.pressure_after_s - (now - t0)
+                        if to_pressure <= 0:
+                            pressured = True
+                            # drop the lock around the hook: a callback
+                            # that reads arena state (free_slots) would
+                            # otherwise self-deadlock
+                            self._cv.release()
+                            try:
+                                self._pressure(now - t0)
+                            finally:
+                                self._cv.acquire()
+                            continue
+                        wait_s = (to_pressure if wait_s is None
+                                  else min(wait_s, to_pressure))
+                    self._cv.wait(timeout=wait_s)
                 slot = self._free.pop()
         metrics.gauge_add("arena.slots_in_use", 1)
         slot.state = SlotState.FETCH_READY
         slot.length = 0
         slot.owner = owner
         return slot
+
+    def _pressure(self, waited_s: float) -> None:
+        """Fire the soft-pressure callback (caller holds the lock; the
+        hook must be cheap and non-blocking — it is observability, not
+        control flow, and its errors never fail the acquire)."""
+        metrics.add("arena.pressure_events")
+        try:
+            self.on_pressure(waited_s)
+        except Exception as e:  # noqa: BLE001
+            log.warn(f"arena pressure callback failed: {e}")
 
     def try_acquire(self, owner=None) -> Optional[BufferSlot]:
         with self._cv:

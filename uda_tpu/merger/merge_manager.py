@@ -35,6 +35,7 @@ from typing import Callable, Optional, Sequence
 from uda_tpu.merger.emitter import FramedEmitter
 from uda_tpu.merger.segment import InputClient, Segment
 from uda_tpu.ops import merge as merge_ops
+from uda_tpu.utils.budget import MemoryBudget
 from uda_tpu.utils.comparators import KeyType, get_key_type
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import FallbackSignal, MergeError, UdaError
@@ -43,6 +44,7 @@ from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 from uda_tpu.utils.retry import RetryPolicy
+from uda_tpu.utils.watchdog import StallError, StallWatchdog
 
 __all__ = ["MergeManager", "PenaltyBox", "PROGRESS_INTERVAL"]
 
@@ -133,6 +135,21 @@ class MergeManager:
         if self.cfg.get("uda.tpu.stats.enable"):
             metrics.enable_stats()
         self._stop = threading.Event()
+        # admission control + liveness (uda_tpu.utils.budget/.watchdog):
+        # the budget is built lazily (platform detection must not run
+        # for explicitly-configured approaches), the watchdog per run()
+        self._budget_obj: Optional[MemoryBudget] = None
+        self.last_admission = None     # routing decision (tests/diag)
+        self._live_segments: list[Segment] = []
+        self._active_overlap = None
+        self._watchdog: Optional[StallWatchdog] = None
+        self._stall_error: Optional[StallError] = None
+        self._emit_progress = 0
+
+    def budget(self) -> MemoryBudget:
+        if self._budget_obj is None:
+            self._budget_obj = MemoryBudget.from_config(self.cfg)
+        return self._budget_obj
 
     # -- fetch phase --------------------------------------------------------
 
@@ -202,24 +219,72 @@ class MergeManager:
             if self.progress and d % PROGRESS_INTERVAL == 0:
                 self.progress(d, len(segs))
 
+        started: list[Segment] = []
+
+        def drained() -> bool:
+            with done_lock:
+                return done >= len(started)
+
+        def stop_drain() -> None:
+            """The stop path must not abandon in-flight segments: abort
+            the overlapped merger first (a completion thread blocked in
+            its bounded feed() would otherwise never deliver on_done),
+            administratively fail every started segment (idempotent —
+            already-finished ones keep their outcome), then wait for the
+            on_done callbacks so credits/progress are fully accounted
+            before the caller sees the error."""
+            om = (self._active_overlap if on_segment is not None
+                  else None)
+            if om is not None:
+                om.abort()
+            error = self._stall_error or MergeError(
+                "merge manager stopped during fetch")
+            for s in started:
+                s.fail(error)
+            deadline = time.monotonic() + 10.0
+            while not drained() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            if not drained():
+                log.warn("stop drain: some fetch completions did not "
+                         "deliver within 10 s; proceeding")
+
+        self._live_segments = segs
         with metrics.timer("fetch"):
             pending = deque(order)
             while pending:
-                credits.acquire()
+                # stop-responsive credit wait: stop() (watchdog rescue,
+                # reduce_exit) must break a fetch loop that is blocked
+                # on credits held by wedged segments
+                while not credits.acquire(timeout=0.25):
+                    if self._stop.is_set():
+                        break
                 if self._stop.is_set():
-                    raise MergeError("merge manager stopped during fetch")
+                    stop_drain()
+                    raise (self._stall_error
+                           or MergeError("merge manager stopped during "
+                                         "fetch"))
                 i = self._next_fetch_index(pending, segs, supplier_of)
                 segs[i].on_done = on_done
                 segs[i].on_fault = on_fault
+                started.append(segs[i])
                 segs[i].start()
             for s in segs:
                 s.wait()
             # a segment's _done fires BEFORE its on_done callback runs:
             # wait for the callbacks too, or a caller could finalize its
             # on_segment consumer (e.g. the overlapped merger) while the
-            # last completion is still being delivered
+            # last completion is still being delivered. Stop-aware: a
+            # completion thread can be wedged INSIDE an on_segment
+            # consumer (e.g. blocked in the overlapped merger's bounded
+            # feed) — a watchdog/stop() must be able to break this wait
+            # too, not only the credit wait above
             if segs:
-                all_notified.wait()
+                while not all_notified.wait(timeout=0.25):
+                    if self._stop.is_set():
+                        stop_drain()
+                        raise (self._stall_error
+                               or MergeError("merge manager stopped "
+                                             "during fetch"))
         if cb_errors:
             raise cb_errors[0]
         if self.progress:
@@ -271,45 +336,155 @@ class MergeManager:
         so the consumer falls back to its vanilla path instead of
         crashing on an internal type (the reference's ``failureInUda``
         flip, UdaBridge.cc:506-530). Non-UdaError exceptions (embedder
-        bugs, injected foreign errors) propagate unwrapped."""
+        bugs, injected foreign errors) propagate unwrapped.
+
+        Liveness contract (``uda.tpu.watchdog.stall.s`` > 0): a stall
+        watchdog samples the task's progress counters; when nothing
+        advances for the deadline it dumps every thread stack + the span
+        tree and (``uda.tpu.watchdog.fallback``, default on) fails the
+        in-flight segments so this call terminates with a
+        ``FallbackSignal(StallError)`` instead of hanging forever."""
+        # task-local emit progress (the watchdog token must not read
+        # process-global counters — another task's emission would mask
+        # this one's wedge); counted AFTER delivery so a consumer that
+        # never returns reads as a stall
+        self._emit_progress = 0
+
+        def tracked_consumer(block: memoryview) -> None:
+            consumer(block)
+            self._emit_progress += len(block)
+
+        wd = self._start_watchdog(reduce_id)
         try:
             # the trace root: every phase timer and per-segment fetch
             # span below hangs off this reduce-task span
             with metrics.span("reduce_task", job=job_id, reduce=reduce_id,
                               maps=len(map_ids)):
-                return self._run(job_id, map_ids, reduce_id, consumer)
+                return self._run(job_id, map_ids, reduce_id,
+                                 tracked_consumer)
         except FallbackSignal:
             raise
         except UdaError as e:
+            # a watchdog rescue surfaces through whichever waiter woke
+            # first (a failed segment's wait, the stopped fetch loop);
+            # report the STALL as the root cause, not the wake artifact
+            stall = self._stall_error
+            if stall is not None and not isinstance(e, StallError):
+                e = stall
             metrics.add("fallback.signals")
             log.error(f"merge failed terminally, requesting fallback: {e}")
             raise FallbackSignal(e) from e
+        finally:
+            if wd is not None:
+                wd.stop()
+                self._watchdog = None
+
+    # -- liveness -----------------------------------------------------------
+
+    def _progress_token(self) -> tuple:
+        """THIS task's progress signature, sampled by the watchdog.
+        Deliberately task-local — built from this manager's own
+        segments, overlapped merger and emit counter, never the
+        process-global metrics hub: a co-located task's counters
+        advancing must not mask this one's wedge. Any component
+        changing (bytes fetched, retries consumed, segments finishing,
+        runs staged/merged/pending, bytes delivered) counts as alive."""
+        segs = self._live_segments
+        ndone = nrec = noff = nret = 0
+        for s in segs:
+            nrec += s.num_records
+            noff += s._next_offset
+            nret += s._retries_left
+            if s._done.is_set():
+                ndone += 1
+        om = self._active_overlap
+        om_sig = ((om.stats["staged_runs"], om.stats["device_merges"],
+                   om.stats["pending"]) if om is not None else ())
+        return (len(segs), ndone, nrec, noff, nret, om_sig,
+                getattr(self, "_emit_progress", 0))
+
+    def _start_watchdog(self, reduce_id: int) -> Optional[StallWatchdog]:
+        stall_s = float(self.cfg.get("uda.tpu.watchdog.stall.s"))
+        if stall_s <= 0:
+            return None
+        on_stall = (self._on_stall
+                    if self.cfg.get("uda.tpu.watchdog.fallback") else None)
+        wd = StallWatchdog(stall_s, self._progress_token,
+                           on_stall=on_stall,
+                           name=f"uda-watchdog-r{reduce_id}")
+        self._watchdog = wd
+        return wd.start()
+
+    def _on_stall(self, err: StallError) -> None:
+        """Watchdog rescue (runs on the watchdog thread): record the
+        stall, stop the manager (breaks the fetch loop's credit and
+        all-notified waits), abort the overlapped merger (unblocks
+        completion threads wedged in its bounded feed / stager loops),
+        and administratively fail every live segment so blocked waiters
+        wake — the failure then flows through the normal FallbackSignal
+        contract. A wedge inside the embedder's consumer callback itself
+        cannot be interrupted from here; it still gets the diagnostic
+        dump."""
+        self._stall_error = err
+        self._stop.set()
+        try:
+            self.client.stop()
+        except Exception as e:  # noqa: BLE001 - rescue must not die here
+            log.warn(f"watchdog: client stop failed: {e}")
+        om = self._active_overlap
+        if om is not None:
+            try:
+                om.abort()
+            except Exception as e:  # noqa: BLE001
+                log.warn(f"watchdog: overlap abort failed: {e}")
+        for seg in list(self._live_segments):
+            try:
+                seg.fail(err)
+            except Exception as e:  # noqa: BLE001
+                log.warn(f"watchdog: failing segment "
+                         f"{seg.map_id} raised: {e}")
 
     def _run(self, job_id: str, map_ids: Sequence, reduce_id: int,
              consumer: Callable[[memoryview], None]) -> int:
         approach = self.cfg.get("mapred.netmerger.merge.approach")
         streaming = bool(self.cfg.get("uda.tpu.online.streaming"))
+        self.last_admission = None  # per-run routing record
         if approach == 0:
             # Auto policy (beyond the reference, which made the user
-            # pick via mapred.netmerger.merge.approach): choose by the
-            # transport's size estimate using the measured crossover —
-            # hybrid LPQ/RPQ is fastest at small/mid scale (1.05 GB:
-            # 102 s vs streaming 192 s) while streaming online wins at
-            # scale with O(window) host memory (10.24 GB: 579 s vs
-            # 866 s at a third of the RSS) — REGRESSION_cpu_
-            # x{,x}large_r05.json. Unknown size -> streaming: bounded
-            # memory is the only safe default for an unbounded input.
+            # pick via mapred.netmerger.merge.approach), now budget-
+            # aware (uda_tpu.utils.budget): the transport's size
+            # estimate routes through MemoryBudget.route —
+            #   in budget + small -> hybrid LPQ/RPQ (fastest at
+            #     small/mid scale: 1.05 GB: 102 s vs streaming 192 s);
+            #   in budget + large -> streaming online (wins at scale
+            #     with O(window) host memory: 10.24 GB: 579 s vs 866 s
+            #     at a third of the RSS) — REGRESSION_cpu_
+            #     x{,x}large_r05.json;
+            #   over the HBM/host budget -> streaming with bounded
+            #     device runs (the degradation, never an OOM);
+            #   over the hard ceiling (uda.tpu.budget.hard.mb) ->
+            #     FallbackSignal BEFORE any fetch or allocation;
+            #   unknown size -> streaming: bounded memory is the only
+            #     safe default for an unbounded input.
             est = self.client.estimate_partition_bytes(
                 job_id, map_ids, reduce_id)
             threshold = (self.cfg.get("uda.tpu.auto.approach.threshold.mb")
                          * (1 << 20))
-            if est is not None and est <= threshold:
+            adm = self.budget().route(est, threshold)
+            self.last_admission = adm
+            if adm.rejected:
+                raise UdaError(
+                    f"partition refused by admission control: "
+                    f"{adm.reason} — falling back to the vanilla path "
+                    f"(raise uda.tpu.budget.hard.mb to admit)")
+            if adm.decision == "hybrid":
                 approach = 2
             else:
                 approach, streaming = 1, True
             log.info(f"auto merge approach: estimate="
                      f"{'unknown' if est is None else est} bytes -> "
-                     f"{'hybrid' if approach == 2 else 'streaming online'}")
+                     f"{'hybrid' if approach == 2 else 'streaming online'}"
+                     f" ({adm.reason})")
         if approach == 2:
             from uda_tpu.merger.hybrid import run_hybrid
             return run_hybrid(self, job_id, map_ids, reduce_id, consumer)
@@ -332,10 +507,18 @@ class MergeManager:
 
             store = RunStore(spill_dirs(self.cfg),
                              tag=f"{job_id}.r{reduce_id}")
+        # admission may have rerouted here BECAUSE the device row forest
+        # would blow the HBM budget: then the streaming merger must not
+        # stage runs to the device at all — run files + bounded k-way
+        # merge instead ("streaming with bounded device runs")
+        adm = self.last_admission
+        bounded_device = (streaming and adm is not None
+                          and adm.cause == "hbm")
         om = OverlappedMerger(
             self.key_type, self.key_width, run_store=store,
             max_pending=self.window if streaming else 0,
-            stagers=self.cfg.get("uda.tpu.online.stagers"))
+            stagers=self.cfg.get("uda.tpu.online.stagers"),
+            device_runs=not bounded_device)
         self._active_overlap = om  # observability (tests/diagnostics)
         try:
             # feed the Segment itself: record_batch() (a full concat of

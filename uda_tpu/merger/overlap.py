@@ -131,9 +131,22 @@ class OverlappedMerger:
     """
 
     def __init__(self, key_type: KeyType, width: int, engine: str = "auto",
-                 run_store=None, max_pending: int = 0, stagers: int = 0):
+                 run_store=None, max_pending: int = 0, stagers: int = 0,
+                 device_runs: bool = True):
         self.key_type = key_type
         self.width = width
+        # device_runs=False (streaming mode only): admission control
+        # decided the full row forest would not fit the HBM budget —
+        # segments still spool to sorted run files, but no run is ever
+        # staged to the device; finish_streaming() merges the run FILES
+        # with the bounded k-way path instead of the device forest.
+        # Run files are written in (words, len) row order, which equals
+        # comparator order for within-width keys, so the k-way merge is
+        # correct on both the fast path and the overflow path.
+        self.device_runs = bool(device_runs)
+        if not self.device_runs and run_store is None:
+            raise MergeError("device_runs=False requires streaming mode "
+                             "(a run store)")
         if engine == "auto":
             engine = "host" if jax.default_backend() == "cpu" else "pallas"
         if engine not in ("host", "pallas"):
@@ -303,7 +316,7 @@ class OverlappedMerger:
         with self._state_lock:
             self._staged += 1
         metrics.add("merge.records", n)
-        if self._overflow:
+        if self._overflow or not self.device_runs:
             return  # forest output won't be consumed; runs are enough
         with metrics.timer("overlap_stage"):
             if self.engine == "pallas":
@@ -486,21 +499,27 @@ class OverlappedMerger:
         if store is None:
             raise MergeError("finish_streaming without a run store")
         try:
+            no_forest = self._overflow or not self.device_runs
             with metrics.timer("merge"):
                 self._drain()
-                acc = None if self._overflow else self._merge_leftovers()
+                acc = None if no_forest else self._merge_leftovers()
             total = store.total_records
             if expected_records is not None and total != expected_records:
                 raise MergeError(
                     f"staged {total} of {expected_records} records")
             if total == 0:
                 return emitter.emit_framed(iter([EOF_MARKER]), consumer)
-            if self._overflow:
+            if no_forest:
                 # every run is comparator-sorted (oversize segments were
-                # ordered by the full comparator at staging), so the
+                # ordered by the full comparator at staging; in-width
+                # runs by (words, len) == comparator order), so the
                 # fallback is a comparator-level k-way merge over the
                 # run FILES — bounded memory, like the hybrid RPQ
-                self._warn_overflow("k-way merge over run files")
+                if self._overflow:
+                    self._warn_overflow("k-way merge over run files")
+                else:
+                    log.info("bounded-device streaming: k-way merge "
+                             "over run files (no device forest)")
                 paths = [store.run_path(s) for s in sorted(store.counts)]
                 if (native_enabled() and native.kway_supported(self.key_type)
                         and native.build()):

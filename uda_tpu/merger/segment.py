@@ -212,6 +212,18 @@ class Segment:
         if cb is not None:
             cb(self)
 
+    def _finish(self, error: Optional[Exception]) -> bool:
+        """The ONLY terminal transition: first caller wins, every other
+        (a concurrent fail() racing the drive loop's own terminal path)
+        is a no-op — on_done must fire exactly once."""
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._error = error
+            self._done.set()
+        self._notify_done()
+        return True
+
     # -- fetch driving ------------------------------------------------------
 
     _PENDING = object()  # sentinel: no inline completion delivered
@@ -243,6 +255,12 @@ class Segment:
         req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
                              offset, self.chunk_size, host=self.host)
         with self._lock:
+            if self._done.is_set():
+                # administratively failed (fail()) while a retry backoff
+                # timer was pending: the segment is finished — issuing
+                # would open a fresh epoch on a dead segment and fire
+                # on_done twice when it completed
+                return None
             self._inline = self._PENDING
             self._issuing = True
             self._epoch += 1
@@ -356,9 +374,7 @@ class Segment:
                         metrics.add("fetch.deadline_exceeded")
                         log.warn(f"fetch of {self.map_id} gave up: "
                                  f"deadline passed with retries left")
-                    self._error = result
-                    self._done.set()
-                    self._notify_done()
+                    self._finish(result)
                     return
                 log.warn(f"fetch of {self.map_id} failed ({result}); "
                          f"retrying ({self._retries_left} left)")
@@ -400,17 +416,14 @@ class Segment:
             try:
                 last = self._ingest(result)
             except Exception as e:  # crack errors -> surfaced to waiter
-                self._error = e
-                self._done.set()
-                self._notify_done()
+                self._finish(e)
                 return
             # notify exactly once, outside _ingest's try scope: an
             # exception thrown by the on_done callback itself must NOT
             # re-enter the error path above and fire on_done a second
             # time (double credit release / double progress count)
             if last:
-                self._done.set()
-                self._notify_done()
+                self._finish(None)
                 return
             result = self._try_issue(self._next_offset)
 
@@ -444,6 +457,33 @@ class Segment:
                         supplier=self.supplier)
         metrics.observe("fetch.chunk.bytes", len(res.data))
         return last
+
+    def fail(self, exc: Exception) -> bool:
+        """Administratively terminate the fetch (watchdog rescue / stop-
+        path drain): the segment completes NOW with ``exc`` and every
+        waiter wakes. The outstanding attempt's epoch is invalidated, so
+        a transport completion that eventually arrives (e.g. a wedged
+        worker finishing minutes later) is dropped as stale instead of
+        double-driving the state machine. Returns False when the segment
+        had already finished (success or error) — fail() never rewrites
+        history. Safe from any thread; fires on_done (credit release)
+        exactly once like every other terminal path."""
+        with self._lock:
+            if self._done.is_set():
+                return False
+            had_open_epoch = not self._epoch_settled
+            self._epoch += 1          # outstanding completion -> stale
+            self._epoch_settled = True
+        if had_open_epoch:
+            # settle the abandoned attempt's on-air accounting (its own
+            # completion, if it ever lands, sees a stale epoch and must
+            # not decrement a second time)
+            metrics.gauge_add("fetch.on_air", -1)
+        self._cancel_timeout()
+        if not self._finish(exc):
+            return False  # a real terminal path won the race
+        metrics.add("fetch.failed_admin")
+        return True
 
     # -- consumption --------------------------------------------------------
 

@@ -196,6 +196,19 @@ class DataEngine:
             * max(1, num_disks)
         self.chunk_size_default = cfg.get("mapred.rdma.buf.size") * 1024
         self._crc = bool(cfg.get("uda.tpu.fetch.crc"))
+        # read-pool admission (the reference's 1000-chunk pool bound,
+        # IndexInfo.cc:276-292, minus the blocking: submit() must stay
+        # non-blocking — see the module docstring — so over-budget
+        # requests are REJECTED with StorageError and the reduce side's
+        # retry/backoff machinery absorbs the push-back). The budget
+        # covers bytes queued or being read; 0 = a 256 MB floor scaled
+        # by the reader thread count.
+        budget_mb = int(cfg.get("uda.tpu.supplier.read.budget.mb"))
+        if budget_mb <= 0:
+            budget_mb = max(256, threads * 32)
+        self.read_budget_bytes = budget_mb * (1 << 20)
+        self._admitted_bytes = 0
+        self._admit_lock = threading.Lock()
         spec = cfg.get("uda.tpu.failpoints")
         if spec:
             failpoints.arm_spec(spec)
@@ -231,21 +244,45 @@ class DataEngine:
         failpoint_no_deadlock)."""
         if self._stopped:
             raise StorageError("DataEngine is stopped")
+        want = req.chunk_size or self.chunk_size_default
+        with self._admit_lock:
+            # an oversized single request is admitted when the pool is
+            # otherwise idle: progress beats the bound (a request larger
+            # than the whole budget could never be served at all, which
+            # would turn push-back into a permanent dead end)
+            if self._admitted_bytes > 0 and \
+                    self._admitted_bytes + want > self.read_budget_bytes:
+                metrics.add("supplier.admission.rejections")
+                raise StorageError(
+                    f"supplier read pool exhausted: {self._admitted_bytes}"
+                    f" B in flight + {want} B > budget "
+                    f"{self.read_budget_bytes} B (retry with backoff, or "
+                    f"raise uda.tpu.supplier.read.budget.mb)")
+            self._admitted_bytes += want
+        metrics.gauge_add("supplier.read.bytes.on_air", want)
         metrics.gauge_add("supplier.reads.on_air", 1)
         try:
-            return self._pool.submit(self._serve, req)
-        except BaseException:  # pool shutdown race: undo the on-air count
+            return self._pool.submit(self._serve, req, want)
+        except BaseException:  # pool shutdown race: undo the accounting
+            self._unadmit(want)
             metrics.gauge_add("supplier.reads.on_air", -1)
             raise
+
+    def _unadmit(self, want: int) -> None:
+        with self._admit_lock:
+            self._admitted_bytes -= want
+        metrics.gauge_add("supplier.read.bytes.on_air", -want)
 
     def fetch(self, req: ShuffleRequest) -> FetchResult:
         return self.submit(req).result()
 
-    def _serve(self, req: ShuffleRequest) -> FetchResult:
+    def _serve(self, req: ShuffleRequest, admitted: int = 0) -> FetchResult:
         t0 = time.perf_counter()
         try:
             return self._serve_inner(req)
         finally:
+            if admitted:
+                self._unadmit(admitted)
             metrics.gauge_add("supplier.reads.on_air", -1)
             metrics.observe("supplier.read.latency_ms",
                             (time.perf_counter() - t0) * 1e3)

@@ -1,0 +1,370 @@
+"""Memory admission control: budgets, the device-bytes model, routing.
+
+The reference validated every buffer budget at INIT and failed into the
+vanilla path when the pool could not fit (handle_init_msg, reference
+src/Merger/reducer.cc:56-133) and *blocked* on chunk-pool exhaustion
+instead of dying (occupy_chunk, reference
+src/MOFServer/IndexInfo.cc:276-292). This engine's equivalent exposure
+is the device row matrix: the global sort holds ~27 uint32 words per
+record device-resident (~108 B/record at the TeraSort shape, ≈1.08x the
+shuffle bytes — VERDICT.md Missing #4), so a >10 GB per-chip partition
+OOMs a 16 GB v5e with no graceful route, and on CPU the same rows are
+host RSS (the 9.3 GB xxlarge symptom).
+
+:class:`MemoryBudget` is the front door: per-chip HBM and host-RSS
+budgets (``uda.tpu.hbm.budget.mb`` / ``uda.tpu.host.budget.mb``,
+defaults derived from the detected platform), an estimator that converts
+the transport's on-disk partition estimate into row-matrix +
+working-set bytes, and two admission points:
+
+- :meth:`validate_init` — the INIT-time buffer-budget check (the
+  reducer.cc:56-133 mirror): the fetch window + staging arena working
+  set must fit the host budget; over-budget either shrinks the window
+  (``uda.tpu.budget.enforce=reroute``, warn like the reference's
+  buffer shrink) or raises (``=reject``, the fallback path);
+- :meth:`route` — the merge-approach decision (consumed by
+  ``MergeManager._run``'s auto policy): in-budget partitions keep the
+  fast hybrid/in-memory path, partitions whose device estimate exceeds
+  the HBM budget are rerouted to bounded-memory streaming, and
+  partitions above the hard ceiling (``uda.tpu.budget.hard.mb``) are
+  rejected *before any allocation* — the caller raises
+  ``FallbackSignal``. Unknown estimates route to streaming (bounded
+  memory is the only safe default for an unbounded input).
+
+Every decision is logged and counted (``budget.admitted`` /
+``budget.rerouted`` / ``budget.rejected``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+from uda_tpu.utils.errors import UdaError
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["MemoryBudget", "Admission", "device_bytes_estimate",
+           "ROW_OVERHEAD_WORDS", "WORKING_SET_FACTOR",
+           "HBM_RESERVE_FRACTION", "PLATFORM_HBM_MB"]
+
+log = get_logger()
+
+MB = 1 << 20
+
+# -- the device-bytes model (VERDICT.md Missing #4) -------------------------
+#
+# Per record the engine holds one uint32 row of (key words, content
+# length, segment index, row index) = key_width/4 + ROW_OVERHEAD_WORDS
+# words. At the TeraSort shape the *sort-network* ladder carries ~27
+# words/record (~108 B, ≈1.08x shuffle bytes): key + payload surrogate
+# columns ride along on the fully device-resident sort path. The
+# admission model uses the larger of the two (row matrix vs the 1.08x
+# sort ladder) so it is conservative for both the forest-merge and the
+# whole-run-sort engines.
+ROW_OVERHEAD_WORDS = 3        # length, segment index, row index columns
+SORT_LADDER_RATIO = 1.08      # device bytes / shuffle bytes, TeraSort shape
+RECORD_BYTES_DEFAULT = 100    # TeraSort record (10 B key + 90 B value)
+
+# Transient working set: a pairwise merge holds both operands plus the
+# output simultaneously, and binary-counter runs pad to a power of two —
+# 2x the resident matrix bounds both.
+WORKING_SET_FACTOR = 2.0
+
+# Fraction of physical HBM the budget may claim by default (the rest is
+# XLA scratch, compiled executables, and the exchange path's buffers).
+HBM_RESERVE_FRACTION = 0.9
+
+# Known per-chip HBM sizes by TPU device-kind substring, FIRST MATCH
+# WINS (VERDICT.md ask #3 names v5e and v5p; the rest are the published
+# per-chip figures). Order matters: every v5e/lite spelling (libtpu
+# reports e.g. "TPU v5 lite") must match before "v5p", and a BARE "v5"
+# resolves to the small end — over-budgeting a 16 GB chip as 95 GB
+# would silently re-open the exact OOM this layer exists to prevent.
+PLATFORM_HBM_MB = (
+    ("v5litepod", 16 * 1024),   # v5e: 16 GB/chip
+    ("v5 lite", 16 * 1024),
+    ("v5lite", 16 * 1024),
+    ("v5e", 16 * 1024),
+    ("v5p", 95 * 1024),         # v5p: 95 GB/chip
+    ("v6e", 32 * 1024),
+    ("v6", 32 * 1024),
+    ("v4", 32 * 1024),
+    ("v3", 16 * 1024),
+    ("v2", 8 * 1024),
+    ("v5", 16 * 1024),          # bare v5: assume the small end
+)
+DEFAULT_HBM_MB = 16 * 1024      # unknown accelerator: assume the small end
+
+
+def _host_available_mb() -> int:
+    """Best-effort available host memory (MemAvailable, else MemTotal,
+    else a conservative 4 GB)."""
+    try:
+        with open("/proc/meminfo") as f:
+            text = f.read()
+        for key in ("MemAvailable", "MemTotal"):
+            m = re.search(rf"^{key}:\s+(\d+)\s*kB", text, re.M)
+            if m:
+                return int(m.group(1)) // 1024
+    except OSError:
+        pass
+    return 4 * 1024
+
+
+def _detect_hbm_mb() -> int:
+    """Per-chip HBM of the ambient backend. On CPU backends the 'device'
+    rows live in host RSS, so the HBM budget IS the host budget (the
+    xxlarge-rung reality). jax import stays lazy: admission must not
+    drag a backend up in processes that never touch the device."""
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        if backend == "cpu":
+            return _host_available_mb()
+        kind = str(jax.devices()[0].device_kind).lower()
+        for sub, mb in PLATFORM_HBM_MB:
+            if sub in kind:
+                return mb
+    except Exception as e:  # noqa: BLE001 - detection is best effort
+        log.warn(f"HBM budget autodetect failed ({e}); "
+                 f"assuming {DEFAULT_HBM_MB} MB")
+    return DEFAULT_HBM_MB
+
+
+def device_bytes_estimate(partition_bytes: int, key_width: int,
+                          record_bytes: int = RECORD_BYTES_DEFAULT) -> int:
+    """Device-resident bytes the merge would hold for a partition of
+    ``partition_bytes`` on-disk bytes: max(row matrix, sort ladder) x
+    the transient working-set factor. Conservative by construction —
+    admission errs toward the bounded path."""
+    if partition_bytes <= 0:
+        return 0
+    row_bytes = 4 * (max(4, key_width) // 4 + ROW_OVERHEAD_WORDS)
+    records = max(1, partition_bytes // max(1, record_bytes))
+    row_matrix = records * row_bytes
+    ladder = int(partition_bytes * SORT_LADDER_RATIO)
+    return int(max(row_matrix, ladder) * WORKING_SET_FACTOR)
+
+
+@dataclasses.dataclass(frozen=True)
+class Admission:
+    """One routing decision: which path the partition was admitted to
+    and why — the logged/counted record of the budget layer."""
+
+    decision: str                 # "in_memory" | "hybrid" | "streaming"
+    #                             | "reject"
+    reason: str                   # human-readable (logs only — never
+    #                             branch on this string)
+    estimate_bytes: Optional[int]   # transport estimate (None = unknown)
+    device_bytes: Optional[int]     # modeled device working set
+    hbm_budget_bytes: int
+    host_budget_bytes: int
+    # structured decision basis — what callers branch on: which budget
+    # forced the decision ("hbm" | "host" | "hard" | "init" | "", the
+    # empty string meaning no budget was binding)
+    cause: str = ""
+    rerouted: bool = False
+
+    @property
+    def rejected(self) -> bool:
+        return self.decision == "reject"
+
+
+class MemoryBudget:
+    """Per-chip HBM + host-RSS budgets with lazy platform detection.
+
+    Budgets resolve in this order: explicit config knob > platform
+    default (detected HBM x HBM_RESERVE_FRACTION; available host memory
+    x ``mapred.job.shuffle.input.buffer.percent``). Detection runs at
+    most once per instance and only when a budget is actually read.
+    """
+
+    def __init__(self, hbm_budget_mb: int = 0, host_budget_mb: int = 0,
+                 hard_ceiling_mb: int = 0, key_width: int = 16,
+                 host_fraction: float = 0.7, enforce: str = "reroute"):
+        self._hbm_mb = int(hbm_budget_mb)
+        self._host_mb = int(host_budget_mb)
+        self.hard_ceiling_mb = int(hard_ceiling_mb)
+        self.key_width = int(key_width)
+        self.host_fraction = float(host_fraction)
+        if enforce not in ("reroute", "reject"):
+            raise UdaError(f"uda.tpu.budget.enforce must be 'reroute' or "
+                           f"'reject', got {enforce!r}")
+        self.enforce = enforce
+
+    @classmethod
+    def from_config(cls, cfg) -> "MemoryBudget":
+        return cls(
+            hbm_budget_mb=cfg.get("uda.tpu.hbm.budget.mb"),
+            host_budget_mb=cfg.get("uda.tpu.host.budget.mb"),
+            hard_ceiling_mb=cfg.get("uda.tpu.budget.hard.mb"),
+            key_width=cfg.get("uda.tpu.key.width"),
+            host_fraction=cfg.get(
+                "mapred.job.shuffle.input.buffer.percent"),
+            enforce=cfg.get("uda.tpu.budget.enforce"))
+
+    @property
+    def hbm_budget_bytes(self) -> int:
+        if self._hbm_mb <= 0:
+            self._hbm_mb = max(
+                1, int(_detect_hbm_mb() * HBM_RESERVE_FRACTION))
+        return self._hbm_mb * MB
+
+    @property
+    def host_budget_bytes(self) -> int:
+        if self._host_mb <= 0:
+            self._host_mb = max(
+                1, int(_host_available_mb() * self.host_fraction))
+        return self._host_mb * MB
+
+    @property
+    def hard_ceiling_bytes(self) -> int:
+        """Estimate above which even the degraded paths are refused
+        (0 = no ceiling): spool disk, emit wall-clock and the consumer
+        side all scale with the partition, and past this point the
+        embedder's vanilla path is the better failure mode."""
+        return self.hard_ceiling_mb * MB
+
+    def device_bytes(self, partition_bytes: int) -> int:
+        return device_bytes_estimate(partition_bytes, self.key_width)
+
+    # -- admission point 1: INIT buffer validation --------------------------
+
+    def validate_init(self, cfg) -> Admission:
+        """The reducer.cc:56-133 mirror: the fetch-window + staging-
+        arena working set (window x chunk in-flight fetch bytes, arena
+        slots, the emitter's double buffer) must fit the host budget.
+        Over budget: ``enforce=reroute`` shrinks the window to fit and
+        warns (the reference's buffer-shrink path); ``enforce=reject``
+        raises ``UdaError`` (-> the fallback contract). A chunk that
+        cannot fit even at window 1 always raises (the reference's
+        "RDMA Buffer is too small" hard failure). Mutates ``cfg`` when
+        it shrinks the window; returns the decision record."""
+        chunk = max(1, cfg.get("mapred.rdma.buf.size")) * 1024
+        window = max(1, cfg.get("mapred.rdma.wqe.per.conn"))
+        slots = max(1, cfg.get("uda.tpu.arena.slots"))
+        fixed = (slots + 2) * chunk           # arena + emitter pair
+        budget = self.host_budget_bytes
+        # the HBM side is not consulted at INIT (no partition known yet)
+        # and must not force backend detection in host-only processes
+        hbm = self._hbm_mb * MB if self._hbm_mb > 0 else 0
+        need = window * chunk + fixed
+        if need <= budget:
+            adm = Admission("in_memory", "init-working-set-in-budget",
+                            need, None, hbm, budget)
+            self._record(adm, "budget.admitted")
+            return adm
+        max_window = (budget - fixed) // chunk
+        if max_window < 1:
+            adm = Admission(
+                "reject",
+                f"chunk {chunk} B + {slots}-slot arena cannot fit host "
+                f"budget {budget} B at any window", need, None,
+                hbm, budget, cause="init")
+            self._record(adm, "budget.rejected")
+            raise UdaError(
+                f"Not enough memory for the fetch working set: "
+                f"host budget {budget} B < one {chunk} B chunk plus the "
+                f"{slots}-slot staging arena (reduce the buffer size or "
+                f"raise uda.tpu.host.budget.mb)")
+        if self.enforce == "reject":
+            adm = Admission(
+                "reject",
+                f"window {window} x {chunk} B exceeds host budget "
+                f"{budget} B (enforce=reject)", need, None,
+                hbm, budget, cause="init")
+            self._record(adm, "budget.rejected")
+            raise UdaError(
+                f"fetch window over budget: {window} x {chunk} B + "
+                f"{fixed} B fixed > host budget {budget} B")
+        cfg.set("mapred.rdma.wqe.per.conn", int(max_window))
+        log.warn(f"shrinking fetch window {window} -> {int(max_window)} "
+                 f"to fit host budget {budget} B "
+                 f"(chunk {chunk} B, arena {slots} slots)")
+        adm = Admission("in_memory",
+                        f"over-host-budget: window shrunk to "
+                        f"{int(max_window)}", need, None,
+                        hbm, budget, cause="host", rerouted=True)
+        self._record(adm, "budget.rerouted")
+        return adm
+
+    # -- admission point 2: merge-approach routing --------------------------
+
+    def route(self, estimate_bytes: Optional[int],
+              threshold_bytes: int) -> Admission:
+        """The budget-aware auto merge-approach decision.
+
+        - unknown estimate -> streaming (bounded memory for unbounded
+          input);
+        - over the hard ceiling -> reject (caller raises
+          ``FallbackSignal`` before any allocation);
+        - device estimate over the HBM budget, or host-resident bytes
+          over the host budget -> streaming with bounded device runs;
+        - small (within the measured hybrid crossover AND in budget) ->
+          hybrid; in-budget above the crossover -> streaming (the
+          measured-fastest large-scale path, which is also bounded).
+        """
+        hbm = self.hbm_budget_bytes
+        host = self.host_budget_bytes
+        if estimate_bytes is None:
+            adm = Admission("streaming", "unknown-estimate", None, None,
+                            hbm, host)
+            self._record(adm, "budget.admitted")
+            return adm
+        dev = self.device_bytes(estimate_bytes)
+        hard = self.hard_ceiling_bytes
+        if hard and estimate_bytes > hard:
+            adm = Admission(
+                "reject", f"over-hard-ceiling: estimate "
+                f"{estimate_bytes} B > {hard} B", estimate_bytes, dev,
+                hbm, host, cause="hard")
+            self._record(adm, "budget.rejected")
+            return adm
+        if dev > hbm:
+            adm = Admission(
+                "streaming", f"over-hbm-budget: device working set "
+                f"{dev} B > {hbm} B", estimate_bytes, dev, hbm, host,
+                cause="hbm", rerouted=True)
+            self._record(adm, "budget.rerouted")
+            return adm
+        # hybrid/in-memory additionally hold the fetched bytes host-
+        # resident through the LPQ spill; gate that on the host budget
+        if estimate_bytes > host:
+            adm = Admission(
+                "streaming", f"over-host-budget: partition "
+                f"{estimate_bytes} B > {host} B", estimate_bytes, dev,
+                hbm, host, cause="host", rerouted=True)
+            self._record(adm, "budget.rerouted")
+            return adm
+        if estimate_bytes <= threshold_bytes:
+            adm = Admission("hybrid", "in-budget-small", estimate_bytes,
+                            dev, hbm, host)
+        else:
+            adm = Admission("streaming", "in-budget-large",
+                            estimate_bytes, dev, hbm, host)
+        self._record(adm, "budget.admitted")
+        return adm
+
+    # -- bookkeeping --------------------------------------------------------
+
+    @staticmethod
+    def _record(adm: Admission, counter: str) -> None:
+        # literal names only: the metrics linter audits call sites
+        if counter == "budget.admitted":
+            metrics.add("budget.admitted")
+        elif counter == "budget.rerouted":
+            metrics.add("budget.rerouted")
+        else:
+            metrics.add("budget.rejected")
+        line = (f"budget {adm.decision}: {adm.reason} "
+                f"(estimate={adm.estimate_bytes}, "
+                f"device={adm.device_bytes}, "
+                f"hbm_budget={adm.hbm_budget_bytes}, "
+                f"host_budget={adm.host_budget_bytes})")
+        if counter == "budget.admitted":
+            log.info(line)
+        else:
+            log.warn(line)

@@ -143,6 +143,42 @@ _FLAG_LIST = [
          "failpoint arming spec, same syntax as UDA_FAILPOINTS: "
          "comma-separated site=action[:arg][:trigger...] entries "
          "(uda_tpu.utils.failpoints)"),
+    # --- memory admission / pressure-response knobs (utils/budget.py) ---
+    Flag("uda.tpu.hbm.budget.mb", 0, int,
+         "per-chip HBM budget for the device row matrix + merge working "
+         "set in MB; 0 = detect the platform (v5e 16 GB, v5p 95 GB, ...) "
+         "and reserve 90% of it (CPU backends use the host budget — the "
+         "'device' rows are host RSS there)"),
+    Flag("uda.tpu.host.budget.mb", 0, int,
+         "host-RSS budget for fetch-window + staging working sets in MB; "
+         "0 = MemAvailable x mapred.job.shuffle.input.buffer.percent"),
+    Flag("uda.tpu.budget.hard.mb", 0, int,
+         "hard admission ceiling on the partition estimate in MB: above "
+         "it the merge refuses the task with FallbackSignal before any "
+         "allocation (0 = no ceiling; the degraded streaming path is "
+         "bounded-memory at any size)"),
+    Flag("uda.tpu.budget.enforce", "reroute", str,
+         "INIT over-budget behavior: 'reroute' shrinks the fetch window "
+         "to fit the host budget with a warning (the reference's buffer-"
+         "shrink, reducer.cc:100-119); 'reject' raises -> fallback"),
+    Flag("uda.tpu.supplier.read.budget.mb", 0, int,
+         "supplier read-pool admission budget in MB: ShuffleRequests "
+         "whose queued+in-flight bytes would exceed it are rejected "
+         "(non-blocking; the reduce side's retry/backoff absorbs the "
+         "push-back — the occupy_chunk pool bound, IndexInfo.cc:276-292)."
+         " 0 = 256 MB floor scaled by the reader thread count"),
+    Flag("uda.tpu.watchdog.stall.s", 0.0, float,
+         "stall watchdog deadline in seconds: no fetch/merge/emit "
+         "progress for this long dumps all thread stacks + the span "
+         "tree and fails the task into the fallback path (0 = off)"),
+    Flag("uda.tpu.watchdog.fallback", True, bool,
+         "when the watchdog fires, fail in-flight segments so the task "
+         "terminates via FallbackSignal (true) or only dump diagnostics "
+         "and keep waiting (false)"),
+    Flag("uda.tpu.arena.pressure.s", 1.0, float,
+         "staging-arena soft-pressure threshold: an acquire that waits "
+         "longer than this fires the arena's pressure callback and "
+         "counts arena.pressure_events"),
     # --- observability knobs (metrics / tracing / stats reporter) ---
     Flag("uda.tpu.stats.enable", False, bool,
          "turn on the optional observability layers (histograms, span "

@@ -73,6 +73,8 @@ METRICS_REGISTRY: Dict[str, tuple] = {
     "fetch.backoff_seconds": ("counter", "seconds spent in retry backoff"),
     "fetch.deadline_exceeded": ("counter", "segments abandoned at the "
                                            "per-segment deadline"),
+    "fetch.failed_admin": ("counter", "segments administratively failed "
+                                      "(watchdog rescue / stop drain)"),
     "fetch.crc_mismatch": ("counter", "chunk CRC validation failures"),
     "fetch.crc_refetch": ("counter", "single-chunk CRC re-fetches"),
     "fetch.penalties": ("counter", "suppliers boxed after repeated "
@@ -81,6 +83,22 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                        "supplier"),
     "fallback.signals": ("counter", "terminal engine failures converted "
                                     "to FallbackSignal"),
+    # -- counters: memory admission / pressure response ------------------
+    "budget.admitted": ("counter", "admission decisions that kept the "
+                                   "requested path (utils/budget.py)"),
+    "budget.rerouted": ("counter", "over-budget tasks rerouted to a "
+                                   "bounded path (streaming / shrunken "
+                                   "window)"),
+    "budget.rejected": ("counter", "tasks refused before allocation "
+                                   "(hard ceiling / unfittable INIT)"),
+    "watchdog.stalls": ("counter", "stall-watchdog firings (diagnostic "
+                                   "dump + optional fallback)"),
+    "arena.pressure_events": ("counter", "arena acquires that waited "
+                                         "past the soft-pressure "
+                                         "threshold"),
+    "supplier.admission.rejections": ("counter", "ShuffleRequests "
+                                      "rejected by the read-pool "
+                                      "admission budget"),
     # -- counters: supplier / emit / merge / exchange --------------------
     "supplier.bytes": ("counter", "bytes served by the DataEngine"),
     "emit.bytes": ("counter", "framed bytes handed to the consumer"),
@@ -98,6 +116,9 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                        "queued or executing"),
     "arena.slots_in_use": ("gauge", "staging-arena slots currently "
                                     "acquired"),
+    "supplier.read.bytes.on_air": ("gauge", "ShuffleRequest bytes "
+                                           "queued or being read "
+                                           "(the admission level)"),
     # -- histograms (recorded only while stats are enabled) --------------
     "fetch.latency_ms": ("histogram", "per-chunk fetch latency "
                                       "[labels: supplier]"),

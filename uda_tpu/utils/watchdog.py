@@ -1,0 +1,155 @@
+"""Stall watchdog: no-progress detection with a diagnostic dump.
+
+The reference could hang forever when a supplier stopped answering —
+the RDMA completion never arrived and the merge thread sat in a
+cond-wait with nothing watching it (the failure mode SURVEY §4.5 calls
+out: no liveness layer existed at all). Here a :class:`StallWatchdog`
+thread samples a *progress token* (any monotonically-advancing value:
+the sum of fetch/merge/emit counters, a queue depth, a file offset).
+When the token stops changing for ``stall_s`` seconds it
+
+1. dumps the live diagnosis to the engine log: every thread's current
+   stack (``sys._current_frames``) plus the recorded span tree and the
+   non-zero counters — the post-mortem a wedged production job never
+   gets to write;
+2. fires ``on_stall(StallError)`` exactly once (configurable off), the
+   hook the MergeManager uses to fail in-flight segments so its waiters
+   wake and the failure flows through the normal ``FallbackSignal`` ->
+   ``failure_in_uda`` fallback contract instead of hanging forever.
+
+Knobs: ``uda.tpu.watchdog.stall.s`` (0 = watchdog off),
+``uda.tpu.watchdog.fallback`` (dump-only when false). The poll period is
+``stall_s / 4`` clamped to [0.05 s, 5 s] — detection latency is at most
+``stall_s + poll``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+from uda_tpu.utils.errors import UdaError
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["StallError", "StallWatchdog", "dump_diagnostics"]
+
+log = get_logger()
+
+
+class StallError(UdaError):
+    """No observable progress for the configured stall deadline."""
+
+
+def dump_diagnostics(reason: str = "") -> str:
+    """The stall dump: all thread stacks + the recorded span tree +
+    non-zero counters, as one log-ready string. Also usable standalone
+    (e.g. from a signal handler or a debug command)."""
+    lines = [f"=== stall diagnostics{': ' + reason if reason else ''} ==="]
+    # thread stacks (the py-spy a wedged job can't run on itself)
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines.append(f"--- {len(frames)} thread stacks ---")
+    for tid, frame in frames.items():
+        lines.append(f"thread {names.get(tid, '?')} (ident {tid}):")
+        lines.extend("  " + ln.rstrip("\n").replace("\n", "\n  ")
+                     for ln in traceback.format_stack(frame))
+    # the span tree: completed spans, rendered parent->child (the live
+    # subtree is whatever has not ended yet — its absence under a parent
+    # with children is itself the wedge signature)
+    spans = list(metrics.spans)
+    if spans:
+        lines.append(f"--- span tree ({len(spans)} recorded spans) ---")
+        children: dict = {}
+        for s in spans:
+            children.setdefault(s.get("parent"), []).append(s)
+
+        def walk(parent_id, depth):
+            for s in children.get(parent_id, []):
+                attrs = s.get("attrs") or {}
+                a = (" " + ",".join(f"{k}={v}" for k, v in attrs.items())
+                     if attrs else "")
+                lines.append(f"{'  ' * depth}{s['name']} "
+                             f"dur={s['dur'] * 1e3:.1f}ms{a}")
+                walk(s["id"], depth + 1)
+
+        walk(None, 1)
+    counters = {k: v for k, v in metrics.snapshot().items() if v}
+    if counters:
+        lines.append("--- non-zero counters ---")
+        lines.extend(f"  {k} = {v:g}" for k, v in sorted(counters.items()))
+    gauges = {k: v for k, v in metrics.gauges_snapshot().items() if v}
+    if gauges:
+        lines.append("--- gauges ---")
+        lines.extend(f"  {k} = {v:g}" for k, v in sorted(gauges.items()))
+    return "\n".join(lines)
+
+
+class StallWatchdog:
+    """One watcher thread per guarded task. ``progress`` is called from
+    the watchdog thread and must be cheap and non-blocking (counter
+    reads); any value supporting ``==`` works as the token."""
+
+    def __init__(self, stall_s: float, progress: Callable[[], object],
+                 on_stall: Optional[Callable[[StallError], None]] = None,
+                 name: str = "uda-watchdog"):
+        if stall_s <= 0:
+            raise UdaError("watchdog needs a positive stall deadline")
+        self.stall_s = float(stall_s)
+        self.progress = progress
+        self.on_stall = on_stall
+        self.poll_s = min(5.0, max(0.05, self.stall_s / 4.0))
+        self.fired = False
+        self.last_dump: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name=name)
+
+    def start(self) -> "StallWatchdog":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # never join from the watchdog's own thread (an on_stall hook
+        # that tears its manager down would deadlock on self-join)
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _watch(self) -> None:
+        token = self.progress()
+        last_change = time.monotonic()
+        while not self._stop.wait(self.poll_s):
+            try:
+                now_token = self.progress()
+            except Exception as e:  # noqa: BLE001 - a broken probe must
+                log.warn(f"watchdog progress probe failed: {e}")  # not
+                continue                                          # kill us
+            now = time.monotonic()
+            if now_token != token:
+                token, last_change = now_token, now
+                continue
+            if now - last_change < self.stall_s:
+                continue
+            self._fire(now - last_change)
+            return
+
+    def _fire(self, stalled_for: float) -> None:
+        metrics.add("watchdog.stalls")
+        err = StallError(
+            f"no fetch/merge progress for {stalled_for:.1f} s "
+            f"(stall deadline {self.stall_s:g} s)")
+        self.last_dump = dump_diagnostics(str(err))
+        log.error(self.last_dump)
+        hook = self.on_stall
+        if hook is not None:
+            try:
+                hook(err)
+            except Exception as e:  # noqa: BLE001 - the hook is rescue
+                log.error(f"watchdog on_stall hook failed: {e}")  # code
+        # set LAST: an observer seeing fired=True may rely on the dump
+        # being written and the rescue hook having run
+        self.fired = True
