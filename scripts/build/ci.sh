@@ -40,6 +40,12 @@ fi
 echo "-- unit + engine tests" | tee -a "$ART/ci.log"
 python -m pytest tests/ -q 2>&1 | tee "$ART/pytest.log" | tail -2
 
+# Network data plane: a real server + 2 concurrent reduce clients over
+# 127.0.0.1, byte-compared against the in-process path (uda_tpu/net/).
+echo "-- net loopback smoke" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/net_smoke.py 2>&1 | tee -a "$ART/ci.log" | tail -1
+
 # CPU-only gates run with the accelerator-pool env stripped: the pool's
 # sitecustomize otherwise dials the pool from every spawned interpreter
 # and can hang at startup while the pool is wedged (pytest strips it

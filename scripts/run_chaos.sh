@@ -48,10 +48,28 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PSPEC}" UDA_TPU_STATS=1 \
     -k "pressure or watchdog or budget" \
     --continue-on-collection-errors "$@" || prc=$?
 
+# Network rung: the net-marked faults tier under a seeded network-chaos
+# schedule (uda_tpu.utils.failpoints.net_chaos_spec) — torn frames (the
+# sender closes: a disconnect mid-stream), slow accepts, slow dials.
+# The wire layer's recovery contract (fail in-flight fetches ->
+# Segment retry/penalty -> reconnect) must absorb all of it.
+NSPEC="$(python -c "from uda_tpu.utils.failpoints import net_chaos_spec; print(net_chaos_spec(${SEED}))")"
+NCOUNTERS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}"' EXIT
+echo "network schedule:    ${NSPEC}"
+nrc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_CHAOS_TELEMETRY="${NCOUNTERS}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    -k "net" \
+    --continue-on-collection-errors "$@" || nrc=$?
+
 python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
-    "${PSPEC}" "${PCOUNTERS}" "${prc}" <<'EOF'
+    "${PSPEC}" "${PCOUNTERS}" "${prc}" \
+    "${NSPEC}" "${NCOUNTERS}" "${nrc}" <<'EOF'
 import json, sys
-seed, spec, counters_path, out, rc, pspec, pcounters, prc = sys.argv[1:9]
+(seed, spec, counters_path, out, rc, pspec, pcounters, prc,
+ nspec, ncounters, nrc) = sys.argv[1:12]
 def load(path):
     try:
         with open(path) as f:
@@ -62,10 +80,13 @@ with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
                "pytest_exit": int(rc), "telemetry": load(counters_path),
                "pressure": {"schedule": pspec, "pytest_exit": int(prc),
-                            "telemetry": load(pcounters)}},
+                            "telemetry": load(pcounters)},
+               "network": {"schedule": nspec, "pytest_exit": int(nrc),
+                           "telemetry": load(ncounters)}},
               f, indent=1, sort_keys=True)
     f.write("\n")
 print(f"chaos telemetry:     {out}")
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
+if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
 exit "${rc}"

@@ -1,0 +1,583 @@
+"""The network shuffle data plane (uda_tpu/net): wire framing,
+ShuffleServer, RemoteFetchClient — the TCP stand-in for the reference's
+RDMAServer/RDMAClient pair (reference src/DataNet/)."""
+
+import io
+import socket
+import threading
+import time
+
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.merger import (HostRoutingClient, LocalFetchClient,
+                            MergeManager)
+from uda_tpu.mofserver import (DataEngine, DirIndexResolver, FetchResult,
+                               ShuffleRequest)
+from uda_tpu.net import RemoteFetchClient, ShuffleServer, wire
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import StorageError, TransportError
+from uda_tpu.utils.failpoints import failpoints, net_chaos_spec
+from uda_tpu.utils.ifile import IFileReader
+from uda_tpu.utils.metrics import metrics
+
+
+# -- wire protocol -----------------------------------------------------------
+
+def _frame_parts(frame: bytes):
+    msg_type, req_id, length = wire.decode_header(frame[:wire.HEADER.size])
+    payload = frame[wire.HEADER.size:]
+    assert len(payload) == length
+    return msg_type, req_id, payload
+
+
+def test_wire_request_roundtrip():
+    req = ShuffleRequest("job_1", "attempt_job_1_m_000003_0", 7,
+                         offset=1 << 33, chunk_size=1 << 20)
+    t, rid, payload = _frame_parts(wire.encode_request(41, req))
+    assert (t, rid) == (wire.MSG_REQ, 41)
+    got = wire.decode_request(payload)
+    assert got == ShuffleRequest(req.job_id, req.map_id, req.reduce_id,
+                                 req.offset, req.chunk_size)
+
+
+@pytest.mark.parametrize("crc", [None, 0xDEADBEEF])
+@pytest.mark.parametrize("data", [b"", b"x" * 1000])
+def test_wire_result_roundtrip(crc, data):
+    res = FetchResult(data, 12345, 2345, 512, "/mofs/file.out",
+                      last=bool(data), crc=crc)
+    t, rid, payload = _frame_parts(wire.encode_result(9, res))
+    assert (t, rid) == (wire.MSG_DATA, 9)
+    got = wire.decode_result(payload)
+    assert (got.data, got.raw_length, got.part_length, got.offset,
+            got.path, got.last, got.crc) == \
+           (data, 12345, 2345, 512, "/mofs/file.out", bool(data), crc)
+
+
+def test_wire_error_roundtrip_is_typed():
+    t, rid, payload = _frame_parts(
+        wire.encode_error(3, StorageError("no such MOF")))
+    assert t == wire.MSG_ERR
+    err = wire.decode_error(payload)
+    assert isinstance(err, StorageError) and "no such MOF" in str(err)
+    # unknown kinds degrade to TransportError, never crash the decoder
+    unknown = wire.encode_error(4, ValueError("alien"))
+    err2 = wire.decode_error(unknown[wire.HEADER.size:])
+    assert isinstance(err2, TransportError) and "alien" in str(err2)
+
+
+def test_wire_size_roundtrip():
+    mids = [f"attempt_j_m_{i:06d}_0" for i in range(3)]
+    t, rid, payload = _frame_parts(wire.encode_size_request(5, "j", mids, 2))
+    assert t == wire.MSG_SIZE_REQ
+    assert wire.decode_size_request(payload) == ("j", mids, 2)
+    assert wire.decode_size(
+        wire.encode_size(1, 12345)[wire.HEADER.size:]) == 12345
+    assert wire.decode_size(
+        wire.encode_size(1, None)[wire.HEADER.size:]) is None
+
+
+def test_wire_decode_strictness():
+    good = wire.encode_request(1, ShuffleRequest("j", "m", 0, 0, 64))
+    # bad magic: not a uda_tpu endpoint / lost frame sync
+    with pytest.raises(TransportError, match="magic"):
+        wire.decode_header(b"XX" + good[2:wire.HEADER.size])
+    # version mismatch names both versions
+    bumped = bytes([good[0], good[1], wire.WIRE_VERSION + 1]) + good[3:]
+    with pytest.raises(TransportError, match="v2.*v1"):
+        wire.decode_header(bumped[:wire.HEADER.size])
+    with pytest.raises(TransportError, match="unknown frame type"):
+        wire.decode_header(good[:2] + bytes([wire.WIRE_VERSION, 99])
+                           + good[4:wire.HEADER.size])
+    # a desynced length field must be rejected before allocation
+    huge = good[:12] + (1 << 31).to_bytes(4, "big")
+    with pytest.raises(TransportError, match="cap"):
+        wire.decode_header(huge[:wire.HEADER.size])
+    with pytest.raises(TransportError, match="truncated"):
+        wire.decode_header(good[:7])
+    # truncated / trailing payload garbage
+    with pytest.raises(TransportError, match="truncated"):
+        wire.decode_request(good[wire.HEADER.size:-3])
+    with pytest.raises(TransportError, match="trailing"):
+        wire.decode_request(good[wire.HEADER.size:] + b"zz")
+    with pytest.raises(TransportError):
+        wire.decode_result(b"\x00" * 4)
+
+
+def test_recv_frame_eof_and_mid_frame_cut():
+    a, b = socket.socketpair()
+    try:
+        frame = wire.encode_request(1, ShuffleRequest("j", "m", 0, 0, 64))
+        a.sendall(frame)
+        assert wire.recv_frame(b)[0] == wire.MSG_REQ
+        # clean EOF at a frame boundary -> None (normal hangup)
+        a.sendall(frame)
+        a.shutdown(socket.SHUT_WR)
+        assert wire.recv_frame(b)[0] == wire.MSG_REQ
+        assert wire.recv_frame(b) is None
+    finally:
+        a.close()
+        b.close()
+    # EOF inside a frame -> mid-frame disconnect
+    a, b = socket.socketpair()
+    try:
+        a.sendall(frame[:-5])
+        a.shutdown(socket.SHUT_WR)
+        with pytest.raises(TransportError, match="mid-frame"):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- server + client ---------------------------------------------------------
+
+JOB = "jobNet"
+
+
+@pytest.fixture
+def supplier(tmp_path):
+    """A MOF tree + DataEngine + ShuffleServer on an ephemeral loopback
+    port -> (expected records per reducer, server)."""
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=4,
+                             num_reducers=2, records_per_map=50, seed=7)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    yield expected, server
+    server.stop()
+    engine.stop()
+
+
+def _fetch_sync(client, req, timeout=10.0):
+    """One fetch through the async InputClient API, synchronously."""
+    box, done = [], threading.Event()
+
+    def on_complete(res):
+        box.append(res)
+        done.set()
+
+    client.start_fetch(req, on_complete)
+    assert done.wait(timeout), "fetch never completed"
+    return box[0]
+
+
+def test_remote_fetch_roundtrip(supplier):
+    expected, server = supplier
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    try:
+        got = []
+        for mid in map_ids(JOB, 4):
+            res = _fetch_sync(client, ShuffleRequest(JOB, mid, 1, 0, 1 << 20))
+            assert isinstance(res, FetchResult) and res.is_last
+            from uda_tpu.utils.ifile import crack
+            got += list(crack(res.data).iter_records())
+        assert sorted(got) == sorted(expected[1])
+    finally:
+        client.stop()
+    assert metrics.get("net.requests") >= 4
+    assert metrics.get_gauge("net.client.connections") == 0
+
+
+def test_remote_error_is_typed_and_connection_survives(supplier):
+    _, server = supplier
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    try:
+        err = _fetch_sync(client, ShuffleRequest(JOB, "no_such_map", 0, 0, 64))
+        assert isinstance(err, StorageError)  # the engine's type, not a
+        # generic transport fault: the Segment retry path must see it
+        # exactly as the in-process client would deliver it
+        ok = _fetch_sync(client, ShuffleRequest(JOB, map_ids(JOB, 1)[0],
+                                                0, 0, 1 << 20))
+        assert isinstance(ok, FetchResult)  # same connection still good
+    finally:
+        client.stop()
+    assert metrics.get("net.errors") == 1
+
+
+def test_many_concurrent_fetches_multiplex_one_connection(supplier):
+    _, server = supplier
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    results, done = {}, threading.Event()
+    lock = threading.Lock()
+    reqs = [ShuffleRequest(JOB, mid, r, 0, 1 << 20)
+            for mid in map_ids(JOB, 4) for r in range(2)]
+    try:
+        def on_complete(key, res):
+            with lock:
+                results[key] = res
+                if len(results) == len(reqs):
+                    done.set()
+
+        for i, req in enumerate(reqs):
+            client.start_fetch(req, lambda res, k=i: on_complete(k, res))
+        assert done.wait(10.0)
+        assert all(isinstance(r, FetchResult) for r in results.values())
+    finally:
+        client.stop()
+    # ONE multiplexed connection carried all of them (RDMAClient.cc's
+    # connect-once-per-host shape)
+    assert metrics.get("net.connects") == 1
+    assert metrics.get("net.accepts") == 1
+
+
+def test_credit_cap_still_serves_everything(tmp_path):
+    """A tiny per-connection credit cap bounds the pipeline but must
+    never deadlock or drop requests (wqe.per.conn semantics)."""
+    make_mof_tree(str(tmp_path), JOB, num_maps=6, num_reducers=1,
+                  records_per_map=30, seed=1)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine, Config({"mapred.rdma.wqe.per.conn": 2}),
+                           host="127.0.0.1", port=0).start()
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    try:
+        results, done = [], threading.Event()
+        lock = threading.Lock()
+
+        def on_complete(res):
+            with lock:
+                results.append(res)
+                if len(results) == 6:
+                    done.set()
+
+        for mid in map_ids(JOB, 6):
+            client.start_fetch(ShuffleRequest(JOB, mid, 0, 0, 1 << 20),
+                               on_complete)
+        assert done.wait(10.0)
+        assert all(isinstance(r, FetchResult) for r in results)
+    finally:
+        client.stop()
+        server.stop()
+        engine.stop()
+    assert metrics.get_gauge("net.server.inflight") == 0
+
+
+def test_estimate_partition_bytes_over_the_wire(supplier):
+    _, server = supplier
+    engine = server.engine
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    try:
+        mids = map_ids(JOB, 4)
+        local = LocalFetchClient(engine).estimate_partition_bytes(
+            JOB, mids, 0)
+        assert local is not None and local > 0
+        assert client.estimate_partition_bytes(JOB, mids, 0) == local
+        # exact-or-unknown across the wire too
+        assert client.estimate_partition_bytes(
+            JOB, mids + ["no_such_map"], 0) is None
+    finally:
+        client.stop()
+
+
+def test_host_routing_default_socket_factory(supplier):
+    """HostRoutingClient with no connect callable dials host[:port]
+    through RemoteFetchClient — and fans estimate_partition_bytes out
+    per host (exact-or-unknown)."""
+    _, server = supplier
+    host = f"127.0.0.1:{server.port}"
+    router = HostRoutingClient(config=Config())
+    try:
+        res = _fetch_sync(router, ShuffleRequest(
+            JOB, map_ids(JOB, 1)[0], 0, 0, 1 << 20, host=host))
+        assert isinstance(res, FetchResult)
+        entries = [(host, m) for m in map_ids(JOB, 4)]
+        est = router.estimate_partition_bytes(JOB, entries, 0)
+        local = LocalFetchClient(server.engine).estimate_partition_bytes(
+            JOB, map_ids(JOB, 4), 0)
+        assert est == local
+        # one unknown host poisons the whole estimate (never a partial
+        # lower bound), and the fetch path reports the dial failure
+        assert router.estimate_partition_bytes(
+            JOB, entries + [("127.0.0.1:1", "m")], 0) is None
+    finally:
+        router.stop()
+
+
+def test_default_factory_address_parsing():
+    """host[:port], bracketed IPv6, bare IPv6 literals; malformed
+    ports fail TYPED (the transport-error contract), never ValueError."""
+    connect = HostRoutingClient._socket_factory(Config())
+    c = connect("sup1:1234")
+    assert (c.host, c.port) == ("sup1", 1234)  # lazy dial: no connect yet
+    c2 = connect("sup2")
+    assert (c2.host, c2.port) == ("sup2", Config().get("uda.tpu.net.port"))
+    c3 = connect("[::1]:4567")
+    assert (c3.host, c3.port) == ("::1", 4567)
+    c4 = connect("fe80::1%eth0")  # bare IPv6 literal: no port split
+    assert c4.host == "fe80::1%eth0"
+    for bad in ("sup1:9o12", "[::1", "[::1]x"):
+        with pytest.raises(TransportError):
+            connect(bad)
+
+
+def test_decompressing_client_forwards_estimate(supplier):
+    """The codec wrapper must not swallow the size estimate: the auto
+    merge-approach policy needs real sizes for compressed jobs too
+    (estimates sum raw_length — the uncompressed domain this client
+    delivers in)."""
+    from uda_tpu.compress import DecompressingClient, get_codec
+
+    inner = LocalFetchClient(supplier[1].engine)
+    wrapped = DecompressingClient(inner, get_codec("zlib"))
+    mids = map_ids(JOB, 4)
+    est = wrapped.estimate_partition_bytes(JOB, mids, 0)
+    assert est == inner.estimate_partition_bytes(JOB, mids, 0)
+    assert est is not None and est > 0
+
+
+def test_default_factory_rejects_empty_host():
+    """An entry with no supplier host must fail loudly, not resolve to
+    localhost and fetch from whatever listens there."""
+    router = HostRoutingClient(config=Config())
+    try:
+        err = _fetch_sync(router, ShuffleRequest(JOB, "m", 0, 0, 64,
+                                                 host=""))
+        assert isinstance(err, TransportError) and "empty host" in str(err)
+        # and the estimate fan-out degrades to unknown, not localhost
+        assert router.estimate_partition_bytes(JOB, ["m"], 0) is None
+    finally:
+        router.stop()
+
+
+def test_unreachable_supplier_fails_fetch_with_transport_error():
+    # nothing listens on port 1; the dial error must arrive as a
+    # completion, not an exception out of start_fetch
+    client = RemoteFetchClient("127.0.0.1", 1,
+                               Config({"uda.tpu.net.connect.timeout.s": 2.0}))
+    try:
+        err = _fetch_sync(client, ShuffleRequest("j", "m", 0, 0, 64))
+        assert isinstance(err, TransportError)
+    finally:
+        client.stop()
+    assert metrics.get("net.connect.failures") >= 1
+
+
+def _run_reduce(port, reduce_id, cfg, out, num_maps=4):
+    router = HostRoutingClient(config=cfg)
+    mm = MergeManager(router, "uda.tpu.RawBytes", cfg)
+    blocks = []
+    maps = [(f"127.0.0.1:{port}", m) for m in map_ids(JOB, num_maps)]
+    try:
+        mm.run(JOB, maps, reduce_id, lambda b: blocks.append(bytes(b)))
+        out[reduce_id] = b"".join(blocks)
+    finally:
+        router.stop()
+
+
+def test_concurrent_reduce_clients_match_local_path(supplier):
+    """The acceptance criterion: a full MergeManager shuffle over
+    RemoteFetchClient -> ShuffleServer -> DataEngine on loopback, >= 2
+    concurrent reduce clients, byte-identical to LocalFetchClient."""
+    expected, server = supplier
+    out = {}
+    threads = [threading.Thread(target=_run_reduce,
+                                args=(server.port, r, Config(), out))
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert sorted(out) == [0, 1]
+    for r in range(2):
+        mm = MergeManager(LocalFetchClient(server.engine),
+                          "uda.tpu.RawBytes", Config())
+        blocks = []
+        mm.run(JOB, map_ids(JOB, 4), r, lambda b: blocks.append(bytes(b)))
+        assert out[r] == b"".join(blocks)  # byte-identical to local
+        got = list(IFileReader(io.BytesIO(out[r])))
+        assert sorted(got) == sorted(expected[r])
+
+
+@pytest.mark.faults
+def test_mid_stream_disconnect_recovers_via_segment_retries(tmp_path):
+    """A torn response frame (net.frame truncate) closes the connection
+    mid-stream; the client fails every in-flight fetch with
+    TransportError and the existing Segment retry/penalty machinery
+    reconnects and completes byte-correct output."""
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=5,
+                             num_reducers=1, records_per_map=60, seed=5)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    # small chunks -> multi-chunk segments; generous retry budget (one
+    # tear fails EVERY in-flight fetch, each burning a retry)
+    cfg = Config({"mapred.rdma.buf.size": 4, "uda.tpu.fetch.retries": 8})
+    out = {}
+    try:
+        with failpoints.scoped("net.frame=truncate:16:every:9"):
+            _run_reduce(server.port, 0, cfg, out, num_maps=5)
+    finally:
+        server.stop()
+        engine.stop()
+    got = list(IFileReader(io.BytesIO(out[0])))
+    assert sorted(got) == sorted(expected[0])
+    if failpoints.hits.get("net.frame"):  # chaos may override the spec
+        assert metrics.get("net.disconnects") >= 1
+        assert metrics.get("fetch.retries") >= 1
+
+
+@pytest.mark.faults
+def test_server_stop_midfetch_then_restart_recovers(tmp_path):
+    """Killed supplier: stop(drain=False) mid-stream fails the fetch
+    with TransportError; a server restarted on the same port serves the
+    segment's retry (the whole-segment re-fetch restarts from offset
+    0, so chunks fetched before the kill are re-fetched consistently)."""
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=3,
+                             num_reducers=1, records_per_map=60, seed=9)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    port = server.port
+
+    # plain stopped server: the fetch completes with TransportError
+    client = RemoteFetchClient("127.0.0.1", port, Config())
+    res = _fetch_sync(client, ShuffleRequest(JOB, map_ids(JOB, 1)[0],
+                                             0, 0, 1 << 20))
+    assert isinstance(res, FetchResult)
+    server.stop(drain=False)
+    err = _fetch_sync(client, ShuffleRequest(JOB, map_ids(JOB, 1)[0],
+                                             0, 0, 1 << 20))
+    assert isinstance(err, TransportError)
+    client.stop()
+
+    # restart on the SAME port; a merge with retry backoff spanning the
+    # outage completes against the restarted server
+    cfg = Config({"mapred.rdma.buf.size": 4, "uda.tpu.fetch.retries": 8,
+                  "mapred.rdma.fetch.retry.backoff.ms": 50})
+    out = {}
+    outage = threading.Event()
+
+    def delayed_restart():
+        outage.wait(10.0)
+        time.sleep(0.15)  # let some in-flight fetches die against the
+        server2.start()   # closed port before the retries land
+
+    server2 = ShuffleServer(engine, Config(), host="127.0.0.1", port=port)
+    restarter = threading.Thread(target=delayed_restart)
+    t = threading.Thread(target=_run_reduce,
+                         args=(port, 0, cfg, out, 3))
+    try:
+        # kill the server as soon as the merge is underway, restart it
+        # shortly after: segments ride their RetryPolicy across the gap
+        restarter.start()
+        t.start()
+        outage.set()
+        t.join(timeout=60)
+        assert not t.is_alive(), "reduce wedged across the restart"
+    finally:
+        server2.stop()
+        engine.stop()
+    got = list(IFileReader(io.BytesIO(out[0])))
+    assert sorted(got) == sorted(expected[0])
+
+
+@pytest.mark.faults
+def test_net_chaos_schedule_is_recoverable(tmp_path):
+    """The network rung of scripts/run_chaos.sh, in miniature: a seeded
+    net_chaos_spec schedule (torn frames OR send errors + slow
+    accepts/dials) must degrade into retries, never into wrong bytes
+    or a wedge."""
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=4,
+                             num_reducers=1, records_per_map=40, seed=3)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    cfg = Config({"mapred.rdma.buf.size": 4, "uda.tpu.fetch.retries": 10,
+                  "mapred.rdma.fetch.retry.backoff.ms": 10})
+    out = {}
+    try:
+        with failpoints.scoped(net_chaos_spec(1234)):
+            _run_reduce(server.port, 0, cfg, out)
+    finally:
+        server.stop()
+        engine.stop()
+    got = list(IFileReader(io.BytesIO(out[0])))
+    assert sorted(got) == sorted(expected[0])
+
+
+def test_server_drain_on_stop_completes_inflight(tmp_path):
+    """Graceful stop: a response the engine is still producing flushes
+    before the connection closes (drain-on-stop), instead of the
+    client seeing a disconnect."""
+    make_mof_tree(str(tmp_path), JOB, num_maps=1, num_reducers=1,
+                  records_per_map=40, seed=2)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    box, done = [], threading.Event()
+    try:
+        with failpoints.scoped("data_engine.pread=delay:150"):
+            client.start_fetch(
+                ShuffleRequest(JOB, map_ids(JOB, 1)[0], 0, 0, 1 << 20),
+                lambda res: (box.append(res), done.set()))
+            time.sleep(0.03)  # request reaches the engine
+            server.stop()     # drain=True default
+        assert done.wait(5.0)
+        assert isinstance(box[0], FetchResult), f"drain lost: {box[0]}"
+    finally:
+        client.stop()
+        engine.stop()
+
+
+def test_bridge_starts_net_server_and_remote_bridge_fetches(tmp_path):
+    """End-to-end through TWO bridges: a MOFSupplier bridge with
+    uda.tpu.net.listen serving its engine, and a NetMerger bridge with
+    uda.tpu.net.fetch routing FETCH-carried hosts over the socket
+    plane (the deployable two-process shape, collapsed into one
+    process over loopback)."""
+    import os
+
+    from uda_tpu.bridge import UdaBridge
+    from uda_tpu.bridge.protocol import Cmd, form_cmd
+    from uda_tpu.mofserver import read_index_file
+
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=3,
+                             num_reducers=1, records_per_map=30, seed=4)
+
+    class SupplierCallable:
+        def get_path_uda(self, job_id, map_id, reduce_id):
+            d = os.path.join(str(tmp_path), job_id, map_id)
+            return read_index_file(
+                os.path.join(d, "file.out.index"),
+                os.path.join(d, "file.out"))[reduce_id]
+
+    supplier = UdaBridge()
+    supplier.start(False, ["-w", "8"], SupplierCallable())
+    supplier.cfg.set("uda.tpu.net.listen", True)
+    supplier.cfg.set("uda.tpu.net.port", 0)  # ephemeral
+    supplier.do_command(form_cmd(Cmd.INIT, []))  # -> server starts
+    assert not supplier.failed and supplier.net_server() is not None
+    port = supplier.net_server().port
+
+    blocks = []
+
+    class ReducerCallable:
+        # the conf pull channel (getConfData) carries the net knobs, as
+        # a Hadoop jobconf would; FETCH hosts then need no ':port'
+        # suffix (the ':'-delimited command protocol could not carry
+        # one anyway)
+        def get_conf_data(self, name, default):
+            return {"uda.tpu.net.fetch": "true",
+                    "uda.tpu.net.port": str(port)}.get(name, "")
+
+        def data_from_uda(self, data, length):
+            blocks.append(bytes(data[:length]))
+
+    reducer = UdaBridge()
+    reducer.start(True, ["-w", "8"], ReducerCallable())
+    try:
+        reducer.do_command(form_cmd(
+            Cmd.INIT, [JOB, "0", "3", "uda.tpu.RawBytes"]))
+        for mid in map_ids(JOB, 3):
+            reducer.do_command(form_cmd(
+                Cmd.FETCH, ["127.0.0.1", JOB, mid, "0"]))
+        assert not reducer.failed
+        reducer.do_command(form_cmd(Cmd.FINAL, []))
+        reducer.reduce_exit()
+        assert not reducer.failed
+        got = list(IFileReader(io.BytesIO(b"".join(blocks))))
+        assert sorted(got) == sorted(expected[0])
+    finally:
+        supplier.do_command(form_cmd(Cmd.EXIT, []))  # stops the server
+        assert supplier.net_server() is None

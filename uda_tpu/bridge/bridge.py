@@ -124,6 +124,9 @@ class UdaBridge:
         self._engine: Optional[DataEngine] = None
         self._resolver: Optional[IndexResolver] = None
         self._owned_engine: Optional[DataEngine] = None
+        # network data plane (uda.tpu.net.listen): the ShuffleServer
+        # serving this role's engine to remote reduce clients
+        self._net_server = None
         # observability
         self._stats: Optional[StatsReporter] = None
 
@@ -226,6 +229,28 @@ class UdaBridge:
             return self._stats.latest()
         return telemetry_block()
 
+    def _maybe_start_net_server(self, engine: Optional[DataEngine]) -> None:
+        """Start the shuffle data plane next to the role's engine when
+        ``uda.tpu.net.listen`` is set (the RDMAServer-next-to-DataEngine
+        shape, reference MOFSupplierMain.cc:84-143). Idempotent per
+        bridge lifetime; torn down with the engine."""
+        if self._net_server is not None or engine is None:
+            return
+        if not self.cfg.get("uda.tpu.net.listen"):
+            return
+        from uda_tpu.net import ShuffleServer
+        self._net_server = ShuffleServer(engine, self.cfg).start()
+
+    def _stop_net_server(self) -> None:
+        srv, self._net_server = self._net_server, None
+        if srv is not None:
+            srv.stop()
+
+    def net_server(self):
+        """The running ShuffleServer (None unless uda.tpu.net.listen):
+        embedders read its bound port for service registration."""
+        return self._net_server
+
     def reduce_exit(self) -> None:
         """reduceExitMsgNative: synchronous teardown of the reduce task
         (UdaBridge.cc:299-314, finalize_reduce_task reducer.cc:354-410)."""
@@ -235,6 +260,7 @@ class UdaBridge:
         if self._mm is not None:
             self._mm.stop()
             self._mm = None
+        self._stop_net_server()  # before its engine goes away
         if self._owned_engine is not None:
             self._owned_engine.stop()
             self._owned_engine = None
@@ -295,6 +321,9 @@ class UdaBridge:
             # the MergeManager reads the window.
             MemoryBudget.from_config(self.cfg).validate_init(self.cfg)
             client = self._make_client(local_dirs)
+            # data plane (uda.tpu.net.listen): serve THIS host's map
+            # outputs to remote reduce clients next to the owned engine
+            self._maybe_start_net_server(self._owned_engine)
             # fetch progress -> fetchOverMessage, the reference cadence:
             # one up-call per PROGRESS_INTERVAL fetched segments plus one
             # at fetch completion (MergeManager.cc:124-130); the embedder
@@ -434,9 +463,24 @@ class UdaBridge:
 
     def _make_client(self, local_dirs: list[str]) -> InputClient:
         """createInputClient: plain or decompressing transport by codec
-        class (reference reducer.cc:412-450)."""
+        class (reference reducer.cc:412-450); with ``uda.tpu.net.fetch``
+        set, a host-routing client over the socket data plane instead of
+        an in-process engine client."""
         if self._client is not None:
             return self._client
+        if self.cfg.get("uda.tpu.net.fetch"):
+            from uda_tpu.merger import HostRoutingClient
+            # fetches dial each FETCH-carried supplier host's
+            # ShuffleServer; a local engine is still built (from the
+            # local dirs) when this host also LISTENS — it serves this
+            # host's own map outputs to the other reduce hosts
+            if local_dirs and self.cfg.get("uda.tpu.net.listen"):
+                from uda_tpu.mofserver import DirIndexResolver
+                self._owned_engine = DataEngine(
+                    DirIndexResolver(local_dirs), self.cfg,
+                    num_disks=len(local_dirs))
+            client: InputClient = HostRoutingClient(config=self.cfg)
+            return self._wrap_codec(client)
         if local_dirs:
             from uda_tpu.mofserver import DirIndexResolver
             # reader threads scale with the disk count, the reference's
@@ -447,7 +491,10 @@ class UdaBridge:
         else:
             engine = DataEngine(_UpcallIndexResolver(self.callable), self.cfg)
         self._owned_engine = engine
-        client: InputClient = LocalFetchClient(engine)
+        return self._wrap_codec(LocalFetchClient(engine))
+
+    def _wrap_codec(self, client: InputClient) -> InputClient:
+        """Decompressing wrap by codec class (reducer.cc:412-450)."""
         if self.cfg.get("mapred.compress.map.output"):
             from uda_tpu.compress import (BLOCK_HEADER, DecompressingClient,
                                           get_codec)
@@ -495,8 +542,12 @@ class UdaBridge:
             if params and self._resolver is not None:
                 self._resolver.invalidate(params[0])
         elif header == Cmd.INIT:
-            pass
+            # data plane (uda.tpu.net.listen): start serving this
+            # supplier's engine to remote reduce clients (the
+            # RDMAServer bound next to the DataEngine)
+            self._maybe_start_net_server(self._engine)
         elif header == Cmd.EXIT:
+            self._stop_net_server()  # drain before the engine stops
             if self._engine is not None:
                 self._engine.stop()
                 self._engine = None

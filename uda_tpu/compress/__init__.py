@@ -225,6 +225,15 @@ class DecompressingClient(InputClient):
         self._streams: dict[tuple, _StreamState] = {}
         self._lock = threading.Lock()
 
+    def estimate_partition_bytes(self, job_id: str, map_ids,
+                                 reduce_id: int):
+        """Forward to the wrapped transport: its estimate sums the
+        spill index's raw_length (uncompressed record bytes), which is
+        the domain this client delivers in — so the auto merge-approach
+        policy sees real sizes for compressed jobs too."""
+        return self.inner.estimate_partition_bytes(job_id, map_ids,
+                                                   reduce_id)
+
     def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
         key = (req.job_id, req.map_id, req.reduce_id)
         tok = object()

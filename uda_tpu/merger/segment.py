@@ -75,9 +75,12 @@ class LocalFetchClient(InputClient):
 
     def estimate_partition_bytes(self, job_id: str, map_ids,
                                  reduce_id: int):
-        """Sum of part_length over the map outputs (the spill-index
+        """Sum of raw_length over the map outputs (the spill-index
         triples the supplier serves from; resolution is cached by the
-        engine's resolver). Exact-or-unknown: ANY unresolvable map makes
+        engine's resolver). raw_length — the UNCOMPRESSED record bytes
+        — is what the merge will actually hold, so the estimate stays
+        correct through a DecompressingClient wrap (for uncompressed
+        jobs raw == part). Exact-or-unknown: ANY unresolvable map makes
         the whole estimate None — a partial sum is a lower bound, and a
         lower bound could steer the auto policy onto the host-resident
         path for a partition that is actually huge. Fetch itself still
@@ -86,7 +89,7 @@ class LocalFetchClient(InputClient):
         for mid in map_ids:
             try:
                 total += int(self.engine.resolver.resolve(
-                    job_id, mid, reduce_id).part_length)
+                    job_id, mid, reduce_id).raw_length)
             except Exception:
                 return None
         return total
@@ -104,13 +107,55 @@ class HostRoutingClient(InputClient):
     reuses the cached transport. A failed connect surfaces through the
     fetch's completion callback like any transport error (the
     reference's connect-retry-then-fail path, RDMAClient.cc:215-356).
+
+    With no ``connect`` callable the router defaults to the socket data
+    plane: each host dials that supplier's ShuffleServer as
+    ``host[:port]`` (port defaulting to ``uda.tpu.net.port``) through a
+    :class:`~uda_tpu.net.client.RemoteFetchClient` — one multiplexed
+    connection per supplier host, the deployed-service wiring.
     """
 
-    def __init__(self, connect):
-        self._connect = connect
+    def __init__(self, connect=None, config=None):
+        self._connect = (connect if connect is not None
+                         else self._socket_factory(config))
         self._clients: dict[str, InputClient] = {}
         self._stopped = False
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _socket_factory(config):
+        """The default connect: dial ``host[:port]`` over TCP. Imported
+        lazily (uda_tpu.net imports this module)."""
+        def connect(host: str) -> InputClient:
+            from uda_tpu.net.client import RemoteFetchClient
+            from uda_tpu.utils.config import Config
+
+            # accepted shapes: "name", "name:port", "[v6addr]:port",
+            # and a bare IPv6 literal (2+ colons, no brackets)
+            name, port = host, ""
+            if host.startswith("["):
+                name, bracket, rest = host[1:].partition("]")
+                if not bracket or (rest and not rest.startswith(":")):
+                    raise TransportError(
+                        f"malformed supplier address {host!r}")
+                port = rest[1:]
+            elif host.count(":") == 1:
+                name, _, port = host.partition(":")
+            if not name:
+                # an empty host would resolve to localhost and
+                # misdirect the fetch to whatever listens there; fail
+                # loudly instead (the entry was built without a
+                # supplier host — a wiring bug, not a transport fault)
+                raise TransportError(
+                    "socket fetch routing needs a supplier host per "
+                    "map entry; got an empty host")
+            if port and not port.isdigit():
+                raise TransportError(
+                    f"malformed supplier port in {host!r}")
+            cfg = config or Config()
+            return RemoteFetchClient(
+                name, int(port) if port else None, config=cfg)
+        return connect
 
     def _client_for(self, host: str) -> InputClient:
         with self._lock:
@@ -142,6 +187,45 @@ class HostRoutingClient(InputClient):
             on_complete(e)      # completion error, like the reference
             return
         client.start_fetch(req, on_complete)
+
+    def estimate_partition_bytes(self, job_id: str, map_ids,
+                                 reduce_id: int):
+        """Per-host fan-out of the size estimate: entries group by
+        supplier host and each host's transport answers for its own
+        maps (RemoteFetchClient probes over the wire, LocalFetchClient
+        sums its spill index). Exact-or-unknown like LocalFetchClient:
+        ANY host that cannot answer (unknown size, failed connect)
+        makes the whole estimate None — a partial sum is a lower bound
+        and would steer the auto merge-approach policy wrong (see
+        LocalFetchClient.estimate_partition_bytes)."""
+        by_host: dict[str, list[str]] = {}
+        for entry in map_ids:
+            host, mid = entry if isinstance(entry, tuple) else ("", entry)
+            by_host.setdefault(host, []).append(mid)
+
+        def probe(host: str, mids: list[str]):
+            try:
+                return self._client_for(host).estimate_partition_bytes(
+                    job_id, mids, reduce_id)
+            except Exception:  # noqa: BLE001 - estimate is best-effort;
+                return None    # fetch itself will fail loudly later
+
+        if len(by_host) == 1:  # the common case, no thread overhead
+            host, mids = next(iter(by_host.items()))
+            return probe(host, mids)
+        # many hosts: probe concurrently — serially, one slow or dead
+        # supplier's connect+probe timeout would stack per host and
+        # stall the auto merge-approach decision for minutes
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+                max_workers=min(16, len(by_host)),
+                thread_name_prefix="uda-size-probe") as pool:
+            estimates = list(pool.map(lambda kv: probe(*kv),
+                                      by_host.items()))
+        if any(est is None for est in estimates):
+            return None
+        return sum(estimates)
 
     def stop(self) -> None:
         with self._lock:
@@ -275,8 +359,13 @@ class Segment:
             # the failpoint is inside the try: an injected raise takes
             # the same sync-failure path as a stopped transport
             failpoint("segment.fetch", key=self.map_id)
-            self.client.start_fetch(
-                req, lambda res, e=epoch: self._on_complete(res, e))
+            # the segment's span is the transport's parent for this
+            # issue: spans a transport opens (e.g. net.fetch) join the
+            # fetch span tree even when the issue happens on a
+            # completion thread with no ambient context
+            with metrics.use_span(self.trace_span):
+                self.client.start_fetch(
+                    req, lambda res, e=epoch: self._on_complete(res, e))
         except Exception as e:  # noqa: BLE001 - a sync raise must fail
             # the segment, never escape into the transport's thread
             with self._lock:

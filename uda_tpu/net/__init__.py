@@ -1,0 +1,15 @@
+"""The shuffle data plane (the DataNet/ layer of SURVEY §1): wire
+framing, supplier-side socket server, reduce-side multiplexed fetch
+client — the TCP stand-in for the reference's RDMAServer/RDMAClient
+ibverbs plane. This is what turns the in-process library into a
+deployable shuffle service: a MOFSupplier listens next to its
+DataEngine (``uda.tpu.net.listen``) and reduce hosts dial it through
+``HostRoutingClient``'s default socket factory (``uda.tpu.net.fetch``).
+"""
+
+from uda_tpu.net.client import RemoteFetchClient
+from uda_tpu.net.server import ShuffleServer
+from uda_tpu.net.wire import MAX_FRAME, WIRE_VERSION
+
+__all__ = ["RemoteFetchClient", "ShuffleServer", "WIRE_VERSION",
+           "MAX_FRAME"]

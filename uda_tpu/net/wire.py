@@ -1,0 +1,295 @@
+"""Wire framing of the shuffle data plane.
+
+Binary encoding of the existing ``ShuffleRequest``/``FetchResult``
+dataclasses — the socket stand-in for the reference's ibverbs message
+pair: ``shuffle_req_t`` (jobid, map, reduceID, map_offset, chunk_size,
+reference src/MOFServer/IndexInfo.h:64-77) and the RDMA ACK string
+``"rawLen:partLen:sentSize:mofOffset:path"`` (reference
+src/DataNet/RDMAServer.cc:597-607). Where the reference rode these on
+pre-established QPs, here every message is one length-prefixed frame on
+a TCP stream:
+
+    +-------+---------+------+------------+-------------+---------+
+    | magic | version | type | request id | payload len | payload |
+    | 2 B   | 1 B     | 1 B  | 8 B        | 4 B         | ...     |
+    +-------+---------+------+------------+-------------+---------+
+
+(network byte order throughout). The request id is the multiplexing
+correlation key: a client may have many requests in flight on one
+connection and the server completes them out of order, exactly like
+RDMA work completions.
+
+Frame types::
+
+    REQ        one chunk fetch            (ShuffleRequest)
+    DATA       one chunk reply            (FetchResult; the ACK fields)
+    ERR        typed failure for one req  (error kind + message)
+    SIZE_REQ   partition size probe       (job, reduce, map ids)
+    SIZE       size reply                 (total bytes, -1 = unknown)
+
+Decoding is STRICT: a bad magic, an unknown version or type, a length
+over :data:`MAX_FRAME`, a short buffer or trailing garbage all raise
+:class:`TransportError` — the receiving side treats any of them as a
+broken connection (the stream has lost frame sync; there is no
+resynchronization, like a torn RDMA connection there is only
+reconnect). ``ERR`` payloads carry the error's class name so the reduce
+side re-raises the TYPED error (a supplier-side ``StorageError``
+admission rejection must look like a StorageError to the Segment retry
+machinery, not like a generic transport fault).
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import struct
+from typing import Optional, Sequence
+
+from uda_tpu.mofserver.data_engine import FetchResult, ShuffleRequest
+from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
+                                  ProtocolError, StorageError, TransportError,
+                                  UdaError)
+
+__all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
+           "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
+           "encode_request", "decode_request", "encode_result",
+           "decode_result", "encode_error", "decode_error",
+           "encode_size_request", "decode_size_request", "encode_size",
+           "decode_size", "encode_frame", "decode_header", "recv_frame",
+           "close_hard"]
+
+MAGIC = b"UD"
+WIRE_VERSION = 1
+# Frames above this are rejected before allocation: a desynced stream
+# read as a length field must not turn into a multi-GB recv buffer.
+MAX_FRAME = (1 << 30) + 4096
+
+HEADER = struct.Struct("!2sBBQI")  # magic, version, type, req id, len
+
+MSG_REQ = 1
+MSG_DATA = 2
+MSG_ERR = 3
+MSG_SIZE_REQ = 4
+MSG_SIZE = 5
+
+_TYPES = (MSG_REQ, MSG_DATA, MSG_ERR, MSG_SIZE_REQ, MSG_SIZE)
+
+_REQ = struct.Struct("!IQI")      # reduce_id, offset, chunk_size
+_DATA = struct.Struct("!QQQB")    # raw_length, part_length, offset, flags
+_CRC = struct.Struct("!I")
+_SIZE_REQ = struct.Struct("!II")  # reduce_id, num maps
+_SIZE = struct.Struct("!q")       # total bytes, -1 = unknown
+
+_FLAG_LAST = 0x01
+_FLAG_CRC = 0x02
+
+# ERR frames carry the error's class name; the decoder re-raises the
+# same typed error on the reduce side so recovery paths (Segment retry,
+# supplier-admission backoff) see realistic types across the wire.
+_ERROR_CLASSES = {cls.__name__: cls for cls in
+                  (UdaError, ConfigError, ProtocolError, TransportError,
+                   MergeError, StorageError, CompressionError)}
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    if len(b) > 0xFFFF:
+        raise ProtocolError(f"string field too long for the wire "
+                            f"({len(b)} B > 65535)")
+    return struct.pack("!H", len(b)) + b
+
+
+def _unpack_str(payload: bytes, off: int, what: str) -> tuple[str, int]:
+    if off + 2 > len(payload):
+        raise TransportError(f"truncated frame: no length for {what}")
+    (n,) = struct.unpack_from("!H", payload, off)
+    off += 2
+    if off + n > len(payload):
+        raise TransportError(f"truncated frame: {what} needs {n} B, "
+                             f"{len(payload) - off} left")
+    return payload[off:off + n].decode("utf-8"), off + n
+
+
+def _done(payload: bytes, off: int, what: str) -> None:
+    if off != len(payload):
+        raise TransportError(f"malformed {what} frame: "
+                             f"{len(payload) - off} trailing bytes")
+
+
+# -- encode ------------------------------------------------------------------
+
+def encode_frame(msg_type: int, req_id: int, payload: bytes) -> bytes:
+    return HEADER.pack(MAGIC, WIRE_VERSION, msg_type, req_id,
+                       len(payload)) + payload
+
+
+def encode_request(req_id: int, req: ShuffleRequest) -> bytes:
+    payload = (_REQ.pack(req.reduce_id, req.offset, req.chunk_size)
+               + _pack_str(req.job_id) + _pack_str(req.map_id))
+    return encode_frame(MSG_REQ, req_id, payload)
+
+
+def encode_result(req_id: int, res: FetchResult) -> bytes:
+    flags = (_FLAG_LAST if res.last else 0) | \
+            (_FLAG_CRC if res.crc is not None else 0)
+    payload = _DATA.pack(res.raw_length, res.part_length, res.offset, flags)
+    if res.crc is not None:
+        payload += _CRC.pack(res.crc & 0xFFFFFFFF)
+    payload += _pack_str(res.path) + res.data
+    return encode_frame(MSG_DATA, req_id, payload)
+
+
+def encode_error(req_id: int, exc: BaseException) -> bytes:
+    """Total by construction: the message is diagnostics, so an
+    over-long one is truncated to fit the u16 string field rather than
+    failing the encode — an ERR frame that cannot be encoded would
+    strand the request's credit on the server."""
+    message = str(exc)
+    if len(message.encode("utf-8")) > 0xFFF0:
+        message = message.encode("utf-8")[:0xFFF0].decode("utf-8",
+                                                          "ignore")
+    payload = _pack_str(type(exc).__name__[:256]) + _pack_str(message)
+    return encode_frame(MSG_ERR, req_id, payload)
+
+
+def encode_size_request(req_id: int, job_id: str, map_ids: Sequence[str],
+                        reduce_id: int) -> bytes:
+    payload = b"".join([_SIZE_REQ.pack(reduce_id, len(map_ids)),
+                        _pack_str(job_id),
+                        *(_pack_str(mid) for mid in map_ids)])
+    return encode_frame(MSG_SIZE_REQ, req_id, payload)
+
+
+def encode_size(req_id: int, total: Optional[int]) -> bytes:
+    return encode_frame(MSG_SIZE, req_id,
+                        _SIZE.pack(-1 if total is None else total))
+
+
+# -- decode ------------------------------------------------------------------
+
+def decode_header(header: bytes) -> tuple[int, int, int]:
+    """Strict header decode -> (msg_type, req_id, payload_len)."""
+    if len(header) != HEADER.size:
+        raise TransportError(f"truncated frame header "
+                             f"({len(header)}/{HEADER.size} B)")
+    magic, version, msg_type, req_id, length = HEADER.unpack(header)
+    if magic != MAGIC:
+        raise TransportError(f"bad frame magic {magic!r} (stream lost "
+                             f"frame sync or peer is not a uda_tpu "
+                             f"shuffle endpoint)")
+    if version != WIRE_VERSION:
+        raise TransportError(f"wire version mismatch: peer speaks "
+                             f"v{version}, this side v{WIRE_VERSION}")
+    if msg_type not in _TYPES:
+        raise TransportError(f"unknown frame type {msg_type}")
+    if length > MAX_FRAME:
+        raise TransportError(f"frame length {length} exceeds the "
+                             f"{MAX_FRAME} B cap (desynced stream?)")
+    return msg_type, req_id, length
+
+
+def decode_request(payload: bytes) -> ShuffleRequest:
+    if len(payload) < _REQ.size:
+        raise TransportError(f"truncated REQ frame ({len(payload)} B)")
+    reduce_id, offset, chunk_size = _REQ.unpack_from(payload, 0)
+    job_id, off = _unpack_str(payload, _REQ.size, "job id")
+    map_id, off = _unpack_str(payload, off, "map id")
+    _done(payload, off, "REQ")
+    return ShuffleRequest(job_id, map_id, reduce_id, offset, chunk_size)
+
+
+def decode_result(payload: bytes) -> FetchResult:
+    if len(payload) < _DATA.size:
+        raise TransportError(f"truncated DATA frame ({len(payload)} B)")
+    raw_length, part_length, offset, flags = _DATA.unpack_from(payload, 0)
+    off = _DATA.size
+    crc = None
+    if flags & _FLAG_CRC:
+        if off + _CRC.size > len(payload):
+            raise TransportError("truncated DATA frame: CRC flagged "
+                                 "but absent")
+        (crc,) = _CRC.unpack_from(payload, off)
+        off += _CRC.size
+    path, off = _unpack_str(payload, off, "path")
+    return FetchResult(payload[off:], raw_length, part_length, offset,
+                       path, last=bool(flags & _FLAG_LAST), crc=crc)
+
+
+def decode_error(payload: bytes) -> UdaError:
+    kind, off = _unpack_str(payload, 0, "error kind")
+    message, off = _unpack_str(payload, off, "error message")
+    _done(payload, off, "ERR")
+    cls = _ERROR_CLASSES.get(kind, TransportError)
+    err = cls(f"remote: {message}")
+    err.remote_kind = kind
+    return err
+
+
+def decode_size_request(payload: bytes) -> tuple[str, list[str], int]:
+    if len(payload) < _SIZE_REQ.size:
+        raise TransportError(f"truncated SIZE_REQ frame ({len(payload)} B)")
+    reduce_id, n = _SIZE_REQ.unpack_from(payload, 0)
+    job_id, off = _unpack_str(payload, _SIZE_REQ.size, "job id")
+    mids = []
+    for i in range(n):
+        mid, off = _unpack_str(payload, off, f"map id {i}")
+        mids.append(mid)
+    _done(payload, off, "SIZE_REQ")
+    return job_id, mids, reduce_id
+
+
+def decode_size(payload: bytes) -> Optional[int]:
+    if len(payload) != _SIZE.size:
+        raise TransportError(f"malformed SIZE frame ({len(payload)} B)")
+    (total,) = _SIZE.unpack(payload)
+    return None if total < 0 else total
+
+
+# -- socket helpers ----------------------------------------------------------
+
+def close_hard(sock) -> None:
+    """shutdown() then close(): close() alone neither wakes a thread
+    blocked in recv() on the socket nor sends the FIN while that
+    thread's syscall pins the file description — the reader (ours or
+    the peer's) would block forever on a 'closed' connection. Also the
+    only reliable way to wake a thread blocked in accept() on a
+    listening socket."""
+    try:
+        sock.shutdown(_socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _recv_exact(sock, n: int, what: str,
+                allow_eof: bool = False) -> Optional[bytes]:
+    """Read exactly ``n`` bytes. Clean EOF before the FIRST byte returns
+    None when ``allow_eof`` (a peer closing between frames is a normal
+    hangup); EOF anywhere else is a mid-frame disconnect ->
+    TransportError."""
+    parts = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if not parts and allow_eof:
+                return None
+            raise TransportError(
+                f"connection closed mid-frame ({got}/{n} B of {what})")
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+def recv_frame(sock) -> Optional[tuple[int, int, bytes]]:
+    """Read one complete frame -> (msg_type, req_id, payload), or None
+    on a clean EOF at a frame boundary. Strict: any malformation raises
+    TransportError and the caller must drop the connection."""
+    header = _recv_exact(sock, HEADER.size, "frame header", allow_eof=True)
+    if header is None:
+        return None
+    msg_type, req_id, length = decode_header(header)
+    payload = _recv_exact(sock, length, "frame payload") if length else b""
+    return msg_type, req_id, payload

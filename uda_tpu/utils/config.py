@@ -143,6 +143,30 @@ _FLAG_LIST = [
          "failpoint arming spec, same syntax as UDA_FAILPOINTS: "
          "comma-separated site=action[:arg][:trigger...] entries "
          "(uda_tpu.utils.failpoints)"),
+    # --- network shuffle data plane (uda_tpu/net/) ---
+    Flag("uda.tpu.net.listen", False, bool,
+         "start a ShuffleServer (the TCP shuffle data plane, the "
+         "reference's RDMAServer role) next to the role's DataEngine at "
+         "INIT; stopped with the engine at EXIT/teardown"),
+    Flag("uda.tpu.net.port", 9012, int,
+         "shuffle data-plane TCP port: the server's bind port (0 = "
+         "ephemeral) and the default port the socket fetch factory "
+         "dials when a supplier host carries no ':port' suffix (one "
+         "above the reference's 9011 control-plane rdma_cm port)"),
+    Flag("uda.tpu.net.bind", "0.0.0.0", str,
+         "listen address for the shuffle server"),
+    Flag("uda.tpu.net.fetch", False, bool,
+         "route reduce-side fetches over the socket data plane: INIT "
+         "builds a HostRoutingClient whose default factory dials each "
+         "supplier host's ShuffleServer (host[:port], one multiplexed "
+         "connection per host) instead of a local in-process client"),
+    Flag("uda.tpu.net.connect.timeout.s", 10.0, float,
+         "TCP connect timeout per dial; a failed/timed-out dial "
+         "completes the fetch with TransportError and the Segment's "
+         "RetryPolicy paces the reconnect attempts"),
+    Flag("uda.tpu.net.drain.s", 5.0, float,
+         "graceful server stop: how long stop() lets in-flight "
+         "responses flush before closing connections"),
     # --- memory admission / pressure-response knobs (utils/budget.py) ---
     Flag("uda.tpu.hbm.budget.mb", 0, int,
          "per-chip HBM budget for the device row matrix + merge working "

@@ -35,10 +35,16 @@ issues by 50 ms, reproducibly. Triggers are deterministic: ``every`` and
 ``once`` count calls under a lock, ``prob`` uses a per-site seeded RNG —
 a chaos schedule (``chaos_spec``) replays exactly from its seed.
 
-Known sites: ``data_engine.pread`` (supplier chunk read — the only site
-that carries data, so truncate/corrupt apply), ``segment.fetch`` (the
+Known sites: ``data_engine.pread`` (supplier chunk read — carries data,
+so truncate/corrupt apply), ``segment.fetch`` (the
 InputClient.start_fetch boundary), ``exchange.round`` (one all-to-all
-round), ``bridge.upcall`` (the data_from_uda consumer call).
+round), ``bridge.upcall`` (the data_from_uda consumer call), and the
+network data plane (uda_tpu/net): ``net.frame`` (every outbound wire
+frame, server responses and client requests — data-bearing, so
+truncate tears a frame mid-stream and the sender then closes the
+connection, a deterministic disconnect), ``net.accept`` (per accepted
+connection: delay = slow accept, error = dropped at birth) and
+``net.connect`` (per client dial).
 """
 
 from __future__ import annotations
@@ -57,7 +63,7 @@ from uda_tpu.utils.errors import (ConfigError, MergeError, ProtocolError,
 from uda_tpu.utils.metrics import metrics
 
 __all__ = ["Failpoint", "FailpointRegistry", "failpoints", "failpoint",
-           "chaos_spec"]
+           "chaos_spec", "net_chaos_spec"]
 
 _ACTIONS = ("error", "delay", "truncate", "corrupt")
 
@@ -77,6 +83,9 @@ _SITE_ERRORS = {
     "segment.fetch": TransportError,
     "exchange.round": TransportError,
     "bridge.upcall": UdaError,
+    "net.frame": TransportError,
+    "net.accept": TransportError,
+    "net.connect": TransportError,
 }
 
 
@@ -305,6 +314,28 @@ def chaos_spec(seed: int) -> str:
     fetch = (f"delay:{rng.randint(1, 10)}:prob:0.15"
              f":seed:{rng.randint(0, 999)}")
     return f"data_engine.pread={pread},segment.fetch={fetch}"
+
+
+def net_chaos_spec(seed: int) -> str:
+    """A seeded *recoverable* schedule for the network data plane
+    (scripts/run_chaos.sh's network rung): torn frames (the sender then
+    closes — a mid-stream disconnect the Segment retry machinery must
+    absorb by reconnecting), slow accepts and slow dials. Same
+    single-restart-inducing-site rule as :func:`chaos_spec`: exactly
+    ONE of the error/truncate shapes is armed (on ``net.frame``) while
+    ``net.accept``/``net.connect`` only ever delay — two periodic
+    connection-killing sites can phase-lock against a multi-fetch
+    segment and livelock the retry loop by construction."""
+    rng = random.Random(seed)
+    frame = rng.choice([
+        f"truncate:{rng.randint(4, 64)}:every:{rng.randint(5, 9)}",
+        f"error:every:{rng.randint(5, 9)}",
+    ])
+    accept = f"delay:{rng.randint(1, 25)}:prob:0.3:seed:{rng.randint(0, 999)}"
+    connect = (f"delay:{rng.randint(1, 10)}:prob:0.2"
+               f":seed:{rng.randint(0, 999)}")
+    return (f"net.frame={frame},net.accept={accept},"
+            f"net.connect={connect}")
 
 
 def _load_env(env=None) -> None:

@@ -109,6 +109,24 @@ METRICS_REGISTRY: Dict[str, tuple] = {
     "exchange.rounds": ("counter", "all-to-all exchange rounds executed"),
     "decompress.bytes": ("counter", "uncompressed bytes produced by the "
                                     "decompressing fetch client"),
+    # -- counters: network data plane (uda_tpu/net/) ---------------------
+    "net.accepts": ("counter", "connections accepted by the shuffle "
+                               "server"),
+    "net.requests": ("counter", "REQ frames handed to the engine by "
+                                "the server"),
+    "net.errors": ("counter", "typed ERR frames completed to clients"),
+    "net.bytes.in": ("counter", "wire bytes received [labels: role="
+                                "server|client]"),
+    "net.bytes.out": ("counter", "wire bytes sent [labels: role="
+                                 "server|client]"),
+    "net.connects": ("counter", "client connections established "
+                                "[labels: host]"),
+    "net.connect.failures": ("counter", "client dials that failed "
+                                        "[labels: host]"),
+    "net.disconnects": ("counter", "connections torn down on error/"
+                                   "EOF/torn frame [labels: role]"),
+    "net.frames.orphaned": ("counter", "frames for no-longer-pending "
+                                       "request ids (stale epoch)"),
     # -- gauges ----------------------------------------------------------
     "fetch.on_air": ("gauge", "fetch attempts currently in flight "
                               "(reference AIO on-air counter)"),
@@ -119,6 +137,14 @@ METRICS_REGISTRY: Dict[str, tuple] = {
     "supplier.read.bytes.on_air": ("gauge", "ShuffleRequest bytes "
                                            "queued or being read "
                                            "(the admission level)"),
+    "net.server.connections": ("gauge", "shuffle-server connections "
+                                        "currently open"),
+    "net.client.connections": ("gauge", "RemoteFetchClient connections "
+                                        "currently open"),
+    "net.server.inflight": ("gauge", "requests inside the server "
+                                     "pipeline (engine + outbound "
+                                     "queue; bounded per conn by "
+                                     "mapred.rdma.wqe.per.conn)"),
     # -- histograms (recorded only while stats are enabled) --------------
     "fetch.latency_ms": ("histogram", "per-chunk fetch latency "
                                       "[labels: supplier]"),
@@ -127,6 +153,12 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                               "resolve latency"),
     "merge.wait_ms": ("histogram", "staging-thread wait for the next "
                                    "completed segment"),
+    "net.frame.latency_ms": ("histogram", "request->response frame "
+                                          "latency [labels: role — "
+                                          "server: REQ read to reply "
+                                          "written; client: request "
+                                          "sent to completion "
+                                          "dispatched]"),
 }
 
 # Dynamically-named families (f-string call sites): the static prefix
