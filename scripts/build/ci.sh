@@ -37,6 +37,14 @@ else
   python scripts/build/check_java.py 2>&1 | tee -a "$ART/ci.log"
 fi
 
+# Static analysis gate: the project invariants (metrics registry,
+# config-key declaration, failpoint sites, shutdown-before-close,
+# structured-cause branching, no silent swallows, no blocking under a
+# lock) are machine-enforced BEFORE any test runs — a violation is a
+# build failure, like the reference's scripts/build check_* gates.
+echo "-- udalint static analysis" | tee -a "$ART/ci.log"
+python scripts/udalint.py uda_tpu scripts 2>&1 | tee -a "$ART/ci.log" | tail -1
+
 echo "-- unit + engine tests" | tee -a "$ART/ci.log"
 python -m pytest tests/ -q 2>&1 | tee "$ART/pytest.log" | tail -2
 

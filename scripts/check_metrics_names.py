@@ -7,71 +7,38 @@ site in ``uda_tpu/`` must name a metric that
 2. is listed in the registry table ``METRICS_REGISTRY`` (or, for
    f-string names, starts with a ``REGISTRY_PREFIXES`` prefix).
 
-Run directly (exit 1 on violations) or through the tier-1 suite
-(``tests/test_metrics.py::test_metrics_names_lint``). The point is that
-a metric cannot be added ad hoc: the registry doubles as the documented
-schema of the JSON-lines stats stream, so a name that never made it
-into the table never made it into the docs either.
+Since PR 5 this is a thin wrapper over the udalint **UDA002** AST rule
+(``uda_tpu.analysis.rules.MetricsNameRule``) — the old regex engine
+missed multiline call sites and aliased receivers (``from ... import
+metrics as m``); the AST pass sees both. Same CLI and exit-code
+contract as before: run directly (exit 1 on violations) or through the
+tier-1 suite (``tests/test_metrics.py::test_metrics_names_lint``). The
+point is unchanged: a metric cannot be added ad hoc — the registry
+doubles as the documented schema of the JSON-lines stats stream, so a
+name that never made it into the table never made it into the docs
+either.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 from typing import List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# first argument of a metrics call: a plain or f- string literal, or
-# anything else (flagged: names must be statically auditable)
-_CALL = re.compile(
-    r"metrics\.(?:add|gauge|gauge_add|observe)\(\s*"
-    r"(?:(f?)([\"'])([^\"']*)\2|([A-Za-z_][\w.\[\]]*))")
-
-
-def _metrics_defs():
-    sys.path.insert(0, REPO)
-    from uda_tpu.utils.metrics import (METRICS_REGISTRY, NAME_RE,
-                                       REGISTRY_PREFIXES)
-    return METRICS_REGISTRY, REGISTRY_PREFIXES, re.compile(NAME_RE + r"\Z")
-
 
 def check(root: str = None) -> List[Tuple[str, int, str, str]]:
     """Returns violations as (file, line, name, reason) tuples."""
-    registry, prefixes, name_re = _metrics_defs()
+    sys.path.insert(0, REPO)
+    from uda_tpu.analysis.core import Engine
+    from uda_tpu.analysis.rules import MetricsNameRule
+
     root = root or os.path.join(REPO, "uda_tpu")
-    bad: List[Tuple[str, int, str, str]] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path) as f:
-                text = f.read()
-            rel = os.path.relpath(path, REPO)
-            for m in _CALL.finditer(text):
-                line = text.count("\n", 0, m.start()) + 1
-                fstr, name, expr = m.group(1), m.group(3), m.group(4)
-                if expr is not None:
-                    bad.append((rel, line, expr,
-                                "metric name must be a string literal"))
-                    continue
-                if fstr:
-                    prefix = name.split("{", 1)[0]
-                    if not any(prefix.startswith(p) for p in prefixes):
-                        bad.append((rel, line, name,
-                                    f"f-string prefix {prefix!r} not in "
-                                    f"REGISTRY_PREFIXES {prefixes}"))
-                    continue
-                if not name_re.match(name):
-                    bad.append((rel, line, name,
-                                "not dotted domain.metric namespace"))
-                elif name not in registry:
-                    bad.append((rel, line, name,
-                                "not listed in METRICS_REGISTRY"))
-    return bad
+    findings = Engine([MetricsNameRule()], root=REPO).lint_paths([root])
+    return [(f.file, f.line, (f.data or {}).get("name", ""),
+             (f.data or {}).get("reason", f.message))
+            for f in findings]
 
 
 def main() -> int:

@@ -40,8 +40,9 @@ def _snapshot_env(out_dir: str) -> None:
         info["git_head"] = subprocess.run(
             ["git", "rev-parse", "HEAD"], cwd=REPO, capture_output=True,
             text=True, timeout=30).stdout.strip()
-    except Exception:  # noqa: BLE001 - best-effort collection
-        pass
+    except Exception as e:  # noqa: BLE001 - best-effort collection: the
+        # failure itself is worth archiving with the snapshot
+        info["git_head_error"] = f"{type(e).__name__}: {e}"
     with open(os.path.join(out_dir, "environment.json"), "w") as f:
         json.dump(info, f, indent=2)
 

@@ -64,29 +64,75 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
     -k "net" \
     --continue-on-collection-errors "$@" || nrc=$?
 
+# Lockdep rung: the whole faults tier again with the runtime lock-order
+# validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
+# guarantees, both checked: the seeded AB/BA inversion fixture
+# (tests/test_udalint.py, on a private LockDep) must be DETECTED — its
+# own assertion fails the tier otherwise — while the REAL code under
+# chaos must produce ZERO cycles on the process-global validator: any
+# uda_tpu lock-order inversion lands as a lockdep.cycles counter plus a
+# cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
+LCOUNTERS="$(mktemp)"
+LCYCLES="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
+echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
+lrc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${LCYCLES}" \
+    UDA_TPU_CHAOS_TELEMETRY="${LCOUNTERS}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    --continue-on-collection-errors "$@" || lrc=$?
+
+mrc=0
 python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${PSPEC}" "${PCOUNTERS}" "${prc}" \
-    "${NSPEC}" "${NCOUNTERS}" "${nrc}" <<'EOF'
+    "${NSPEC}" "${NCOUNTERS}" "${nrc}" \
+    "${LCOUNTERS}" "${lrc}" "${LCYCLES}" <<'EOF' || mrc=$?
 import json, sys
 (seed, spec, counters_path, out, rc, pspec, pcounters, prc,
- nspec, ncounters, nrc) = sys.argv[1:12]
+ nspec, ncounters, nrc, lcounters, lrc, lcycles) = sys.argv[1:15]
 def load(path):
     try:
         with open(path) as f:
             return json.load(f)
     except Exception:
         return {"counters": {}}
+def load_cycles(path):
+    reports = []
+    try:
+        with open(path) as f:
+            reports = [json.loads(ln) for ln in f if ln.strip()]
+    except Exception:
+        pass
+    return reports
+ltelem = load(lcounters)
+cycle_reports = load_cycles(lcycles)
 with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
                "pytest_exit": int(rc), "telemetry": load(counters_path),
                "pressure": {"schedule": pspec, "pytest_exit": int(prc),
                             "telemetry": load(pcounters)},
                "network": {"schedule": nspec, "pytest_exit": int(nrc),
-                           "telemetry": load(ncounters)}},
+                           "telemetry": load(ncounters)},
+               "lockdep": {"schedule": spec, "pytest_exit": int(lrc),
+                           "cycles": int(ltelem.get("counters", {})
+                                         .get("lockdep.cycles", 0)),
+                           "cycle_reports": cycle_reports,
+                           "telemetry": ltelem}},
               f, indent=1, sort_keys=True)
     f.write("\n")
-print(f"chaos telemetry:     {out}")
+ncyc = len(cycle_reports)
+print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc})")
+# the zero-cycles-on-real-code guarantee is ENFORCED, not just
+# printed: a detected inversion that never got the unlucky scheduling
+# still fails the tier (that is the entire point of lockdep)
+sys.exit(3 if ncyc else 0)
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
+if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
+if [ "${mrc}" -ne 0 ]; then
+  echo "LOCKDEP: cycle reports on real code (see CHAOS_TELEMETRY.json)" >&2
+  rc="${mrc}"
+fi
 exit "${rc}"

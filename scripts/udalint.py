@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""udalint CLI: the shuffle stack's AST invariant linter.
+
+Runs the uda_tpu.analysis rule suite (UDA001-UDA007, see
+``--list-rules``) over the given files/directories and prints findings
+as ``file:line:col: RULE message [fix: hint]``. Exit 1 when any
+non-suppressed finding exists, 0 on a clean tree.
+
+Usage::
+
+    python scripts/udalint.py [paths ...]       # default: uda_tpu scripts
+    python scripts/udalint.py --list-rules
+    python scripts/udalint.py --rule UDA004 uda_tpu/net
+
+Suppression: append ``# udalint: disable=<RULE>[,<RULE>...]`` (or
+``disable=all``) to the offending line. ``scripts/build/ci.sh`` runs
+this gate before the test tiers; ``tests/test_udalint.py`` keeps the
+whole tree clean in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="udalint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint "
+                         "(default: uda_tpu scripts)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule inventory and exit")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="ID", help="run only these rule ids "
+                                       "(repeatable)")
+    args = ap.parse_args(argv)
+
+    from uda_tpu.analysis.core import Engine, iter_py_files
+    from uda_tpu.analysis.rules import ALL_RULES
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.rule_id}  {cls.description}")
+        return 0
+
+    wanted = {r.upper() for r in args.rule} if args.rule else None
+    rules = [cls() for cls in ALL_RULES
+             if wanted is None or cls.rule_id in wanted]
+    if wanted and not rules:
+        print(f"udalint: no such rule(s): {', '.join(sorted(wanted))}",
+              file=sys.stderr)
+        return 2
+
+    paths = [os.path.join(REPO, p) if not os.path.isabs(p) else p
+             for p in (args.paths or ["uda_tpu", "scripts"])]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"udalint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    engine = Engine(rules, root=REPO)
+    findings = engine.lint_paths(paths)
+    for f in findings:
+        print(f.render(), file=sys.stderr)
+    nfiles = len(iter_py_files(paths))
+    if findings:
+        print(f"udalint: {len(findings)} finding(s) in {nfiles} file(s)",
+              file=sys.stderr)
+        return 1
+    print(f"udalint: {nfiles} file(s) clean "
+          f"({len(rules)} rule(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -188,3 +188,46 @@ def test_chained_fetches_under_delay_failpoint_no_deadlock(tmp_path):
         engine.stop()
     assert all(s.ready for s in out["segs"])
     assert sum(s.num_records for s in out["segs"]) == 240
+
+
+@pytest.mark.faults
+def test_sync_fetch_timeout_releases_admission_budget(tmp_path):
+    """fetch() is deadline-bounded (derived from mapred.rdma.fetch.*)
+    AND accounting-clean on both timeout shapes: a request cancelled
+    while still QUEUED (its _serve never runs) must hand back its
+    admission bytes and gauges, or repeated timeouts pin the read
+    budget on an idle engine."""
+    import time
+
+    from uda_tpu.utils.failpoints import failpoints
+    from uda_tpu.utils.metrics import metrics
+
+    make_mof_tree(str(tmp_path), "job9", num_maps=1, num_reducers=1,
+                  records_per_map=20)
+    cfg = Config({"mapred.uda.provider.blocked.threads.per.disk": 1,
+                  "mapred.rdma.fetch.attempt.timeout.ms": 200})
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    assert engine.sync_fetch_timeout_s == pytest.approx(0.2)
+    mid = map_ids("job9", 1)[0]
+    try:
+        with failpoints.scoped("data_engine.pread=delay:800"):
+            # occupy the single reader thread...
+            running = engine.submit(ShuffleRequest("job9", mid, 0, 0, 512))
+            time.sleep(0.05)
+            # ...so this one times out QUEUED and gets truly cancelled
+            with pytest.raises(StorageError, match="did not complete"):
+                engine.fetch(ShuffleRequest("job9", mid, 0, 0, 512))
+            running.result(timeout=5.0)
+        # the running read settled in _serve, the cancelled one in
+        # fetch(): all admission state must be back to idle
+        deadline = time.monotonic() + 5.0
+        while engine._admitted_bytes and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert engine._admitted_bytes == 0
+        assert metrics.get_gauge("supplier.read.bytes.on_air") == 0
+        assert metrics.get_gauge("supplier.reads.on_air") == 0
+        # and the engine is NOT spuriously "exhausted" afterwards
+        res = engine.fetch(ShuffleRequest("job9", mid, 0, 0, 1 << 20))
+        assert res.data
+    finally:
+        engine.stop()

@@ -1,0 +1,625 @@
+"""udalint + lockdep tier-1 coverage.
+
+Three layers:
+
+1. per-rule fixtures: every rule (UDA001-UDA007) is proven to FIRE on a
+   minimal bad snippet and to stay quiet on the corresponding good
+   shape, with injected registries so the fixtures never chase the live
+   tables;
+2. the suppression contract (``# udalint: disable=...``);
+3. the whole-tree clean gate: ``uda_tpu/`` and ``scripts/`` must be
+   finding-free — the same gate ``scripts/udalint.py`` (and ci.sh) runs;
+
+plus the dynamic half: TrackedLock/TrackedCondition lockdep unit tests
+including the seeded AB/BA inversion fixture (marked ``faults`` so the
+chaos tier's lockdep rung re-proves detection under fault schedules).
+Fixture inversions use PRIVATE LockDep instances: the process-global
+validator must report zero cycles on real code, and a seeded fixture
+cycle must never pollute that invariant (or its ``lockdep.cycles``
+metric).
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from uda_tpu.analysis.core import Engine, Finding
+from uda_tpu.analysis.rules import (ALL_RULES, BlockingInLockRule,
+                                    ConfigKeyRule, FailpointSiteRule,
+                                    MetricsNameRule, RawSocketCloseRule,
+                                    ReasonStringBranchRule,
+                                    SwallowedExceptionRule)
+from uda_tpu.utils.locks import LockDep, TrackedCondition, TrackedLock
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NAME_RE = r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+"
+
+
+def lint(src: str, rules, rel: str = "uda_tpu/x.py") -> list[Finding]:
+    return Engine(rules).lint_source(textwrap.dedent(src), rel)
+
+
+def rule_ids(findings) -> list[str]:
+    return [f.rule for f in findings]
+
+
+# -- UDA001: config keys -----------------------------------------------------
+
+
+class TestConfigKeyRule:
+    RULES = [ConfigKeyRule(flags={"uda.tpu.known", "mapred.known.key"})]
+
+    def test_undeclared_key_fires(self):
+        out = lint('cfg.get("uda.tpu.un.known")\n', self.RULES)
+        assert rule_ids(out) == ["UDA001"]
+        assert "uda.tpu.un.known" in out[0].message
+
+    def test_declared_keys_pass(self):
+        out = lint('cfg.get("uda.tpu.known")\n'
+                   'cfg.set("mapred.known.key", 1)\n', self.RULES)
+        assert out == []
+
+    def test_docstrings_and_prose_skipped(self):
+        out = lint('"""Talks about uda.tpu.un.known at length."""\n'
+                   'x = "see the uda.tpu.un.known knob"\n', self.RULES)
+        assert out == []  # docstring + non-key-shaped prose
+
+    def test_mapred_prefix_checked_too(self):
+        out = lint('cfg.get("mapred.not.a.key")\n', self.RULES)
+        assert rule_ids(out) == ["UDA001"]
+
+    def test_suppression_silences(self):
+        out = lint('cfg.get("uda.tpu.un.known")  '
+                   '# udalint: disable=UDA001\n', self.RULES)
+        assert out == []
+
+
+# -- UDA002: metrics names ---------------------------------------------------
+
+
+class TestMetricsNameRule:
+    def rules(self):
+        return [MetricsNameRule(registry={"fetch.bytes"},
+                                prefixes=("failpoint.",),
+                                name_re=NAME_RE)]
+
+    def test_registered_literal_passes(self):
+        assert lint('metrics.add("fetch.bytes", 4)\n', self.rules()) == []
+
+    def test_unregistered_literal_fires(self):
+        out = lint('metrics.add("nope.metric")\n', self.rules())
+        assert rule_ids(out) == ["UDA002"]
+        assert out[0].data["reason"] == "not listed in METRICS_REGISTRY"
+
+    def test_bad_namespace_fires(self):
+        out = lint('metrics.gauge("NotDotted", 1)\n', self.rules())
+        assert out[0].data["reason"] == "not dotted domain.metric namespace"
+
+    def test_non_literal_name_fires(self):
+        out = lint('metrics.observe(some_var, 1)\n', self.rules())
+        assert "string literal" in out[0].data["reason"]
+
+    def test_fstring_prefix_family(self):
+        good = lint('metrics.add(f"failpoint.{site}")\n', self.rules())
+        assert good == []
+        bad = lint('metrics.add(f"mystery.{site}")\n', self.rules())
+        assert rule_ids(bad) == ["UDA002"]
+
+    def test_aliased_receiver_caught(self):
+        # the old regex engine ONLY matched the spelling `metrics.` —
+        # an import alias walked straight past it
+        src = """
+        from uda_tpu.utils.metrics import metrics as m
+        m.add("nope.metric")
+        """
+        assert rule_ids(lint(src, self.rules())) == ["UDA002"]
+
+    def test_assigned_alias_caught(self):
+        src = """
+        from uda_tpu.utils.metrics import metrics
+        hub = metrics
+        hub.gauge_add("nope.metric", 1)
+        """
+        assert rule_ids(lint(src, self.rules())) == ["UDA002"]
+
+    def test_multiline_call_caught(self):
+        # the other regex blind spot: the name on a continuation line
+        src = """
+        metrics.add(
+            "nope.metric",
+            42)
+        """
+        assert rule_ids(lint(src, self.rules())) == ["UDA002"]
+
+    def test_set_add_not_confused(self):
+        assert lint('seen.add("anything at all")\n', self.rules()) == []
+
+
+# -- UDA003: failpoint sites -------------------------------------------------
+
+
+class TestFailpointSiteRule:
+    RULES = [FailpointSiteRule(sites={"good.site"})]
+
+    def test_registered_site_passes(self):
+        assert lint('failpoint("good.site", key="k")\n', self.RULES) == []
+
+    def test_unknown_site_fires(self):
+        out = lint('failpoint("typo.site")\n', self.RULES)
+        assert rule_ids(out) == ["UDA003"]
+
+    def test_dynamic_site_fires(self):
+        out = lint('failpoint(site_var)\n', self.RULES)
+        assert rule_ids(out) == ["UDA003"]
+
+    def test_live_inventory_matches_tree(self):
+        # the default-constructed rule loads KNOWN_SITES; every real
+        # call site must resolve (this is the live half of the gate)
+        from uda_tpu.utils.failpoints import KNOWN_SITES
+        assert "segment.fetch" in KNOWN_SITES
+
+
+# -- UDA004: raw socket close in net/ ----------------------------------------
+
+
+class TestRawSocketCloseRule:
+    RULES = [RawSocketCloseRule()]
+
+    def test_raw_close_in_net_fires(self):
+        out = lint("sock.close()\n", self.RULES,
+                   rel="uda_tpu/net/server.py")
+        assert rule_ids(out) == ["UDA004"]
+
+    def test_close_hard_passes(self):
+        out = lint("wire.close_hard(sock)\n", self.RULES,
+                   rel="uda_tpu/net/server.py")
+        assert out == []
+
+    def test_wire_py_exempt(self):
+        # close_hard's own implementation must be allowed to close
+        out = lint("sock.close()\n", self.RULES, rel="uda_tpu/net/wire.py")
+        assert out == []
+
+    def test_outside_net_exempt(self):
+        out = lint("sock.close()\n", self.RULES,
+                   rel="uda_tpu/merger/segment.py")
+        assert out == []
+
+    def test_self_sock_attribute_fires(self):
+        out = lint("self._sock.close()\n", self.RULES,
+                   rel="uda_tpu/net/client.py")
+        assert rule_ids(out) == ["UDA004"]
+
+
+# -- UDA005: reason-string branching -----------------------------------------
+
+
+class TestReasonStringBranchRule:
+    RULES = [ReasonStringBranchRule()]
+
+    def test_str_exception_membership_fires(self):
+        src = """
+        try:
+            work()
+        except Exception as e:
+            if "timed out" in str(e):
+                retry()
+        """
+        assert rule_ids(lint(src, self.RULES)) == ["UDA005"]
+
+    def test_str_exception_equality_fires(self):
+        src = """
+        try:
+            work()
+        except Exception as e:
+            if str(e) == "pool exhausted":
+                backoff()
+        """
+        assert rule_ids(lint(src, self.RULES)) == ["UDA005"]
+
+    def test_str_exception_startswith_fires(self):
+        src = """
+        try:
+            work()
+        except Exception as e:
+            if str(e).startswith("supplier read pool"):
+                backoff()
+        """
+        assert rule_ids(lint(src, self.RULES)) == ["UDA005"]
+
+    def test_reason_attr_compare_fires(self):
+        src = 'retry = adm.reason == "over the host budget"\n'
+        assert rule_ids(lint(src, self.RULES)) == ["UDA005"]
+
+    def test_cause_enum_compare_passes(self):
+        src = 'bounded = adm.cause == "hbm"\n'
+        assert lint(src, self.RULES) == []
+
+    def test_str_of_non_exception_passes(self):
+        src = 'ok = str(port) == "9012"\n'
+        assert lint(src, self.RULES) == []
+
+
+# -- UDA006: swallowed exceptions --------------------------------------------
+
+
+class TestSwallowedExceptionRule:
+    RULES = [SwallowedExceptionRule()]
+
+    def test_silent_swallow_fires(self):
+        src = """
+        try:
+            work()
+        except Exception:
+            pass
+        """
+        assert rule_ids(lint(src, self.RULES)) == ["UDA006"]
+
+    def test_bare_except_fires(self):
+        src = """
+        try:
+            work()
+        except:
+            return None
+        """
+        assert rule_ids(lint(src, self.RULES)) == ["UDA006"]
+
+    def test_logged_passes(self):
+        src = """
+        try:
+            work()
+        except Exception as e:
+            log.warn(f"best effort: {e}")
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_counted_passes(self):
+        src = """
+        try:
+            work()
+        except Exception:
+            metrics.add("errors.swallowed")
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_reraise_passes(self):
+        src = """
+        try:
+            work()
+        except Exception:
+            cleanup()
+            raise
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_forwarded_exception_passes(self):
+        src = """
+        try:
+            work()
+        except Exception as e:
+            on_complete(e)
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_narrow_handler_exempt(self):
+        src = """
+        try:
+            work()
+        except OSError:
+            pass
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_suppression_silences(self):
+        src = """
+        try:
+            work()
+        except Exception:  # udalint: disable=UDA006
+            pass
+        """
+        assert lint(src, self.RULES) == []
+
+
+# -- UDA007: blocking under a lock -------------------------------------------
+
+
+class TestBlockingInLockRule:
+    RULES = [BlockingInLockRule()]
+
+    def test_bare_result_under_lock_fires(self):
+        src = """
+        with self._lock:
+            data = fut.result()
+        """
+        out = lint(src, self.RULES)
+        assert rule_ids(out) == ["UDA007"]
+        assert "result" in out[0].message
+
+    def test_bounded_result_passes(self):
+        src = """
+        with self._lock:
+            data = fut.result(timeout=5.0)
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_queue_get_under_lock_fires(self):
+        src = """
+        with done_lock:
+            item = outq.get()
+        """
+        assert rule_ids(lint(src, self.RULES)) == ["UDA007"]
+
+    def test_dict_get_not_confused(self):
+        src = """
+        with self._lock:
+            v = table.get(key)
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_unbounded_wait_under_cv_fires(self):
+        src = """
+        with self._cv:
+            while not ready:
+                self._cv.wait()
+        """
+        assert rule_ids(lint(src, self.RULES)) == ["UDA007"]
+
+    def test_bounded_wait_passes(self):
+        src = """
+        with self._cv:
+            while not ready:
+                self._cv.wait(timeout=0.25)
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_recv_under_lock_fires(self):
+        src = """
+        with self._wlock:
+            data = sock.recv(4096)
+        """
+        assert rule_ids(lint(src, self.RULES)) == ["UDA007"]
+
+    def test_non_lock_with_exempt(self):
+        src = """
+        with open(path) as f:
+            data = fut.result()
+        """
+        assert lint(src, self.RULES) == []
+
+    def test_deferred_code_exempt(self):
+        # a callback DEFINED under the lock does not RUN under it
+        src = """
+        with self._lock:
+            def cb(f):
+                return f.result()
+            fut.add_done_callback(cb)
+        """
+        assert lint(src, self.RULES) == []
+
+
+# -- engine plumbing ---------------------------------------------------------
+
+
+class TestEngine:
+    def test_parse_error_is_a_finding(self):
+        out = Engine([ConfigKeyRule(flags=set())]).lint_source(
+            "def broken(:\n", "uda_tpu/broken.py")
+        assert rule_ids(out) == ["UDA000"]
+
+    def test_disable_all_silences_every_rule(self):
+        src = ('metrics.add("nope.metric")  # udalint: disable=all\n')
+        rules = [MetricsNameRule(registry=set(), prefixes=(),
+                                 name_re=NAME_RE)]
+        assert lint(src, rules) == []
+
+    def test_findings_sorted_and_rendered(self):
+        src = 'cfg.get("uda.tpu.zzz.bad")\ncfg.get("mapred.aaa.bad")\n'
+        out = lint(src, [ConfigKeyRule(flags=set())])
+        assert [f.line for f in out] == [1, 2]
+        assert "uda_tpu/x.py:1:" in out[0].render()
+        assert "[fix:" in out[0].render()
+
+
+# -- the whole-tree clean gate (the same gate ci.sh runs) --------------------
+
+
+def test_tree_clean():
+    findings = Engine([cls() for cls in ALL_RULES], root=REPO).lint_paths(
+        [os.path.join(REPO, "uda_tpu"), os.path.join(REPO, "scripts")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_check_metrics_names_wrapper_contract(tmp_path):
+    """The old CLI's check() contract survives the AST port: tuples of
+    (file, line, name, reason), aliased receivers now included."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_names",
+        os.path.join(REPO, "scripts", "check_metrics_names.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = tmp_path / "bad.py"
+    bad.write_text("from uda_tpu.utils.metrics import metrics as m\n"
+                   "m.add(\n    'not.registered')\n")
+    violations = mod.check(root=str(tmp_path))
+    assert len(violations) == 1
+    _, line, name, reason = violations[0]
+    assert (line, name) == (3, "not.registered")
+    assert reason == "not listed in METRICS_REGISTRY"
+
+
+# -- lockdep: the dynamic half -----------------------------------------------
+
+
+@pytest.mark.faults
+def test_lockdep_detects_seeded_ab_ba_inversion():
+    """The seeded AB/BA fixture: two lock classes taken in opposite
+    orders by two code paths. No actual deadlock is provoked (the
+    acquisitions are sequential) — lockdep must flag the ORDER, which
+    is exactly what makes it useful before the unlucky scheduling."""
+    dep = LockDep(enabled=True)  # private: the global stays cycle-free
+    a = TrackedLock("fixture.A", dep=dep)
+    b = TrackedLock("fixture.B", dep=dep)
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=ab)
+    t.start()
+    t.join()
+    ba()
+    assert len(dep.cycles) == 1
+    cyc = dep.cycles[0]
+    assert cyc["kind"] == "order-inversion"
+    assert {"fixture.A", "fixture.B"} <= set(cyc["path"])
+    # both stacks present: the current acquire and the first-seen edge
+    assert any("(now)" in k for k in cyc["stacks"])
+    assert any(v for k, v in cyc["stacks"].items() if "(now)" not in k)
+    # dedup: replaying the same inversion does not re-report
+    ba()
+    assert len(dep.cycles) == 1
+
+
+def test_lockdep_consistent_order_is_clean():
+    dep = LockDep(enabled=True)
+    a = TrackedLock("x.outer", dep=dep)
+    b = TrackedLock("x.inner", dep=dep)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert dep.cycles == []
+
+
+def test_lockdep_same_class_nesting_not_an_edge():
+    """Two INSTANCES of one class held together is legitimate (an
+    instance hierarchy); only same-INSTANCE re-acquisition reports."""
+    dep = LockDep(enabled=True)
+    s1 = TrackedLock("seg", dep=dep)
+    s2 = TrackedLock("seg", dep=dep)
+    with s1:
+        with s2:
+            pass
+    assert dep.cycles == []
+
+
+def test_lockdep_self_deadlock_reported_before_blocking():
+    dep = LockDep(enabled=True)
+    s = TrackedLock("solo", dep=dep)
+    assert s.acquire()
+    try:
+        # the re-acquire WILL fail (non-reentrant) — the report must be
+        # written before the wait, or a real wedge would never log it
+        assert s.acquire(timeout=0.05) is False
+        assert len(dep.cycles) == 1
+        assert dep.cycles[0]["kind"] == "self-deadlock"
+    finally:
+        s.release()
+
+
+def test_tracked_condition_wait_releases_the_hold():
+    """A waiter parked in cv.wait must NOT count as holding the lock:
+    another thread can take it (that is what wait means), and lockdep's
+    held stack must agree or every wake pattern would false-cycle."""
+    dep = LockDep(enabled=True)
+    lock = TrackedLock("cv.lock", dep=dep)
+    cv = TrackedCondition(lock)
+    entered = threading.Event()
+    released = threading.Event()
+
+    def waiter():
+        with cv:
+            entered.set()
+            cv.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert entered.wait(timeout=5.0)
+    # while the waiter sits in wait(), the lock is takeable...
+    assert lock.acquire(timeout=2.0)
+    # ...and the waiter's held table shows nothing held
+    held = dep.held_by_thread()
+    assert all("cv.lock" not in classes
+               for who, classes in held.items()
+               if str(t.ident) in who)
+    cv.notify_all()  # legal: this thread holds the raw lock via `lock`
+    lock.release()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert dep.cycles == []
+
+
+def test_tracked_lock_disabled_is_a_plain_lock():
+    dep = LockDep(enabled=False)
+    a = TrackedLock("off.a", dep=dep)
+    b = TrackedLock("off.b", dep=dep)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert dep.cycles == []  # not watching
+    assert dep._edges == {}
+
+
+@pytest.mark.faults
+def test_lockdep_emit_metrics_and_json_report(tmp_path, monkeypatch):
+    """The chaos rung's reporting channel: an emitting LockDep counts
+    ``lockdep.cycles`` and appends the report to UDA_TPU_LOCKDEP_JSON
+    (run_chaos.sh folds that file into CHAOS_TELEMETRY.json)."""
+    import json
+
+    from uda_tpu.utils.metrics import metrics
+
+    out = tmp_path / "cycles.jsonl"
+    monkeypatch.setenv("UDA_TPU_LOCKDEP_JSON", str(out))
+    before = metrics.get("lockdep.cycles")
+    dep = LockDep(enabled=True, emit_metrics=True)
+    a = TrackedLock("emit.A", dep=dep)
+    b = TrackedLock("emit.B", dep=dep)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    try:
+        assert metrics.get("lockdep.cycles") == before + 1
+        rep = json.loads(out.read_text().strip())
+        assert rep["kind"] == "order-inversion"
+        assert {"emit.A", "emit.B"} <= set(rep["path"])
+    finally:
+        # the fixture's synthetic cycle must not leak into the session
+        # telemetry: the chaos rung's "cycles on real code" field sums
+        # this very counter across the run (conftest accumulation)
+        metrics.reset()
+
+
+def test_watchdog_dump_includes_lock_table():
+    from uda_tpu.utils.locks import lockdep
+    from uda_tpu.utils.watchdog import dump_diagnostics
+
+    was = lockdep.enabled
+    lockdep.enabled = True
+    try:
+        hold = TrackedLock("dump.probe")
+        with hold:
+            dump = dump_diagnostics("test")
+        assert "tracked locks held" in dump
+        assert "dump.probe" in dump
+    finally:
+        lockdep.enabled = was
+        lockdep.reset()

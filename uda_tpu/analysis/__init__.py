@@ -1,0 +1,21 @@
+"""udalint: AST invariant linter for the shuffle stack.
+
+Four PRs accreted project invariants that lived only as prose or
+fragile regexes (metrics registry membership, config-key declaration,
+shutdown-before-close, structured-cause branching). This package makes
+them machine-enforced: :mod:`uda_tpu.analysis.core` is a small rule
+engine (one parented AST walk per file, ``# udalint: disable=<rule>``
+suppressions, findings with file:line + rule id + fix hint) and
+:mod:`uda_tpu.analysis.rules` the rule suite encoding the invariants.
+``scripts/udalint.py`` is the CLI; ``scripts/build/ci.sh`` gates on it
+before the test tiers.
+
+The dynamic half of the same program — the runtime lock-order validator
+— lives in :mod:`uda_tpu.utils.locks` (``UDA_TPU_LOCKDEP=1``).
+"""
+
+from uda_tpu.analysis.core import Engine, Finding, Rule, lint_paths
+from uda_tpu.analysis.rules import ALL_RULES, default_engine
+
+__all__ = ["Engine", "Finding", "Rule", "lint_paths", "ALL_RULES",
+           "default_engine"]

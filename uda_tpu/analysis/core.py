@@ -1,0 +1,235 @@
+"""The udalint rule engine.
+
+One engine pass per file: parse, attach parent pointers, collect
+``# udalint: disable=...`` suppression comments (via tokenize, so a
+comment anywhere on a physical line works), then walk the tree ONCE in
+document order dispatching each node to every rule that registered
+interest in its type. Rules are small classes; per-file state (alias
+tables, path predicates) lives in the rule between ``begin_file`` and
+``end_file``, shared read-only context (path, source lines) in the
+:class:`FileContext`.
+
+Suppression syntax (the rule id is case-insensitive)::
+
+    sock.close()  # udalint: disable=UDA004        one rule
+    ...           # udalint: disable=UDA004,UDA006 several
+    ...           # udalint: disable=all           every rule
+
+A suppression silences findings REPORTED on its physical line, so for a
+multi-line statement the comment goes on the line the finding names
+(the node's ``lineno`` — for an ``except`` handler, the ``except``
+line; for a call, the line the call starts on).
+
+Design notes: rules never re-walk the tree (the engine's single walk is
+the contract — a rule that needs ancestry walks ``node.parent``
+pointers up, never the tree down), and findings are plain data so the
+CLI, the test fixtures and the check_metrics_names wrapper all consume
+the same objects.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "FileContext", "Rule", "Engine", "lint_paths",
+           "iter_py_files", "PARSE_RULE_ID"]
+
+# a file that does not parse is itself a finding (the tree gate must
+# fail loudly, not skip silently)
+PARSE_RULE_ID = "UDA000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*udalint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    file: str          # repo-relative path
+    line: int          # 1-based
+    col: int           # 0-based (ast convention)
+    rule: str          # rule id, e.g. "UDA004"
+    message: str       # what is wrong, specifically
+    hint: str = ""     # how to fix it (the rule's standing advice)
+    data: Optional[dict] = None  # rule-specific extras (wrappers use it)
+
+    def render(self) -> str:
+        out = f"{self.file}:{self.line}:{self.col}: {self.rule} {self.message}"
+        if self.hint:
+            out += f" [fix: {self.hint}]"
+        return out
+
+
+class FileContext:
+    """Read-only per-file context handed to every rule callback."""
+
+    def __init__(self, rel: str, source: str, tree: ast.AST):
+        self.rel = rel
+        self.source = source
+        self.tree = tree
+        self.in_net = "uda_tpu/net/" in rel.replace(os.sep, "/")
+        self.basename = os.path.basename(rel)
+
+    def is_docstring(self, node: ast.Constant) -> bool:
+        """True when ``node`` is a module/class/function docstring (the
+        first statement's bare constant)."""
+        expr = getattr(node, "parent", None)
+        if not isinstance(expr, ast.Expr):
+            return False
+        owner = getattr(expr, "parent", None)
+        if not isinstance(owner, (ast.Module, ast.ClassDef,
+                                  ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        body = owner.body
+        return bool(body) and body[0] is expr
+
+
+class Rule:
+    """Base rule. Subclasses set ``rule_id``, ``hint`` and
+    ``node_types`` and implement ``visit`` (and optionally
+    ``begin_file``/``end_file`` for per-file state)."""
+
+    rule_id: str = ""
+    hint: str = ""
+    description: str = ""
+    node_types: Tuple[type, ...] = ()
+
+    def begin_file(self, ctx: FileContext) -> None:
+        pass
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def end_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str,
+                data: Optional[dict] = None) -> Finding:
+        return Finding(ctx.rel, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0),
+                       self.rule_id, message, self.hint, data)
+
+
+def _suppressions(source: str) -> Dict[int, Set[str]]:
+    """line (1-based) -> set of suppressed rule ids ("ALL" = every)."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group(1).split(",")
+                     if r.strip()}
+            out.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass  # the parse-error finding covers broken files
+    return out
+
+
+def _iter_parented(tree: ast.AST) -> Iterable[ast.AST]:
+    """Document-order (preorder) walk that stamps ``node.parent``."""
+    # stamp the WHOLE tree first: a rule visiting a node may walk
+    # parent pointers up from anywhere in that node's subtree (e.g.
+    # UDA005 resolving which except-handler bound the name inside a
+    # nested str(e) call)
+    tree.parent = None  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories to a sorted list of ``.py`` files
+    (``__pycache__`` pruned)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            files.extend(os.path.join(dirpath, fn)
+                         for fn in filenames if fn.endswith(".py"))
+    return sorted(files)
+
+
+class Engine:
+    """Runs a rule set over sources; one parented walk per file."""
+
+    def __init__(self, rules: Sequence[Rule], root: Optional[str] = None):
+        self.rules = list(rules)
+        self.root = root  # rel-path anchor; None = leave paths as given
+        self._dispatch: Dict[type, List[Rule]] = {}
+        for rule in self.rules:
+            for t in rule.node_types:
+                self._dispatch.setdefault(t, []).append(rule)
+
+    def _rel(self, path: str) -> str:
+        if self.root:
+            try:
+                return os.path.relpath(path, self.root)
+            except ValueError:
+                pass
+        return path
+
+    def lint_source(self, source: str, rel: str) -> List[Finding]:
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            return [Finding(rel, e.lineno or 0, e.offset or 0,
+                            PARSE_RULE_ID, f"file does not parse: {e.msg}",
+                            "fix the syntax error")]
+        ctx = FileContext(rel, source, tree)
+        suppressed = _suppressions(source)
+        findings: List[Finding] = []
+        for rule in self.rules:
+            rule.begin_file(ctx)
+        for node in _iter_parented(tree):
+            for rule in self._dispatch.get(type(node), ()):
+                findings.extend(rule.visit(node, ctx))
+        for rule in self.rules:
+            findings.extend(rule.end_file(ctx))
+        if suppressed:
+            findings = [
+                f for f in findings
+                if not (f.line in suppressed
+                        and ("ALL" in suppressed[f.line]
+                             or f.rule.upper() in suppressed[f.line]))]
+        return findings
+
+    def lint_file(self, path: str) -> List[Finding]:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        return self.lint_source(source, self._rel(path))
+
+    def lint_paths(self, paths: Sequence[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in iter_py_files(paths):
+            findings.extend(self.lint_file(path))
+        findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+        return findings
+
+
+def lint_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Convenience entry point: lint ``paths`` with ``rules`` (default:
+    the full suite from :mod:`uda_tpu.analysis.rules`)."""
+    if rules is None:
+        from uda_tpu.analysis.rules import ALL_RULES
+        rules = [cls() for cls in ALL_RULES]
+    return Engine(rules, root=root).lint_paths(paths)

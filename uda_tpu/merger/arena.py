@@ -19,13 +19,13 @@ window (see uda_tpu.mofserver.data_engine docstring).
 from __future__ import annotations
 
 import enum
-import threading
 import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from uda_tpu.utils.errors import MergeError
+from uda_tpu.utils.locks import TrackedCondition, TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -86,8 +86,11 @@ class BufferArena:
         self.slot_size = slot_size
         self._free: list[BufferSlot] = [BufferSlot(slot_size)
                                         for _ in range(num_slots)]
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        # lockdep-tracked (utils/locks.py, UDA_TPU_LOCKDEP=1): the
+        # arena cv is where the reference's wait-for-mem blocked, the
+        # canonical seat of a lost-wakeup/inversion deadlock
+        self._lock = TrackedLock("arena")
+        self._cv = TrackedCondition(self._lock)
         self.num_slots = num_slots
         # soft-pressure hook: an acquire that waits past the threshold
         # reports the exhaustion ONCE per acquire (uda.tpu.arena.

@@ -40,6 +40,7 @@ from uda_tpu.utils.comparators import KeyType, get_key_type
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import FallbackSignal, MergeError, UdaError
 from uda_tpu.utils.failpoints import failpoints
+from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
@@ -66,7 +67,7 @@ class PenaltyBox:
     def __init__(self, threshold: int = 2, penalty_s: float = 1.0):
         self.threshold = max(1, threshold)
         self.penalty_s = penalty_s
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("penalty_box")
         self._faults: dict[str, int] = {}
         self._until: dict[str, float] = {}
 
@@ -185,7 +186,7 @@ class MergeManager:
         order = list(range(len(segs)))
         random.Random(self.seed).shuffle(order)  # MergeManager.cc:58-63
         credits = threading.Semaphore(self.window)
-        done_lock = threading.Lock()
+        done_lock = TrackedLock("merge.fetch_done")
         done = 0
         all_notified = threading.Event()  # ALL on_done callbacks returned
         cb_errors: list[Exception] = []
@@ -527,7 +528,16 @@ class MergeManager:
             segments = self.fetch_all(job_id, map_ids, reduce_id,
                                       on_segment=om.feed)
         except Exception:
-            om.abort()  # also cleans up the run store
+            # the abort (which also cleans up the run store) must never
+            # MASK the fetch error that got us here: a failing cleanup
+            # replacing the root cause is how errors get dropped on the
+            # floor mid-unwind
+            try:
+                om.abort()
+            except Exception as cleanup_err:  # noqa: BLE001
+                metrics.add("errors.swallowed")
+                log.warn(f"overlap abort during failure unwind itself "
+                         f"failed: {cleanup_err}")
             raise
         # the "merge" timer covers drain + forest carry inside the
         # finish paths; emission stays under the emitter's "emit" timer

@@ -28,6 +28,7 @@ from uda_tpu.mofserver.data_engine import DataEngine, FetchResult, ShuffleReques
 from uda_tpu.utils.errors import MergeError, StorageError, TransportError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.ifile import RecordBatch, crack_partial
+from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 from uda_tpu.utils.retry import RetryPolicy
@@ -90,7 +91,13 @@ class LocalFetchClient(InputClient):
             try:
                 total += int(self.engine.resolver.resolve(
                     job_id, mid, reduce_id).raw_length)
-            except Exception:
+            except Exception as e:  # noqa: BLE001 - exact-or-unknown:
+                # the estimate degrades to None, but never silently —
+                # a perpetually-unresolvable index would otherwise hide
+                # behind "the auto policy just picked streaming again"
+                metrics.add("errors.swallowed")
+                log.debug(f"size estimate: {mid} unresolvable ({e}); "
+                          f"partition size unknown")
                 return None
         return total
 
@@ -120,7 +127,7 @@ class HostRoutingClient(InputClient):
                          else self._socket_factory(config))
         self._clients: dict[str, InputClient] = {}
         self._stopped = False
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("host_router")
 
     @staticmethod
     def _socket_factory(config):
@@ -207,8 +214,13 @@ class HostRoutingClient(InputClient):
             try:
                 return self._client_for(host).estimate_partition_bytes(
                     job_id, mids, reduce_id)
-            except Exception:  # noqa: BLE001 - estimate is best-effort;
-                return None    # fetch itself will fail loudly later
+            except Exception as e:  # noqa: BLE001 - estimate is best-
+                # effort (fetch itself will fail loudly later), but the
+                # degradation is counted and logged, never silent
+                metrics.add("errors.swallowed")
+                log.debug(f"size estimate: probe of host {host!r} "
+                          f"failed ({e}); partition size unknown")
+                return None
 
         if len(by_host) == 1:  # the common case, no thread overhead
             host, mids = next(iter(by_host.items()))
@@ -285,7 +297,10 @@ class Segment:
         self._timeout_timer: Optional[threading.Timer] = None
         self._done = threading.Event()
         self._error: Optional[Exception] = None
-        self._lock = threading.Lock()
+        # lockdep-tracked: the segment state machine is driven from
+        # transport completion threads, retry timers AND the merge
+        # thread — the widest thread fan-in in the tree
+        self._lock = TrackedLock("segment.state")
 
     def _notify_done(self) -> None:
         span = self.trace_span

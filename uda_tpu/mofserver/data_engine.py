@@ -33,6 +33,7 @@ import threading
 import time
 import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Dict, Optional
 
 from uda_tpu.mofserver.index import IndexResolver
@@ -207,6 +208,15 @@ class DataEngine:
         if budget_mb <= 0:
             budget_mb = max(256, threads * 32)
         self.read_budget_bytes = budget_mb * (1 << 20)
+        # the synchronous path's wait bound (fetch()): derived from the
+        # reduce side's retry knobs so the two paths give up on a
+        # wedged completion on the same schedule; both unset -> 60 s
+        # (no caller means "forever" by leaving a knob at 0)
+        attempt_ms = int(cfg.get("mapred.rdma.fetch.attempt.timeout.ms"))
+        deadline_ms = int(cfg.get("mapred.rdma.fetch.deadline.ms"))
+        self.sync_fetch_timeout_s = (
+            (attempt_ms or deadline_ms) / 1e3
+            if (attempt_ms or deadline_ms) else 60.0)
         self._admitted_bytes = 0
         self._admit_lock = threading.Lock()
         spec = cfg.get("uda.tpu.failpoints")
@@ -274,7 +284,30 @@ class DataEngine:
         metrics.gauge_add("supplier.read.bytes.on_air", -want)
 
     def fetch(self, req: ShuffleRequest) -> FetchResult:
-        return self.submit(req).result()
+        """Synchronous fetch with a deadline. A wedged read (native pool
+        stall, failpoint delay storm, dead disk) must not hang the
+        caller forever: the wait is bounded by the fetch retry knobs —
+        the per-attempt timeout when set, else the per-segment deadline,
+        else a 60 s default — and a timeout surfaces as StorageError
+        (the same class a dead disk would raise), so sync callers share
+        the async path's failure semantics."""
+        fut = self.submit(req)
+        try:
+            return fut.result(timeout=self.sync_fetch_timeout_s)
+        except FutureTimeout as e:
+            if fut.cancel():
+                # cancelled while still QUEUED: _serve never runs, so
+                # its finally-block accounting never fires — undo the
+                # admission charge here or timeouts would pin the read
+                # budget until submit() rejects an idle engine
+                self._unadmit(req.chunk_size or self.chunk_size_default)
+                metrics.gauge_add("supplier.reads.on_air", -1)
+            # else: the read is running; _serve's finally settles it
+            raise StorageError(
+                f"synchronous fetch of {req.map_id}/{req.reduce_id} at "
+                f"offset {req.offset} did not complete within "
+                f"{self.sync_fetch_timeout_s:g} s (bounded by the "
+                f"mapred.rdma.fetch.* knobs)") from e
 
     def _serve(self, req: ShuffleRequest, admitted: int = 0) -> FetchResult:
         t0 = time.perf_counter()

@@ -49,6 +49,7 @@ from uda_tpu.net import wire
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import TransportError
 from uda_tpu.utils.failpoints import failpoint
+from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -81,8 +82,10 @@ class RemoteFetchClient(InputClient):
                         else cfg.get("uda.tpu.net.port"))
         self.connect_timeout_s = float(
             cfg.get("uda.tpu.net.connect.timeout.s"))
-        self._lock = threading.Lock()       # table + connection state
-        self._wlock = threading.Lock()      # socket write serialization
+        # lockdep-tracked: PR 4's deadlock lived exactly here (reader
+        # blocked in recv holding what close needed)
+        self._lock = TrackedLock("net.client")    # table + conn state
+        self._wlock = TrackedLock("net.client.write")  # write serial.
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[threading.Thread] = None
         self._pending: dict[int, _Waiter] = {}
@@ -121,7 +124,7 @@ class RemoteFetchClient(InputClient):
             if self._stopped or self._sock is not None:
                 # lost the dial race (or stopped underneath): keep the
                 # winner's connection
-                sock.close()
+                wire.close_hard(sock)
                 if self._stopped:
                     raise TransportError(
                         f"RemoteFetchClient({self.host}) is stopped")

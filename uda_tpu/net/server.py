@@ -49,6 +49,7 @@ from uda_tpu.net import wire
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import TransportError, UdaError
 from uda_tpu.utils.failpoints import failpoint
+from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -71,7 +72,7 @@ class _Conn:
         self.draining = threading.Event()
         self._inflight = 0          # requests handed to the engine whose
         self._closing = False       # response is not yet written
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("net.conn")
         self.reader = threading.Thread(
             target=self._read_loop, daemon=True,
             name=f"uda-net-read-{peer}")
@@ -316,7 +317,7 @@ class ShuffleServer:
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set[_Conn] = set()
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("net.server")
         self._stopping = threading.Event()
 
     # -- lifecycle ----------------------------------------------------------
@@ -359,13 +360,13 @@ class ShuffleServer:
                 failpoint("net.accept", key=peer)
             except UdaError as e:
                 log.warn(f"net: accept of {peer} rejected: {e}")
-                sock.close()
+                wire.close_hard(sock)
                 continue
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = _Conn(self, sock, peer)
             with self._lock:
                 if self._stopping.is_set():
-                    sock.close()
+                    wire.close_hard(sock)
                     return
                 self._conns.add(conn)
             metrics.add("net.accepts")

@@ -71,6 +71,10 @@ _FLAG_LIST = [
          "when set, log to a private file instead of the up-call sink"),
     Flag("mapred.uda.provider.blocked.threads.per.disk", 1, int,
          "reader threads per local dir in the supplier data engine"),
+    Flag("mapred.local.dir", "", str,
+         "comma-separated task-local dirs (the Hadoop key); the bridge "
+         "resolves spill directories from it when uda.tpu.spill.dirs "
+         "is unset (reference LocalDirAllocator rotation)"),
     Flag("mapred.rdma.developer.mode", False, bool,
          "abort on failure instead of falling back to vanilla"),
     Flag("mapred.compress.map.output", False, bool, "map outputs are compressed"),

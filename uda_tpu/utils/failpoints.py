@@ -63,7 +63,7 @@ from uda_tpu.utils.errors import (ConfigError, MergeError, ProtocolError,
 from uda_tpu.utils.metrics import metrics
 
 __all__ = ["Failpoint", "FailpointRegistry", "failpoints", "failpoint",
-           "chaos_spec", "net_chaos_spec"]
+           "chaos_spec", "net_chaos_spec", "KNOWN_SITES"]
 
 _ACTIONS = ("error", "delay", "truncate", "corrupt")
 
@@ -87,6 +87,11 @@ _SITE_ERRORS = {
     "net.accept": TransportError,
     "net.connect": TransportError,
 }
+
+# The registered-site inventory. udalint's UDA003 rule checks every
+# ``failpoint("<site>")`` call site in the tree against this tuple, so
+# a typo'd site (a failpoint that can never fire) is a lint error.
+KNOWN_SITES = tuple(_SITE_ERRORS)
 
 
 class Failpoint:

@@ -1,0 +1,354 @@
+"""Runtime lock-order validation (lockdep) for the shuffle threads.
+
+19 modules spawn threads and take locks with no deadlock tooling at
+all — the failure class that cost PR 4 its first version (a reader
+blocked in ``recv()`` holding state another thread needed to close the
+socket). udalint's **UDA007** rule is the static half (no unbounded
+blocking call under a lock); this module is the dynamic half, modeled
+on the kernel's lockdep: locks are grouped into *classes* by name, and
+every acquisition while other locks are held records a directed edge
+``held-class -> acquired-class`` in a process-global order graph. An
+acquisition that would close a cycle in that graph is a potential
+deadlock — two threads CAN interleave the two orders — and is reported
+at acquire time with both stacks (the current one and the stack that
+established the reverse path), long before the unlucky scheduling that
+would actually wedge.
+
+Usage::
+
+    self._lock = TrackedLock("segment.state")
+    self._cv = TrackedCondition(self._lock)       # or its own name
+    with self._lock: ...
+
+Zero-overhead-when-off contract: with ``UDA_TPU_LOCKDEP`` unset the
+wrappers delegate straight to the underlying primitive (one attribute
+check per acquire). Enabled (``UDA_TPU_LOCKDEP=1``), every tracked
+acquire/release maintains a per-thread held stack and the global edge
+graph. ``scripts/run_chaos.sh`` runs the whole faults tier under
+lockdep; detected cycles count ``lockdep.cycles`` and the reports land
+in ``CHAOS_TELEMETRY.json``. The stall watchdog's diagnostic dump
+(:func:`uda_tpu.utils.watchdog.dump_diagnostics`) includes the held-
+lock table when lockdep is on.
+
+Same-class nesting (two INSTANCES of one class held together) is
+deliberately not an edge — like lockdep's nesting annotations, class-
+level self-edges would false-positive on legitimate instance
+hierarchies; re-acquiring the SAME non-reentrant instance, however, is
+reported immediately as a self-deadlock (it will wedge this very
+thread).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LockDep", "TrackedLock", "TrackedCondition", "lockdep",
+           "lockdep_enabled_from_env"]
+
+
+def lockdep_enabled_from_env() -> bool:
+    """UDA_TPU_LOCKDEP=1 (or true/yes/on) arms the validator for the
+    whole process."""
+    return os.environ.get("UDA_TPU_LOCKDEP", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+class LockDep:
+    """The order graph + per-thread held stacks. One global instance
+    (:data:`lockdep`) serves every TrackedLock by default; tests that
+    SEED inversions use private instances so fixture cycles never
+    pollute the real code's zero-cycle invariant (or its metrics)."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 emit_metrics: bool = False):
+        self.enabled = (lockdep_enabled_from_env() if enabled is None
+                        else bool(enabled))
+        self.emit_metrics = emit_metrics
+        self._mu = threading.Lock()   # guards the graph (deliberately a
+        # raw lock: the validator must not validate itself)
+        self._tls = threading.local()
+        # edge (held_class, acquired_class) -> stack where first seen,
+        # plus the incremental adjacency the cycle DFS walks (a cycle
+        # can only APPEAR when a new edge is inserted, so the check —
+        # and the stack capture feeding it — run only then)
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._adj: Dict[str, List[str]] = {}
+        self._reported: set = set()   # cycle keys already reported
+        self.cycles: List[dict] = []  # cycle reports (see _report)
+        # thread ident -> (thread name, held classes): the cross-thread
+        # mirror of the per-thread held stacks (tls is invisible from
+        # other threads, and the watchdog dumps from its own)
+        self._held_all: Dict[int, Tuple[str, List[str]]] = {}
+
+    # -- per-thread held stack ----------------------------------------------
+
+    def _held(self) -> List["TrackedLock"]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def held_by_thread(self) -> Dict[str, List[str]]:
+        """thread label -> held lock classes, every thread that holds
+        anything (the watchdog dump's lock table). Best-effort: the
+        table mutates concurrently, but a wedged thread's entry is
+        static — which is exactly the one a stall dump needs."""
+        with self._mu:
+            snap = dict(self._held_all)
+        return {f"{name} (ident {tid})": list(classes)
+                for tid, (name, classes) in snap.items() if classes}
+
+    def _publish_held(self, held: List["TrackedLock"]) -> None:
+        """Mirror this thread's held stack into the global table the
+        watchdog can read from another thread."""
+        t = threading.current_thread()
+        with self._mu:
+            if held:
+                self._held_all[t.ident] = (t.name,
+                                           [lk.name for lk in held])
+            else:
+                self._held_all.pop(t.ident, None)
+
+    # -- events --------------------------------------------------------------
+
+    def before_acquire(self, lock: "TrackedLock") -> None:
+        """Pre-acquire check: re-acquiring the same non-reentrant
+        instance is a self-deadlock — report BEFORE blocking on it, or
+        the report would never be written."""
+        held = self._held()
+        if any(lk is lock for lk in held):
+            self._report(
+                kind="self-deadlock", path=[lock.name, lock.name],
+                stacks={"acquire": "".join(traceback.format_stack()[:-2])},
+                note=f"thread re-acquires non-reentrant lock "
+                     f"{lock.name!r} it already holds")
+
+    def note_acquire(self, lock: "TrackedLock") -> None:
+        held = self._held()
+        if not getattr(self._tls, "reporting", False):
+            cur_stack: Optional[str] = None
+            for h in held:
+                if h.name == lock.name:
+                    continue  # same-class nesting: see module docstring
+                edge = (h.name, lock.name)
+                # unlocked membership probe: a steady-state nested
+                # acquire (edge already recorded) must not pay stack
+                # capture + DFS on every pass through a hot path; the
+                # rare lost race just re-checks under _mu in _add_edge
+                if edge in self._edges:
+                    continue
+                if cur_stack is None:
+                    cur_stack = "".join(traceback.format_stack()[:-2])
+                self._add_edge(edge, cur_stack)
+        held.append(lock)
+        self._publish_held(held)
+
+    def note_release(self, lock: "TrackedLock") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+        self._publish_held(held)
+
+    # -- the graph -----------------------------------------------------------
+
+    def _add_edge(self, edge: Tuple[str, str], stack: str) -> None:
+        a, b = edge
+        with self._mu:
+            if edge in self._edges:
+                return  # raced with another thread: already analyzed
+            self._edges[edge] = stack
+            self._adj.setdefault(a, []).append(b)
+            # a cycle exists iff b already reaches a — and only a NEW
+            # edge can create one, so this DFS runs once per edge ever
+            path = self._find_path(b, a)
+        if path is not None:
+            stacks = {f"{x}->{y}": self._edges.get((x, y), "")
+                      for x, y in zip(path, path[1:])}
+            stacks[f"{a}->{b} (now)"] = stack
+            self._report(kind="order-inversion",
+                         path=[a, b] + path[1:],
+                         stacks=stacks,
+                         note=f"acquiring {b!r} while holding {a!r}, "
+                              f"but {b!r} already reaches {a!r} via "
+                              f"{' -> '.join(path)}")
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS path src -> dst over recorded edges (caller holds _mu)."""
+        stack = [(src, [src])]
+        seen = {src}
+        adj = self._adj
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in adj.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- reporting -----------------------------------------------------------
+
+    def _report(self, kind: str, path: List[str], stacks: Dict[str, str],
+                note: str) -> None:
+        key = (kind, tuple(sorted(set(path))))
+        with self._mu:
+            if key in self._reported:
+                return
+            self._reported.add(key)
+            rep = {"kind": kind, "path": path, "note": note,
+                   "stacks": stacks}
+            self.cycles.append(rep)
+        # everything below may take tracked locks (metrics, the
+        # logger): the reporting flag keeps the recursion out of the
+        # graph without breaking held-stack symmetry
+        self._tls.reporting = True
+        try:
+            lines = [f"LOCKDEP: potential deadlock ({kind}): {note}"]
+            for label, stk in stacks.items():
+                if stk:
+                    lines.append(f"-- first seen {label} --\n{stk}")
+            text = "\n".join(lines)
+            try:
+                from uda_tpu.utils.logging import get_logger
+                get_logger().error(text)
+            except Exception:  # noqa: BLE001 - the report must survive
+                print(text)    # a half-imported logging module
+            if self.emit_metrics:
+                try:
+                    from uda_tpu.utils.metrics import metrics
+                    metrics.add("lockdep.cycles")
+                except Exception as e:  # noqa: BLE001
+                    print(f"lockdep: metrics unavailable: {e}")
+                out = os.environ.get("UDA_TPU_LOCKDEP_JSON")
+                if out:
+                    try:
+                        with open(out, "a") as f:
+                            f.write(json.dumps(
+                                {"kind": kind, "path": path,
+                                 "note": note}) + "\n")
+                    except OSError as e:
+                        print(f"lockdep: cannot append {out}: {e}")
+        finally:
+            self._tls.reporting = False
+
+    def reset(self) -> None:
+        """Forget edges, cycles and dedup state (tests). Held stacks
+        are per-thread and survive — they describe reality, not
+        history."""
+        with self._mu:
+            self._edges.clear()
+            self._adj.clear()
+            self._reported.clear()
+            self.cycles.clear()
+
+
+lockdep = LockDep(emit_metrics=True)
+
+
+class TrackedLock:
+    """``threading.Lock`` with lockdep class tracking. The ``name`` is
+    the lock CLASS (shared by every instance guarding the same kind of
+    state — 'segment.state', 'net.conn', ...), exactly like lockdep
+    keys classes, not instances."""
+
+    __slots__ = ("_lock", "name", "_dep")
+
+    def __init__(self, name: str, dep: Optional[LockDep] = None):
+        self._lock = threading.Lock()
+        self.name = name
+        self._dep = dep if dep is not None else lockdep
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        dep = self._dep
+        if dep.enabled and blocking:
+            # self-deadlock pre-check only for acquires that would WAIT:
+            # a non-blocking try-acquire of a held lock just returns
+            # False — a legitimate pattern, not a wedge
+            dep.before_acquire(self)
+        got = self._lock.acquire(blocking, timeout)
+        if got and dep.enabled:
+            dep.note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        if self._dep.enabled:
+            self._dep.note_release(self)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"TrackedLock({self.name!r})"
+
+
+class TrackedCondition:
+    """``threading.Condition`` over a :class:`TrackedLock`. ``wait``
+    really releases the lock, so the held stack drops the entry for the
+    duration — a waiter parked in ``cv.wait`` does NOT order-constrain
+    locks acquired by the threads that will wake it."""
+
+    def __init__(self, lock: Optional[TrackedLock] = None,
+                 name: str = "cond", dep: Optional[LockDep] = None):
+        self._tlock = lock if lock is not None else TrackedLock(name, dep)
+        self._cond = threading.Condition(self._tlock._lock)
+
+    @property
+    def name(self) -> str:
+        return self._tlock.name
+
+    def acquire(self, *args, **kwargs) -> bool:
+        return self._tlock.acquire(*args, **kwargs)
+
+    def release(self) -> None:
+        self._tlock.release()
+
+    def __enter__(self) -> "TrackedCondition":
+        self._tlock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._tlock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        dep = self._tlock._dep
+        if dep.enabled:
+            dep.note_release(self._tlock)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            if dep.enabled:
+                dep.note_acquire(self._tlock)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        dep = self._tlock._dep
+        if dep.enabled:
+            dep.note_release(self._tlock)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            if dep.enabled:
+                dep.note_acquire(self._tlock)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"TrackedCondition({self._tlock.name!r})"

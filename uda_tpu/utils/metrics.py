@@ -48,6 +48,8 @@ import time
 from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
+from uda_tpu.utils.locks import TrackedLock
+
 __all__ = ["Metrics", "Span", "metrics", "device_trace",
            "METRICS_REGISTRY", "REGISTRY_PREFIXES", "NAME_RE",
            "PARITY_ALIASES", "stats_enabled_from_env"]
@@ -99,6 +101,15 @@ METRICS_REGISTRY: Dict[str, tuple] = {
     "supplier.admission.rejections": ("counter", "ShuffleRequests "
                                       "rejected by the read-pool "
                                       "admission budget"),
+    # -- counters: error accounting / lock discipline --------------------
+    "errors.swallowed": ("counter", "exceptions intentionally absorbed "
+                                    "by a best-effort path (every such "
+                                    "site logs too; udalint UDA006 "
+                                    "forbids silent swallows)"),
+    "lockdep.cycles": ("counter", "lock-order cycles (potential "
+                                  "deadlocks) detected by the runtime "
+                                  "validator (utils/locks.py, "
+                                  "UDA_TPU_LOCKDEP=1)"),
     # -- counters: supplier / emit / merge / exchange --------------------
     "supplier.bytes": ("counter", "bytes served by the DataEngine"),
     "emit.bytes": ("counter", "framed bytes handed to the consumer"),
@@ -292,7 +303,10 @@ class Metrics:
     until enabled."""
 
     def __init__(self, stats: Optional[bool] = None) -> None:
-        self._lock = threading.Lock()
+        # lockdep-tracked (utils/locks.py): the metrics hub is a LEAF
+        # lock — every layer counts under its own locks, so an edge
+        # OUT of "metrics" would itself be a design smell
+        self._lock = TrackedLock("metrics")
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, _Hist] = {}

@@ -32,6 +32,7 @@ import traceback
 from typing import Callable, Optional
 
 from uda_tpu.utils.errors import UdaError
+from uda_tpu.utils.locks import lockdep
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -57,6 +58,20 @@ def dump_diagnostics(reason: str = "") -> str:
         lines.append(f"thread {names.get(tid, '?')} (ident {tid}):")
         lines.extend("  " + ln.rstrip("\n").replace("\n", "\n  ")
                      for ln in traceback.format_stack(frame))
+    # the lockdep view (UDA_TPU_LOCKDEP=1): who holds which tracked
+    # locks right now, and any order cycles seen so far — a wedged
+    # thread's stack says WHERE it sits, the lock table says WHAT it
+    # sits on
+    if lockdep.enabled:
+        held = lockdep.held_by_thread()
+        lines.append(f"--- tracked locks held ({len(held)} threads) ---")
+        lines.extend(f"  {who}: {' -> '.join(classes)}"
+                     for who, classes in sorted(held.items()))
+        if lockdep.cycles:
+            lines.append(f"--- lockdep cycles "
+                         f"({len(lockdep.cycles)} reported) ---")
+            lines.extend(f"  [{c['kind']}] {c['note']}"
+                         for c in lockdep.cycles)
     # the span tree: completed spans, rendered parent->child (the live
     # subtree is whatever has not ended yet — its absence under a parent
     # with children is itself the wedge signature)
