@@ -54,6 +54,15 @@ echo "-- net loopback smoke" | tee -a "$ART/ci.log"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python scripts/net_smoke.py 2>&1 | tee -a "$ART/ci.log" | tail -1
 
+# Net data-plane bench, quick mode: A/B of the event-loop vs threaded
+# cores + the 256-connection fan-in. Gates on correctness (zero fan-in
+# errors/stalls); the speedup is reported, not gated, so a noisy
+# shared host cannot flake CI (full runs ride BENCH_NET_*.json).
+echo "-- net data-plane bench (quick)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/net_bench.py --quick --out "$ART/bench_net.json" \
+  2>&1 | tee -a "$ART/ci.log" | tail -4
+
 # CPU-only gates run with the accelerator-pool env stripped: the pool's
 # sitecustomize otherwise dials the pool from every spawned interpreter
 # and can hang at startup while the pool is wedged (pytest strips it

@@ -64,6 +64,26 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
     -k "net" \
     --continue-on-collection-errors "$@" || nrc=$?
 
+# Event-loop network rung: the SAME seeded network-chaos schedule
+# against the event-loop core only (-k "net and evloop" selects the
+# dual-core parametrization's evloop ids) with the runtime lock-order
+# validator armed. The refactored core must absorb the identical
+# torn-frame/kill schedule the threaded core does, AND its new lock
+# classes (net.loop, net.conn.write, net.client.write) must produce
+# zero order cycles while doing it — lockdep + udalint exist precisely
+# so this rewrite cannot reintroduce the PR 4 deadlock class.
+EVCOUNTERS="$(mktemp)"
+EVCYCLES="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${EVCOUNTERS}" "${EVCYCLES}"' EXIT
+echo "evloop-net schedule: ${NSPEC} (UDA_TPU_LOCKDEP=1)"
+evrc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${EVCYCLES}" \
+    UDA_TPU_CHAOS_TELEMETRY="${EVCOUNTERS}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    -k "net and evloop" \
+    --continue-on-collection-errors "$@" || evrc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -74,7 +94,7 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${EVCOUNTERS}" "${EVCYCLES}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -87,10 +107,12 @@ mrc=0
 python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${PSPEC}" "${PCOUNTERS}" "${prc}" \
     "${NSPEC}" "${NCOUNTERS}" "${nrc}" \
-    "${LCOUNTERS}" "${lrc}" "${LCYCLES}" <<'EOF' || mrc=$?
+    "${LCOUNTERS}" "${lrc}" "${LCYCLES}" \
+    "${EVCOUNTERS}" "${evrc}" "${EVCYCLES}" <<'EOF' || mrc=$?
 import json, sys
 (seed, spec, counters_path, out, rc, pspec, pcounters, prc,
- nspec, ncounters, nrc, lcounters, lrc, lcycles) = sys.argv[1:15]
+ nspec, ncounters, nrc, lcounters, lrc, lcycles,
+ evcounters, evrc, evcycles) = sys.argv[1:18]
 def load(path):
     try:
         with open(path) as f:
@@ -107,6 +129,8 @@ def load_cycles(path):
     return reports
 ltelem = load(lcounters)
 cycle_reports = load_cycles(lcycles)
+evtelem = load(evcounters)
+ev_cycle_reports = load_cycles(evcycles)
 with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
                "pytest_exit": int(rc), "telemetry": load(counters_path),
@@ -114,6 +138,12 @@ with open(out, "w") as f:
                             "telemetry": load(pcounters)},
                "network": {"schedule": nspec, "pytest_exit": int(nrc),
                            "telemetry": load(ncounters)},
+               "network_evloop": {"schedule": nspec,
+                                  "pytest_exit": int(evrc),
+                                  "cycles": int(evtelem.get("counters", {})
+                                                .get("lockdep.cycles", 0)),
+                                  "cycle_reports": ev_cycle_reports,
+                                  "telemetry": evtelem},
                "lockdep": {"schedule": spec, "pytest_exit": int(lrc),
                            "cycles": int(ltelem.get("counters", {})
                                          .get("lockdep.cycles", 0)),
@@ -121,7 +151,7 @@ with open(out, "w") as f:
                            "telemetry": ltelem}},
               f, indent=1, sort_keys=True)
     f.write("\n")
-ncyc = len(cycle_reports)
+ncyc = len(cycle_reports) + len(ev_cycle_reports)
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc})")
 # the zero-cycles-on-real-code guarantee is ENFORCED, not just
 # printed: a detected inversion that never got the unlucky scheduling
@@ -130,6 +160,7 @@ sys.exit(3 if ncyc else 0)
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
+if [ "${evrc}" -ne 0 ]; then rc="${evrc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP: cycle reports on real code (see CHAOS_TELEMETRY.json)" >&2

@@ -231,3 +231,33 @@ def test_sync_fetch_timeout_releases_admission_budget(tmp_path):
         assert res.data
     finally:
         engine.stop()
+
+
+def test_try_plan_unwinds_admission_on_open_failure(tmp_path):
+    """The zero-copy fast path's charge must pair with an unwind: a
+    cached index entry whose MOF was deleted underneath (job-cleanup
+    race) fails the fd open AFTER admission — repeated failures must
+    leave the read budget untouched, not leak it until the supplier
+    wedges on 'read pool exhausted'."""
+    job = "jobLeak"
+    make_mof_tree(str(tmp_path), job, num_maps=1, num_reducers=1,
+                  records_per_map=10, seed=1)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    mid = map_ids(job, 1)[0]
+    req = ShuffleRequest(job, mid, 0, 0, 1 << 20)
+    try:
+        # warm the index cache (try_plan only fires on cache hits)
+        engine.fetch(req)
+        plan = engine.try_plan(req)
+        assert plan is not None  # sanity: planable while the MOF exists
+        plan.release()           # a live slice HOLDS its charge
+        # engine-visible state back to idle before the breakage
+        engine._fds.close_all()
+        os.remove(os.path.join(str(tmp_path), job, mid, "file.out"))
+        assert engine._admitted_bytes == 0
+        for _ in range(3):
+            with pytest.raises(OSError):
+                engine.try_plan(req)
+        assert engine._admitted_bytes == 0  # no leak, no wedge
+    finally:
+        engine.stop()

@@ -1,7 +1,15 @@
 """The network shuffle data plane (uda_tpu/net): wire framing,
 ShuffleServer, RemoteFetchClient — the TCP stand-in for the reference's
-RDMAServer/RDMAClient pair (reference src/DataNet/)."""
+RDMAServer/RDMAClient pair (reference src/DataNet/).
 
+The whole suite is parametrized over BOTH data-plane cores — the
+selector event loop (the live default) and the legacy threaded core —
+via the autouse ``net_core`` fixture below: a semantic divergence
+between the cores is a test failure here, not a migration surprise.
+The threaded core rides along until the BENCH_NET_* trajectory retires
+it; delete the parameter with it."""
+
+import dataclasses
 import io
 import socket
 import threading
@@ -20,6 +28,21 @@ from uda_tpu.utils.errors import StorageError, TransportError
 from uda_tpu.utils.failpoints import failpoints, net_chaos_spec
 from uda_tpu.utils.ifile import IFileReader
 from uda_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True, params=["evloop", "threaded"])
+def net_core(request, monkeypatch):
+    """Pin the ``uda.tpu.net.core`` DEFAULT for the test, so every
+    Config() built anywhere in the test (fixtures, helper threads,
+    bridge INITs) selects the same core without plumbing the knob
+    through each call site."""
+    from uda_tpu.utils import config as config_mod
+
+    key = "uda.tpu.net.core"
+    monkeypatch.setitem(
+        config_mod.FLAGS, key,
+        dataclasses.replace(config_mod.FLAGS[key], default=request.param))
+    return request.param
 
 
 # -- wire protocol -----------------------------------------------------------
@@ -429,11 +452,20 @@ def test_server_stop_midfetch_then_restart_recovers(tmp_path):
     server.start()
     port = server.port
 
-    # plain stopped server: the fetch completes with TransportError
+    # plain stopped server: the fetch completes with TransportError.
+    # The live-fetch prelude retries a few times: under the chaos
+    # rung's ambient net.frame schedule an injected fault may land on
+    # any one frame (the phase depends on how many frames ran before
+    # this test), and this test's subject is stop/restart recovery,
+    # not fault-free fetching
     client = RemoteFetchClient("127.0.0.1", port, Config())
-    res = _fetch_sync(client, ShuffleRequest(JOB, map_ids(JOB, 1)[0],
-                                             0, 0, 1 << 20))
-    assert isinstance(res, FetchResult)
+    res = None
+    for _ in range(6):
+        res = _fetch_sync(client, ShuffleRequest(JOB, map_ids(JOB, 1)[0],
+                                                 0, 0, 1 << 20))
+        if isinstance(res, FetchResult):
+            break
+    assert isinstance(res, FetchResult), res
     server.stop(drain=False)
     err = _fetch_sync(client, ShuffleRequest(JOB, map_ids(JOB, 1)[0],
                                              0, 0, 1 << 20))
@@ -581,3 +613,294 @@ def test_bridge_starts_net_server_and_remote_bridge_fetches(tmp_path):
     finally:
         supplier.do_command(form_cmd(Cmd.EXIT, []))  # stops the server
         assert supplier.net_server() is None
+
+
+# -- event-loop core: zero-copy serve path + tuning --------------------------
+
+def test_wire_result_head_scatter_matches_encode():
+    """The buffer-donating encode: head + chunk bytes sent separately
+    must be byte-identical to the monolithic encode_result frame."""
+    for crc in (None, 0xCAFEF00D):
+        res = FetchResult(b"y" * 500, 9000, 8000, 256, "/m/file.out",
+                          last=True, crc=crc)
+        head = wire.encode_result_head(
+            7, raw_length=res.raw_length, part_length=res.part_length,
+            offset=res.offset, last=res.last, path=res.path, crc=res.crc,
+            data_len=len(res.data))
+        assert head + res.data == wire.encode_result(7, res)
+
+
+def test_zero_copy_fd_serve_path(tmp_path, net_core, monkeypatch):
+    """The acceptance criterion: on the fd-cache hit path the DATA
+    serve makes ZERO Python-heap copies of chunk payloads. Proven with
+    a tracing wire shim: every serve-path allocation (the frame heads)
+    is counted and size-bounded, and every chunk byte is accounted for
+    by os.sendfile — bytes that leave via sendfile go disk-cache ->
+    socket without ever existing as a Python object."""
+    if net_core != "evloop":
+        pytest.skip("zero-copy serve is an event-loop core feature")
+    from uda_tpu.net import server as server_mod
+
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=2,
+                             num_reducers=1, records_per_map=2000,
+                             seed=13, val_bytes=500)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(
+        engine, Config({"uda.tpu.net.zerocopy.mode": "sendfile"}),
+        host="127.0.0.1", port=0)
+    server.start()
+
+    sent = {"bytes": 0, "calls": 0}
+    real_sendfile = server_mod.os.sendfile
+
+    def traced_sendfile(out_fd, in_fd, offset, count):
+        n = real_sendfile(out_fd, in_fd, offset, count)
+        sent["bytes"] += n
+        sent["calls"] += 1
+        return n
+
+    heads = []
+    real_head = server_mod.wire.encode_result_head
+
+    def traced_head(req_id, **kw):
+        out = real_head(req_id, **kw)
+        heads.append(len(out))
+        return out
+
+    monkeypatch.setattr(server_mod.os, "sendfile", traced_sendfile)
+    monkeypatch.setattr(server_mod.wire, "encode_result_head",
+                        traced_head)
+
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    payload_bytes = 0
+    fetched: dict = {}
+    try:
+        for mid in map_ids(JOB, 2):
+            parts, offset, last = [], 0, False
+            while not last:  # multi-chunk: 256 KB chunks over ~1 MB
+                res = _fetch_sync(client, ShuffleRequest(
+                    JOB, mid, 0, offset, 256 * 1024))
+                assert isinstance(res, FetchResult), res
+                parts.append(res.data)
+                payload_bytes += len(res.data)
+                offset += len(res.data)
+                last = res.is_last
+            fetched[mid] = b"".join(parts)
+    finally:
+        client.stop()
+        server.stop()
+        engine.stop()
+    assert payload_bytes > 1 << 20  # the test must move real data
+    # every chunk byte left through sendfile; none through the heap
+    assert sent["bytes"] == payload_bytes
+    assert metrics.get("net.sendfile.bytes") == payload_bytes
+    assert metrics.get("net.serve.fd") == len(heads) > 0
+    assert metrics.get("net.serve.copy") == 0
+    # the only serve-path allocations are the frame heads — flat,
+    # tiny, and independent of chunk size
+    assert max(heads) < 256
+    # byte-for-byte correctness of what crossed the zero-copy path
+    from uda_tpu.utils.ifile import crack
+    got = []
+    for data in fetched.values():
+        got += list(crack(data).iter_records())
+    assert sorted(got) == sorted(expected[0])
+
+
+def test_zero_copy_mmap_mode(tmp_path, net_core):
+    """The mmap rung of the zero-copy ladder: chunks served as
+    memoryviews of the MOF's page-cache mapping (sendmsg), still zero
+    Python-heap copies — every chunk byte is accounted for by the
+    net.mmap.bytes counter and the bytes are correct."""
+    if net_core != "evloop":
+        pytest.skip("zero-copy serve is an event-loop core feature")
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=2,
+                             num_reducers=1, records_per_map=400,
+                             seed=19, val_bytes=200)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(
+        engine, Config({"uda.tpu.net.zerocopy.mode": "mmap"}),
+        host="127.0.0.1", port=0)
+    server.start()
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    payload_bytes, got = 0, []
+    try:
+        from uda_tpu.utils.ifile import crack
+        for mid in map_ids(JOB, 2):
+            parts, offset, last = [], 0, False
+            while not last:
+                res = _fetch_sync(client, ShuffleRequest(
+                    JOB, mid, 0, offset, 64 * 1024))
+                assert isinstance(res, FetchResult), res
+                parts.append(res.data)
+                payload_bytes += len(res.data)
+                offset += len(res.data)
+                last = res.is_last
+            got += list(crack(b"".join(parts)).iter_records())
+    finally:
+        client.stop()
+        server.stop()
+        engine.stop()
+    assert sorted(got) == sorted(expected[0])
+    assert metrics.get("net.mmap.bytes") == payload_bytes > 0
+    assert metrics.get("net.sendfile.bytes") == 0
+    assert metrics.get("net.serve.copy") == 0
+
+
+def test_zero_copy_disabled_under_crc_and_failpoints(tmp_path, net_core):
+    """The byte-path ladder: CRC stamping or an armed data_engine.pread
+    failpoint must force chunks off the fd path (the checksum needs the
+    bytes; injected corruption must keep mangling real bytes), and the
+    output must stay correct either way."""
+    if net_core != "evloop":
+        pytest.skip("zero-copy serve is an event-loop core feature")
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=2,
+                             num_reducers=1, records_per_map=50, seed=17)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)),
+                        Config({"uda.tpu.fetch.crc": True}))
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    try:
+        from uda_tpu.utils.ifile import crack
+        got = []
+        for mid in map_ids(JOB, 2):
+            res = _fetch_sync(client, ShuffleRequest(JOB, mid, 0, 0,
+                                                     1 << 20))
+            assert isinstance(res, FetchResult) and res.crc is not None
+            got += list(crack(res.data).iter_records())
+        assert sorted(got) == sorted(expected[0])
+    finally:
+        client.stop()
+        server.stop()
+        engine.stop()
+    assert metrics.get("net.serve.fd") == 0
+    assert metrics.get("net.serve.copy") >= 2
+    assert metrics.get("net.sendfile.bytes") == 0
+
+
+def test_compressed_job_byte_parity_over_wire(tmp_path, net_core):
+    """The acceptance criterion's compressed half: a compressed job
+    fetched over the socket plane (fd-backed on-disk chunks ride the
+    zero-copy path; decompression happens reduce-side) must produce
+    output byte-identical to the in-process LocalFetchClient path."""
+    import numpy as np
+
+    from uda_tpu.compress import DecompressingClient, get_codec
+    from uda_tpu.mofserver.writer import MOFWriter
+
+    codec = get_codec("zlib")
+    job = "jobNetZ"
+    writer = MOFWriter(str(tmp_path), job, codec=codec)
+    rng = np.random.default_rng(29)
+    for m in range(3):
+        recs = sorted((rng.bytes(10), rng.bytes(60)) for _ in range(120))
+        writer.write(f"attempt_{job}_m_{m:06d}_0", [recs])
+
+    cfg = Config({"mapred.rdma.buf.size": 4})
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        def run(client, maps):
+            mm = MergeManager(client, "uda.tpu.RawBytes", cfg)
+            blocks = []
+            mm.run(job, maps, 0, lambda b: blocks.append(bytes(b)))
+            return b"".join(blocks)
+
+        router = HostRoutingClient(config=cfg)
+        try:
+            remote = run(DecompressingClient(router, codec),
+                         [(f"127.0.0.1:{server.port}", m)
+                          for m in writer.map_ids])
+        finally:
+            router.stop()
+        local = run(DecompressingClient(LocalFetchClient(engine), codec),
+                    writer.map_ids)
+    finally:
+        server.stop()
+        engine.stop()
+    assert remote == local  # byte-identical, compressed job included
+    assert len(remote) > 0
+
+
+def test_socket_tuning_knobs(tmp_path, net_core):
+    """uda.tpu.net.sockbuf.kb sizes SO_SNDBUF/SO_RCVBUF on data-plane
+    sockets and TCP_NODELAY is set unconditionally, on both cores."""
+    make_mof_tree(str(tmp_path), JOB, num_maps=1, num_reducers=1,
+                  records_per_map=10, seed=3)
+    cfg = Config({"uda.tpu.net.sockbuf.kb": 128})
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine, cfg, host="127.0.0.1", port=0)
+    server.start()
+    client = RemoteFetchClient("127.0.0.1", server.port, cfg)
+    try:
+        res = _fetch_sync(client, ShuffleRequest(JOB, map_ids(JOB, 1)[0],
+                                                 0, 0, 1 << 20))
+        assert isinstance(res, FetchResult)
+        sock = (client._conn.sock if net_core == "evloop"
+                else client._sock)
+        assert sock.getsockopt(socket.IPPROTO_TCP,
+                               socket.TCP_NODELAY) != 0
+        # Linux reports back 2x the requested value; >= is the contract
+        assert sock.getsockopt(socket.SOL_SOCKET,
+                               socket.SO_SNDBUF) >= 128 * 1024
+        assert sock.getsockopt(socket.SOL_SOCKET,
+                               socket.SO_RCVBUF) >= 128 * 1024
+    finally:
+        client.stop()
+        server.stop()
+        engine.stop()
+
+
+def test_parked_request_burst_drains_iteratively(tmp_path, net_core):
+    """800 pipelined fetches against a tiny credit cap: the server's
+    parked-request queue must drain ITERATIVELY — the recursive unpark
+    (settle -> start -> inline serve -> settle -> ...) blew the Python
+    stack at ~170 parked entries and tore the connection down under
+    plain burst load, no fault injection."""
+    make_mof_tree(str(tmp_path), JOB, num_maps=1, num_reducers=1,
+                  records_per_map=20, seed=21)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine,
+                           Config({"mapred.rdma.wqe.per.conn": 8}),
+                           host="127.0.0.1", port=0).start()
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    n = 800
+    results, done = [], threading.Event()
+    lock = threading.Lock()
+
+    def on_complete(res):
+        with lock:
+            results.append(res)
+            if len(results) == n:
+                done.set()
+
+    try:
+        for _ in range(n):
+            client.start_fetch(
+                ShuffleRequest(JOB, map_ids(JOB, 1)[0], 0, 0, 1 << 20),
+                on_complete)
+        assert done.wait(60.0), f"only {len(results)}/{n} completed"
+        bad = [r for r in results if not isinstance(r, FetchResult)]
+        assert not bad, f"{len(bad)} failed, first: {bad[:2]}"
+    finally:
+        client.stop()
+        server.stop()
+        engine.stop()
+    assert metrics.get_gauge("net.server.inflight") == 0
+
+
+def test_tune_socket_defaults_leave_os_buffers():
+    """sockbuf.kb=0 must not touch the autotuned buffer sizes."""
+    a, b = socket.socketpair()
+    try:
+        before = a.getsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF)
+        wire.tune_socket(a, 0)
+        assert a.getsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF) == before
+        wire.tune_socket(b, 64)
+        assert b.getsockopt(socket.SOL_SOCKET,
+                            socket.SO_SNDBUF) >= 64 * 1024
+    finally:
+        a.close()
+        b.close()

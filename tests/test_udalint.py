@@ -2,7 +2,7 @@
 
 Three layers:
 
-1. per-rule fixtures: every rule (UDA001-UDA007) is proven to FIRE on a
+1. per-rule fixtures: every rule (UDA001-UDA008) is proven to FIRE on a
    minimal bad snippet and to stay quiet on the corresponding good
    shape, with injected registries so the fixtures never chase the live
    tables;
@@ -29,8 +29,9 @@ import pytest
 
 from uda_tpu.analysis.core import Engine, Finding
 from uda_tpu.analysis.rules import (ALL_RULES, BlockingInLockRule,
-                                    ConfigKeyRule, FailpointSiteRule,
-                                    MetricsNameRule, RawSocketCloseRule,
+                                    ConfigKeyRule, EventLoopBlockingRule,
+                                    FailpointSiteRule, MetricsNameRule,
+                                    RawSocketCloseRule,
                                     ReasonStringBranchRule,
                                     SwallowedExceptionRule)
 from uda_tpu.utils.locks import LockDep, TrackedCondition, TrackedLock
@@ -400,6 +401,99 @@ class TestBlockingInLockRule:
             fut.add_done_callback(cb)
         """
         assert lint(src, self.RULES) == []
+
+
+# -- UDA008: blocking in event-loop callbacks --------------------------------
+
+
+class TestEventLoopBlockingRule:
+    RULES = [EventLoopBlockingRule()]
+    NET = "uda_tpu/net/x.py"
+
+    def test_sendall_in_callback_fires(self):
+        src = """
+        @loop_callback
+        def _on_event(self, mask):
+            self.sock.sendall(frame)
+        """
+        out = lint(src, self.RULES, rel=self.NET)
+        assert rule_ids(out) == ["UDA008"]
+        assert "sendall" in out[0].message
+
+    def test_blocking_recv_in_callback_fires(self):
+        src = """
+        @loop_callback
+        def _on_event(self, mask):
+            data = self.sock.recv(4096)
+        """
+        assert rule_ids(lint(src, self.RULES, rel=self.NET)) == ["UDA008"]
+
+    def test_unbounded_result_in_callback_fires(self):
+        src = """
+        @loop_callback
+        def _on_engine_done(self, f):
+            res = f.result()
+        """
+        assert rule_ids(lint(src, self.RULES, rel=self.NET)) == ["UDA008"]
+
+    def test_unbounded_queue_get_in_callback_fires(self):
+        src = """
+        @loop_callback
+        def _drain(self):
+            item = self.outq.get()
+        """
+        assert rule_ids(lint(src, self.RULES, rel=self.NET)) == ["UDA008"]
+
+    def test_nonblocking_forms_pass(self):
+        src = """
+        @loop_callback
+        def _on_event(self, mask):
+            n = self.sock.recv_into(self._rbuf)
+            sent = self.sock.send(mv)
+            sent2 = self.sock.sendmsg(bufs)
+            res = f.result(timeout=0)
+            item = self.outq.get(timeout=0.25)
+            v = table.get(key)
+        """
+        assert lint(src, self.RULES, rel=self.NET) == []
+
+    def test_loop_thread_itself_exempt(self):
+        # the run loop is not a REGISTERED callback: parking in
+        # select() (and blocking on its own queues) is its job
+        src = """
+        def _run(self):
+            while True:
+                events = self._sel.select(timeout=0.25)
+                item = self._dispatchq.get()
+        """
+        assert lint(src, self.RULES, rel=self.NET) == []
+
+    def test_outside_net_exempt(self):
+        src = """
+        @loop_callback
+        def _on_event(self, mask):
+            self.sock.sendall(frame)
+        """
+        assert lint(src, self.RULES, rel="uda_tpu/merger/x.py") == []
+
+    def test_deferred_code_exempt(self):
+        # a function DEFINED in a callback does not RUN on the loop
+        src = """
+        @loop_callback
+        def _on_event(self, mask):
+            def later(f):
+                return f.result()
+            fut.add_done_callback(later)
+        """
+        assert lint(src, self.RULES, rel=self.NET) == []
+
+    def test_decorator_attribute_form_caught(self):
+        src = """
+        @evloop.loop_callback
+        def _on_event(self, mask):
+            self.sock.sendall(frame)
+        """
+        assert rule_ids(lint(src, self.RULES, rel=self.NET)) == ["UDA008"]
 
 
 # -- engine plumbing ---------------------------------------------------------

@@ -19,6 +19,11 @@ UDA007 no unbounded blocking call (``.result()``, ``Queue.get()``,
        ``Condition.wait()`` without timeout, socket ``recv``) inside a
        ``with <lock>:`` body — the static half of deadlock prevention
        (the dynamic half is uda_tpu/utils/locks.py lockdep)
+UDA008 no blocking call (``recv``/``sendall``/unbounded ``.result()``/
+       unbounded ``Queue.get()``) inside an event-loop callback body
+       in uda_tpu/net/ — registered callbacks are the functions marked
+       ``@loop_callback`` (uda_tpu/net/evloop.py); the loop thread's
+       own run loop is exempt (parking in select() is its job)
 ====== ==============================================================
 
 Every rule is constructor-injectable (registry/sites/flags overrides)
@@ -37,7 +42,8 @@ from uda_tpu.analysis.core import FileContext, Finding, Rule
 __all__ = ["ALL_RULES", "default_engine",
            "ConfigKeyRule", "MetricsNameRule", "FailpointSiteRule",
            "RawSocketCloseRule", "ReasonStringBranchRule",
-           "SwallowedExceptionRule", "BlockingInLockRule"]
+           "SwallowedExceptionRule", "BlockingInLockRule",
+           "EventLoopBlockingRule"]
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -465,9 +471,84 @@ class BlockingInLockRule(Rule):
         return None
 
 
+# -- UDA008 ------------------------------------------------------------------
+
+
+class EventLoopBlockingRule(Rule):
+    """No blocking call inside an event-loop callback body in
+    ``uda_tpu/net/``: one parked callback stalls EVERY connection the
+    shared loop multiplexes (and, transitively, every fetch in the
+    process) — the failure mode the event-loop refactor exists to make
+    impossible. Registered callbacks are the functions marked with
+    ``@loop_callback`` (the declarative contract from
+    uda_tpu/net/evloop.py); the loop thread's own run loop is exempt —
+    parking in ``select()`` is its job. Banned forms: blocking socket
+    ``recv``/``sendall`` (use ``recv_into``/``send``/``sendmsg`` on
+    the non-blocking fd), unbounded ``Future.result()``, unbounded
+    queue ``get()``. Deferred code (nested defs, lambdas) is skipped —
+    it does not run on the loop. Potentially-blocking completion
+    upcalls belong on ``EventLoop.dispatch()``."""
+
+    rule_id = "UDA008"
+    description = "no blocking calls in event-loop callbacks in net/"
+    hint = ("use the non-blocking form (recv_into/send/sendmsg, "
+            "result(timeout=...), get(timeout=...)), or move the work "
+            "to EventLoop.dispatch()")
+    node_types = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def __init__(self, marker: str = "loop_callback"):
+        self.marker = marker
+
+    def _is_marked(self, node) -> bool:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _last_segment(target) == self.marker:
+                return True
+        return False
+
+    def visit(self, node, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_net or not self._is_marked(node):
+            return ()
+        findings: List[Finding] = []
+        stack: List[ast.AST] = list(node.body)
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue  # deferred code does not run on the loop
+            if isinstance(cur, ast.Call):
+                bad = self._blocking(cur)
+                if bad:
+                    findings.append(self.finding(
+                        ctx, cur,
+                        f"{bad} inside event-loop callback "
+                        f"{node.name!r}"))
+            stack.extend(ast.iter_child_nodes(cur))
+        return findings
+
+    @staticmethod
+    def _blocking(call: ast.Call) -> Optional[str]:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        if attr == "sendall":
+            return "blocking .sendall()"
+        if attr == "recv":
+            return "blocking .recv()"
+        if attr == "result" and not _call_has_timeout(call):
+            return "unbounded Future.result()"
+        if attr == "get" and not _call_has_timeout(call):
+            seg = _last_segment(func.value)
+            if seg is not None and _QUEUE_RE.fullmatch(seg):
+                return f"unbounded {seg}.get()"
+        return None
+
+
 ALL_RULES = (ConfigKeyRule, MetricsNameRule, FailpointSiteRule,
              RawSocketCloseRule, ReasonStringBranchRule,
-             SwallowedExceptionRule, BlockingInLockRule)
+             SwallowedExceptionRule, BlockingInLockRule,
+             EventLoopBlockingRule)
 
 
 def default_engine(root: Optional[str] = None):

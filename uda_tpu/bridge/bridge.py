@@ -93,6 +93,15 @@ class _UpcallIndexResolver(IndexResolver):
                 self._cache[key] = rec
         return rec
 
+    def resolve_cached(self, job_id: str, map_id: str,
+                       reduce_id: int) -> Optional[IndexRecord]:
+        """Cache-hit-only resolve (no upcall): the event-loop serve
+        path's inline fast path; a miss returns None and the caller
+        falls back to the engine pool, whose resolve() pays the upcall
+        off the loop thread."""
+        with self._lock:
+            return self._cache.get((job_id, map_id, reduce_id))
+
     def invalidate(self, job_id: str) -> None:
         with self._lock:
             for key in [k for k in self._cache if k[0] == job_id]:

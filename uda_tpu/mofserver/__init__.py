@@ -1,11 +1,12 @@
 """Map-output supplier (the MOFServer/ layer of SURVEY §1): index
 resolution, chunk-served data engine."""
 
-from uda_tpu.mofserver.data_engine import DataEngine, FetchResult, ShuffleRequest
+from uda_tpu.mofserver.data_engine import (DataEngine, FdSlice, FetchResult,
+                                           ShuffleRequest)
 from uda_tpu.mofserver.index import (DirIndexResolver, IndexRecord,
                                      IndexResolver, read_index_file,
                                      write_index_file)
 
-__all__ = ["DataEngine", "FetchResult", "ShuffleRequest", "DirIndexResolver",
-           "IndexRecord", "IndexResolver", "read_index_file",
-           "write_index_file"]
+__all__ = ["DataEngine", "FdSlice", "FetchResult", "ShuffleRequest",
+           "DirIndexResolver", "IndexRecord", "IndexResolver",
+           "read_index_file", "write_index_file"]

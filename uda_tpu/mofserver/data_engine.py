@@ -43,7 +43,7 @@ from uda_tpu.utils.failpoints import failpoint, failpoints
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
-__all__ = ["ShuffleRequest", "FetchResult", "DataEngine"]
+__all__ = ["ShuffleRequest", "FetchResult", "FdSlice", "DataEngine"]
 
 log = get_logger()
 
@@ -74,9 +74,14 @@ class FetchResult:
     matching Hadoop's spill-index semantics); ``last`` is set by the
     producer in whatever domain it serves (DataEngine: on-disk bytes;
     DecompressingClient: uncompressed stream).
+
+    ``data`` is bytes-LIKE, not necessarily bytes: the event-loop
+    client donates its per-frame receive bytearray straight into this
+    field (zero-copy receive), so consumers must stay buffer-agnostic
+    (len/crc32/decompress/``bytes + data`` concatenation all are).
     """
 
-    data: bytes
+    data: bytes  # bytes-like (bytes or bytearray); see docstring
     raw_length: int      # total uncompressed record bytes of the partition
     part_length: int     # total on-disk bytes of the partition
     offset: int          # echo of the request offset
@@ -91,47 +96,166 @@ class FetchResult:
         return self.last
 
 
+@dataclasses.dataclass
+class FdSlice:
+    """A zero-copy serve plan: one chunk of a MOF described as
+    ``(fd, offset, length)`` instead of bytes — the event-loop server
+    streams it with ``os.sendfile`` so the chunk never transits the
+    Python heap (the reference's RDMA-WRITE-from-registered-MOF-memory
+    shape, RDMAServer.cc:537-631, minus the NIC).
+
+    Holds one fd-cache reference AND the request's admission charge
+    until :meth:`release` — bytes on their way to the wire stay inside
+    the supplier read budget exactly like bytes sitting in a
+    FetchResult would. ``release()`` is idempotent and MUST be called
+    exactly-once-effective on every path (written, torn, dropped)."""
+
+    fd: int
+    file_offset: int     # absolute offset in the MOF file
+    length: int          # chunk bytes to serve
+    raw_length: int      # the FetchResult ACK fields, verbatim
+    part_length: int
+    offset: int          # echo of the request offset
+    path: str
+    last: bool
+    _engine: "DataEngine" = dataclasses.field(repr=False, default=None)
+    _admitted: int = 0
+    _released: bool = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._engine._fds.release(self.path)
+        if self._admitted:
+            self._engine._unadmit(self._admitted)
+
+    def view(self):
+        """A memoryview of the chunk inside the MOF's cached whole-file
+        mmap (the serve path's mmap mode: sent with ``sendmsg``, the
+        bytes go page-cache -> socket without a Python-heap object).
+        None when the file cannot be mapped — caller falls back to
+        sendfile. Only valid while this slice is unreleased; callers
+        must drop the view before (or with) release()."""
+        if self._released:
+            return None
+        mm = self._engine._fds.mmap_for(self.path)
+        if mm is None:
+            return None
+        return memoryview(mm)[self.file_offset:
+                              self.file_offset + self.length]
+
+
 class _FdCache:
     """Refcounted fd reuse across in-flight requests for the same MOF
-    (reference fd_counter, IndexInfo.cc:195-233)."""
+    (reference fd_counter, IndexInfo.cc:195-233), with an optional
+    per-entry read-only ``mmap`` of the whole file — the registered-
+    memory analogue the zero-copy serve path's mmap mode slices
+    memoryviews out of (one map per MOF, zero per-chunk syscalls).
+
+    Entries whose refcount hits zero are RETAINED idle (LRU, up to
+    ``_IDLE_CAP``) instead of closed: the serve path acquires/releases
+    once per chunk, and paying an open+close (+ mmap/munmap) syscall
+    round trip per chunk dominated the serve critical path on
+    emulated-syscall kernels — this is the reference's registered-
+    memory-stays-registered property. Eviction and close_all() still
+    close for real."""
+
+    _IDLE_CAP = 128
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._fds: Dict[str, tuple[int, int]] = {}  # path -> (fd, refs)
+        # path -> [fd, refs, mmap|None]
+        self._fds: Dict[str, list] = {}
+        self._idle: list = []  # LRU order of refs==0 paths (front=oldest)
 
     def acquire(self, path: str) -> int:
         with self._lock:
             ent = self._fds.get(path)
             if ent:
-                self._fds[path] = (ent[0], ent[1] + 1)
+                if ent[1] == 0:
+                    self._idle.remove(path)
+                ent[1] += 1
                 return ent[0]
         fd = os.open(path, os.O_RDONLY)
         with self._lock:
             ent = self._fds.get(path)
             if ent:  # raced: keep the existing one
-                self._fds[path] = (ent[0], ent[1] + 1)
+                if ent[1] == 0:
+                    self._idle.remove(path)
+                ent[1] += 1
                 os.close(fd)
                 return ent[0]
-            self._fds[path] = (fd, 1)
+            self._fds[path] = [fd, 1, None]
             return fd
 
+    def mmap_for(self, path: str):
+        """The whole-file read-only map for an entry the caller holds a
+        reference on (lazily created, cached with the fd). None when
+        the file cannot be mapped (empty file, exotic fs) — the caller
+        falls back to sendfile/pread."""
+        import mmap as mmap_mod
+
+        with self._lock:
+            ent = self._fds.get(path)
+            if ent is None:
+                return None
+            if ent[2] is not None:
+                return ent[2]
+            fd = ent[0]
+        try:
+            mm = mmap_mod.mmap(fd, 0, prot=mmap_mod.PROT_READ)
+        except (ValueError, OSError):
+            return None
+        with self._lock:
+            ent = self._fds.get(path)
+            if ent is None or ent[2] is not None:
+                mm.close()
+                return ent[2] if ent else None
+            ent[2] = mm
+            return mm
+
+    @staticmethod
+    def _close_entry(fd: int, mm) -> None:
+        if mm is not None:
+            try:
+                mm.close()
+            except BufferError:
+                # a serve-path memoryview still points into the map
+                # (abandoned mid-write item not yet collected): leaking
+                # the map until process exit beats a crash
+                log.warn("mmap still exported at fd-cache release; "
+                         "leaking the mapping")
+        os.close(fd)
+
     def release(self, path: str) -> None:
+        evicted = None
         with self._lock:
             ent = self._fds.get(path)
             if not ent:
                 return
-            fd, refs = ent
-            if refs <= 1:
-                del self._fds[path]
-                os.close(fd)
-            else:
-                self._fds[path] = (fd, refs - 1)
+            ent[1] -= 1
+            if ent[1] > 0:
+                return
+            ent[1] = 0
+            if path in self._idle:
+                return  # defensive: an over-release must not double-add
+            # keep the entry idle (fd + mmap stay warm); evict the
+            # oldest idle entry beyond the cap
+            self._idle.append(path)
+            if len(self._idle) > self._IDLE_CAP:
+                victim = self._idle.pop(0)
+                evicted = self._fds.pop(victim, None)
+        if evicted is not None:
+            self._close_entry(evicted[0], evicted[2])
 
     def close_all(self) -> None:
         with self._lock:
-            for fd, _ in self._fds.values():
-                os.close(fd)
+            ents = list(self._fds.values())
             self._fds.clear()
+            self._idle.clear()
+        for fd, _, mm in ents:
+            self._close_entry(fd, mm)
 
 
 class _NativeReads:
@@ -255,11 +379,27 @@ class DataEngine:
         if self._stopped:
             raise StorageError("DataEngine is stopped")
         want = req.chunk_size or self.chunk_size_default
+        self._admit_bytes(want)
+        metrics.gauge_add("supplier.reads.on_air", 1)
+        try:
+            return self._pool.submit(self._serve, req, want)
+        except BaseException:  # pool shutdown race: undo the accounting
+            self._unadmit(want)
+            metrics.gauge_add("supplier.reads.on_air", -1)
+            raise
+
+    def _admit_bytes(self, want: int) -> None:
+        """THE read-budget admission gate (the occupy_chunk pool bound,
+        IndexInfo.cc:276-292, minus the blocking): every serve path —
+        submit, submit_serve, try_plan — charges through here, and
+        every non-serving outcome must pair the charge with
+        :meth:`_unadmit` (budget-critical logic lives exactly once).
+        Raises StorageError on rejection. An oversized single request
+        is admitted when the pool is otherwise idle: progress beats the
+        bound (a request larger than the whole budget could never be
+        served at all, which would turn push-back into a permanent
+        dead end)."""
         with self._admit_lock:
-            # an oversized single request is admitted when the pool is
-            # otherwise idle: progress beats the bound (a request larger
-            # than the whole budget could never be served at all, which
-            # would turn push-back into a permanent dead end)
             if self._admitted_bytes > 0 and \
                     self._admitted_bytes + want > self.read_budget_bytes:
                 metrics.add("supplier.admission.rejections")
@@ -270,18 +410,115 @@ class DataEngine:
                     f"raise uda.tpu.supplier.read.budget.mb)")
             self._admitted_bytes += want
         metrics.gauge_add("supplier.read.bytes.on_air", want)
-        metrics.gauge_add("supplier.reads.on_air", 1)
-        try:
-            return self._pool.submit(self._serve, req, want)
-        except BaseException:  # pool shutdown race: undo the accounting
-            self._unadmit(want)
-            metrics.gauge_add("supplier.reads.on_air", -1)
-            raise
 
     def _unadmit(self, want: int) -> None:
         with self._admit_lock:
             self._admitted_bytes -= want
         metrics.gauge_add("supplier.read.bytes.on_air", -want)
+
+    def submit_serve(self, req: ShuffleRequest) -> Future:
+        """Like :meth:`submit`, but the Future may resolve to an
+        :class:`FdSlice` (the zero-copy plan: chunk described as
+        fd+offset+length with the fd pinned in the cache) instead of a
+        FetchResult. The byte path is taken — transparently, same
+        Future type — whenever the chunk cannot be served straight off
+        the fd: CRC stamping is on (the checksum needs the bytes), or
+        the ``data_engine.pread`` failpoint is armed (injected
+        truncation/corruption must keep mangling real bytes, or chaos
+        would silently stop testing anything on the zero-copy plane).
+        Identical admission, backpressure and error semantics to
+        submit(); callers that receive an FdSlice own its release()."""
+        if self._stopped:
+            raise StorageError("DataEngine is stopped")
+        want = req.chunk_size or self.chunk_size_default
+        self._admit_bytes(want)
+        metrics.gauge_add("supplier.reads.on_air", 1)
+        try:
+            return self._pool.submit(self._serve_plan, req, want)
+        except BaseException:  # pool shutdown race: undo the accounting
+            self._unadmit(want)
+            metrics.gauge_add("supplier.reads.on_air", -1)
+            raise
+
+    def _slice_eligible(self) -> bool:
+        return not self._crc \
+            and not failpoints.is_armed("data_engine.pread")
+
+    def try_plan(self, req: ShuffleRequest) -> Optional[FdSlice]:
+        """The synchronous zero-copy fast path: an FdSlice built INLINE
+        from the index cache — the (fd, offset, len) triple for a cache
+        hit, no pool handoff, no IO, no upcall. Returns None whenever
+        planning would need blocking work (cold index entry, CRC
+        stamping on, armed pread failpoint, stopped engine) and the
+        caller falls back to :meth:`submit_serve`. Admission semantics
+        are submit()'s exactly: an over-budget request raises
+        StorageError (typed ERR to the wire), and the slice holds its
+        admission charge until release(). This is what lets the
+        event-loop server serve a hot chunk entirely on the loop
+        thread — read, plan, sendfile — the RDMA-WRITE-from-registered-
+        memory critical path with zero thread handoffs."""
+        if self._stopped or not self._slice_eligible():
+            return None
+        resolve_cached = getattr(self.resolver, "resolve_cached", None)
+        if resolve_cached is None:
+            return None
+        rec = resolve_cached(req.job_id, req.map_id, req.reduce_id)
+        if rec is None:
+            return None
+        want_admit = req.chunk_size or self.chunk_size_default
+        self._admit_bytes(want_admit)
+        try:
+            return self._build_slice(rec, req, want_admit)
+        except BaseException:
+            # bad offset / fd-open failure (MOF deleted under a cached
+            # index entry): the charge MUST unwind or the budget leaks
+            # permanently and eventually wedges the supplier
+            self._unadmit(want_admit)
+            raise
+
+    def _serve_plan(self, req: ShuffleRequest, admitted: int = 0):
+        """Worker-side body of submit_serve: resolve on the pool thread
+        (the resolver may be an embedder upcall — never run it on the
+        event loop), then either pin an FdSlice or fall through to the
+        byte serve. An FdSlice KEEPS its admission charge until
+        release(); every other outcome settles here."""
+        t0 = time.perf_counter()
+        sliced = False
+        try:
+            if self._slice_eligible():
+                plan = self._plan_inner(req, admitted)
+                sliced = True
+                return plan
+            return self._serve_inner(req)
+        finally:
+            if admitted and not sliced:
+                self._unadmit(admitted)
+            metrics.gauge_add("supplier.reads.on_air", -1)
+            metrics.observe("supplier.read.latency_ms",
+                            (time.perf_counter() - t0) * 1e3)
+
+    def _plan_inner(self, req: ShuffleRequest, admitted: int) -> FdSlice:
+        rec = self.resolver.resolve(req.job_id, req.map_id, req.reduce_id)
+        return self._build_slice(rec, req, admitted)
+
+    def _build_slice(self, rec, req: ShuffleRequest,
+                     admitted: int) -> FdSlice:
+        """The one slice constructor both plan paths (pool + inline)
+        share: offset validation, chunk sizing, fd pin."""
+        served = rec.part_length  # the on-disk domain
+        if req.offset < 0 or req.offset >= max(served, 1):
+            raise StorageError(
+                f"offset {req.offset} outside partition (on-disk "
+                f"{served}) for {req.map_id}/{req.reduce_id}")
+        want = min(req.chunk_size or self.chunk_size_default,
+                   served - req.offset)
+        fd = self._fds.acquire(rec.path)
+        metrics.add("supplier.bytes", want)
+        return FdSlice(fd=fd, file_offset=rec.start_offset + req.offset,
+                       length=want, raw_length=rec.raw_length,
+                       part_length=rec.part_length, offset=req.offset,
+                       path=rec.path, last=req.offset + want >= served,
+                       _engine=self, _admitted=admitted)
 
     def fetch(self, req: ShuffleRequest) -> FetchResult:
         """Synchronous fetch with a deadline. A wedged read (native pool

@@ -97,6 +97,22 @@ class IndexResolver:
                 f"({len(records)} partitions)")
         return records[reduce_id]
 
+    def resolve_cached(self, job_id: str, map_id: str, reduce_id: int):
+        """Cache-hit-only resolve: the record when the (job, map)
+        partition table is already cached, None on a miss — NEVER does
+        IO or an upcall, so the event-loop serve path may call it
+        inline (the reference's partition_table_t hit path,
+        IndexInfo.cc:237-251, without the first-fetch round trip)."""
+        with self._lock:
+            records = self._cache.get((job_id, map_id))
+        if records is None:
+            return None
+        if not 0 <= reduce_id < len(records):
+            raise StorageError(
+                f"reduce {reduce_id} out of range for {map_id} "
+                f"({len(records)} partitions)")
+        return records[reduce_id]
+
     def invalidate(self, job_id: str) -> None:
         with self._lock:
             for key in [k for k in self._cache if k[0] == job_id]:

@@ -1,51 +1,58 @@
-"""RemoteFetchClient: the reduce-side socket endpoint of the data plane.
+"""RemoteFetchClient: the reduce-side endpoint on the shared event loop.
 
-The TCP stand-in for the reference's RDMAClient (reference
-src/DataNet/RDMAClient.cc:498-527): ONE multiplexed connection per
-supplier host, many fetches in flight on it, completions correlated
-back to their requests by id — the socket analogue of work completions
-matched to posted WQEs. An :class:`~uda_tpu.merger.segment.InputClient`,
-so it plugs into Segment / MergeManager / HostRoutingClient unchanged.
+The reduce side of the data plane rebuilt on the selector core
+(:mod:`uda_tpu.net.evloop`): every supplier connection of every client
+in the process is multiplexed onto ONE shared loop thread (the
+reference ran one completion-channel epoll thread for all QPs,
+RDMAClient.cc:498-527 + RDMAComm.cc), replacing PR 4's blocking reader
+thread per host. The contract is the threaded client's, exactly:
 
-Shape:
+- ONE multiplexed connection per supplier host, request-id correlation
+  table, completions dispatched out of order;
+- a dead connection (EOF, torn frame, decode error, send failure)
+  fails EVERY in-flight request with ``TransportError`` — each flows
+  into its Segment's retry/penalty/fallback machinery independently —
+  and the next ``start_fetch`` dials fresh (connection identity is the
+  epoch: frames from a dead connection can never complete new
+  requests, and request ids are never reused);
+- typed ERR frames re-raise the server-side error class;
+- ``estimate_partition_bytes`` rides the same connection (SIZE
+  frames), best effort, exact-or-unknown.
 
-- lazy connect on first fetch; ONE connect attempt per ``start_fetch``
-  — a failed connect completes the fetch with ``TransportError`` and
-  the *Segment's* ``RetryPolicy`` (the existing
-  ``mapred.rdma.fetch.*`` backoff/deadline machinery) paces the
-  reconnect attempts, exactly as it paces every other transport fault
-  (the reference's connect-retry-then-fail dance, RDMAClient.cc:
-  215-356, already lives there);
-- a correlation table ``req_id -> waiter`` under one lock; a reader
-  thread (``uda-net-client-<host>``) dispatches DATA/ERR frames to
-  their waiters out of order;
-- a dead connection (EOF, torn frame, decode error) fails EVERY
-  in-flight request with ``TransportError`` — each flows into its
-  Segment's retry/penalty/fallback machinery independently — and the
-  next ``start_fetch`` dials a fresh connection (a new epoch: frames
-  from the old socket can never complete new requests);
-- typed ERR frames re-raise the server-side error class (a supplier
-  ``StorageError`` admission rejection stays a StorageError, so the
-  reduce side's backoff semantics match the in-process path);
-- ``estimate_partition_bytes`` rides the same connection (SIZE frames),
-  giving the auto merge-approach policy real sizes across the wire.
+Receive path: the frame header lands in a REUSABLE per-connection
+buffer via ``recv_into``; the payload is then received straight into a
+single per-frame bytearray (``recv_into`` a sliced memoryview — no
+accumulate-and-join), and :func:`uda_tpu.net.wire.decode_result`
+parses meta fields in place so the one ``bytes()`` of the chunk region
+is the ONLY reduce-side heap copy per chunk (the threaded core made
+three).
 
-Failpoints: ``net.connect`` fires per dial (error = connect refused,
-delay = slow handshake); ``net.frame`` fires on every outbound request
-frame (truncation desyncs the server's stream — a torn-request
-disconnect).
+Completion upcalls (``on_complete`` — Segment code that may block on
+arena admission) run on the loop's dispatcher thread, never the loop
+thread itself, so one slow consumer cannot stall the whole process's
+fetch plane (UDA008 discipline; the reference's completion-channel
+upcall thread).
+
+Failpoints: ``net.connect`` per dial (evaluated on the CALLER thread —
+a delay models a slow handshake without stalling the shared loop);
+``net.frame`` per outbound request frame, also on the caller thread
+(truncation queues the torn bytes and then tears the connection down
+deterministically after they flush).
 """
 
 from __future__ import annotations
 
+import selectors
 import socket
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional, Sequence
 
 from uda_tpu.merger.segment import InputClient
 from uda_tpu.mofserver.data_engine import ShuffleRequest
 from uda_tpu.net import wire
+from uda_tpu.net.evloop import EventLoop, loop_callback, shared_client_loop
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import TransportError
 from uda_tpu.utils.failpoints import failpoint
@@ -53,9 +60,12 @@ from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
-__all__ = ["RemoteFetchClient"]
+__all__ = ["RemoteFetchClient", "EvLoopFetchClient"]
 
 log = get_logger()
+
+_READ = selectors.EVENT_READ
+_WRITE = selectors.EVENT_WRITE
 
 _SIZE_PROBE_TIMEOUT_S = 30.0
 
@@ -71,8 +81,230 @@ class _Waiter:
         self.t0 = t0
 
 
-class RemoteFetchClient(InputClient):
-    """Multiplexed fetch client for one supplier host."""
+class _ClientConn:
+    """One connection's loop-side state machine (loop thread owns every
+    field except ``dead``, which other threads may READ)."""
+
+    def __init__(self, client: "EvLoopFetchClient", loop: EventLoop,
+                 sock: socket.socket):
+        self.client = client
+        self.loop = loop
+        self.sock = sock
+        self.dead = False
+        # write side: any thread may send inline under _wlock (the
+        # opportunistic-write fast path — a fetch's REQ frame normally
+        # leaves on the ISSUING thread, no loop hop, no wakeup)
+        self._wlock = TrackedLock("net.client.write")
+        self._outq: "deque" = deque()  # [memoryview, close_after] pairs
+        self._poison = False
+        self._mask = 0
+        # reassembly: reusable header buffer; payload received straight
+        # into its own per-frame buffer (no intermediate copies)
+        self._hdr = bytearray(wire.HEADER.size)
+        self._hdr_got = 0
+        self._payload: Optional[bytearray] = None
+        self._pay_got = 0
+        self._cur = (0, 0)
+
+    # -- registration --------------------------------------------------------
+
+    @loop_callback
+    def register(self) -> None:
+        if self.dead:
+            return
+        self.loop.register(self.sock, _READ, self._on_event)
+        self._mask = _READ
+
+    def _update_interest(self) -> None:
+        if self.dead:
+            return
+        mask = _READ | (_WRITE if self._outq else 0)
+        if mask != self._mask:
+            self.loop.set_events(self.sock, mask)
+            self._mask = mask
+
+    @loop_callback
+    def _kick(self) -> None:
+        self._update_interest()
+
+    # -- outbound (any thread; _wlock serializes writers) --------------------
+
+    def send_frame(self, data: bytes, close_after: bool = False) -> None:
+        """Queue one frame and opportunistically write it NOW on the
+        calling thread; the loop takes over only a would-block
+        residual. Callable from any thread."""
+        backlog = False
+        with self._wlock:
+            if self.dead or self._poison:
+                return  # teardown fails this frame's waiter
+            self._outq.append([memoryview(data), close_after])
+            err = self._drain_locked()
+            backlog = bool(self._outq) and not self._poison
+        if err is not None:
+            self.loop.call_soon(self.die, err)
+        elif backlog:
+            self.loop.call_soon(self._kick)
+
+    def _drain_locked(self) -> Optional[Exception]:
+        """_wlock held: send from the queue head until it would block.
+        Returns a fatal error (send failure or a completed torn frame)
+        or None."""
+        while self._outq and not self._poison:
+            ent = self._outq[0]
+            try:
+                n = self.sock.send(ent[0])
+            except (BlockingIOError, InterruptedError):
+                return None
+            except OSError as e:
+                self._poison = True
+                return e
+            metrics.add("net.bytes.out", n, role="client")
+            if n < len(ent[0]):
+                ent[0] = ent[0][n:]
+                continue
+            self._outq.popleft()
+            if ent[1]:
+                # we knowingly desynced the server's stream (torn
+                # net.frame): finish the damage deterministically
+                self._poison = True
+                return TransportError("request frame torn by failpoint")
+        return None
+
+    @loop_callback
+    def _flush(self) -> None:
+        with self._wlock:
+            err = self._drain_locked()
+        if err is not None:
+            self._die(err)
+            return
+        self._update_interest()
+
+    # -- inbound -------------------------------------------------------------
+
+    @loop_callback
+    def _on_event(self, mask: int) -> None:
+        if self.dead:
+            return
+        if mask & _WRITE:
+            self._flush()
+        if self.dead:
+            return
+        if mask & _READ:
+            self._do_read()
+
+    def _do_read(self) -> None:
+        # Fill-based recv batching, straight into the final destination
+        # (header buffer or the frame's own payload buffer): keep
+        # reading only while each recv FILLS what it asked for (more is
+        # certainly buffered — a full header is followed by its payload
+        # without a select round trip), stop on the first partial
+        # return instead of spinning to EAGAIN. On emulated-syscall
+        # kernels an empty-handed EAGAIN probe costs as much as a full
+        # recv, and stopping early lets bytes batch up in the
+        # (sockbuf-sized) kernel buffer between calls — level-triggered
+        # epoll re-fires while anything remains.
+        while not self.dead:
+            if self._payload is None:
+                dest = memoryview(self._hdr)[self._hdr_got:]
+            else:
+                dest = memoryview(self._payload)[self._pay_got:]
+            want = len(dest)
+            try:
+                n = self.sock.recv_into(dest)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:
+                self._die(e)
+                return
+            finally:
+                # drop the export BEFORE decoding: the buffer-donating
+                # decode resizes the payload bytearray in place, which
+                # a live memoryview would veto (BufferError)
+                dest.release()
+            if n == 0:
+                self._die(TransportError("supplier closed the connection"))
+                return
+            metrics.add("net.bytes.in", n, role="client")
+            try:
+                self._advance(n)
+            except TransportError as e:
+                self._die(e)
+                return
+            if n < want:
+                return  # kernel buffer drained (or nearly) — back to
+                # select; let the next burst accumulate
+
+    def _advance(self, n: int) -> None:
+        if self._payload is None:
+            self._hdr_got += n
+            if self._hdr_got == wire.HEADER.size:
+                msg_type, req_id, length = wire.decode_header(
+                    bytes(self._hdr))
+                self._cur = (msg_type, req_id)
+                self._payload = bytearray(length)
+                self._pay_got = 0
+                if length == 0:
+                    self._frame_done()
+        else:
+            self._pay_got += n
+            if self._pay_got == len(self._payload):
+                self._frame_done()
+
+    def _frame_done(self) -> None:
+        msg_type, req_id = self._cur
+        payload = self._payload
+        self._payload = None
+        self._hdr_got = 0
+        if msg_type == wire.MSG_DATA:
+            # buffer-donating decode: the per-frame receive buffer
+            # BECOMES FetchResult.data (one short memmove for the meta
+            # prefix, no chunk-sized allocation or copy)
+            result = wire.decode_result_take(payload)
+        elif msg_type == wire.MSG_ERR:
+            result = wire.decode_error(memoryview(payload))
+        elif msg_type == wire.MSG_SIZE:
+            result = wire.decode_size(memoryview(payload))
+        else:
+            raise TransportError(
+                f"unexpected frame type {msg_type} on the client side")
+        self.client._complete(self, req_id, result, msg_type)
+
+    # -- teardown ------------------------------------------------------------
+
+    def _die(self, cause: Exception) -> None:
+        """Loop thread: close this connection and fail everything in
+        flight on it (via the client, which owns the table)."""
+        if self.dead:
+            return
+        self.dead = True
+        with self._wlock:
+            self._poison = True
+            self._outq.clear()
+        self.loop.unregister(self.sock)
+        wire.close_hard(self.sock)
+        self.client._on_conn_dead(self, cause)
+
+    @loop_callback
+    def die(self, cause: Exception) -> None:
+        self._die(cause)
+
+    @loop_callback
+    def close_quiet(self) -> None:
+        """Stop-path close: the client already settled its own table,
+        gauges and waiters — just release the loop/socket resources."""
+        if self.dead:
+            return
+        self.dead = True
+        with self._wlock:
+            self._poison = True
+            self._outq.clear()
+        self.loop.unregister(self.sock)
+        wire.close_hard(self.sock)
+
+
+class EvLoopFetchClient(InputClient):
+    """Multiplexed fetch client for one supplier host, on the shared
+    process-wide event loop."""
 
     def __init__(self, host: str, port: Optional[int] = None,
                  config: Optional[Config] = None):
@@ -82,33 +314,27 @@ class RemoteFetchClient(InputClient):
                         else cfg.get("uda.tpu.net.port"))
         self.connect_timeout_s = float(
             cfg.get("uda.tpu.net.connect.timeout.s"))
-        # lockdep-tracked: PR 4's deadlock lived exactly here (reader
-        # blocked in recv holding what close needed)
-        self._lock = TrackedLock("net.client")    # table + conn state
-        self._wlock = TrackedLock("net.client.write")  # write serial.
-        self._sock: Optional[socket.socket] = None
-        self._reader: Optional[threading.Thread] = None
-        self._pending: dict[int, _Waiter] = {}
-        self._next_id = 0
-        self._epoch = 0
+        self.sockbuf_kb = int(cfg.get("uda.tpu.net.sockbuf.kb"))
+        # lockdep-tracked: PR 4's deadlock class lived exactly here
+        self._lock = TrackedLock("net.client")  # table + conn identity
+        self._conn: Optional[_ClientConn] = None
+        self._pending: dict = {}       # req_id -> _Waiter
+        self._next_id = 0              # never reused across connections
         self._stopped = False
 
     # -- connection management ----------------------------------------------
 
-    def _ensure_connected(self) -> socket.socket:
-        """The live socket, dialing a fresh connection when there is
-        none. Raises TransportError on a failed dial — the caller turns
-        that into a completion error (Segment retries drive the
-        reconnect pacing)."""
+    def _ensure_connected(self) -> _ClientConn:
+        """The live connection, dialing fresh when there is none. The
+        dial itself is blocking WITH a timeout and runs on the caller's
+        thread (never the loop); a failed dial raises TransportError and
+        the Segment's RetryPolicy paces the reconnects."""
         with self._lock:
             if self._stopped:
                 raise TransportError(
                     f"RemoteFetchClient({self.host}) is stopped")
-            if self._sock is not None:
-                return self._sock
-            epoch = self._epoch + 1
-        # dial OUTSIDE the lock: a slow handshake must not block the
-        # reader thread's teardown of the previous connection
+            if self._conn is not None:
+                return self._conn
         failpoint("net.connect", key=f"{self.host}:{self.port}")
         try:
             sock = socket.create_connection(
@@ -118,43 +344,36 @@ class RemoteFetchClient(InputClient):
             raise TransportError(
                 f"connect to supplier {self.host}:{self.port} failed: "
                 f"{e}") from e
-        sock.settimeout(None)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setblocking(False)
+        wire.tune_socket(sock, self.sockbuf_kb)
+        loop = shared_client_loop()
+        conn = _ClientConn(self, loop, sock)
         with self._lock:
-            if self._stopped or self._sock is not None:
+            if self._stopped or self._conn is not None:
                 # lost the dial race (or stopped underneath): keep the
                 # winner's connection
                 wire.close_hard(sock)
                 if self._stopped:
                     raise TransportError(
                         f"RemoteFetchClient({self.host}) is stopped")
-                return self._sock
-            self._sock = sock
-            self._epoch = epoch
-            self._reader = threading.Thread(
-                target=self._read_loop, args=(sock, epoch), daemon=True,
-                name=f"uda-net-client-{self.host}")
-            reader = self._reader
+                return self._conn
+            self._conn = conn
         metrics.add("net.connects", host=self.host)
         metrics.gauge_add("net.client.connections", 1)
-        reader.start()
-        return sock
+        loop.call_soon(conn.register)
+        return conn
 
-    def _drop_connection(self, sock: socket.socket, epoch: int,
-                         cause: Exception) -> None:
-        """Tear down one connection epoch and fail every request still
-        in flight on it. Idempotent per epoch; a newer connection's
-        table entries are untouched (requests registered after the
-        reconnect belong to the new epoch by construction: the table is
-        cleared under the same lock that swaps the socket)."""
+    def _on_conn_dead(self, conn: _ClientConn, cause: Exception) -> None:
+        """Loop thread (via _die): fail every request in flight on this
+        connection. Requests registered after a reconnect belong to the
+        new connection object by construction — the table swaps under
+        the same lock as the connection identity."""
         with self._lock:
-            if self._epoch != epoch or self._sock is not sock:
-                return  # an earlier caller already tore this epoch down
-            self._sock = None
-            self._reader = None
+            if self._conn is not conn:
+                return  # the stop path (or an earlier _die) settled it
+            self._conn = None
             orphans = list(self._pending.items())
             self._pending.clear()
-        wire.close_hard(sock)
         metrics.gauge_add("net.client.connections", -1)
         metrics.add("net.disconnects", role="client")
         err = TransportError(
@@ -163,86 +382,63 @@ class RemoteFetchClient(InputClient):
             f"{len(orphans)} fetches in flight")
         for req_id, waiter in orphans:
             waiter.span.end(error="disconnect")
-            try:
-                waiter.on_complete(err)
-            except Exception as e:  # noqa: BLE001 - one waiter's bug
-                # must not starve the other orphans of their completion
-                log.warn(f"net: completion callback for req {req_id} "
-                         f"raised during disconnect: {e}")
+            # completion upcalls may block (and may re-issue fetches):
+            # dispatcher thread, same FIFO as normal completions
+            conn.loop.dispatch(self._deliver, req_id, waiter, err)
 
-    def _read_loop(self, sock: socket.socket, epoch: int) -> None:
-        """Dispatch frames to waiters until the connection dies."""
+    def _complete(self, conn: _ClientConn, req_id: int, result,
+                  msg_type: int) -> None:
+        """Loop thread: correlate one decoded frame to its waiter and
+        hand the upcall to the dispatcher (the completing connection's
+        own loop — no global-lock rediscovery on the per-frame path)."""
+        with self._lock:
+            waiter = self._pending.pop(req_id, None)
+        if waiter is None:
+            # dead-connection leftovers / cancelled probe: count, move on
+            metrics.add("net.frames.orphaned")
+            return
+        if msg_type != wire.MSG_SIZE:
+            metrics.observe("net.frame.latency_ms",
+                            (time.perf_counter() - waiter.t0) * 1e3,
+                            role="client")
+        if isinstance(result, Exception):
+            waiter.span.end(error=type(result).__name__)
+        else:
+            waiter.span.end()
+        conn.loop.dispatch(self._deliver, req_id, waiter, result)
+
+    def _deliver(self, req_id: int, waiter: _Waiter, result) -> None:
+        """Dispatcher thread: the actual upcall."""
         try:
-            while True:
-                frame = wire.recv_frame(sock)
-                if frame is None:
-                    raise TransportError("supplier closed the connection")
-                msg_type, req_id, payload = frame
-                metrics.add("net.bytes.in",
-                            wire.HEADER.size + len(payload), role="client")
-                if msg_type == wire.MSG_DATA:
-                    result = wire.decode_result(payload)
-                elif msg_type == wire.MSG_ERR:
-                    result = wire.decode_error(payload)
-                elif msg_type == wire.MSG_SIZE:
-                    result = wire.decode_size(payload)
-                else:
-                    raise TransportError(
-                        f"unexpected frame type {msg_type} on the "
-                        f"client side")
-                with self._lock:
-                    waiter = self._pending.pop(req_id, None)
-                if waiter is None:
-                    # stale epoch / cancelled request: count and move on
-                    metrics.add("net.frames.orphaned")
-                    continue
-                if msg_type != wire.MSG_SIZE:
-                    metrics.observe("net.frame.latency_ms",
-                                    (time.perf_counter() - waiter.t0) * 1e3,
-                                    role="client")
-                if isinstance(result, Exception):
-                    waiter.span.end(error=type(result).__name__)
-                else:
-                    waiter.span.end()
-                try:
-                    waiter.on_complete(result)
-                except Exception as e:  # noqa: BLE001 - one waiter's
-                    # bug must not tear down the multiplexed connection
-                    # under every OTHER in-flight fetch (same policy as
-                    # the teardown paths)
-                    log.warn(f"net: completion callback for req "
-                             f"{req_id} raised: {e}")
-        except (OSError, TransportError) as e:
-            self._drop_connection(sock, epoch, e)
-        except Exception as e:  # noqa: BLE001 - a decode/dispatch bug
-            # must still fail the in-flight fetches, not strand them
-            log.error(f"net: client reader died unexpectedly: {e}")
-            self._drop_connection(sock, epoch, e)
+            waiter.on_complete(result)
+        except Exception as e:  # noqa: BLE001 - one waiter's bug must
+            # not starve every later completion of delivery
+            log.warn(f"net: completion callback for req {req_id} "
+                     f"raised: {e}")
 
     # -- InputClient --------------------------------------------------------
 
     def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
         """Issue one fetch on the multiplexed connection. Completion
         (FetchResult, typed remote error, or disconnect TransportError)
-        arrives on the reader thread — the same thread shape as the
-        reference's completion-channel upcalls."""
+        arrives on the shared dispatcher thread — the completion-
+        channel upcall shape."""
         span = metrics.start_span(
             "net.fetch", host=self.host, map=req.map_id,
             reduce=req.reduce_id, offset=req.offset)
         try:
-            sock = self._ensure_connected()
+            conn = self._ensure_connected()
         except TransportError as e:
             span.end(error=type(e).__name__)
             on_complete(e)
             return
         with self._lock:
-            died = self._sock is not sock
+            died = self._conn is not conn
             if not died:
                 self._next_id += 1
                 req_id = self._next_id
                 self._pending[req_id] = _Waiter(on_complete, span,
                                                 time.perf_counter())
-                epoch = self._epoch
         if died:
             # connection died between dial and registration; complete
             # OUTSIDE the lock — the callback may re-issue immediately
@@ -251,31 +447,22 @@ class RemoteFetchClient(InputClient):
                 f"connection to {self.host}:{self.port} lost before "
                 f"the fetch was issued"))
             return
-        frame = wire.encode_request(req_id, req)
-        if not self._send(sock, epoch, req_id, frame):
-            return  # completion already delivered by the teardown path
+        self._post(conn, wire.encode_request(req_id, req))
 
-    def _send(self, sock: socket.socket, epoch: int, req_id: int,
-              frame: bytes) -> bool:
-        """Write one frame; on failure tears the connection down (which
-        fails req_id along with every other in-flight request). Returns
-        False when the send failed."""
+    def _post(self, conn: _ClientConn, frame: bytes) -> None:
+        """Write one frame — inline on this thread when the socket has
+        room (the fast path), via the loop for any residual. The
+        net.frame failpoint fires HERE, on the caller thread: an
+        injected error tears the connection down (failing this request
+        with every other in-flight one); a truncation sends the torn
+        bytes with a deterministic teardown behind them."""
         try:
             out = failpoint("net.frame", data=frame,
                             key=f"client:{self.host}")
-            torn = len(out) != len(frame)
-            with self._wlock:
-                sock.sendall(out)
-            if torn:
-                # we knowingly desynced the server's stream: finish the
-                # damage deterministically instead of waiting for the
-                # server's decoder to notice
-                raise TransportError("request frame torn by failpoint")
         except Exception as e:  # noqa: BLE001
-            self._drop_connection(sock, epoch, e)
-            return False
-        metrics.add("net.bytes.out", len(out), role="client")
-        return True
+            conn.loop.call_soon(conn.die, e)
+            return
+        conn.send_frame(out, len(out) != len(frame))
 
     def estimate_partition_bytes(self, job_id: str, map_ids: Sequence[str],
                                  reduce_id: int) -> Optional[int]:
@@ -284,7 +471,7 @@ class RemoteFetchClient(InputClient):
         auto merge-approach policy then takes its bounded-memory
         default, it must never fail a task over a size probe."""
         try:
-            sock = self._ensure_connected()
+            conn = self._ensure_connected()
         except TransportError:
             return None
         box: list = [None]
@@ -297,18 +484,15 @@ class RemoteFetchClient(InputClient):
         span = metrics.start_span("net.size_probe", host=self.host,
                                   reduce=reduce_id, maps=len(map_ids))
         with self._lock:
-            if self._sock is not sock:
+            if self._conn is not conn:
                 span.end(error="disconnect")
                 return None
             self._next_id += 1
             req_id = self._next_id
             self._pending[req_id] = _Waiter(on_size, span,
                                             time.perf_counter())
-            epoch = self._epoch
-        frame = wire.encode_size_request(req_id, job_id, list(map_ids),
-                                         reduce_id)
-        if not self._send(sock, epoch, req_id, frame):
-            return None
+        self._post(conn, wire.encode_size_request(req_id, job_id,
+                                                  list(map_ids), reduce_id))
         if not got.wait(timeout=_SIZE_PROBE_TIMEOUT_S):
             with self._lock:
                 self._pending.pop(req_id, None)  # late reply -> orphaned
@@ -320,12 +504,11 @@ class RemoteFetchClient(InputClient):
     def stop(self) -> None:
         with self._lock:
             self._stopped = True
-            sock, self._sock = self._sock, None
-            self._reader = None
+            conn, self._conn = self._conn, None
             orphans = list(self._pending.values())
             self._pending.clear()
-        if sock is not None:
-            wire.close_hard(sock)
+        if conn is not None:
+            conn.loop.call_soon(conn.close_quiet)
             metrics.gauge_add("net.client.connections", -1)
         err = TransportError(
             f"RemoteFetchClient({self.host}) stopped with "
@@ -337,3 +520,17 @@ class RemoteFetchClient(InputClient):
             except Exception as e:  # noqa: BLE001
                 log.warn(f"net: completion callback raised during "
                          f"stop: {e}")
+
+
+def RemoteFetchClient(host: str, port: Optional[int] = None,
+                      config: Optional[Config] = None):
+    """Construct the configured client core: the shared event loop
+    (default) or the legacy thread-per-host reader
+    (``uda.tpu.net.core=threaded``). Identical public surface — factory
+    callers (HostRoutingClient's socket factory, tests, benches) never
+    know which they hold."""
+    cfg = config or Config()
+    if str(cfg.get("uda.tpu.net.core")).strip().lower() == "threaded":
+        from uda_tpu.net.client_threaded import ThreadedFetchClient
+        return ThreadedFetchClient(host, port, cfg)
+    return EvLoopFetchClient(host, port, cfg)

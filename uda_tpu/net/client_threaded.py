@@ -1,0 +1,348 @@
+"""ThreadedFetchClient: the LEGACY thread-per-host fetch client.
+
+PR 4's original reduce-side core — one blocking reader thread per
+supplier host — kept selectable behind ``uda.tpu.net.core=threaded``
+as the measured baseline for ``scripts/net_bench.py`` and the
+dual-core parametrization of ``tests/test_net.py``; scheduled for
+deletion once the ``BENCH_NET_*`` trajectory has a second
+event-loop-only data point. Do not grow features here — the live core
+is ``net/client.py``.
+
+The TCP stand-in for the reference's RDMAClient (reference
+src/DataNet/RDMAClient.cc:498-527): ONE multiplexed connection per
+supplier host, many fetches in flight on it, completions correlated
+back to their requests by id — the socket analogue of work completions
+matched to posted WQEs. An :class:`~uda_tpu.merger.segment.InputClient`,
+so it plugs into Segment / MergeManager / HostRoutingClient unchanged.
+
+Shape:
+
+- lazy connect on first fetch; ONE connect attempt per ``start_fetch``
+  — a failed connect completes the fetch with ``TransportError`` and
+  the *Segment's* ``RetryPolicy`` (the existing
+  ``mapred.rdma.fetch.*`` backoff/deadline machinery) paces the
+  reconnect attempts, exactly as it paces every other transport fault
+  (the reference's connect-retry-then-fail dance, RDMAClient.cc:
+  215-356, already lives there);
+- a correlation table ``req_id -> waiter`` under one lock; a reader
+  thread (``uda-net-client-<host>``) dispatches DATA/ERR frames to
+  their waiters out of order;
+- a dead connection (EOF, torn frame, decode error) fails EVERY
+  in-flight request with ``TransportError`` — each flows into its
+  Segment's retry/penalty/fallback machinery independently — and the
+  next ``start_fetch`` dials a fresh connection (a new epoch: frames
+  from the old socket can never complete new requests);
+- typed ERR frames re-raise the server-side error class (a supplier
+  ``StorageError`` admission rejection stays a StorageError, so the
+  reduce side's backoff semantics match the in-process path);
+- ``estimate_partition_bytes`` rides the same connection (SIZE frames),
+  giving the auto merge-approach policy real sizes across the wire.
+
+Failpoints: ``net.connect`` fires per dial (error = connect refused,
+delay = slow handshake); ``net.frame`` fires on every outbound request
+frame (truncation desyncs the server's stream — a torn-request
+disconnect).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from uda_tpu.merger.segment import InputClient
+from uda_tpu.mofserver.data_engine import ShuffleRequest
+from uda_tpu.net import wire
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import TransportError
+from uda_tpu.utils.failpoints import failpoint
+from uda_tpu.utils.locks import TrackedLock
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["ThreadedFetchClient"]
+
+log = get_logger()
+
+_SIZE_PROBE_TIMEOUT_S = 30.0
+
+
+class _Waiter:
+    """One in-flight request's completion slot."""
+
+    __slots__ = ("on_complete", "span", "t0")
+
+    def __init__(self, on_complete: Callable, span, t0: float):
+        self.on_complete = on_complete
+        self.span = span
+        self.t0 = t0
+
+
+class ThreadedFetchClient(InputClient):
+    """Multiplexed fetch client for one supplier host."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 config: Optional[Config] = None):
+        cfg = config or Config()
+        self.host = host
+        self.port = int(port if port is not None
+                        else cfg.get("uda.tpu.net.port"))
+        self.connect_timeout_s = float(
+            cfg.get("uda.tpu.net.connect.timeout.s"))
+        self.sockbuf_kb = int(cfg.get("uda.tpu.net.sockbuf.kb"))
+        # lockdep-tracked: PR 4's deadlock lived exactly here (reader
+        # blocked in recv holding what close needed)
+        self._lock = TrackedLock("net.client")    # table + conn state
+        self._wlock = TrackedLock("net.client.write")  # write serial.
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[threading.Thread] = None
+        self._pending: dict[int, _Waiter] = {}
+        self._next_id = 0
+        self._epoch = 0
+        self._stopped = False
+
+    # -- connection management ----------------------------------------------
+
+    def _ensure_connected(self) -> socket.socket:
+        """The live socket, dialing a fresh connection when there is
+        none. Raises TransportError on a failed dial — the caller turns
+        that into a completion error (Segment retries drive the
+        reconnect pacing)."""
+        with self._lock:
+            if self._stopped:
+                raise TransportError(
+                    f"ThreadedFetchClient({self.host}) is stopped")
+            if self._sock is not None:
+                return self._sock
+            epoch = self._epoch + 1
+        # dial OUTSIDE the lock: a slow handshake must not block the
+        # reader thread's teardown of the previous connection
+        failpoint("net.connect", key=f"{self.host}:{self.port}")
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self.connect_timeout_s)
+        except OSError as e:
+            metrics.add("net.connect.failures", host=self.host)
+            raise TransportError(
+                f"connect to supplier {self.host}:{self.port} failed: "
+                f"{e}") from e
+        sock.settimeout(None)
+        wire.tune_socket(sock, self.sockbuf_kb)
+        with self._lock:
+            if self._stopped or self._sock is not None:
+                # lost the dial race (or stopped underneath): keep the
+                # winner's connection
+                wire.close_hard(sock)
+                if self._stopped:
+                    raise TransportError(
+                        f"ThreadedFetchClient({self.host}) is stopped")
+                return self._sock
+            self._sock = sock
+            self._epoch = epoch
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(sock, epoch), daemon=True,
+                name=f"uda-net-client-{self.host}")
+            reader = self._reader
+        metrics.add("net.connects", host=self.host)
+        metrics.gauge_add("net.client.connections", 1)
+        reader.start()
+        return sock
+
+    def _drop_connection(self, sock: socket.socket, epoch: int,
+                         cause: Exception) -> None:
+        """Tear down one connection epoch and fail every request still
+        in flight on it. Idempotent per epoch; a newer connection's
+        table entries are untouched (requests registered after the
+        reconnect belong to the new epoch by construction: the table is
+        cleared under the same lock that swaps the socket)."""
+        with self._lock:
+            if self._epoch != epoch or self._sock is not sock:
+                return  # an earlier caller already tore this epoch down
+            self._sock = None
+            self._reader = None
+            orphans = list(self._pending.items())
+            self._pending.clear()
+        wire.close_hard(sock)
+        metrics.gauge_add("net.client.connections", -1)
+        metrics.add("net.disconnects", role="client")
+        err = TransportError(
+            f"connection to supplier {self.host}:{self.port} lost "
+            f"({type(cause).__name__}: {cause}); "
+            f"{len(orphans)} fetches in flight")
+        for req_id, waiter in orphans:
+            waiter.span.end(error="disconnect")
+            try:
+                waiter.on_complete(err)
+            except Exception as e:  # noqa: BLE001 - one waiter's bug
+                # must not starve the other orphans of their completion
+                log.warn(f"net: completion callback for req {req_id} "
+                         f"raised during disconnect: {e}")
+
+    def _read_loop(self, sock: socket.socket, epoch: int) -> None:
+        """Dispatch frames to waiters until the connection dies."""
+        try:
+            while True:
+                frame = wire.recv_frame(sock)
+                if frame is None:
+                    raise TransportError("supplier closed the connection")
+                msg_type, req_id, payload = frame
+                metrics.add("net.bytes.in",
+                            wire.HEADER.size + len(payload), role="client")
+                if msg_type == wire.MSG_DATA:
+                    result = wire.decode_result(payload)
+                elif msg_type == wire.MSG_ERR:
+                    result = wire.decode_error(payload)
+                elif msg_type == wire.MSG_SIZE:
+                    result = wire.decode_size(payload)
+                else:
+                    raise TransportError(
+                        f"unexpected frame type {msg_type} on the "
+                        f"client side")
+                with self._lock:
+                    waiter = self._pending.pop(req_id, None)
+                if waiter is None:
+                    # stale epoch / cancelled request: count and move on
+                    metrics.add("net.frames.orphaned")
+                    continue
+                if msg_type != wire.MSG_SIZE:
+                    metrics.observe("net.frame.latency_ms",
+                                    (time.perf_counter() - waiter.t0) * 1e3,
+                                    role="client")
+                if isinstance(result, Exception):
+                    waiter.span.end(error=type(result).__name__)
+                else:
+                    waiter.span.end()
+                try:
+                    waiter.on_complete(result)
+                except Exception as e:  # noqa: BLE001 - one waiter's
+                    # bug must not tear down the multiplexed connection
+                    # under every OTHER in-flight fetch (same policy as
+                    # the teardown paths)
+                    log.warn(f"net: completion callback for req "
+                             f"{req_id} raised: {e}")
+        except (OSError, TransportError) as e:
+            self._drop_connection(sock, epoch, e)
+        except Exception as e:  # noqa: BLE001 - a decode/dispatch bug
+            # must still fail the in-flight fetches, not strand them
+            log.error(f"net: client reader died unexpectedly: {e}")
+            self._drop_connection(sock, epoch, e)
+
+    # -- InputClient --------------------------------------------------------
+
+    def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
+        """Issue one fetch on the multiplexed connection. Completion
+        (FetchResult, typed remote error, or disconnect TransportError)
+        arrives on the reader thread — the same thread shape as the
+        reference's completion-channel upcalls."""
+        span = metrics.start_span(
+            "net.fetch", host=self.host, map=req.map_id,
+            reduce=req.reduce_id, offset=req.offset)
+        try:
+            sock = self._ensure_connected()
+        except TransportError as e:
+            span.end(error=type(e).__name__)
+            on_complete(e)
+            return
+        with self._lock:
+            died = self._sock is not sock
+            if not died:
+                self._next_id += 1
+                req_id = self._next_id
+                self._pending[req_id] = _Waiter(on_complete, span,
+                                                time.perf_counter())
+                epoch = self._epoch
+        if died:
+            # connection died between dial and registration; complete
+            # OUTSIDE the lock — the callback may re-issue immediately
+            span.end(error="disconnect")
+            on_complete(TransportError(
+                f"connection to {self.host}:{self.port} lost before "
+                f"the fetch was issued"))
+            return
+        frame = wire.encode_request(req_id, req)
+        if not self._send(sock, epoch, req_id, frame):
+            return  # completion already delivered by the teardown path
+
+    def _send(self, sock: socket.socket, epoch: int, req_id: int,
+              frame: bytes) -> bool:
+        """Write one frame; on failure tears the connection down (which
+        fails req_id along with every other in-flight request). Returns
+        False when the send failed."""
+        try:
+            out = failpoint("net.frame", data=frame,
+                            key=f"client:{self.host}")
+            torn = len(out) != len(frame)
+            with self._wlock:
+                sock.sendall(out)
+            if torn:
+                # we knowingly desynced the server's stream: finish the
+                # damage deterministically instead of waiting for the
+                # server's decoder to notice
+                raise TransportError("request frame torn by failpoint")
+        except Exception as e:  # noqa: BLE001
+            self._drop_connection(sock, epoch, e)
+            return False
+        metrics.add("net.bytes.out", len(out), role="client")
+        return True
+
+    def estimate_partition_bytes(self, job_id: str, map_ids: Sequence[str],
+                                 reduce_id: int) -> Optional[int]:
+        """Partition size probe over the wire (SIZE frames). Best
+        effort: any transport trouble or timeout returns None — the
+        auto merge-approach policy then takes its bounded-memory
+        default, it must never fail a task over a size probe."""
+        try:
+            sock = self._ensure_connected()
+        except TransportError:
+            return None
+        box: list = [None]
+        got = threading.Event()
+
+        def on_size(result) -> None:
+            box[0] = result
+            got.set()
+
+        span = metrics.start_span("net.size_probe", host=self.host,
+                                  reduce=reduce_id, maps=len(map_ids))
+        with self._lock:
+            if self._sock is not sock:
+                span.end(error="disconnect")
+                return None
+            self._next_id += 1
+            req_id = self._next_id
+            self._pending[req_id] = _Waiter(on_size, span,
+                                            time.perf_counter())
+            epoch = self._epoch
+        frame = wire.encode_size_request(req_id, job_id, list(map_ids),
+                                         reduce_id)
+        if not self._send(sock, epoch, req_id, frame):
+            return None
+        if not got.wait(timeout=_SIZE_PROBE_TIMEOUT_S):
+            with self._lock:
+                self._pending.pop(req_id, None)  # late reply -> orphaned
+            span.end(error="timeout")
+            return None
+        result = box[0]
+        return None if isinstance(result, Exception) else result
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            sock, self._sock = self._sock, None
+            self._reader = None
+            orphans = list(self._pending.values())
+            self._pending.clear()
+        if sock is not None:
+            wire.close_hard(sock)
+            metrics.gauge_add("net.client.connections", -1)
+        err = TransportError(
+            f"ThreadedFetchClient({self.host}) stopped with "
+            f"{len(orphans)} fetches in flight")
+        for waiter in orphans:
+            waiter.span.end(error="stopped")
+            try:
+                waiter.on_complete(err)
+            except Exception as e:  # noqa: BLE001
+                log.warn(f"net: completion callback raised during "
+                         f"stop: {e}")

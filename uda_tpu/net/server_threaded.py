@@ -1,0 +1,421 @@
+"""ThreadedShuffleServer: the LEGACY thread-per-connection server core.
+
+PR 4's original shape, kept selectable behind ``uda.tpu.net.core=
+threaded`` for exactly one purpose: it is the measured baseline the
+event-loop core (``net/server.py``) must beat — ``scripts/net_bench.py``
+A/Bs the two on the same host and ``tests/test_net.py`` runs its whole
+suite against both, so a semantic divergence between the cores is a
+test failure, not a migration surprise. Scheduled for deletion once the
+``BENCH_NET_*`` trajectory has a second event-loop-only data point; do
+not grow features here.
+
+The TCP stand-in for the reference's RDMAServer (reference
+src/DataNet/RDMAServer.cc:537-631): where the reference posted
+RDMA-WRITEs into the reduce client's pre-registered memory and completed
+them out of order from the AIO completion queue, this server wraps a
+:class:`~uda_tpu.mofserver.data_engine.DataEngine` and completes REQ
+frames out of order from the engine's futures.
+
+Shape:
+
+- one accept thread (``uda-net-accept``), one reader + one writer
+  thread per connection — the per-connection pipeline;
+- per-connection credit cap (``mapred.rdma.wqe.per.conn``, the
+  reference's WQEs-per-connection bound): the reader blocks before
+  handing request N+credit to the engine until an earlier response has
+  been WRITTEN back, so a slow or malicious client can hold at most
+  ``credit`` engine reads + replies of buffered memory. TCP's own flow
+  control then pushes back on the client's send side — credit flow
+  without a credit message;
+- responses travel reader -> engine future -> per-connection outbound
+  queue -> writer, so completion callbacks never block on a slow
+  client's socket (the engine pool must keep draining);
+- engine errors (missing MOF, admission rejection, injected faults)
+  are completed as typed ERR frames, not connection teardown — the
+  reduce side's Segment retry machinery decides what to do;
+- graceful drain-on-stop: ``stop()`` closes the listener, stops
+  READING on every connection, lets in-flight responses flush for up to
+  ``uda.tpu.net.drain.s``, then closes (``stop(drain=False)`` is the
+  hard variant — mid-stream disconnect, what a killed supplier looks
+  like).
+
+Failpoints: ``net.accept`` fires per accepted connection (delay = slow
+accept, error = connection dropped at birth); ``net.frame`` fires on
+every outbound response frame (truncate = torn frame then disconnect,
+error = the send path dying mid-stream).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from typing import Optional
+
+from uda_tpu.mofserver.data_engine import DataEngine
+from uda_tpu.net import wire
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import TransportError, UdaError
+from uda_tpu.utils.failpoints import failpoint
+from uda_tpu.utils.locks import TrackedLock
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["ThreadedShuffleServer"]
+
+log = get_logger()
+
+
+class _Conn:
+    """One accepted connection: reader pipeline + writer drain."""
+
+    def __init__(self, server: "ThreadedShuffleServer", sock: socket.socket,
+                 peer: str):
+        self.server = server
+        self.sock = sock
+        self.peer = peer
+        self.credits = threading.Semaphore(server.credit)
+        self.outq: "queue.Queue[tuple[bytes, float, bool]]" = queue.Queue()
+        self.closed = threading.Event()
+        self.draining = threading.Event()
+        self._inflight = 0          # requests handed to the engine whose
+        self._closing = False       # response is not yet written
+        self._lock = TrackedLock("net.conn")
+        self.reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"uda-net-read-{peer}")
+        self.writer = threading.Thread(
+            target=self._write_loop, daemon=True,
+            name=f"uda-net-write-{peer}")
+
+    def start(self) -> None:
+        self.reader.start()
+        self.writer.start()
+
+    # -- inbound ------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            while not self.closed.is_set() and not self.draining.is_set():
+                frame = wire.recv_frame(self.sock)
+                if frame is None:
+                    break  # clean peer hangup
+                msg_type, req_id, payload = frame
+                metrics.add("net.bytes.in", wire.HEADER.size + len(payload),
+                            role="server")
+                if msg_type == wire.MSG_REQ:
+                    self._handle_request(req_id, payload)
+                elif msg_type == wire.MSG_SIZE_REQ:
+                    self._handle_size(req_id, payload)
+                else:
+                    raise TransportError(
+                        f"unexpected frame type {msg_type} on the "
+                        f"server side")
+        except OSError:
+            pass  # socket closed under us (stop path)
+        except TransportError as e:
+            if not self.closed.is_set():
+                log.warn(f"net: dropping connection {self.peer}: {e}")
+                metrics.add("net.disconnects", role="server")
+        finally:
+            # half-close: no new requests; in-flight responses may
+            # still flush through the writer until close()
+            self.draining.set()
+            if self.closed.is_set():
+                return
+            # no drain pending -> full close now; otherwise the stop
+            # path / last completion closes
+            if not self.server._stopping.is_set() and self.inflight == 0 \
+                    and self.outq.empty():
+                self.close()
+
+    def _acquire_credit(self) -> bool:
+        """The per-connection credit gate: block READING until a
+        response slot frees (the wqe.per.conn bound; EVERY frame that
+        produces a response passes through it, so a misbehaving client
+        cannot grow the outbound queue without limit). Stop-responsive:
+        a closed connection must not leave the reader parked forever.
+        Returns False when the connection died while waiting."""
+        while not self.credits.acquire(timeout=0.25):
+            if self.closed.is_set() or self.draining.is_set():
+                return False
+        with self._lock:
+            self._inflight += 1
+        metrics.gauge_add("net.server.inflight", 1)
+        return True
+
+    def _release_credit(self) -> None:
+        """The single credit-settle point (the inverse of
+        _acquire_credit): inflight==0 gates BOTH close paths, so the
+        accounting must never fork into hand-synchronized copies."""
+        with self._lock:
+            self._inflight -= 1
+        metrics.gauge_add("net.server.inflight", -1)
+        self.credits.release()
+
+    def _handle_request(self, req_id: int, payload: bytes) -> None:
+        req = wire.decode_request(payload)
+        if not self._acquire_credit():
+            return
+        metrics.add("net.requests")
+        t0 = time.perf_counter()
+        span = metrics.start_span("net.serve", map=req.map_id,
+                                  reduce=req.reduce_id, offset=req.offset,
+                                  peer=self.peer)
+        try:
+            fut = self.server.engine.submit(req)
+        except Exception as e:  # noqa: BLE001 - sync rejection (stopped
+            # engine, admission push-back) -> typed ERR completion
+            self._complete(req_id, None, e, t0, span)
+            return
+        fut.add_done_callback(
+            lambda f: self._complete(req_id, *(
+                (None, f.exception()) if f.exception() is not None
+                else (f.result(), None)), t0, span))
+
+    def _complete(self, req_id: int, res, err, t0: float, span) -> None:
+        """Engine completion -> encoded response on the outbound queue
+        (runs on the engine's worker thread; must never block on the
+        socket)."""
+        try:
+            if err is not None:
+                frame = wire.encode_error(req_id, err)
+                metrics.add("net.errors")
+                span.end(error=type(err).__name__)
+            else:
+                frame = wire.encode_result(req_id, res)
+                span.end(bytes=len(res.data))
+        except Exception as e:  # noqa: BLE001 - this runs as a Future
+            # done-callback: an escaping exception would be swallowed by
+            # the Future machinery WITH the request's credit (the reader
+            # eventually wedges at the credit gate). Settle and drop the
+            # connection — the client re-fetches on the disconnect.
+            log.error(f"net: response encoding for {self.peer} failed: "
+                      f"{e}; dropping the connection")
+            self._release_credit()
+            span.end(error="encode_failed")
+            self.close()
+            return
+        self.outq.put((frame, t0, True))
+        if self.closed.is_set():
+            # connection died while the engine was reading: the writer
+            # is gone, so nobody will pop this frame — settle whatever
+            # is stranded in the queue (racing close()'s own drain is
+            # fine, the settle helper is idempotent per frame)
+            self._settle_abandoned()
+
+    def _handle_size(self, req_id: int, payload: bytes) -> None:
+        """Partition size probe (the estimate_partition_bytes channel):
+        resolver sums are index-cache lookups, cheap enough to serve
+        inline on the reader. Delegates to LocalFetchClient so the
+        exact-or-unknown semantics cannot diverge between the wire and
+        in-process estimates (the auto merge-approach policy must see
+        the same numbers either way)."""
+        from uda_tpu.merger.segment import LocalFetchClient
+
+        job_id, mids, reduce_id = wire.decode_size_request(payload)
+        if not self._acquire_credit():  # SIZE replies are credited like
+            return  # DATA: no frame escapes the wqe.per.conn bound
+        total = LocalFetchClient(self.server.engine) \
+            .estimate_partition_bytes(job_id, mids, reduce_id)
+        self.outq.put((wire.encode_size(req_id, total),
+                       time.perf_counter(), True))
+        if self.closed.is_set():  # same post-put race as _complete
+            self._settle_abandoned()
+
+    # -- outbound -----------------------------------------------------------
+
+    def _write_loop(self) -> None:
+        while not self.closed.is_set():
+            try:
+                frame, t0, credited = self.outq.get(timeout=0.25)
+            except queue.Empty:
+                if self.draining.is_set() and self.inflight == 0:
+                    self.close()
+                    break
+                continue
+            torn = False
+            try:
+                out = failpoint("net.frame", data=frame, key=self.peer)
+                torn = len(out) != len(frame)  # injected truncation
+                self.sock.sendall(out)
+            except Exception as e:  # noqa: BLE001 - send failure (peer
+                # gone, injected error): this connection is over; the
+                # client's reader sees the disconnect and fails its
+                # in-flight requests into the Segment retry machinery
+                if not self.closed.is_set():
+                    log.warn(f"net: send to {self.peer} failed: {e}")
+                    metrics.add("net.disconnects", role="server")
+                self.close()
+                break
+            finally:
+                if credited:
+                    self._release_credit()
+            metrics.add("net.bytes.out", len(out), role="server")
+            if credited:
+                metrics.observe("net.frame.latency_ms",
+                                (time.perf_counter() - t0) * 1e3,
+                                role="server")
+            if torn:
+                # a truncated frame broke the peer's stream framing:
+                # finish the damage deterministically (mid-stream
+                # disconnect) instead of feeding it desynced bytes
+                log.warn(f"net: frame to {self.peer} torn by failpoint; "
+                         f"closing")
+                metrics.add("net.disconnects", role="server")
+                self.close()
+                break
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def drained(self) -> bool:
+        return self.inflight == 0 and self.outq.empty()
+
+    def stop_reading(self) -> None:
+        self.draining.set()
+        try:  # wake a reader blocked in recv
+            self.sock.shutdown(socket.SHUT_RD)
+        except OSError:
+            pass
+
+    def _settle_abandoned(self) -> None:
+        """Settle accounting for queued responses that will never be
+        written (the connection closed under them). Each frame is
+        settled exactly once — whoever pops it from the queue owns its
+        credit."""
+        while True:
+            try:
+                _, _, credited = self.outq.get_nowait()
+            except queue.Empty:
+                return
+            if credited:
+                self._release_credit()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closing:  # atomic test-and-set: a concurrent
+                return         # writer-error close and stop() close
+            self._closing = True  # must not double-run the body
+        self.closed.set()
+        wire.close_hard(self.sock)  # shutdown-then-close: wakes blocked
+        # readers AND forces the FIN out (see wire.close_hard)
+        self._settle_abandoned()
+        self.server._forget(self)
+        metrics.gauge_add("net.server.connections", -1)
+
+
+class ThreadedShuffleServer:
+    """Serves many concurrent reduce clients over TCP from one
+    DataEngine. ``port=0`` binds an ephemeral port (tests); read the
+    bound address back from :attr:`address` / :attr:`port`."""
+
+    def __init__(self, engine: DataEngine, config: Optional[Config] = None,
+                 host: Optional[str] = None, port: Optional[int] = None):
+        cfg = config or Config()
+        self.engine = engine
+        self.bind_host = host if host is not None \
+            else str(cfg.get("uda.tpu.net.bind"))
+        self.bind_port = int(port if port is not None
+                             else cfg.get("uda.tpu.net.port"))
+        self.credit = max(1, int(cfg.get("mapred.rdma.wqe.per.conn")))
+        self.drain_s = float(cfg.get("uda.tpu.net.drain.s"))
+        self.sockbuf_kb = int(cfg.get("uda.tpu.net.sockbuf.kb"))
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set[_Conn] = set()
+        self._lock = TrackedLock("net.server")
+        self._stopping = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ThreadedShuffleServer":
+        if self._listener is not None:
+            raise UdaError("ThreadedShuffleServer already started")
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self.bind_host, self.bind_port))
+        ls.listen(128)
+        self._listener = ls
+        self._stopping.clear()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="uda-net-accept")
+        self._accept_thread.start()
+        log.info(f"shuffle server listening on {self.address[0]}:"
+                 f"{self.address[1]} (credit/conn={self.credit})")
+        return self
+
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._listener is None:
+            raise UdaError("ThreadedShuffleServer not started")
+        return self._listener.getsockname()[:2]
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                break  # listener closed (stop path)
+            peer = f"{addr[0]}:{addr[1]}"
+            try:
+                # slow-accept / dropped-at-birth injection point
+                failpoint("net.accept", key=peer)
+            except UdaError as e:
+                log.warn(f"net: accept of {peer} rejected: {e}")
+                wire.close_hard(sock)
+                continue
+            wire.tune_socket(sock, self.sockbuf_kb)
+            conn = _Conn(self, sock, peer)
+            with self._lock:
+                if self._stopping.is_set():
+                    wire.close_hard(sock)
+                    return
+                self._conns.add(conn)
+            metrics.add("net.accepts")
+            metrics.gauge_add("net.server.connections", 1)
+            conn.start()
+
+    def _forget(self, conn: _Conn) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop serving. ``drain=True`` (the default) completes what the
+        engine already accepted: stop reading new requests everywhere,
+        flush in-flight responses for up to ``uda.tpu.net.drain.s``,
+        then close. ``drain=False`` tears connections down mid-stream
+        (clients see TransportError — the killed-supplier shape the
+        retry/penalty machinery must absorb)."""
+        self._stopping.set()
+        if self._listener is not None:
+            wire.close_hard(self._listener)  # also wakes accept()
+        with self._lock:
+            conns = list(self._conns)
+        if drain:
+            for c in conns:
+                c.stop_reading()
+            deadline = time.monotonic() + self.drain_s
+            while time.monotonic() < deadline:
+                if all(c.drained() or c.closed.is_set() for c in conns):
+                    break
+                time.sleep(0.01)
+        for c in conns:
+            c.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+            self._accept_thread = None
+        self._listener = None
+
+    def __enter__(self) -> "ThreadedShuffleServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

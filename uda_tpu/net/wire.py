@@ -52,10 +52,11 @@ from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
 __all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
            "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
            "encode_request", "decode_request", "encode_result",
-           "decode_result", "encode_error", "decode_error",
-           "encode_size_request", "decode_size_request", "encode_size",
-           "decode_size", "encode_frame", "decode_header", "recv_frame",
-           "close_hard"]
+           "encode_result_head", "decode_result", "decode_result_take",
+           "encode_error", "decode_error", "encode_size_request",
+           "decode_size_request", "encode_size", "decode_size",
+           "encode_frame", "decode_header", "recv_frame", "close_hard",
+           "tune_socket"]
 
 MAGIC = b"UD"
 WIRE_VERSION = 1
@@ -98,7 +99,10 @@ def _pack_str(s: str) -> bytes:
     return struct.pack("!H", len(b)) + b
 
 
-def _unpack_str(payload: bytes, off: int, what: str) -> tuple[str, int]:
+def _unpack_str(payload, off: int, what: str) -> tuple[str, int]:
+    """Buffer-agnostic (bytes OR memoryview: the event-loop cores decode
+    straight out of their receive buffers without materializing the
+    payload as bytes first)."""
     if off + 2 > len(payload):
         raise TransportError(f"truncated frame: no length for {what}")
     (n,) = struct.unpack_from("!H", payload, off)
@@ -106,7 +110,7 @@ def _unpack_str(payload: bytes, off: int, what: str) -> tuple[str, int]:
     if off + n > len(payload):
         raise TransportError(f"truncated frame: {what} needs {n} B, "
                              f"{len(payload) - off} left")
-    return payload[off:off + n].decode("utf-8"), off + n
+    return bytes(payload[off:off + n]).decode("utf-8"), off + n
 
 
 def _done(payload: bytes, off: int, what: str) -> None:
@@ -128,14 +132,30 @@ def encode_request(req_id: int, req: ShuffleRequest) -> bytes:
     return encode_frame(MSG_REQ, req_id, payload)
 
 
+def encode_result_head(req_id: int, *, raw_length: int, part_length: int,
+                       offset: int, last: bool, path: str,
+                       crc: Optional[int] = None, data_len: int) -> bytes:
+    """Everything of a DATA frame BEFORE the chunk bytes — frame header
+    plus the ACK fields — with the payload length accounting for
+    ``data_len`` chunk bytes that the caller sends separately (the
+    buffer-donating encode: ``sendmsg([head, chunk])`` scatter-gather,
+    or ``head`` + ``os.sendfile`` when the chunk is fd-backed). The
+    chunk bytes never pass through an encode-side concatenation."""
+    flags = (_FLAG_LAST if last else 0) | \
+            (_FLAG_CRC if crc is not None else 0)
+    meta = _DATA.pack(raw_length, part_length, offset, flags)
+    if crc is not None:
+        meta += _CRC.pack(crc & 0xFFFFFFFF)
+    meta += _pack_str(path)
+    return HEADER.pack(MAGIC, WIRE_VERSION, MSG_DATA, req_id,
+                       len(meta) + data_len) + meta
+
+
 def encode_result(req_id: int, res: FetchResult) -> bytes:
-    flags = (_FLAG_LAST if res.last else 0) | \
-            (_FLAG_CRC if res.crc is not None else 0)
-    payload = _DATA.pack(res.raw_length, res.part_length, res.offset, flags)
-    if res.crc is not None:
-        payload += _CRC.pack(res.crc & 0xFFFFFFFF)
-    payload += _pack_str(res.path) + res.data
-    return encode_frame(MSG_DATA, req_id, payload)
+    return encode_result_head(
+        req_id, raw_length=res.raw_length, part_length=res.part_length,
+        offset=res.offset, last=res.last, path=res.path, crc=res.crc,
+        data_len=len(res.data)) + res.data
 
 
 def encode_error(req_id: int, exc: BaseException) -> bytes:
@@ -197,7 +217,9 @@ def decode_request(payload: bytes) -> ShuffleRequest:
     return ShuffleRequest(job_id, map_id, reduce_id, offset, chunk_size)
 
 
-def decode_result(payload: bytes) -> FetchResult:
+def _decode_result_meta(payload):
+    """Parse a DATA payload's meta prefix in place -> (raw_length,
+    part_length, offset, last, crc, path, data_start)."""
     if len(payload) < _DATA.size:
         raise TransportError(f"truncated DATA frame ({len(payload)} B)")
     raw_length, part_length, offset, flags = _DATA.unpack_from(payload, 0)
@@ -210,8 +232,32 @@ def decode_result(payload: bytes) -> FetchResult:
         (crc,) = _CRC.unpack_from(payload, off)
         off += _CRC.size
     path, off = _unpack_str(payload, off, "path")
-    return FetchResult(payload[off:], raw_length, part_length, offset,
-                       path, last=bool(flags & _FLAG_LAST), crc=crc)
+    return (raw_length, part_length, offset, bool(flags & _FLAG_LAST),
+            crc, path, off)
+
+
+def decode_result(payload) -> FetchResult:
+    """Accepts bytes or a memoryview (meta fields are parsed in place;
+    the single ``bytes()`` of the data region is the only copy)."""
+    raw_length, part_length, offset, last, crc, path, off = \
+        _decode_result_meta(payload)
+    return FetchResult(bytes(payload[off:]), raw_length, part_length,
+                       offset, path, last=last, crc=crc)
+
+
+def decode_result_take(payload: bytearray) -> FetchResult:
+    """Buffer-donating decode: ``payload`` is a bytearray the caller
+    OWNS (the event-loop client's per-frame receive buffer) — the meta
+    fields are parsed in place, the short meta prefix is deleted with
+    one memmove, and the SAME bytearray becomes ``FetchResult.data``.
+    Zero allocations, zero full-payload copies on the receive path;
+    every downstream consumer (record cracking, CRC, decompress,
+    ``carry + data`` concatenation) is buffer-agnostic."""
+    raw_length, part_length, offset, last, crc, path, off = \
+        _decode_result_meta(payload)
+    del payload[:off]  # one short memmove; the chunk stays in place
+    return FetchResult(payload, raw_length, part_length, offset, path,
+                       last=last, crc=crc)
 
 
 def decode_error(payload: bytes) -> UdaError:
@@ -245,6 +291,26 @@ def decode_size(payload: bytes) -> Optional[int]:
 
 
 # -- socket helpers ----------------------------------------------------------
+
+def tune_socket(sock, sockbuf_kb: int = 0) -> None:
+    """Data-plane socket tuning, applied to EVERY connection on both
+    sides and both cores: ``TCP_NODELAY`` always (small REQ/SIZE frames
+    must not eat Nagle delays waiting for an ACK that the peer is
+    itself delaying), and ``SO_SNDBUF``/``SO_RCVBUF`` sized from the
+    ``uda.tpu.net.sockbuf.kb`` knob when non-zero (0 = leave the OS
+    autotuned defaults alone)."""
+    try:
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # not a TCP socket (socketpair in tests)
+    if sockbuf_kb > 0:
+        nbytes = int(sockbuf_kb) * 1024
+        for opt in (_socket.SO_SNDBUF, _socket.SO_RCVBUF):
+            try:
+                sock.setsockopt(_socket.SOL_SOCKET, opt, nbytes)
+            except OSError:
+                pass  # kernel caps (wmem_max) clamp silently anyway
+
 
 def close_hard(sock) -> None:
     """shutdown() then close(): close() alone neither wakes a thread

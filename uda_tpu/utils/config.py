@@ -171,6 +171,29 @@ _FLAG_LIST = [
     Flag("uda.tpu.net.drain.s", 5.0, float,
          "graceful server stop: how long stop() lets in-flight "
          "responses flush before closing connections"),
+    Flag("uda.tpu.net.core", "evloop", str,
+         "data-plane core: 'evloop' (selector event loop, non-blocking "
+         "sockets, zero-copy serve path — the default) or 'threaded' "
+         "(the legacy PR 4 thread-per-connection core, kept as the "
+         "bench baseline until the BENCH_NET_* trajectory retires it)"),
+    Flag("uda.tpu.net.sockbuf.kb", 0, int,
+         "SO_SNDBUF/SO_RCVBUF for every data-plane socket in KB "
+         "(both sides, both cores); 0 = leave the OS autotuned "
+         "defaults. TCP_NODELAY is always set regardless — small "
+         "REQ/SIZE frames must not eat Nagle delays"),
+    Flag("uda.tpu.net.zerocopy", True, bool,
+         "serve fd-cache-backed DATA chunks zero-copy so chunk bytes "
+         "never transit the Python heap (event-loop core only); the "
+         "byte path (sendmsg scatter-gather) is taken per-chunk "
+         "whenever the chunk is not fd-backed: CRC stamping on, "
+         "data_engine.pread failpoint armed, or a sendfile-refusing "
+         "fd. off = always serve bytes"),
+    Flag("uda.tpu.net.zerocopy.mode", "auto", str,
+         "zero-copy mechanism: 'sendfile' (splice from the MOF fd), "
+         "'mmap' (sendmsg memoryviews of the MOF's page-cache "
+         "mapping — faster on kernels that emulate sendfile, e.g. "
+         "sandboxed runtimes), or 'auto' (one-time per-process probe "
+         "picks the faster; sendfile wins ties)"),
     # --- memory admission / pressure-response knobs (utils/budget.py) ---
     Flag("uda.tpu.hbm.budget.mb", 0, int,
          "per-chip HBM budget for the device row matrix + merge working "

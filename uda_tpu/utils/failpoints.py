@@ -237,6 +237,15 @@ class FailpointRegistry:
         with self._lock:
             return {s: fp.spec for s, fp in self._sites.items()}
 
+    def is_armed(self, site: str) -> bool:
+        """Cheap hot-path probe: is anything armed at ``site``? (One
+        dict lookup, same locking discipline as :func:`failpoint`'s
+        fast path.) Used by paths that must DISABLE an optimization
+        while a site is armed — e.g. the DataEngine's zero-copy fd
+        slices bypass the ``data_engine.pread`` byte mangling, so an
+        armed site forces the byte path to keep chaos honest."""
+        return site in self._sites
+
     @contextlib.contextmanager
     def scoped(self, spec: str) -> Iterator["FailpointRegistry"]:
         """Arm ``spec`` for the duration of a with-block, restoring the

@@ -138,6 +138,21 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                    "EOF/torn frame [labels: role]"),
     "net.frames.orphaned": ("counter", "frames for no-longer-pending "
                                        "request ids (stale epoch)"),
+    "net.serve.fd": ("counter", "DATA responses served zero-copy from "
+                                "the fd cache via os.sendfile (event-"
+                                "loop core)"),
+    "net.serve.copy": ("counter", "DATA responses served through the "
+                                  "byte path (CRC on, pread failpoint "
+                                  "armed, zerocopy off, or sendfile "
+                                  "fallback)"),
+    "net.sendfile.bytes": ("counter", "chunk bytes that went disk->"
+                                      "socket via os.sendfile without "
+                                      "transiting the Python heap"),
+    "net.mmap.bytes": ("counter", "chunk bytes that went page-cache->"
+                                  "socket via sendmsg over the MOF's "
+                                  "mmap (the zerocopy mmap mode) "
+                                  "without transiting the Python "
+                                  "heap"),
     # -- gauges ----------------------------------------------------------
     "fetch.on_air": ("gauge", "fetch attempts currently in flight "
                               "(reference AIO on-air counter)"),
