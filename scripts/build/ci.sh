@@ -54,14 +54,25 @@ echo "-- net loopback smoke" | tee -a "$ART/ci.log"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python scripts/net_smoke.py 2>&1 | tee -a "$ART/ci.log" | tail -1
 
-# Net data-plane bench, quick mode: A/B of the event-loop vs threaded
-# cores + the 256-connection fan-in. Gates on correctness (zero fan-in
-# errors/stalls); the speedup is reported, not gated, so a noisy
-# shared host cannot flake CI (full runs ride BENCH_NET_*.json).
+# Net data-plane bench, quick mode: single-stream + p99 latency + the
+# 256-connection fan-in on the event-loop core. Gates on correctness
+# (zero fan-in errors/stalls); throughput is reported, not gated, so a
+# noisy shared host cannot flake CI (full runs ride BENCH_NET_*.json).
 echo "-- net data-plane bench (quick)" | tee -a "$ART/ci.log"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python scripts/net_bench.py --quick --out "$ART/bench_net.json" \
   2>&1 | tee -a "$ART/ci.log" | tail -4
+
+# Hierarchical exchange gate, quick mode (2x4 virtual mesh): the
+# two-stage pod exchange must be byte-identical to the flat exchange
+# and the host oracles, and the accounting invariant must hold —
+# hierarchical per-round DCN messages <= the pod-pair bound and <= the
+# flat device-pair count, DCN bytes no higher than flat (full 8/16/64
+# runs ride MULTICHIP_SCALE_r*.json).
+echo "-- hierarchical exchange bench (quick)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS \
+  python scripts/exchange_bench.py --quick \
+  --out "$ART/exchange_bench.json" 2>&1 | tee -a "$ART/ci.log" | tail -5
 
 # CPU-only gates run with the accelerator-pool env stripped: the pool's
 # sitecustomize otherwise dials the pool from every spawned interpreter

@@ -3,27 +3,26 @@
 
 The net plane's perf trajectory without the (frequently unreachable)
 accelerator pool: a ShuffleServer over a synthetic MOF on 127.0.0.1,
-measured three ways, A/B'd across BOTH data-plane cores
-(``uda.tpu.net.core``):
+measured three ways on the event-loop core (the ONLY core since the
+legacy threaded baseline was deleted — its last measured point is
+``BENCH_NET_r06.json``: 944 vs 323 MB/s single-stream, 2.92x):
 
 1. **single-stream throughput** — one client, windowed pipelined chunk
    fetches of one large partition (the Segment steady-state shape);
-   the headline number the zero-copy serve path must move: the
-   acceptance bar for PR 6 is evloop >= 2x threaded on the same host;
+   the headline number the zero-copy serve path must move;
 2. **p99 frame latency** — sequential small (4 KB) request->response
-   round trips; the TCP_NODELAY/sockbuf satellite's regression guard;
+   round trips; the TCP_NODELAY/sockbuf regression guard;
 3. **256-connection fan-in** — 256 concurrent fetch clients against
-   one server (event-loop core only: the threaded core would burn 512
-   threads on what the loop does with one); must complete with zero
-   errors and zero stall, the "dead at 10k" scale direction.
+   one server; must complete with zero errors and zero stall, the
+   "dead at 10k" scale direction.
 
-Emits a comparable JSON block (default ``BENCH_NET_r06.json``) with
-per-core throughput, latency percentiles, the zero-copy counters
-(sendfile bytes, fd/byte-path serve split) and the process-wide traced
+Emits a comparable JSON block (default ``BENCH_NET_r07.json``) with
+throughput, latency percentiles, the zero-copy counters (sendfile
+bytes, fd/byte-path serve split) and the process-wide traced
 allocation peak (tracemalloc) — the flat-per-chunk-alloc evidence.
 
 Exit code != 0 on any fan-in error/stall or a single-stream failure
-(the ci.sh --quick gate); the speedup itself is reported, not gated,
+(the ci.sh --quick gate); throughput itself is reported, not gated,
 so a noisy shared host cannot flake CI.
 
 Usage: scripts/net_bench.py [--quick] [--out PATH] [--sockbuf-kb N]
@@ -77,16 +76,15 @@ def _make_data_file(tmp: str, nbytes: int) -> str:
     return path
 
 
-def _cfg(core: str, sockbuf_kb: int) -> Config:
-    return Config({"uda.tpu.net.core": core,
-                   "uda.tpu.net.sockbuf.kb": sockbuf_kb})
+def _cfg(sockbuf_kb: int) -> Config:
+    return Config({"uda.tpu.net.sockbuf.kb": sockbuf_kb})
 
 
-def run_single_stream(core: str, path: str, total: int, chunk: int,
+def run_single_stream(path: str, total: int, chunk: int,
                       window: int, sockbuf_kb: int) -> dict:
     """Windowed pipelined fetches of one `total`-byte partition."""
     metrics.reset()
-    cfg = _cfg(core, sockbuf_kb)
+    cfg = _cfg(sockbuf_kb)
     engine = DataEngine(_SyntheticResolver(path, total), Config())
     server = ShuffleServer(engine, cfg, host="127.0.0.1", port=0).start()
     client = RemoteFetchClient("127.0.0.1", server.port, cfg)
@@ -127,7 +125,7 @@ def run_single_stream(core: str, path: str, total: int, chunk: int,
     server.stop()
     engine.stop()
     if not ok or state["err"] is not None:
-        raise RuntimeError(f"single-stream[{core}] failed: "
+        raise RuntimeError(f"single-stream failed: "
                            f"{state['err'] or 'stalled'}")
     return {"bytes": state["got"], "seconds": round(secs, 4),
             "mb_per_s": round(state["got"] / (1 << 20) / secs, 1),
@@ -139,11 +137,11 @@ def run_single_stream(core: str, path: str, total: int, chunk: int,
             "traced_peak_mb": round(peak / (1 << 20), 1)}
 
 
-def run_latency(core: str, path: str, total: int, samples: int,
+def run_latency(path: str, total: int, samples: int,
                 sockbuf_kb: int) -> dict:
     """Sequential 4 KB round trips -> p50/p99 frame latency."""
     metrics.reset()
-    cfg = _cfg(core, sockbuf_kb)
+    cfg = _cfg(sockbuf_kb)
     engine = DataEngine(_SyntheticResolver(path, total), Config())
     server = ShuffleServer(engine, cfg, host="127.0.0.1", port=0).start()
     client = RemoteFetchClient("127.0.0.1", server.port, cfg)
@@ -156,9 +154,9 @@ def run_latency(core: str, path: str, total: int, samples: int,
             client.start_fetch(ShuffleRequest(JOB, MAP, 0, off, 4096),
                                lambda r: (box.append(r), got.set()))
             if not got.wait(timeout=30.0):
-                raise RuntimeError(f"latency[{core}] fetch {i} stalled")
+                raise RuntimeError(f"latency fetch {i} stalled")
             if isinstance(box[0], Exception):
-                raise RuntimeError(f"latency[{core}] fetch {i} failed: "
+                raise RuntimeError(f"latency fetch {i} failed: "
                                    f"{box[0]}")
             lats.append((time.perf_counter() - t0) * 1e3)
     finally:
@@ -175,9 +173,9 @@ def run_latency(core: str, path: str, total: int, samples: int,
 def run_fanin(path: str, total: int, connections: int, chunks: int,
               chunk: int, sockbuf_kb: int) -> dict:
     """N concurrent clients, each chaining `chunks` fetches — the
-    fan-in scale test (event-loop core only)."""
+    fan-in scale test."""
     metrics.reset()
-    cfg = _cfg("evloop", sockbuf_kb)
+    cfg = _cfg(sockbuf_kb)
     engine = DataEngine(_SyntheticResolver(path, total), Config())
     server = ShuffleServer(engine, cfg, host="127.0.0.1", port=0).start()
     clients = [RemoteFetchClient("127.0.0.1", server.port, cfg)
@@ -232,12 +230,11 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true",
                     help="small sizes for the ci.sh gate")
     ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "BENCH_NET_r06.json"))
+                                                  "BENCH_NET_r07.json"))
     ap.add_argument("--sockbuf-kb", type=int, default=4096,
-                    help="uda.tpu.net.sockbuf.kb for every socket "
-                         "(both cores, for a fair A/B)")
+                    help="uda.tpu.net.sockbuf.kb for every socket")
     ap.add_argument("--reps", type=int, default=3,
-                    help="single-stream repetitions per core; the best "
+                    help="single-stream repetitions; the best "
                          "is reported (noisy-host discipline: the "
                          "minimum-interference run is the one that "
                          "measures the core, not the neighbors)")
@@ -254,35 +251,31 @@ def main() -> int:
 
     tmp = tempfile.mkdtemp(prefix="uda_net_bench_")
     path = _make_data_file(tmp, total)
-    out: dict = {"bench": "net_loopback", "round": "r06",
+    out: dict = {"bench": "net_loopback", "round": "r07",
                  "quick": args.quick,
                  "sockbuf_kb": args.sockbuf_kb,
+                 # the deleted threaded core's last measured point, for
+                 # trajectory comparisons (BENCH_NET_r06.json)
+                 "threaded_baseline_r06_mb_per_s": 323,
                  "single_stream": {}, "frame_latency": {}}
 
     rc = 0
-    for core in ("evloop", "threaded"):
-        runs = [run_single_stream(core, path, total, chunk_kb << 10,
-                                  window, args.sockbuf_kb)
-                for _ in range(max(1, args.reps))]
-        s = max(runs, key=lambda r: r["mb_per_s"])
-        s["reps_mb_per_s"] = [r["mb_per_s"] for r in runs]
-        out["single_stream"][core] = s
-        print(f"single-stream[{core}]: {s['mb_per_s']} MB/s best of "
-              f"{s['reps_mb_per_s']} "
-              f"({s['bytes'] >> 20} MB; sendfile "
-              f"{s['sendfile_bytes'] >> 20} MB, mmap "
-              f"{s['mmap_bytes'] >> 20} MB, traced peak "
-              f"{s['traced_peak_mb']} MB)")
-        lt = run_latency(core, path, total, lat_samples, args.sockbuf_kb)
-        out["frame_latency"][core] = lt
-        print(f"frame-latency[{core}]: p50 {lt['p50_ms']} ms, "
-              f"p99 {lt['p99_ms']} ms over {lt['samples']} fetches")
-    ev = out["single_stream"]["evloop"]["mb_per_s"]
-    th = out["single_stream"]["threaded"]["mb_per_s"]
-    out["single_stream"]["speedup_evloop_vs_threaded"] = \
-        round(ev / th, 2) if th else None
-    print(f"single-stream speedup evloop/threaded: "
-          f"{out['single_stream']['speedup_evloop_vs_threaded']}x")
+    runs = [run_single_stream(path, total, chunk_kb << 10,
+                              window, args.sockbuf_kb)
+            for _ in range(max(1, args.reps))]
+    s = max(runs, key=lambda r: r["mb_per_s"])
+    s["reps_mb_per_s"] = [r["mb_per_s"] for r in runs]
+    out["single_stream"]["evloop"] = s
+    print(f"single-stream: {s['mb_per_s']} MB/s best of "
+          f"{s['reps_mb_per_s']} "
+          f"({s['bytes'] >> 20} MB; sendfile "
+          f"{s['sendfile_bytes'] >> 20} MB, mmap "
+          f"{s['mmap_bytes'] >> 20} MB, traced peak "
+          f"{s['traced_peak_mb']} MB)")
+    lt = run_latency(path, total, lat_samples, args.sockbuf_kb)
+    out["frame_latency"]["evloop"] = lt
+    print(f"frame-latency: p50 {lt['p50_ms']} ms, "
+          f"p99 {lt['p99_ms']} ms over {lt['samples']} fetches")
 
     fan = run_fanin(path, total, 256, fanin_chunks, fanin_kb << 10,
                     args.sockbuf_kb)

@@ -52,37 +52,44 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PSPEC}" UDA_TPU_STATS=1 \
 # schedule (uda_tpu.utils.failpoints.net_chaos_spec) — torn frames (the
 # sender closes: a disconnect mid-stream), slow accepts, slow dials.
 # The wire layer's recovery contract (fail in-flight fetches ->
-# Segment retry/penalty -> reconnect) must absorb all of it.
+# Segment retry/penalty -> reconnect) must absorb all of it. Runs
+# under the runtime lock-order validator (the former separate evloop
+# rung folded in when the threaded core was deleted — the event loop
+# IS the data plane now): the net lock classes (net.loop,
+# net.conn.write, net.client.write) must produce zero order cycles
+# mid-chaos.
 NSPEC="$(python -c "from uda_tpu.utils.failpoints import net_chaos_spec; print(net_chaos_spec(${SEED}))")"
 NCOUNTERS="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}"' EXIT
-echo "network schedule:    ${NSPEC}"
+NCYCLES="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}"' EXIT
+echo "network schedule:    ${NSPEC} (UDA_TPU_LOCKDEP=1)"
 nrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${NCYCLES}" \
     UDA_TPU_CHAOS_TELEMETRY="${NCOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
     -k "net" \
     --continue-on-collection-errors "$@" || nrc=$?
 
-# Event-loop network rung: the SAME seeded network-chaos schedule
-# against the event-loop core only (-k "net and evloop" selects the
-# dual-core parametrization's evloop ids) with the runtime lock-order
-# validator armed. The refactored core must absorb the identical
-# torn-frame/kill schedule the threaded core does, AND its new lock
-# classes (net.loop, net.conn.write, net.client.write) must produce
-# zero order cycles while doing it — lockdep + udalint exist precisely
-# so this rewrite cannot reintroduce the PR 4 deadlock class.
-EVCOUNTERS="$(mktemp)"
-EVCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${EVCOUNTERS}" "${EVCYCLES}"' EXIT
-echo "evloop-net schedule: ${NSPEC} (UDA_TPU_LOCKDEP=1)"
-evrc=0
-env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
-    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${EVCYCLES}" \
-    UDA_TPU_CHAOS_TELEMETRY="${EVCOUNTERS}" \
+# Exchange rung: the exchange-marked faults tier (the hierarchical
+# two-stage data plane: a stage-B fault must surface as TransportError,
+# never a hang or silent loss) under the lock-order validator. The
+# exchange.round schedules are armed by the tests themselves
+# (failpoints.scoped — the stage-B match needs precise phase, an
+# ambient periodic spec would mis-fire on the planner loop); the rung's
+# job is running them with lockdep watching the metrics/layout locks
+# the device exchange shares with everything else.
+ECOUNTERS="$(mktemp)"
+ECYCLES="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}"' EXIT
+echo "exchange rung:       scoped exchange.round schedules (UDA_TPU_LOCKDEP=1)"
+erc=0
+env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${ECYCLES}" \
+    UDA_TPU_CHAOS_TELEMETRY="${ECOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
-    -k "net and evloop" \
-    --continue-on-collection-errors "$@" || evrc=$?
+    -k "exchange" \
+    --continue-on-collection-errors "$@" || erc=$?
 
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
@@ -94,7 +101,7 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${EVCOUNTERS}" "${EVCYCLES}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -106,13 +113,14 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
 mrc=0
 python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${PSPEC}" "${PCOUNTERS}" "${prc}" \
-    "${NSPEC}" "${NCOUNTERS}" "${nrc}" \
-    "${LCOUNTERS}" "${lrc}" "${LCYCLES}" \
-    "${EVCOUNTERS}" "${evrc}" "${EVCYCLES}" <<'EOF' || mrc=$?
+    "${NSPEC}" "${NCOUNTERS}" "${nrc}" "${NCYCLES}" \
+    "${ECOUNTERS}" "${erc}" "${ECYCLES}" \
+    "${LCOUNTERS}" "${lrc}" "${LCYCLES}" <<'EOF' || mrc=$?
 import json, sys
 (seed, spec, counters_path, out, rc, pspec, pcounters, prc,
- nspec, ncounters, nrc, lcounters, lrc, lcycles,
- evcounters, evrc, evcycles) = sys.argv[1:18]
+ nspec, ncounters, nrc, ncycles,
+ ecounters, erc, ecycles,
+ lcounters, lrc, lcycles) = sys.argv[1:19]
 def load(path):
     try:
         with open(path) as f:
@@ -127,31 +135,28 @@ def load_cycles(path):
     except Exception:
         pass
     return reports
-ltelem = load(lcounters)
-cycle_reports = load_cycles(lcycles)
-evtelem = load(evcounters)
-ev_cycle_reports = load_cycles(evcycles)
+def lockdep_block(schedule, exit_code, telem_path, cycles_path):
+    telem = load(telem_path)
+    reports = load_cycles(cycles_path)
+    return {"schedule": schedule, "pytest_exit": int(exit_code),
+            "cycles": int(telem.get("counters", {})
+                          .get("lockdep.cycles", 0)),
+            "cycle_reports": reports, "telemetry": telem}, reports
+network, n_reports = lockdep_block(nspec, nrc, ncounters, ncycles)
+exchange, e_reports = lockdep_block("scoped exchange.round (per-test)",
+                                    erc, ecounters, ecycles)
+lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
                "pytest_exit": int(rc), "telemetry": load(counters_path),
                "pressure": {"schedule": pspec, "pytest_exit": int(prc),
                             "telemetry": load(pcounters)},
-               "network": {"schedule": nspec, "pytest_exit": int(nrc),
-                           "telemetry": load(ncounters)},
-               "network_evloop": {"schedule": nspec,
-                                  "pytest_exit": int(evrc),
-                                  "cycles": int(evtelem.get("counters", {})
-                                                .get("lockdep.cycles", 0)),
-                                  "cycle_reports": ev_cycle_reports,
-                                  "telemetry": evtelem},
-               "lockdep": {"schedule": spec, "pytest_exit": int(lrc),
-                           "cycles": int(ltelem.get("counters", {})
-                                         .get("lockdep.cycles", 0)),
-                           "cycle_reports": cycle_reports,
-                           "telemetry": ltelem}},
+               "network": network,
+               "exchange": exchange,
+               "lockdep": lockdep},
               f, indent=1, sort_keys=True)
     f.write("\n")
-ncyc = len(cycle_reports) + len(ev_cycle_reports)
+ncyc = len(n_reports) + len(e_reports) + len(l_reports)
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc})")
 # the zero-cycles-on-real-code guarantee is ENFORCED, not just
 # printed: a detected inversion that never got the unlucky scheduling
@@ -160,7 +165,7 @@ sys.exit(3 if ncyc else 0)
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
-if [ "${evrc}" -ne 0 ]; then rc="${evrc}"; fi
+if [ "${erc}" -ne 0 ]; then rc="${erc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP: cycle reports on real code (see CHAOS_TELEMETRY.json)" >&2

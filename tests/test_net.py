@@ -1,15 +1,10 @@
 """The network shuffle data plane (uda_tpu/net): wire framing,
 ShuffleServer, RemoteFetchClient — the TCP stand-in for the reference's
-RDMAServer/RDMAClient pair (reference src/DataNet/).
+RDMAServer/RDMAClient pair (reference src/DataNet/). The event-loop
+core is the only data plane (the legacy threaded core and its dual-core
+parametrization were deleted with it once BENCH_NET_r07.json recorded
+the second evloop-only bench point)."""
 
-The whole suite is parametrized over BOTH data-plane cores — the
-selector event loop (the live default) and the legacy threaded core —
-via the autouse ``net_core`` fixture below: a semantic divergence
-between the cores is a test failure here, not a migration surprise.
-The threaded core rides along until the BENCH_NET_* trajectory retires
-it; delete the parameter with it."""
-
-import dataclasses
 import io
 import socket
 import threading
@@ -28,21 +23,6 @@ from uda_tpu.utils.errors import StorageError, TransportError
 from uda_tpu.utils.failpoints import failpoints, net_chaos_spec
 from uda_tpu.utils.ifile import IFileReader
 from uda_tpu.utils.metrics import metrics
-
-
-@pytest.fixture(autouse=True, params=["evloop", "threaded"])
-def net_core(request, monkeypatch):
-    """Pin the ``uda.tpu.net.core`` DEFAULT for the test, so every
-    Config() built anywhere in the test (fixtures, helper threads,
-    bridge INITs) selects the same core without plumbing the knob
-    through each call site."""
-    from uda_tpu.utils import config as config_mod
-
-    key = "uda.tpu.net.core"
-    monkeypatch.setitem(
-        config_mod.FLAGS, key,
-        dataclasses.replace(config_mod.FLAGS[key], default=request.param))
-    return request.param
 
 
 # -- wire protocol -----------------------------------------------------------
@@ -630,15 +610,13 @@ def test_wire_result_head_scatter_matches_encode():
         assert head + res.data == wire.encode_result(7, res)
 
 
-def test_zero_copy_fd_serve_path(tmp_path, net_core, monkeypatch):
+def test_zero_copy_fd_serve_path(tmp_path, monkeypatch):
     """The acceptance criterion: on the fd-cache hit path the DATA
     serve makes ZERO Python-heap copies of chunk payloads. Proven with
     a tracing wire shim: every serve-path allocation (the frame heads)
     is counted and size-bounded, and every chunk byte is accounted for
     by os.sendfile — bytes that leave via sendfile go disk-cache ->
     socket without ever existing as a Python object."""
-    if net_core != "evloop":
-        pytest.skip("zero-copy serve is an event-loop core feature")
     from uda_tpu.net import server as server_mod
 
     expected = make_mof_tree(str(tmp_path), JOB, num_maps=2,
@@ -707,13 +685,11 @@ def test_zero_copy_fd_serve_path(tmp_path, net_core, monkeypatch):
     assert sorted(got) == sorted(expected[0])
 
 
-def test_zero_copy_mmap_mode(tmp_path, net_core):
+def test_zero_copy_mmap_mode(tmp_path):
     """The mmap rung of the zero-copy ladder: chunks served as
     memoryviews of the MOF's page-cache mapping (sendmsg), still zero
     Python-heap copies — every chunk byte is accounted for by the
     net.mmap.bytes counter and the bytes are correct."""
-    if net_core != "evloop":
-        pytest.skip("zero-copy serve is an event-loop core feature")
     expected = make_mof_tree(str(tmp_path), JOB, num_maps=2,
                              num_reducers=1, records_per_map=400,
                              seed=19, val_bytes=200)
@@ -747,13 +723,11 @@ def test_zero_copy_mmap_mode(tmp_path, net_core):
     assert metrics.get("net.serve.copy") == 0
 
 
-def test_zero_copy_disabled_under_crc_and_failpoints(tmp_path, net_core):
+def test_zero_copy_disabled_under_crc_and_failpoints(tmp_path):
     """The byte-path ladder: CRC stamping or an armed data_engine.pread
     failpoint must force chunks off the fd path (the checksum needs the
     bytes; injected corruption must keep mangling real bytes), and the
     output must stay correct either way."""
-    if net_core != "evloop":
-        pytest.skip("zero-copy serve is an event-loop core feature")
     expected = make_mof_tree(str(tmp_path), JOB, num_maps=2,
                              num_reducers=1, records_per_map=50, seed=17)
     engine = DataEngine(DirIndexResolver(str(tmp_path)),
@@ -779,7 +753,7 @@ def test_zero_copy_disabled_under_crc_and_failpoints(tmp_path, net_core):
     assert metrics.get("net.sendfile.bytes") == 0
 
 
-def test_compressed_job_byte_parity_over_wire(tmp_path, net_core):
+def test_compressed_job_byte_parity_over_wire(tmp_path):
     """The acceptance criterion's compressed half: a compressed job
     fetched over the socket plane (fd-backed on-disk chunks ride the
     zero-copy path; decompression happens reduce-side) must produce
@@ -824,9 +798,9 @@ def test_compressed_job_byte_parity_over_wire(tmp_path, net_core):
     assert len(remote) > 0
 
 
-def test_socket_tuning_knobs(tmp_path, net_core):
+def test_socket_tuning_knobs(tmp_path):
     """uda.tpu.net.sockbuf.kb sizes SO_SNDBUF/SO_RCVBUF on data-plane
-    sockets and TCP_NODELAY is set unconditionally, on both cores."""
+    sockets and TCP_NODELAY is set unconditionally, on both sides."""
     make_mof_tree(str(tmp_path), JOB, num_maps=1, num_reducers=1,
                   records_per_map=10, seed=3)
     cfg = Config({"uda.tpu.net.sockbuf.kb": 128})
@@ -838,8 +812,7 @@ def test_socket_tuning_knobs(tmp_path, net_core):
         res = _fetch_sync(client, ShuffleRequest(JOB, map_ids(JOB, 1)[0],
                                                  0, 0, 1 << 20))
         assert isinstance(res, FetchResult)
-        sock = (client._conn.sock if net_core == "evloop"
-                else client._sock)
+        sock = client._conn.sock
         assert sock.getsockopt(socket.IPPROTO_TCP,
                                socket.TCP_NODELAY) != 0
         # Linux reports back 2x the requested value; >= is the contract
@@ -853,7 +826,7 @@ def test_socket_tuning_knobs(tmp_path, net_core):
         engine.stop()
 
 
-def test_parked_request_burst_drains_iteratively(tmp_path, net_core):
+def test_parked_request_burst_drains_iteratively(tmp_path):
     """800 pipelined fetches against a tiny credit cap: the server's
     parked-request queue must drain ITERATIVELY — the recursive unpark
     (settle -> start -> inline serve -> settle -> ...) blew the Python

@@ -1,0 +1,174 @@
+"""Host round planner + mesh topology units (uda_tpu/parallel/planner,
+uda_tpu/parallel/mesh): pure host-side logic — no device work, no mesh
+construction beyond names. The device-facing halves (the round bodies
+the plans drive) are pinned by tests/test_exchange_hier.py."""
+
+import numpy as np
+import pytest
+
+from uda_tpu.parallel import MeshTopology, WindowPlan, plan_rounds
+from uda_tpu.parallel.mesh import is_dcn_axis
+from uda_tpu.parallel.planner import record_window_metrics
+from uda_tpu.utils.metrics import metrics
+
+TOPO_2x4 = MeshTopology("dcn", "ici", 2, 4)
+
+
+def test_is_dcn_axis_tagging():
+    assert is_dcn_axis("dcn")
+    assert is_dcn_axis("dcn0") and is_dcn_axis("dcn_outer")
+    assert not is_dcn_axis("shuffle")
+    assert not is_dcn_axis("ici")
+    assert not is_dcn_axis("data")
+
+
+def test_topology_helpers_4x2():
+    t = MeshTopology("dcn", "shuffle", 4, 2)
+    assert t.num_devices == 8 and t.hierarchical
+    assert [t.pod_of(i) for i in range(8)] == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert [t.chip_of(i) for i in range(8)] == [0, 1] * 4
+    assert list(t.pod_members(2)) == [4, 5]
+    # egress stays inside the pod's chip range and is pair-symmetric
+    for g in range(4):
+        for g2 in range(4):
+            assert 0 <= t.egress_chip(g, g2) < 2
+            assert t.egress_chip(g, g2) == t.egress_chip(g2, g)
+
+
+def test_plan_single_window_when_capacity_covers():
+    counts = np.zeros((8, 8), np.int64)
+    counts[1, 2] = 7
+    plan = plan_rounds(counts, 8, TOPO_2x4, record_bytes=12,
+                       hierarchical=True)
+    assert plan.planned == 1 and plan.skipped == 0
+    assert len(plan.windows) == 1
+    assert plan.windows[0].moved_rows == 7
+    assert plan.record_bytes == 12 and plan.hierarchical
+
+
+def test_plan_window_indices_and_draining_tail():
+    # bucket of 5 at capacity 2: windows 0..2 move 2, 2, 1 rows
+    counts = np.zeros((8, 8), np.int64)
+    counts[0, 7] = 5                       # pod 0 -> pod 1
+    plan = plan_rounds(counts, 2, TOPO_2x4, record_bytes=4,
+                       hierarchical=True)
+    assert [w.index for w in plan.windows] == [0, 1, 2]
+    assert [w.moved_rows for w in plan.windows] == [2, 2, 1]
+    assert [w.dcn_rows for w in plan.windows] == [2, 2, 1]
+    assert all(w.dcn_messages == 1 for w in plan.windows)
+    assert not any(w.empty for w in plan.windows)
+
+
+def test_plan_self_delivery_is_not_wire_traffic():
+    counts = np.zeros((8, 8), np.int64)
+    counts[3, 3] = 4                       # device to itself
+    plan = plan_rounds(counts, 4, TOPO_2x4, record_bytes=4,
+                       hierarchical=True)
+    w = plan.windows[0]
+    assert w.moved_rows == 4
+    assert (w.ici_rows, w.dcn_rows, w.dcn_messages) == (0, 0, 0)
+
+
+def test_plan_hierarchical_staging_hops_exact():
+    # pod pair (0 -> 1): egress chip = (0 + 1) % 4 = 1. A record from
+    # chip 1 to dst chip 1 takes NO staging hops (src == egress ==
+    # ingress == dst); from chip 0 to dst chip 0 it takes both.
+    counts = np.zeros((8, 8), np.int64)
+    counts[1, 5] = 10                      # (pod 0, chip 1) -> (1, 1)
+    plan = plan_rounds(counts, 16, TOPO_2x4, record_bytes=4,
+                       hierarchical=True)
+    assert plan.windows[0].ici_rows == 0
+    assert plan.windows[0].dcn_rows == 10
+    counts2 = np.zeros((8, 8), np.int64)
+    counts2[0, 4] = 10                     # (pod 0, chip 0) -> (1, 0)
+    plan2 = plan_rounds(counts2, 16, TOPO_2x4, record_bytes=4,
+                        hierarchical=True)
+    assert plan2.windows[0].ici_rows == 20     # both hops, 10 rows each
+    assert plan2.windows[0].dcn_rows == 10
+
+
+def test_plan_flat_wire_on_pod_mesh_counts_device_pairs():
+    counts = np.zeros((8, 8), np.int64)
+    counts[0, 4] = 1
+    counts[0, 5] = 1
+    counts[1, 4] = 1                       # 3 cross device pairs, 1 pod pair
+    counts[2, 3] = 6                       # intra-pod
+    flat = plan_rounds(counts, 8, TOPO_2x4, record_bytes=4,
+                       hierarchical=False)
+    hier = plan_rounds(counts, 8, TOPO_2x4, record_bytes=4,
+                       hierarchical=True)
+    assert flat.windows[0].dcn_messages == 3
+    assert hier.windows[0].dcn_messages == 1
+    assert flat.windows[0].dcn_rows == hier.windows[0].dcn_rows == 3
+    assert flat.windows[0].ici_rows == 6   # intra-pod off-device rows
+
+
+def test_plan_per_pod_breakdown_sums_to_totals():
+    rng = np.random.default_rng(3)
+    counts = rng.integers(0, 9, size=(8, 8)).astype(np.int64)
+    for hier in (False, True):
+        plan = plan_rounds(counts, 3, TOPO_2x4, record_bytes=4,
+                           hierarchical=hier)
+        for w in plan.windows:
+            assert sum(r for _, r, _ in w.per_pod) == w.dcn_rows
+            assert sum(m for _, _, m in w.per_pod) == w.dcn_messages
+
+
+def test_plan_flat_mesh_topology_none():
+    counts = np.zeros((4, 4), np.int64)
+    counts[0, 1] = 2
+    plan = plan_rounds(counts, 2, None, record_bytes=4)
+    w = plan.windows[0]
+    assert (w.dcn_rows, w.dcn_messages, w.per_pod) == (0, 0, ())
+    assert w.ici_rows == 2
+
+
+def test_plan_empty_and_zero_capacity_guard():
+    empty = plan_rounds(np.zeros((4, 4), np.int64), 5, None,
+                        record_bytes=4)
+    assert empty.planned == 1 and empty.skipped == 1
+    assert empty.windows == ()
+    none = plan_rounds(np.zeros((0, 0), np.int64), 5, None,
+                       record_bytes=4)
+    assert none.skipped == 1
+    # non-positive capacity plans zero deliverable windows — refuse
+    # loudly instead of silently dropping the shuffle
+    counts = np.ones((4, 4), np.int64)
+    for cap in (0, -3):
+        with pytest.raises(ValueError, match="capacity"):
+            plan_rounds(counts, cap, None, record_bytes=4)
+    # hierarchical delivery tags are int32: P*capacity past 2^31 would
+    # wrap and misdeliver — the planner rejects it up front
+    with pytest.raises(ValueError, match="tag overflow"):
+        plan_rounds(np.ones((8, 8), np.int64), 1 << 28, TOPO_2x4,
+                    record_bytes=4, hierarchical=True)
+
+
+def test_record_window_metrics_label_series():
+    metrics.reset()
+    win = WindowPlan(index=0, moved_rows=9, ici_rows=3, dcn_rows=6,
+                     dcn_messages=2, per_pod=((0, 4, 1), (1, 2, 1)))
+    record_window_metrics(win, 16)
+    assert metrics.get("exchange.ici.bytes") == 3 * 16
+    assert metrics.get("exchange.dcn.bytes") == 6 * 16
+    assert metrics.get("exchange.dcn.bytes", pod=0) == 4 * 16
+    assert metrics.get("exchange.dcn.bytes", pod=1) == 2 * 16
+    assert metrics.get("exchange.dcn.messages") == 2
+    assert metrics.get("exchange.dcn.messages", pod=0) == 1
+    metrics.reset()
+
+
+def test_record_window_metrics_zero_rows_is_silent():
+    metrics.reset()
+    win = WindowPlan(index=0, moved_rows=2, ici_rows=0, dcn_rows=0,
+                     dcn_messages=0, per_pod=())
+    record_window_metrics(win, 16)
+    assert metrics.get("exchange.ici.bytes") == 0
+    assert metrics.get("exchange.dcn.bytes") == 0
+    assert "exchange.ici.bytes" not in metrics.counters
+    metrics.reset()
+
+
+def test_windowplan_empty_property():
+    assert WindowPlan(0, 0, 0, 0, 0, ()).empty
+    assert not WindowPlan(0, 1, 1, 0, 0, ()).empty

@@ -522,15 +522,7 @@ class EvLoopFetchClient(InputClient):
                          f"stop: {e}")
 
 
-def RemoteFetchClient(host: str, port: Optional[int] = None,
-                      config: Optional[Config] = None):
-    """Construct the configured client core: the shared event loop
-    (default) or the legacy thread-per-host reader
-    (``uda.tpu.net.core=threaded``). Identical public surface — factory
-    callers (HostRoutingClient's socket factory, tests, benches) never
-    know which they hold."""
-    cfg = config or Config()
-    if str(cfg.get("uda.tpu.net.core")).strip().lower() == "threaded":
-        from uda_tpu.net.client_threaded import ThreadedFetchClient
-        return ThreadedFetchClient(host, port, cfg)
-    return EvLoopFetchClient(host, port, cfg)
+# The shared event loop is THE client core: the legacy thread-per-host
+# reader (PR 4) was deleted once BENCH_NET_r07.json recorded the second
+# evloop-only point (last A/B: BENCH_NET_r06.json).
+RemoteFetchClient = EvLoopFetchClient

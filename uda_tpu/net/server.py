@@ -987,16 +987,7 @@ class EvLoopShuffleServer:
         self.stop()
 
 
-def ShuffleServer(engine: DataEngine, config: Optional[Config] = None,
-                  host: Optional[str] = None,
-                  port: Optional[int] = None):
-    """Construct the configured server core: the event loop (default)
-    or the legacy threaded core (``uda.tpu.net.core=threaded``, kept as
-    the measured baseline until the bench trajectory retires it). Both
-    expose the identical public surface — start/stop(drain)/address/
-    port/engine — so callers never know which they hold."""
-    cfg = config or Config()
-    if str(cfg.get("uda.tpu.net.core")).strip().lower() == "threaded":
-        from uda_tpu.net.server_threaded import ThreadedShuffleServer
-        return ThreadedShuffleServer(engine, cfg, host, port)
-    return EvLoopShuffleServer(engine, cfg, host, port)
+# The event loop is THE server core: the legacy thread-per-connection
+# baseline (PR 4) was deleted once BENCH_NET_r07.json recorded the
+# second evloop-only point (last A/B: BENCH_NET_r06.json, 2.92x).
+ShuffleServer = EvLoopShuffleServer

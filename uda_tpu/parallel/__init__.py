@@ -49,13 +49,17 @@ from uda_tpu.parallel.distributed import (DistributedSortResult,
                                           uniform_splitters)
 from uda_tpu.parallel.exchange import (ShuffleLayout, exchange_record_batches,
                                        exchange_round, prepare_layout,
+                                       resolve_exchange_mode,
                                        shuffle_exchange)
-from uda_tpu.parallel.mesh import (SHUFFLE_AXIS, make_mesh, mesh_from_config,
+from uda_tpu.parallel.mesh import (SHUFFLE_AXIS, MeshTopology, make_mesh,
+                                   mesh_from_config, mesh_topology,
                                    shard_spec)
+from uda_tpu.parallel.planner import RoundPlan, WindowPlan, plan_rounds
 
 __all__ = ["DistributedSortResult", "distributed_sort_step",
            "sample_splitters", "uniform_splitters", "ShuffleLayout",
            "exchange_record_batches", "exchange_round", "prepare_layout",
-           "shuffle_exchange", "exchange_blobs", "ExchangeFetchClient",
-           "SHUFFLE_AXIS", "make_mesh", "mesh_from_config", "shard_spec",
-           "shard_map"]
+           "resolve_exchange_mode", "shuffle_exchange", "exchange_blobs",
+           "ExchangeFetchClient", "SHUFFLE_AXIS", "MeshTopology",
+           "make_mesh", "mesh_from_config", "mesh_topology", "shard_spec",
+           "RoundPlan", "WindowPlan", "plan_rounds", "shard_map"]

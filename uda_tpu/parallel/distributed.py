@@ -34,7 +34,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uda_tpu.parallel.multihost import put_global, put_rows, zeros_global
 from uda_tpu.utils.errors import TransportError
-from uda_tpu.utils.metrics import metrics
 
 __all__ = ["uniform_splitters", "sample_splitters", "distributed_sort_step",
            "distributed_sort_multiround", "DistributedSortResult"]
@@ -272,9 +271,12 @@ def _sort_valid_rows_lanes(flat, valid, num_keys, interpret,
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis", "capacity", "num_keys",
-                                   "payload_path", "interpret"))
+                                   "payload_path", "interpret",
+                                   "exchange_mode", "dcn_axis",
+                                   "ici_axis"))
 def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
-               payload_path="carry", interpret=False):
+               payload_path="carry", interpret=False,
+               exchange_mode="flat", dcn_axis=None, ici_axis=None):
     # check_vma now runs on the REAL lanes path too: the merge-pass
     # fori_loop carry is pcast to the data's vma at init
     # (ops/pallas_sort.py _pass_splits), which was the only mis-typing
@@ -289,6 +291,8 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
              out_specs=(P(axis), P(axis), P(axis)),
              check_vma=_vma_check_on(payload_path, interpret))
     def _go(w, spl):
+        from uda_tpu.parallel.exchange import run_round_body
+
         p = lax.psum(1, axis)
         n, wcols = w.shape
         # 1. partition: monotone in the first key word
@@ -301,18 +305,13 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
         starts = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                   jnp.cumsum(counts)[:-1].astype(jnp.int32)])
         pos = jnp.arange(n, dtype=jnp.int32) - jnp.take(starts, sd)
-        # 3. single-round exchange (overflow reported, not silently lost)
-        slot = jnp.where(pos < capacity, pos, capacity)
-        send = jnp.zeros((p, capacity + 1, wcols), w.dtype)
-        send = send.at[sd, slot].set(sw)
-        send_counts = jnp.minimum(counts, capacity)
+        # 3. single-round exchange at window base 0 (the shared round
+        # bodies of parallel/exchange.py; overflow — rows past the
+        # credit window — is reported, not silently lost)
         overflow = jnp.sum(jnp.maximum(counts - capacity, 0))
-        recv = lax.all_to_all(send[:, :capacity], axis, split_axis=0,
-                              concat_axis=0, tiled=False)
-        recv_counts = lax.all_to_all(send_counts[:, None], axis,
-                                     split_axis=0, concat_axis=0,
-                                     tiled=False).reshape(p)
-        flat = recv.reshape(p * capacity, wcols)
+        flat, recv_counts = run_round_body(sw, sd, pos, 0, capacity,
+                                           axis, exchange_mode,
+                                           dcn_axis, ici_axis)
         # 4. local sort: invalid rows forced past every real key
         row = jnp.arange(p * capacity, dtype=jnp.int32)
         valid = (row % capacity) < jnp.take(recv_counts, row // capacity)
@@ -330,7 +329,8 @@ def _sort_step(words, splitters, mesh, axis, capacity, num_keys,
 def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
                           capacity: int, num_keys: int,
                           payload_path: str = "auto",
-                          multiround: str = "auto"
+                          multiround: str = "auto",
+                          exchange_mode: str = "auto"
                           ) -> DistributedSortResult:
     """Run the fused partition/exchange/sort step.
 
@@ -338,9 +338,16 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
     first ``num_keys`` columns are the big-endian key words).
     ``axis``: one mesh axis name, or a TUPLE of axis names for
     multi-pod meshes — e.g. ``("dcn", "shuffle")`` on a (pods, chips)
-    mesh shards rows over both and XLA routes the all_to_all per axis
-    (ICI within a pod, DCN across pods); results are byte-identical to
-    the flat single-axis mesh of the same device order.
+    mesh shards rows over both; results are byte-identical to the flat
+    single-axis mesh of the same device order.
+    ``exchange_mode``: fabric dispatch for multi-pod meshes —
+    ``"auto"`` (default) runs the two-stage hierarchical round body
+    (pod-local all_to_all, ONE coalesced DCN tile per pod pair, pod-
+    local delivery scatter — parallel/exchange.py) whenever the mesh
+    has a DCN-tagged outer axis with >1 pod of >1 chip; ``"flat"``
+    forces the single-stage body (the A/B baseline, where XLA routes
+    one global all_to_all per axis); ``"hierarchical"`` demands a pod
+    mesh.
     ``capacity``: per-(src, dst) records per round — the credit window.
     ``payload_path``: how the local sort moves value columns ("auto":
     operand-carry on CPU meshes, chunked operand-carry ("carrychunk",
@@ -356,29 +363,39 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
     completes). "never" reports overflow in the result (caller handles
     it); "always" skips the fused attempt.
     """
+    from uda_tpu.parallel.exchange import (exchange_dispatch,
+                                           resolve_exchange_mode)
+
     payload_path = _resolve_payload_path(payload_path, int(words.shape[1]),
                                          num_keys)
     if multiround not in ("auto", "never", "always"):
         raise ValueError(f"unknown multiround policy {multiround!r}")
+    topo, hier = resolve_exchange_mode(mesh, axis, exchange_mode)
     if multiround == "always":
         return distributed_sort_multiround(words, splitters, mesh, axis,
-                                           capacity, num_keys, payload_path)
+                                           capacity, num_keys, payload_path,
+                                           exchange_mode)
     words = put_rows(words, mesh, axis)
     splitters_dev = put_global(np.asarray(splitters, dtype=np.uint32),
                                NamedSharding(mesh, P()))
     out, nvalid, overflow, total = _sort_step(
         words, splitters_dev, mesh, axis, capacity, num_keys, payload_path,
-        interpret=_lanes_interpret(payload_path, mesh))
+        interpret=_lanes_interpret(payload_path, mesh),
+        **exchange_dispatch(topo, hier))
     res = DistributedSortResult(out, nvalid, overflow, total)
     if multiround == "auto" and res.overflow() != 0:
         return distributed_sort_multiround(words, splitters, mesh, axis,
-                                           capacity, num_keys, payload_path)
+                                           capacity, num_keys, payload_path,
+                                           exchange_mode)
     return res
 
 
-@partial(jax.jit, static_argnames=("mesh", "axis", "capacity"),
+@partial(jax.jit, static_argnames=("mesh", "axis", "capacity",
+                                   "exchange_mode", "dcn_axis",
+                                   "ici_axis"),
          donate_argnames=("acc",))
-def _round_scatter(words, dest, pos, acc, colbase, r, mesh, axis, capacity):
+def _round_scatter(words, dest, pos, acc, colbase, r, mesh, axis, capacity,
+                   exchange_mode="flat", dcn_axis=None, ici_axis=None):
     """One windowed exchange round scattered into the accumulator.
 
     The accumulator (donated: updated in place across rounds) holds each
@@ -386,10 +403,12 @@ def _round_scatter(words, dest, pos, acc, colbase, r, mesh, axis, capacity):
     the row from peer s with in-bucket position q lands at
     ``colbase[s] + q``. Rows outside this round's window or past a
     peer's bucket count scatter to the drop sentinel. ``r`` is TRACED,
-    so ONE compiled program serves every round.
+    so ONE compiled program serves every round. On hierarchical meshes
+    the round runs the staged two-stage body — identical delivery
+    contract, so the scatter below is dispatch-blind.
     """
 
-    from uda_tpu.parallel.exchange import window_round_body
+    from uda_tpu.parallel.exchange import run_round_body
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
@@ -397,7 +416,9 @@ def _round_scatter(words, dest, pos, acc, colbase, r, mesh, axis, capacity):
     def _go(w, d, q, acc, cb, rr):
         p = lax.psum(1, axis)
         lo = rr[0] * capacity
-        flat, recv_counts = window_round_body(w, d, q, lo, axis, capacity)
+        flat, recv_counts = run_round_body(w, d, q, lo, capacity, axis,
+                                           exchange_mode, dcn_axis,
+                                           ici_axis)
         row = jnp.arange(p * capacity, dtype=jnp.int32)
         peer = row // capacity
         slot = row % capacity
@@ -432,21 +453,27 @@ def _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,
 
 def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
                                 capacity: int, num_keys: int,
-                                payload_path: str = "auto"
+                                payload_path: str = "auto",
+                                exchange_mode: str = "auto"
                                 ) -> DistributedSortResult:
     """Skew-proof distributed sort: windowed multi-round exchange
     scattered into a shard-sized accumulator, then one local sort.
 
-    The round count comes from the gathered count matrix (one host
-    readback per shuffle), so every (src, dst) bucket — however skewed —
-    drains completely: the TPU-native equivalent of the reference's
-    credit backlog (reference src/DataNet/RDMAComm.cc:707-752, drained
-    in RDMAClient.cc:64-92). Peak memory per device is
+    The round schedule comes from the gathered count matrix (one host
+    readback per shuffle, planned by parallel/planner.py — globally-
+    empty windows are skipped and the per-axis ICI/DCN accounting is
+    recorded per executed round), so every (src, dst) bucket — however
+    skewed — drains completely: the TPU-native equivalent of the
+    reference's credit backlog (reference src/DataNet/RDMAComm.cc:
+    707-752, drained in RDMAClient.cc:64-92). Peak memory per device is
     O(largest destination shard + P x capacity): each round's delivery
     is compacted into the accumulator immediately (donated buffer), so
     nothing scales with the round count.
     """
     from uda_tpu.parallel.exchange import prepare_layout
+    from uda_tpu.parallel.planner import (plan_layout_rounds,
+                                          record_executed_window,
+                                          record_plan_skips)
 
     payload_path = _resolve_payload_path(payload_path, int(words.shape[1]),
                                          num_keys)
@@ -463,10 +490,9 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
                                 side="right").astype(jnp.int32)
 
     dest = _dests(words, splitters_dev[None, :])
-    layout = prepare_layout(words, dest, mesh, axis)
+    layout = prepare_layout(words, dest, mesh, axis, exchange_mode)
     counts = layout.counts                      # [src, dst]
-    biggest = int(counts.max()) if counts.size else 0
-    rounds = max(1, -(-biggest // capacity))
+    plan = plan_layout_rounds(layout, capacity)
     # destination-side layout: shard sized to the largest destination,
     # rows grouped by (src, in-bucket arrival)
     colbase = np.zeros((p, p), np.int32)        # [dst, src] exclusive cumsum
@@ -476,11 +502,13 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
     acc = zeros_global((p * shard_rows, int(words.shape[1])), np.uint32,
                        spec)
     colbase_dev = put_global(colbase, spec)
-    for r in range(rounds):
+    dispatch = layout.dispatch()
+    for win in plan.windows:
         acc = _round_scatter(layout.words, layout.dest, layout.pos, acc,
-                             colbase_dev, jnp.int32(r), mesh, axis,
-                             capacity)
-        metrics.add("exchange.rounds")
+                             colbase_dev, jnp.int32(win.index), mesh,
+                             axis, capacity, **dispatch)
+        record_executed_window(win, plan)
+    record_plan_skips(plan)
     nvalid = put_global(per_dst.astype(np.int32), spec)
     out = _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,
                       interpret=_lanes_interpret(payload_path, mesh))

@@ -19,6 +19,33 @@ uda_tpu.ops.packing); within one jitted round everything is static
 shapes, so XLA lowers the exchange to ICI collectives with no host in
 the loop. A host-side variable-length RecordBatch exchange is provided
 for the Hadoop byte-exact path and as the CPU reference.
+
+Hierarchical (multi-pod) meshes: on a ``(dcn, ici)`` 2-axis mesh the
+flat round would give every cross-pod *device* pair its own DCN lane —
+O((p*c)^2) per-round DCN messages. The two-stage round body
+(:func:`hierarchical_round_body`) instead runs the all_to_all only
+over the ICI axis, staging every record's cross-pod hop onto the ONE
+designated egress chip of its (pod, peer-pod) pair, moves one
+coalesced tile per pod pair over the DCN axis — O(p^2) messages, the
+reference's per-QP aggregation win (RDMAServer.cc chunked server
+pool) — and delivers with a second pod-local scatter. Same window
+semantics, same delivery contract, byte-identical output; the host
+planner (parallel/planner.py) proves the per-round message reduction
+and accounts the RECORD bytes each tier carries (identical to flat on
+the DCN by construction — the same rows cross pods either way).
+
+Scope of the byte accounting: ``lax.all_to_all`` lowers to DENSE
+static buffers, so the stage-B collective's wire footprint includes
+the unpopulated tile slots of non-egress chips (a ~pod_size padding
+factor over the populated rows; stage C likewise on ICI). A
+sparse/ragged collective (``lax.ragged_all_to_all``, newer JAX) is
+the lever that makes the wire footprint match the record accounting —
+until then the hierarchical win this module claims, measures and
+gates is the MESSAGE/coalescing structure (per-transfer setup cost,
+the per-QP analogy) plus the per-tier record-byte ledger, not the
+padded collective payload. ``shuffle_exchange``/``prepare_layout``
+dispatch on the mesh topology (flat 1-axis meshes keep the
+single-stage path).
 """
 
 from __future__ import annotations
@@ -34,14 +61,52 @@ from jax import lax
 from uda_tpu.parallel import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from uda_tpu.parallel.mesh import MeshTopology, mesh_topology
 from uda_tpu.parallel.multihost import allgather, put_rows
-from uda_tpu.utils.errors import TransportError
+from uda_tpu.utils.errors import ConfigError, TransportError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.ifile import RecordBatch
-from uda_tpu.utils.metrics import metrics
 
 __all__ = ["ShuffleLayout", "prepare_layout", "window_round_body",
+           "hierarchical_round_body", "run_round_body",
+           "resolve_exchange_mode", "exchange_dispatch",
            "exchange_round", "shuffle_exchange", "exchange_record_batches"]
+
+EXCHANGE_MODES = ("auto", "flat", "hierarchical")
+
+
+def resolve_exchange_mode(mesh: Mesh, axis, mode: str = "auto"):
+    """Resolve the exchange dispatch for a (mesh, axis) pair.
+
+    Returns ``(topology, hierarchical)``. ``auto`` takes the two-stage
+    path exactly when the mesh has a real pod structure (a DCN-tagged
+    outer axis with >1 pod of >1 chip); ``flat`` forces the
+    single-stage path on any mesh (the A/B baseline); ``hierarchical``
+    demands a hierarchical mesh and refuses otherwise."""
+    if mode not in EXCHANGE_MODES:
+        raise ConfigError(f"unknown exchange mode {mode!r} "
+                          f"(one of {EXCHANGE_MODES})")
+    topo = mesh_topology(mesh, axis)
+    if mode == "hierarchical" and not topo.hierarchical:
+        raise ConfigError(
+            f"exchange mode 'hierarchical' needs a (dcn, ici) mesh with "
+            f">1 pod of >1 chip; got axes {axis!r} on mesh "
+            f"{dict(mesh.shape)}")
+    return topo, (topo.hierarchical if mode == "auto"
+                  else mode == "hierarchical")
+
+
+def exchange_dispatch(topology: Optional[MeshTopology],
+                      hierarchical: bool) -> dict:
+    """The static dispatch triple every jitted exchange entry point
+    shares (``_round_impl``, ``distributed._sort_step``,
+    ``distributed._round_scatter``) — ONE definition so the fused,
+    multiround and plain-exchange paths can never disagree on which
+    round body a mesh runs."""
+    hier = bool(hierarchical) and topology is not None
+    return {"exchange_mode": "hierarchical" if hier else "flat",
+            "dcn_axis": topology.dcn_axis if hier else None,
+            "ici_axis": topology.ici_axis if hier else None}
 
 
 @dataclasses.dataclass
@@ -55,7 +120,9 @@ class ShuffleLayout:
     - ``pos``: int32[N] position of the record within its (src, dst)
       bucket — ``pos // capacity`` is the round it travels in;
     - ``counts``: int32[P, P] full count matrix (row = src device,
-      col = dst) gathered to every device for round planning.
+      col = dst) gathered to every device for round planning;
+    - ``topology``/``hierarchical``: the resolved fabric dispatch —
+      which round body :func:`exchange_round` runs.
     """
 
     words: jax.Array
@@ -64,6 +131,19 @@ class ShuffleLayout:
     counts: np.ndarray
     mesh: Mesh
     axis: str
+    topology: Optional[MeshTopology] = None
+    hierarchical: bool = False
+
+    def dispatch(self) -> dict:
+        """Static round-body dispatch kwargs (see
+        :func:`exchange_dispatch`)."""
+        return exchange_dispatch(self.topology, self.hierarchical)
+
+    def record_bytes(self) -> int:
+        """Wire stride of one record row — the byte unit of the
+        planner's ICI/DCN accounting."""
+        return (int(self.words.shape[1])
+                * int(np.dtype(self.words.dtype).itemsize))
 
 
 def _bucket_local(words, dest, axis):
@@ -81,9 +161,11 @@ def _bucket_local(words, dest, axis):
 
 
 def prepare_layout(words: jax.Array, dest: jax.Array, mesh: Mesh,
-                   axis: str) -> ShuffleLayout:
-    """Bucket every device's records and gather the count matrix."""
-    spec_rows = NamedSharding(mesh, P(axis))
+                   axis: str, mode: str = "auto") -> ShuffleLayout:
+    """Bucket every device's records and gather the count matrix.
+    ``mode`` resolves the fabric dispatch (see
+    :func:`resolve_exchange_mode`)."""
+    topo, hier = resolve_exchange_mode(mesh, axis, mode)
 
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
              out_specs=(P(axis), P(axis), P(axis), P(axis)))
@@ -96,7 +178,8 @@ def prepare_layout(words: jax.Array, dest: jax.Array, mesh: Mesh,
     sw, sd, pos, counts = _prep(words, dest)
     # count-matrix readback: allgather works on multi-process meshes
     # where the sharded array is not host-addressable
-    return ShuffleLayout(sw, sd, pos, allgather(counts), mesh, axis)
+    return ShuffleLayout(sw, sd, pos, allgather(counts), mesh, axis,
+                         topo, hier)
 
 
 def window_round_body(w, d, q, lo, axis: str, capacity: int):
@@ -124,22 +207,138 @@ def window_round_body(w, d, q, lo, axis: str, capacity: int):
     return recv.reshape(p * capacity, wcols), recv_counts
 
 
-@partial(jax.jit, static_argnames=("capacity", "axis", "mesh"))
-def _round_impl(words, dest, pos, round_index, mesh, axis, capacity):
+def hierarchical_round_body(w, d, q, lo, dcn_axis: str, ici_axis: str,
+                            capacity: int):
+    """The two-stage (pod-local + coalesced DCN) round body, for use
+    INSIDE a shard_map over BOTH mesh axes. Same window semantics and
+    same delivery contract as :func:`window_round_body` — callers
+    cannot tell which body ran except through the fabric accounting:
+
+    - **stage A (ICI all_to_all):** records are re-bucketed by
+      destination POD; an intra-pod record goes straight to its final
+      chip, a cross-pod record to the ONE designated egress chip of its
+      (pod, peer-pod) pair (``MeshTopology.egress_chip`` =
+      ``(g + g') % c``, rotating pairs across chips);
+    - **stage B (DCN all_to_all):** each populated egress chip moves
+      ONE coalesced tile per peer pod — O(p^2) DCN messages per round
+      instead of the flat body's O((p*c)^2) device pairs;
+    - **stage C (ICI all_to_all):** the ingress chip scatters arrived
+      rows to their final chips.
+
+    Delivery slots are carried, not recomputed: every staged row rides
+    with a ``tag`` column (``src_device * capacity + in_window_slot +
+    1``; 0 marks an empty staging slot), and the final scatter places
+    row ``tag - 1`` of the ``[P*capacity, W]`` output — exactly the
+    (peer row-block, slot) layout of the flat body, so the output is
+    byte-identical by construction, not by sort order luck. The tag is
+    computed and decoded in int32, capping ``P * capacity`` at
+    2^31 - 1 — a bound the [P*capacity, W] delivery buffer hits in HBM
+    long before the tag does, and which the host planner
+    (parallel/planner.py plan_rounds) rejects loudly.
+    """
+    p = lax.psum(1, dcn_axis)           # pods
+    c = lax.psum(1, ici_axis)           # chips per pod
+    g = lax.axis_index(dcn_axis)        # my pod
+    i = lax.axis_index(ici_axis)        # my chip
+    m = -(-p // c)                      # peer-pod slots per egress chip
+    nd = p * c
+    wcols = w.shape[1]
+    in_round = (q >= lo) & (q < lo + capacity)
+    slot = q - lo
+    tag = ((g * c + i) * capacity + slot + 1).astype(w.dtype)
+    ext = jnp.concatenate([w, tag[:, None]], axis=1)
+    wex = wcols + 1
+
+    # -- stage A: pod-local all_to_all (direct delivery / egress stage)
+    dpod = d // c
+    dchip = d % c
+    intra = dpod == g
+    rows_a = capacity + m * c * capacity
+    blk = jnp.where(intra, dchip, (g + dpod) % c)
+    row = jnp.where(intra, slot,
+                    capacity + (dpod // c) * (c * capacity)
+                    + dchip * capacity + slot)
+    row = jnp.where(in_round, row, rows_a)      # trash row, sliced off
+    send_a = jnp.zeros((c, rows_a + 1, wex), w.dtype)
+    send_a = send_a.at[blk, row].set(ext, mode="drop")
+    recv_a = lax.all_to_all(send_a[:, :rows_a], ici_axis, split_axis=0,
+                            concat_axis=0, tiled=False)
+    intra_rows = recv_a[:, :capacity].reshape(c * capacity, wex)
+    # [src chip, peer-pod rank, dst chip, slot, word]
+    cross = recv_a[:, capacity:].reshape(c, m, c, capacity, wex)
+
+    # -- stage B: ONE coalesced tile per pod pair over the DCN axis.
+    # I am the egress chip of peer pods g' with (g + g') % c == i, i.e.
+    # g' = ((i - g) mod c) + k*c for rank k — and by the same formula
+    # the INGRESS chip for tiles arriving from those pods.
+    peers = ((i - g) % c) + jnp.arange(m) * c
+    tiles = jnp.swapaxes(cross, 0, 1).reshape(m, c * c * capacity, wex)
+    send_b = jnp.zeros((p + 1, c * c * capacity, wex), w.dtype)
+    send_b = send_b.at[jnp.where(peers < p, peers, p)].set(
+        tiles, mode="drop")
+    recv_b = lax.all_to_all(send_b[:p], dcn_axis, split_axis=0,
+                            concat_axis=0, tiled=False)
+
+    # -- stage C: pod-local scatter of the arrived tiles (only the
+    # blocks whose source pod I ingress for are populated; compact to
+    # the m populated ranks before the all_to_all)
+    compact = jnp.take(recv_b, jnp.minimum(peers, p - 1), axis=0)
+    compact = jnp.where((peers < p)[:, None, None], compact, 0)
+    compact = compact.reshape(m, c, c, capacity, wex)
+    send_c = jnp.transpose(compact, (2, 0, 1, 3, 4)).reshape(
+        c, m * c * capacity, wex)
+    recv_c = lax.all_to_all(send_c, ici_axis, split_axis=0,
+                            concat_axis=0, tiled=False)
+
+    # -- final assembly: tag - 1 IS the output row
+    arrived = jnp.concatenate([
+        intra_rows, recv_c.reshape(c * m * c * capacity, wex)])
+    atag = arrived[:, wcols].astype(jnp.int32)
+    valid = atag > 0
+    idx = jnp.where(valid, atag - 1, nd * capacity)
+    out = jnp.zeros((nd * capacity + 1, wcols), w.dtype)
+    out = out.at[idx].set(arrived[:, :wcols], mode="drop")[:nd * capacity]
+    peer_dev = jnp.where(valid, (atag - 1) // capacity, nd)
+    recv_counts = jnp.bincount(peer_dev, length=nd + 1)[:nd].astype(
+        jnp.int32)
+    return out, recv_counts
+
+
+def run_round_body(w, d, q, lo, capacity: int, axis,
+                   exchange_mode="flat", dcn_axis=None, ici_axis=None):
+    """The flat-vs-hierarchical body dispatch, for use INSIDE a
+    shard_map body — the single branch shared by ``_round_impl``,
+    ``distributed._sort_step`` and ``distributed._round_scatter``
+    (fed the static kwargs of :func:`exchange_dispatch`), completing
+    the one-definition contract: a new mode or body signature changes
+    exactly here."""
+    if exchange_mode == "hierarchical":
+        return hierarchical_round_body(w, d, q, lo, dcn_axis, ici_axis,
+                                       capacity)
+    return window_round_body(w, d, q, lo, axis, capacity)
+
+
+@partial(jax.jit, static_argnames=("capacity", "axis", "mesh",
+                                   "exchange_mode", "dcn_axis",
+                                   "ici_axis"))
+def _round_impl(words, dest, pos, round_index, mesh, axis, capacity,
+                exchange_mode="flat", dcn_axis=None, ici_axis=None):
     # round_index is TRACED: one compiled program serves every round
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis), P(axis), P(axis), P()),
              out_specs=(P(axis), P(axis)))
     def _go(w, d, q, r):
-        flat, recv_counts = window_round_body(w, d, q, r[0] * capacity,
-                                              axis, capacity)
+        flat, recv_counts = run_round_body(
+            w, d, q, r[0] * capacity, capacity, axis,
+            exchange_mode, dcn_axis, ici_axis)
         return flat, recv_counts.reshape(1, -1)
 
     return _go(words, dest, pos, round_index)
 
 
 def exchange_round(layout: ShuffleLayout, capacity: int, round_index: int):
-    """One windowed all-to-all round.
+    """One windowed exchange round (single-stage, or the two-stage
+    hierarchical body when the layout resolved a pod topology).
 
     Returns ``(recv_words, recv_counts)``: per device, ``capacity`` rows
     from each peer (``recv_words`` row-block i = peer i's contribution,
@@ -147,34 +346,54 @@ def exchange_round(layout: ShuffleLayout, capacity: int, round_index: int):
     """
     return _round_impl(layout.words, layout.dest, layout.pos,
                        jnp.asarray([round_index], jnp.int32),
-                       layout.mesh, layout.axis, capacity)
+                       layout.mesh, layout.axis, capacity,
+                       **layout.dispatch())
 
 
 def shuffle_exchange(words, dest, mesh: Mesh, axis: str,
                      capacity: int,
-                     max_rounds: Optional[int] = None):
+                     max_rounds: Optional[int] = None,
+                     mode: str = "auto"):
     """Full exchange: as many rounds as the largest (src, dst) bucket
     needs. Returns ``(per_round_results, layout)`` where each round entry
     is the (recv_words, recv_counts) pair of exchange_round.
 
-    The round count is data-dependent but *host*-decided (one count
+    The round schedule is data-dependent but *host*-decided (one count
     matrix readback per shuffle, analogous to the reference's per-MOF
-    fetch bookkeeping) so every device executes the same static program.
+    fetch bookkeeping) so every device executes the same static
+    program: the planner (parallel/planner.py) derives every window
+    from the counts matrix, skips globally-empty ones
+    (``exchange.rounds.skipped``) and records the per-axis fabric
+    accounting (``exchange.ici.bytes`` / ``exchange.dcn.bytes`` /
+    ``exchange.dcn.messages``) for each executed round. ``mode``
+    picks flat vs two-stage hierarchical dispatch (see
+    :func:`resolve_exchange_mode`).
     """
-    layout = prepare_layout(words, dest, mesh, axis)
-    biggest = int(layout.counts.max()) if layout.counts.size else 0
-    rounds = max(1, -(-biggest // capacity))
-    if max_rounds is not None and rounds > max_rounds:
+    from uda_tpu.parallel.planner import (plan_layout_rounds,
+                                          record_executed_window,
+                                          record_plan_skips)
+
+    layout = prepare_layout(words, dest, mesh, axis, mode)
+    plan = plan_layout_rounds(layout, capacity)
+    if max_rounds is not None and plan.planned > max_rounds:
+        biggest = int(layout.counts.max()) if layout.counts.size else 0
         raise TransportError(
-            f"skew needs {rounds} rounds (bucket {biggest} > capacity "
-            f"{capacity} x {max_rounds}); raise capacity or max_rounds")
+            f"skew needs {plan.planned} rounds (bucket {biggest} > "
+            f"capacity {capacity} x {max_rounds}); raise capacity or "
+            f"max_rounds")
     results = []
-    for r in range(rounds):
+    for win in plan.windows:
         # injection site for exchange-plane faults (a failed collective
         # surfaces as TransportError, like a reference WC error)
-        failpoint("exchange.round", key=f"round{r}")
-        results.append(exchange_round(layout, capacity, r))
-        metrics.add("exchange.rounds")
+        failpoint("exchange.round", key=f"round{win.index}")
+        if layout.hierarchical:
+            # stage-resolved rung: a fault in the cross-pod DCN stage
+            # (arm with match:stageB) must surface exactly like a
+            # whole-round collective failure
+            failpoint("exchange.round", key=f"round{win.index}.stageB")
+        results.append(exchange_round(layout, capacity, win.index))
+        record_executed_window(win, plan)
+    record_plan_skips(plan)
     return results, layout
 
 

@@ -1,4 +1,4 @@
-"""Device mesh construction.
+"""Device mesh construction + the hierarchical topology descriptor.
 
 The TPU equivalent of the reference's connection topology: where the
 reference built one RDMA QP per (reducer, supplier-host) pair lazily
@@ -8,11 +8,20 @@ collectives over ICI/DCN. The shuffle data plane uses one named axis
 (default ``"shuffle"``); multi-axis meshes (e.g. ``dp x shuffle`` for
 several concurrent jobs, or an ICI x DCN split for multi-pod) compose by
 naming which axis carries the exchange.
+
+Axis tagging: an axis whose name is ``dcn`` (or starts with ``dcn``) is
+the cross-pod data-center-network axis; every other exchange axis is
+ICI. A ``uda.tpu.mesh.shape`` of ``dcn:4,ici:8`` therefore describes 4
+pods of 8 chips. :func:`mesh_topology` classifies a (mesh, axis-spec)
+pair into a :class:`MeshTopology`, which the exchange uses to pick the
+two-stage hierarchical round body (pod-local all-to-all + one coalesced
+DCN tile per pod pair) over the flat single-stage path.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -21,9 +30,91 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import ConfigError
 
-__all__ = ["make_mesh", "mesh_from_config", "shard_spec", "SHUFFLE_AXIS"]
+__all__ = ["make_mesh", "mesh_from_config", "shard_spec", "SHUFFLE_AXIS",
+           "MeshTopology", "mesh_topology", "is_dcn_axis"]
 
 SHUFFLE_AXIS = "shuffle"
+
+AxisSpec = Union[str, Tuple[str, ...]]
+
+
+def is_dcn_axis(name) -> bool:
+    """An axis is DCN-tagged by NAME: ``dcn`` or any ``dcn``-prefixed
+    name (``dcn``, ``dcn0`` ...). Everything else rides ICI."""
+    return str(name).startswith("dcn")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology:
+    """How the exchange axes map onto the physical fabric.
+
+    Device linear index contract: rows sharded with
+    ``PartitionSpec((dcn_axis, ici_axis))`` land on devices in row-major
+    (pod-major) order, so global device ``t`` is pod ``t // pod_size``,
+    chip ``t % pod_size`` — every pod helper below assumes it.
+    """
+
+    dcn_axis: Optional[str]     # None = no DCN-tagged axis (flat mesh)
+    ici_axis: Optional[str]     # the intra-pod axis name (None if flat
+    #                             over an untagged multi-axis tuple)
+    num_pods: int
+    pod_size: int
+
+    @property
+    def num_devices(self) -> int:
+        return self.num_pods * self.pod_size
+
+    @property
+    def hierarchical(self) -> bool:
+        """True when the mesh has a real pod structure the two-stage
+        exchange can exploit (>1 pod of >1 chip)."""
+        return (self.dcn_axis is not None and self.num_pods > 1
+                and self.pod_size > 1)
+
+    def pod_of(self, device_index: int) -> int:
+        return int(device_index) // self.pod_size
+
+    def chip_of(self, device_index: int) -> int:
+        return int(device_index) % self.pod_size
+
+    def pod_members(self, pod: int) -> range:
+        return range(pod * self.pod_size, (pod + 1) * self.pod_size)
+
+    def egress_chip(self, src_pod: int, dst_pod: int) -> int:
+        """The ONE designated chip of ``src_pod`` that stages the
+        coalesced DCN tile for pod pair (src_pod -> dst_pod) — and, by
+        the chip-index-preserving semantics of the DCN all_to_all, the
+        ingress chip of ``dst_pod`` for the same pair. The rotation
+        spreads pairs across chips so no chip is every pair's relay.
+        Single definition of the contract: the device round body
+        (exchange.hierarchical_round_body) and the host planner
+        (parallel/planner.py) both compute exactly this."""
+        return (src_pod + dst_pod) % self.pod_size
+
+
+def mesh_topology(mesh: Mesh, axis: AxisSpec) -> MeshTopology:
+    """Classify the exchange axes of ``mesh``.
+
+    ``axis`` is the exchange axis spec as passed to the exchange APIs: a
+    single name, or a tuple for multi-axis meshes. A 2-tuple whose OUTER
+    axis is DCN-tagged and whose inner is not describes a (pods x chips)
+    hierarchy; anything else is treated as one flat exchange group (the
+    single-stage path — including untagged multi-axis tuples, where the
+    linearized device order carries no pod semantics)."""
+    if isinstance(axis, str):
+        return MeshTopology(None, axis, 1, int(mesh.shape[axis]))
+    names = tuple(axis)
+    if len(names) == 1:
+        return MeshTopology(None, names[0], 1, int(mesh.shape[names[0]]))
+    if (len(names) == 2 and is_dcn_axis(names[0])
+            and not is_dcn_axis(names[1])):
+        return MeshTopology(names[0], names[1],
+                            int(mesh.shape[names[0]]),
+                            int(mesh.shape[names[1]]))
+    size = 1
+    for n in names:
+        size *= int(mesh.shape[n])
+    return MeshTopology(None, None, 1, size)
 
 
 def make_mesh(num_devices: Optional[int] = None,
@@ -41,7 +132,9 @@ def make_mesh(num_devices: Optional[int] = None,
 
 def mesh_from_config(cfg: Config) -> Mesh:
     """Mesh from the ``uda.tpu.mesh.shape`` flag: ``'axis:N,axis2:M'``;
-    empty = 1D over all devices."""
+    empty = 1D over all devices. Axis names tag the fabric tier —
+    ``'dcn:4,ici:8'`` is 4 pods x 8 chips (see :func:`mesh_topology`);
+    the outer DCN axis must come first so pods are device-contiguous."""
     spec = str(cfg.get("uda.tpu.mesh.shape")).strip()
     if not spec:
         return make_mesh()

@@ -171,14 +171,9 @@ _FLAG_LIST = [
     Flag("uda.tpu.net.drain.s", 5.0, float,
          "graceful server stop: how long stop() lets in-flight "
          "responses flush before closing connections"),
-    Flag("uda.tpu.net.core", "evloop", str,
-         "data-plane core: 'evloop' (selector event loop, non-blocking "
-         "sockets, zero-copy serve path — the default) or 'threaded' "
-         "(the legacy PR 4 thread-per-connection core, kept as the "
-         "bench baseline until the BENCH_NET_* trajectory retires it)"),
     Flag("uda.tpu.net.sockbuf.kb", 0, int,
          "SO_SNDBUF/SO_RCVBUF for every data-plane socket in KB "
-         "(both sides, both cores); 0 = leave the OS autotuned "
+         "(server and client); 0 = leave the OS autotuned "
          "defaults. TCP_NODELAY is always set regardless — small "
          "REQ/SIZE frames must not eat Nagle delays"),
     Flag("uda.tpu.net.zerocopy", True, bool,

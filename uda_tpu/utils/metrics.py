@@ -118,6 +118,24 @@ METRICS_REGISTRY: Dict[str, tuple] = {
     "spool.bytes": ("counter", "bytes spooled to sorted run files "
                                "(streaming online mode)"),
     "exchange.rounds": ("counter", "all-to-all exchange rounds executed"),
+    "exchange.rounds.skipped": ("counter", "planned exchange windows the "
+                                           "host round planner dropped "
+                                           "because no device had "
+                                           "in-window records"),
+    "exchange.ici.bytes": ("counter", "record bytes the round planner "
+                                      "routed over intra-pod ICI links "
+                                      "(off-device rows; hierarchical "
+                                      "mode includes the egress/"
+                                      "delivery staging hops)"),
+    "exchange.dcn.bytes": ("counter", "record bytes crossing a pod "
+                                      "boundary over DCN [labels: pod "
+                                      "(source pod)]"),
+    "exchange.dcn.messages": ("counter", "per-round DCN transfers: "
+                                         "cross-pod (src, dst) device "
+                                         "pairs with traffic (flat "
+                                         "exchange) vs coalesced pod "
+                                         "pairs (hierarchical) [labels: "
+                                         "pod (source pod)]"),
     "decompress.bytes": ("counter", "uncompressed bytes produced by the "
                                     "decompressing fetch client"),
     # -- counters: network data plane (uda_tpu/net/) ---------------------
