@@ -91,6 +91,26 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
     -k "exchange" \
     --continue-on-collection-errors "$@" || erc=$?
 
+# Completion rung: the survivable-shuffle guarantee (ISSUE 8) — a
+# seeded supplier KILL (rs:4:6 coding, no restart) and a seeded
+# supplier BOUNCE (warm restart + handoff) must both end in a FINISHED
+# job with byte-correct merged output (coding.reconstructed.partitions
+# > 0 for the kill, fetch.resumed > 0 for the bounce, zero
+# FallbackSignals) — the tests assert all of it, so a job that merely
+# "falls back cleanly" FAILS this rung. Runs under lockdep: the
+# recovery paths (recovery ledger, stripe fan-out, speculation timers)
+# must add no lock-order cycles.
+CCOUNTERS="$(mktemp)"
+CCYCLES="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}"' EXIT
+echo "completion rung:     seeded supplier kill + warm restart (seed ${SEED}, UDA_TPU_LOCKDEP=1)"
+crc=0
+env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 UDA_TPU_CHAOS_SEED="${SEED}" \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${CCYCLES}" \
+    UDA_TPU_CHAOS_TELEMETRY="${CCOUNTERS}" \
+    python -m pytest tests/test_coding.py -m faults -q -p no:cacheprovider \
+    --continue-on-collection-errors "$@" || crc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -101,7 +121,7 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -115,12 +135,14 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${PSPEC}" "${PCOUNTERS}" "${prc}" \
     "${NSPEC}" "${NCOUNTERS}" "${nrc}" "${NCYCLES}" \
     "${ECOUNTERS}" "${erc}" "${ECYCLES}" \
+    "${CCOUNTERS}" "${crc}" "${CCYCLES}" \
     "${LCOUNTERS}" "${lrc}" "${LCYCLES}" <<'EOF' || mrc=$?
 import json, sys
 (seed, spec, counters_path, out, rc, pspec, pcounters, prc,
  nspec, ncounters, nrc, ncycles,
  ecounters, erc, ecycles,
- lcounters, lrc, lcycles) = sys.argv[1:19]
+ ccounters, crc_, ccycles,
+ lcounters, lrc, lcycles) = sys.argv[1:22]
 def load(path):
     try:
         with open(path) as f:
@@ -145,6 +167,21 @@ def lockdep_block(schedule, exit_code, telem_path, cycles_path):
 network, n_reports = lockdep_block(nspec, nrc, ncounters, ncycles)
 exchange, e_reports = lockdep_block("scoped exchange.round (per-test)",
                                     erc, ecounters, ecycles)
+completion, c_reports = lockdep_block(
+    f"seeded supplier kill + warm restart (seed {seed})",
+    crc_, ccounters, ccycles)
+# the completion guarantee, surfaced in the telemetry: reconstructed
+# partitions and resumed fetches with ZERO fallbacks (the per-test
+# asserts enforce it; this block is the cross-round diffable record)
+cc = completion["telemetry"].get("counters", {})
+completion["survived"] = {
+    "reconstructed_partitions": cc.get(
+        "coding.reconstructed.partitions", 0),
+    "resumed_fetches": cc.get("fetch.resumed", 0),
+    "resumed_bytes": cc.get("fetch.resumed.bytes", 0),
+    "speculation_won": cc.get("fetch.speculation.won", 0),
+    "fallback_signals": cc.get("fallback.signals", 0),
+}
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
@@ -153,10 +190,11 @@ with open(out, "w") as f:
                             "telemetry": load(pcounters)},
                "network": network,
                "exchange": exchange,
+               "completion": completion,
                "lockdep": lockdep},
               f, indent=1, sort_keys=True)
     f.write("\n")
-ncyc = len(n_reports) + len(e_reports) + len(l_reports)
+ncyc = len(n_reports) + len(e_reports) + len(c_reports) + len(l_reports)
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc})")
 # the zero-cycles-on-real-code guarantee is ENFORCED, not just
 # printed: a detected inversion that never got the unlucky scheduling
@@ -166,6 +204,7 @@ EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
 if [ "${erc}" -ne 0 ]; then rc="${erc}"; fi
+if [ "${crc}" -ne 0 ]; then rc="${crc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP: cycle reports on real code (see CHAOS_TELEMETRY.json)" >&2

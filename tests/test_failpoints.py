@@ -233,7 +233,43 @@ def test_penalty_box_threshold_expiry_forgive():
     assert not box.penalized("h")       # parole
     assert box.punish("h")              # one more fault re-boxes
     box.forgive("h")
-    assert not box.penalized("h") and not box.punish("h")
+    # forgiveness DECAYS one step (faults 2 -> 1): unboxed, but one
+    # more fault re-boxes immediately — a flapping supplier cannot
+    # oscillate out of the box on a single lucky fetch
+    assert not box.penalized("h") and box.faults("h") == 1
+    assert box.punish("h") and box.penalized("h")
+
+
+def test_penalty_box_decay_and_full_reset_after_streak():
+    box = PenaltyBox(threshold=2, penalty_s=60.0, reset_successes=3)
+    box.punish("h")
+    box.punish("h")
+    box.punish("h")                     # faults=3, boxed
+    assert box.penalized("h")
+    box.forgive("h")                    # decay -> 2: still >= threshold,
+    assert box.faults("h") == 2        # but the active box is kept only
+    box.forgive("h")                    # while over it...
+    assert box.faults("h") == 1 and not box.penalized("h")
+    box.forgive("h")                    # 3rd CONSECUTIVE success: clear
+    assert box.faults("h") == 0 and not box.penalized("h")
+    # a fault mid-streak restarts the streak
+    box.punish("h")
+    box.punish("h")
+    box.forgive("h")
+    box.punish("h")                     # streak broken at 1
+    box.forgive("h")
+    box.forgive("h")
+    assert box.faults("h") == 0        # cleared by streak, not decay
+
+
+def test_penalty_box_rank_orders_by_health():
+    box = PenaltyBox(threshold=2, penalty_s=60.0)
+    box.punish("sick")
+    box.punish("sick")                  # boxed
+    box.punish("meh")                   # one fault, unboxed
+    assert box.rank(["sick", "meh", "ok"]) == ["ok", "meh", "sick"]
+    # stable within a tier: caller preference breaks ties
+    assert box.rank(["b", "a"]) == ["b", "a"]
 
 
 def test_penalty_box_deprioritizes_sick_supplier(tmp_path):

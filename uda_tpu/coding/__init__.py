@@ -1,0 +1,92 @@
+"""k-of-n erasure-coded map outputs (the survivable-shuffle layer).
+
+Coded TeraSort (arXiv:1702.04850) showed that trading cheap redundant
+compute for scarce shuffle bandwidth wins whenever compute is abundant
+— which on TPU hosts it is. This package applies the idea to supplier
+LOSS rather than bandwidth: with ``uda.tpu.coding.scheme=rs:k:n`` each
+map partition's on-disk bytes are a systematic Reed-Solomon stripe —
+k data chunks + (n-k) parity chunks over GF(2^8) (uda_tpu.coding.rs,
+pure numpy) — spread over n suppliers, and the reduce side can rebuild
+the partition from ANY k of them when the primary is dead or penalized
+(uda_tpu.coding.recovery, the post-retry rung of the Segment ladder).
+
+Layout contract (shared with uda_tpu.mofserver):
+
+- the PRIMARY supplier holds the full plain MOF with the parity chunks
+  appended as a parity section (data offsets byte-identical to the
+  uncoded layout) and a v2 index recording the stripe (index.py);
+- stripe chunk ``i`` is addressable as the shard pseudo-map
+  ``<map_id>~s<i>`` — a tiny MOF of its own on peer suppliers, or a
+  synthesized byte range of the primary's file.out (both resolve
+  through the ordinary DirIndexResolver, so the whole data plane —
+  DataEngine, wire, zero-copy serve — serves shards unchanged);
+- placement is positional over the job's canonically-ordered supplier
+  list (sorted unique host strings): chunk i of a map whose primary
+  sits at index p lives on supplier ``(p + i) % num_suppliers``
+  (:func:`stripe_host`). Writer and reducer derive it independently
+  from the same rule — no placement metadata travels.
+
+The decoder slots in BELOW DecompressingClient and the CRC layer:
+reconstruction rebuilds the partition's on-disk bytes, so compression
+and integrity checking downstream stay byte-agnostic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from uda_tpu.mofserver.index import parse_shard_id, shard_map_id
+from uda_tpu.utils.errors import ConfigError
+
+__all__ = ["CodingScheme", "parse_scheme", "stripe_host", "shard_map_id",
+           "parse_shard_id"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CodingScheme:
+    """One parsed ``uda.tpu.coding.scheme`` value (``rs:k:n``)."""
+
+    k: int
+    n: int
+
+    @property
+    def parity(self) -> int:
+        return self.n - self.k
+
+    def __str__(self) -> str:
+        return f"rs:{self.k}:{self.n}"
+
+
+def parse_scheme(spec: str) -> Optional[CodingScheme]:
+    """``"rs:k:n"`` -> CodingScheme; empty/None -> None (coding off)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    parts = spec.split(":")
+    if len(parts) != 3 or parts[0] != "rs":
+        raise ConfigError(f"bad uda.tpu.coding.scheme {spec!r} "
+                          f"(want rs:<k>:<n>)")
+    try:
+        k, n = int(parts[1]), int(parts[2])
+    except ValueError as e:
+        raise ConfigError(f"bad uda.tpu.coding.scheme {spec!r}: {e}") from e
+    if not (1 <= k <= n <= 255):
+        raise ConfigError(f"bad uda.tpu.coding.scheme {spec!r} "
+                          f"(need 1 <= k <= n <= 255)")
+    return CodingScheme(k, n)
+
+
+def stripe_host(suppliers: Sequence[str], primary: str, chunk: int) -> str:
+    """The supplier holding stripe chunk ``chunk`` of a map whose
+    primary is ``primary``: positional rotation over the canonically
+    ordered supplier list. A primary absent from the list (a supplier
+    the reduce side never saw as a map host) anchors at index 0 —
+    placement stays total either way."""
+    if not suppliers:
+        return primary
+    try:
+        p = list(suppliers).index(primary)
+    except ValueError:
+        p = 0
+    return suppliers[(p + chunk) % len(suppliers)]

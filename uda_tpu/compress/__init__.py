@@ -234,6 +234,43 @@ class DecompressingClient(InputClient):
         return self.inner.estimate_partition_bytes(job_id, map_ids,
                                                    reduce_id)
 
+    def resume_ok(self, host: str = "") -> bool:
+        """Never resumable: an inner transport error pops the stream
+        state (clean slate), so a mid-partition continuation would hit
+        the non-sequential guard — the whole-segment restart IS this
+        wrapper's recovery contract."""
+        return False
+
+    def speculate_ok(self) -> bool:
+        """Never duplicate-safe: start_fetch claims the partition's
+        sequential stream token, so a concurrent duplicate for the
+        same (job, map, reduce) would steal it and fail the healthy
+        attempt's completion as stale — fabricating a fault against a
+        supplier that was merely slow."""
+        return False
+
+    def recover_partition(self, req, ctx, on_complete) -> bool:
+        """k-of-n reconstruction BELOW the decompression (the stripe
+        codes the on-disk/compressed bytes — uda_tpu.coding's
+        byte-agnostic contract): delegate to the inner transport and
+        decompress the rebuilt partition on the way up, delivering the
+        same uncompressed domain a fetched stream would."""
+        def _done(res) -> None:
+            if not isinstance(res, Exception):
+                try:
+                    out = decompress_block_stream(bytes(res.data),
+                                                  self.codec)
+                    metrics.add("decompress.bytes", len(out))
+                    res = FetchResult(out, len(out), res.part_length,
+                                      0, res.path, last=True)
+                except Exception as e:  # noqa: BLE001 - a corrupt
+                    # reconstruction must surface as the segment's
+                    # terminal error, not crash the recovery thread
+                    res = e
+            on_complete(res)
+
+        return self.inner.recover_partition(req, ctx, _done)
+
     def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
         key = (req.job_id, req.map_id, req.reduce_id)
         tok = object()

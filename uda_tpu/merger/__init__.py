@@ -3,9 +3,10 @@ arena, streaming segments, merge manager, hybrid LPQ/RPQ merge."""
 
 from uda_tpu.merger.arena import BufferArena, BufferSlot, SlotState
 from uda_tpu.merger.merge_manager import MergeManager, PenaltyBox
+from uda_tpu.merger.recovery import RecoveryLedger
 from uda_tpu.merger.segment import (HostRoutingClient, InputClient,
                                     LocalFetchClient, Segment)
 
 __all__ = ["BufferArena", "BufferSlot", "SlotState", "MergeManager",
-           "PenaltyBox", "InputClient", "LocalFetchClient",
-           "HostRoutingClient", "Segment"]
+           "PenaltyBox", "RecoveryLedger", "InputClient",
+           "LocalFetchClient", "HostRoutingClient", "Segment"]

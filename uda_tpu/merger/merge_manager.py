@@ -32,7 +32,9 @@ import time
 from collections import deque
 from typing import Callable, Optional, Sequence
 
+from uda_tpu.coding import parse_scheme
 from uda_tpu.merger.emitter import FramedEmitter
+from uda_tpu.merger.recovery import RecoveryLedger
 from uda_tpu.merger.segment import InputClient, Segment
 from uda_tpu.ops import merge as merge_ops
 from uda_tpu.utils.budget import MemoryBudget
@@ -44,7 +46,7 @@ from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
-from uda_tpu.utils.retry import RetryPolicy
+from uda_tpu.utils.retry import RetryPolicy, SpeculationPolicy
 from uda_tpu.utils.watchdog import StallError, StallWatchdog
 
 __all__ = ["MergeManager", "PenaltyBox", "PROGRESS_INTERVAL"]
@@ -60,21 +62,31 @@ class PenaltyBox:
     fetch schedule instead of burning the window on a sick host (the
     dynamic counterpart of the reference's randomized fetch list, which
     only spread load statically, MergeManager.cc:58-63). Suppliers leave
-    the box on a successful fetch or when the penalty expires; boxing is
+    the box when the penalty expires or through forgiveness; boxing is
     never exclusion — when every pending supplier is boxed the scheduler
-    proceeds anyway (progress beats politeness)."""
+    proceeds anyway (progress beats politeness).
 
-    def __init__(self, threshold: int = 2, penalty_s: float = 1.0):
+    Forgiveness DECAYS rather than resets: one success takes one fault
+    off the record; only ``reset_successes`` CONSECUTIVE successes (a
+    fault restarts the streak) clear it outright — a flapping supplier
+    that alternates success and fault can no longer oscillate out of
+    the box on every lucky fetch."""
+
+    def __init__(self, threshold: int = 2, penalty_s: float = 1.0,
+                 reset_successes: int = 3):
         self.threshold = max(1, threshold)
         self.penalty_s = penalty_s
+        self.reset_successes = max(1, reset_successes)
         self._lock = TrackedLock("penalty_box")
         self._faults: dict[str, int] = {}
         self._until: dict[str, float] = {}
+        self._streak: dict[str, int] = {}  # consecutive successes
 
     def punish(self, key: str) -> bool:
         """Record one fault; returns True when this fault boxed the
         supplier (crossing the threshold, or extending an active box)."""
         with self._lock:
+            self._streak.pop(key, None)  # a fault breaks the streak
             n = self._faults.get(key, 0) + 1
             self._faults[key] = n
             if n < self.threshold:
@@ -84,10 +96,43 @@ class PenaltyBox:
         return True
 
     def forgive(self, key: str) -> None:
-        """A successful fetch clears the supplier's record entirely."""
+        """One success decays the fault record one step (and unboxes a
+        supplier that dropped below the threshold); the record clears
+        entirely only after ``reset_successes`` consecutive
+        successes."""
         with self._lock:
-            self._faults.pop(key, None)
-            self._until.pop(key, None)
+            n = self._faults.get(key)
+            if n is None:
+                return
+            streak = self._streak.get(key, 0) + 1
+            n = max(0, n - 1)
+            if streak >= self.reset_successes or n == 0:
+                self._faults.pop(key, None)
+                self._until.pop(key, None)
+                self._streak.pop(key, None)
+                return
+            self._streak[key] = streak
+            self._faults[key] = n
+            if n < self.threshold:
+                self._until.pop(key, None)
+
+    def faults(self, key: str) -> int:
+        with self._lock:
+            return self._faults.get(key, 0)
+
+    def rank(self, keys) -> list:
+        """``keys`` healthiest-first: unboxed before boxed, fewer
+        faults before more, stable otherwise (the caller's preference
+        order breaks ties). Read-only — no parole side effects."""
+        with self._lock:
+            now = time.monotonic()
+
+            def score(k):
+                t = self._until.get(k)
+                return (1 if (t is not None and t > now) else 0,
+                        self._faults.get(k, 0))
+
+            return sorted(keys, key=score)
 
     def penalized(self, key: str) -> bool:
         with self._lock:
@@ -130,6 +175,13 @@ class MergeManager:
         self.penalty_box = PenaltyBox(
             threshold=self.cfg.get("uda.tpu.fetch.penalty.threshold"),
             penalty_s=self.cfg.get("uda.tpu.fetch.penalty.ms") / 1e3)
+        # the survivable-shuffle layer (ISSUE 8): speculation, resume
+        # and k-of-n reconstruction all share ONE recovery ledger
+        self.ledger = RecoveryLedger(self.penalty_box)
+        self.speculation = SpeculationPolicy.from_config(self.cfg)
+        self.resume_fetch = bool(self.cfg.get("uda.tpu.fetch.resume"))
+        self.coding_scheme = parse_scheme(
+            self.cfg.get("uda.tpu.coding.scheme"))
         spec = self.cfg.get("uda.tpu.failpoints")
         if spec:
             failpoints.arm_spec(spec)
@@ -175,13 +227,44 @@ class MergeManager:
         supplier to the penalty box; maps of a boxed supplier rotate to
         the back of the pending schedule (see :class:`PenaltyBox`).
         """
-        # entries are "map_id" or ("host", "map_id") — the latter routes
-        # through a per-host transport (HostRoutingClient)
-        entries = [m if isinstance(m, tuple) else ("", m) for m in map_ids]
+        # entries are "map_id", ("host", "map_id"), or
+        # (["host", ...], "map_id") — hosts route through a per-host
+        # transport (HostRoutingClient); a host LIST means replicas
+        # (every listed supplier holds the map output) and must lead
+        # with the map WRITER's host (the stripe placement anchor):
+        # fetching opens against the best PenaltyBox-ranked replica and
+        # speculation duplicates to the alternates
+        def _norm(m):
+            if isinstance(m, tuple):
+                host, mid = m
+                hosts = (list(host) if isinstance(host, (list, tuple))
+                         else [host])
+            else:
+                hosts, mid = [""], m
+            return hosts or [""], mid
+
+        entries = [_norm(m) for m in map_ids]
+        stripe_ctx = None
+        if self.coding_scheme is not None:
+            from uda_tpu.coding.recovery import StripeContext
+
+            # the placement domain: the job's canonically-ordered
+            # supplier universe (sorted unique hosts — writers derive
+            # the identical order; see uda_tpu.coding). Host-less local
+            # entries ("") are NOT suppliers: mixed in with real hosts
+            # they would shift the ring against the writer's
+            # supplier_roots; the all-local degenerate keeps [""]
+            universe = sorted({h for hosts, _ in entries
+                               for h in hosts if h}) or [""]
+            stripe_ctx = StripeContext(self.coding_scheme, universe,
+                                       ledger=self.ledger)
         segs = [Segment(self.client, job_id, mid, reduce_id,
-                        self.chunk_size, host=host,
-                        policy=self.retry_policy)
-                for host, mid in entries]
+                        self.chunk_size, host=hosts[0],
+                        policy=self.retry_policy, hosts=hosts,
+                        ledger=self.ledger,
+                        speculation=self.speculation,
+                        resume=self.resume_fetch, stripe=stripe_ctx)
+                for hosts, mid in entries]
         index_of = {id(s): i for i, s in enumerate(segs)}
         order = list(range(len(segs)))
         random.Random(self.seed).shuffle(order)  # MergeManager.cc:58-63
@@ -197,8 +280,15 @@ class MergeManager:
             return seg.supplier
 
         def on_fault(seg, exc) -> None:
-            if box.punish(supplier_of(seg)):
-                log.warn(f"supplier {supplier_of(seg)!r} penalized "
+            # the STRUCTURED cause wins over the segment's current
+            # source: a speculation loser's fault must punish the host
+            # whose attempt failed, not whichever source the segment
+            # switched to (UDA005: attribute, never reason-string)
+            sup = getattr(exc, "supplier", None) or supplier_of(seg)
+            self.ledger.record("fault", supplier=sup, map_id=seg.map_id,
+                               error=exc)
+            if box.punish(sup):
+                log.warn(f"supplier {sup!r} penalized "
                          f"after repeated fetch faults ({exc})")
 
         def on_done(seg) -> None:
@@ -401,8 +491,11 @@ class MergeManager:
         om = self._active_overlap
         om_sig = ((om.stats["staged_runs"], om.stats["device_merges"],
                    om.stats["pending"]) if om is not None else ())
+        # the ledger version makes RECOVERY progress visible: a
+        # reconstruction fetching stripe shards advances nothing on the
+        # segment itself, but it is progress, not a stall
         return (len(segs), ndone, nrec, noff, nret, om_sig,
-                getattr(self, "_emit_progress", 0))
+                self.ledger.version, getattr(self, "_emit_progress", 0))
 
     def _start_watchdog(self, reduce_id: int) -> Optional[StallWatchdog]:
         stall_s = float(self.cfg.get("uda.tpu.watchdog.stall.s"))

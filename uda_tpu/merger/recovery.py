@@ -1,0 +1,78 @@
+"""The recovery ledger: one shared record of everything the
+survivable-shuffle layer did for a reduce task.
+
+The three fault-tolerance rungs above plain retry — speculative
+dual-source fetch, k-of-n stripe reconstruction, and warm-restart
+resume (ISSUE 8) — all need the same two things: a structured,
+string-parse-free record of WHO failed and WHAT recovered (the penalty
+box and the watchdog diagnostics key on it), and a shared source
+ranking so every rung prefers the same healthy suppliers. The ledger
+is that shared state: a bounded event log plus a rank() view over the
+task's :class:`~uda_tpu.merger.merge_manager.PenaltyBox`.
+
+Events are structured dicts (kind, supplier, map_id, error class) —
+never reason strings (udalint UDA005). The monotone ``version``
+feeds the stall watchdog's progress token: a reconstruction fetching
+shards IS progress even while the segment's own counters stand still.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional, Sequence
+
+from uda_tpu.utils.locks import TrackedLock
+
+__all__ = ["RecoveryLedger"]
+
+_MAX_EVENTS = 256
+
+
+class RecoveryLedger:
+    """Bounded per-task recovery journal + supplier health ranking."""
+
+    def __init__(self, box=None):
+        self._box = box  # PenaltyBox (rank source); optional for tests
+        self._lock = TrackedLock("recovery.ledger")
+        self._events: deque = deque(maxlen=_MAX_EVENTS)
+        self.version = 0  # monotone event counter (watchdog progress)
+
+    def record(self, kind: str, supplier: str = "", map_id: str = "",
+               error: Optional[BaseException] = None) -> None:
+        """Append one structured event. ``error`` is recorded by CLASS
+        NAME only — the ledger is for keying and diagnostics, not for
+        re-raising."""
+        event = {"kind": kind, "supplier": supplier, "map_id": map_id,
+                 "error": type(error).__name__ if error is not None
+                 else None}
+        with self._lock:
+            self._events.append(event)
+            self.version += 1
+
+    def rank(self, hosts: Sequence[str]) -> list:
+        """``hosts`` ordered healthiest-first by PenaltyBox state
+        (unboxed before boxed, fewer faults before more; stable within
+        a tier, so the caller's preference order breaks ties). The
+        shared source-choice primitive: the scheduler's primary pick,
+        speculation's alternate pick and reconstruction's shard
+        fan-out all rank through here."""
+        box = self._box
+        if box is None:
+            return list(hosts)
+        return box.rank(hosts)
+
+    def events(self, kind: Optional[str] = None) -> list:
+        with self._lock:
+            evs = list(self._events)
+        return evs if kind is None else [e for e in evs
+                                         if e["kind"] == kind]
+
+    def snapshot(self) -> dict:
+        """Diagnostics view (watchdog dumps, tests)."""
+        with self._lock:
+            evs = list(self._events)
+            version = self.version
+        counts: dict = {}
+        for e in evs:
+            counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+        return {"version": version, "counts": counts, "events": evs}

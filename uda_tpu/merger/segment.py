@@ -25,13 +25,14 @@ import zlib
 from typing import Optional
 
 from uda_tpu.mofserver.data_engine import DataEngine, FetchResult, ShuffleRequest
-from uda_tpu.utils.errors import MergeError, StorageError, TransportError
+from uda_tpu.utils.errors import (MergeError, StorageError, TransportError,
+                                  attribute_supplier)
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.ifile import RecordBatch, crack_partial
 from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
-from uda_tpu.utils.retry import RetryPolicy
+from uda_tpu.utils.retry import RetryPolicy, SpeculationPolicy
 
 log = get_logger()
 
@@ -53,6 +54,45 @@ class InputClient(abc.ABC):
         fetching (the auto merge-approach policy then defaults to the
         bounded-memory path — see MergeManager.run)."""
         return None
+
+    def resume_ok(self, host: str = "") -> bool:
+        """May a retrying Segment keep its offset ledger and resume
+        mid-partition instead of refetching from zero
+        (``uda.tpu.fetch.resume``)? True by default — MOFs are
+        immutable files, so a byte range re-read after a transport
+        blip is the same bytes. Transports with per-stream state
+        (DecompressingClient) or evidence of a cold supplier restart
+        (RemoteFetchClient's generation tracking) answer False; the
+        Segment then restarts the whole fetch."""
+        return True
+
+    def speculate_ok(self) -> bool:
+        """May the straggler detector issue a DUPLICATE in-flight fetch
+        for the same (job, map, reduce) through this transport? True by
+        default — stateless transports serve concurrent duplicates
+        independently. Transports with per-stream state keyed on the
+        partition (DecompressingClient's sequential stream claim)
+        answer False: a duplicate would steal the stream token and turn
+        the healthy primary's completion into a fabricated fault."""
+        return True
+
+    def recover_partition(self, req: ShuffleRequest, ctx,
+                          on_complete) -> bool:
+        """k-of-n stripe reconstruction (uda_tpu.coding): rebuild
+        ``req``'s whole partition from any k of its n stripe chunks,
+        delivering a full-partition FetchResult (or an Exception) to
+        ``on_complete``. Returns False when unsupported (no stripe
+        context) — the Segment then fails terminally as before. The
+        default implementation drives the generic recovery over THIS
+        transport's ``start_fetch`` (shard pseudo-maps route per host
+        like any other fetch); wrappers that transform the byte domain
+        (DecompressingClient) override to re-wrap the result."""
+        if ctx is None:
+            return False
+        from uda_tpu.coding.recovery import start_recovery
+
+        start_recovery(self, req, ctx, on_complete)
+        return True
 
     def stop(self) -> None:
         pass
@@ -195,6 +235,14 @@ class HostRoutingClient(InputClient):
             return
         client.start_fetch(req, on_complete)
 
+    def resume_ok(self, host: str = "") -> bool:
+        """Delegate to the host's transport (a RemoteFetchClient may
+        have observed a cold supplier restart); an unconnected host is
+        resumable by default — the reconnect itself revalidates."""
+        with self._lock:
+            client = self._clients.get(host)
+        return True if client is None else client.resume_ok(host)
+
     def estimate_partition_bytes(self, job_id: str, map_ids,
                                  reduce_id: int):
         """Per-host fan-out of the size estimate: entries group by
@@ -204,10 +252,14 @@ class HostRoutingClient(InputClient):
         ANY host that cannot answer (unknown size, failed connect)
         makes the whole estimate None — a partial sum is a lower bound
         and would steer the auto merge-approach policy wrong (see
-        LocalFetchClient.estimate_partition_bytes)."""
+        LocalFetchClient.estimate_partition_bytes). Replicated entries
+        (a host LIST per map) are estimated against their first
+        (primary) host."""
         by_host: dict[str, list[str]] = {}
         for entry in map_ids:
             host, mid = entry if isinstance(entry, tuple) else ("", entry)
+            if isinstance(host, (list, tuple)):
+                host = host[0] if host else ""
             by_host.setdefault(host, []).append(mid)
 
         def probe(host: str, mids: list[str]):
@@ -257,17 +309,54 @@ class Segment:
     switch_mem loop, StreamRW.cc:462-590). Completed chunks are cracked
     into RecordBatches immediately so bytes can be packed/shipped to
     device while later chunks are still in flight.
+
+    Survivable-shuffle ladder (ISSUE 8; every rung shares the task's
+    :class:`~uda_tpu.merger.recovery.RecoveryLedger`):
+
+    - **speculation** (``uda.tpu.fetch.speculate.pn``): an in-flight
+      chunk that outlives max(floor, pN of the observed
+      ``fetch.latency_ms`` histogram) gets a DUPLICATE fetch issued to
+      the best-ranked alternate source (PenaltyBox rank over
+      ``hosts``; the same source when no alternate exists).
+      First-completion-wins rides the attempt-epoch machinery — the
+      loser's completion is discarded as stale — and a speculation win
+      switches the segment to the faster source for its remaining
+      chunks;
+    - **resume** (``uda.tpu.fetch.resume``): a transport-level retry
+      against a resumable source (InputClient.resume_ok — warm
+      supplier restart, immutable MOFs) keeps the offset ledger
+      (batches + carry + next offset) and continues mid-partition
+      instead of refetching from zero; the first resumed chunk's
+      ``raw_length`` must match the pre-fault identity or the segment
+      falls back to a full restart;
+    - **reconstruction** (``uda.tpu.coding.scheme``): once retries are
+      exhausted, the partition is rebuilt from any k of its n erasure
+      stripe chunks on the surviving suppliers
+      (InputClient.recover_partition) — the rung that turns a dead
+      supplier from a FallbackSignal into a completed task.
     """
 
     def __init__(self, client: InputClient, job_id: str, map_id: str,
                  reduce_id: int, chunk_size: int, host: str = "",
-                 retries: int = 3, policy: Optional[RetryPolicy] = None):
+                 retries: int = 3, policy: Optional[RetryPolicy] = None,
+                 *, hosts=None, ledger=None,
+                 speculation: Optional[SpeculationPolicy] = None,
+                 resume: bool = False, stripe=None):
         self.client = client
         self.job_id = job_id
         self.map_id = map_id
         self.reduce_id = reduce_id
         self.chunk_size = chunk_size
-        self.host = host
+        # candidate sources: ``hosts`` are suppliers known to hold this
+        # map output (replicas); the primary is (re)picked by ledger
+        # rank, speculation duplicates to the best alternate
+        self.hosts: list[str] = [h for h in (hosts or ([host] if host
+                                                       else [""]))]
+        self.host = host or self.hosts[0]
+        self.ledger = ledger
+        self.speculation = speculation
+        self.resume_enabled = bool(resume)
+        self.stripe = stripe  # StripeContext when k-of-n coding is on
         self.batches: list[RecordBatch] = []
         self.num_records = 0  # monotone fetch-side record count
         self.raw_length: Optional[int] = None
@@ -275,11 +364,6 @@ class Segment:
         self.on_fault = None  # callback fired on EVERY transport fault
         # (retried or terminal) — the penalty-box feedback channel
         self.policy = policy or RetryPolicy(retries=max(0, retries))
-        # observability: the supplier label for this segment's metric
-        # series (host when routed per host, else the map id), and the
-        # trace span opened by start() as a child of the caller's
-        # current span (the reduce task's fetch phase)
-        self.supplier = host or map_id
         self.trace_span = None
         self._issue_t0 = 0.0
         self._released = False
@@ -292,15 +376,29 @@ class Segment:
                                   ^ zlib.crc32(map_id.encode()))
         self._issuing = False
         self._inline = self._PENDING
-        self._epoch = 0          # attempt id of the outstanding fetch
-        self._epoch_settled = True  # its completion has been accepted
+        self._next_epoch = 0     # attempt-id allocator (monotone)
+        self._epoch = 0          # id of the outstanding PRIMARY attempt
+        self._spec: Optional[tuple] = None  # (epoch, host) of the live
+        # speculative duplicate, if any — the `speculative` epoch flag
+        self._epoch_settled = True  # the attempt group has completed
+        self._open_attempts = 0  # live attempts (on-air accounting)
+        self._attempt_hosts: dict[int, str] = {}
+        self._resume_check = False   # next chunk must revalidate identity
+        self._recover_tried = False  # the reconstruction rung is one-shot
         self._timeout_timer: Optional[threading.Timer] = None
+        self._spec_timer: Optional[threading.Timer] = None
         self._done = threading.Event()
         self._error: Optional[Exception] = None
         # lockdep-tracked: the segment state machine is driven from
         # transport completion threads, retry timers AND the merge
         # thread — the widest thread fan-in in the tree
         self._lock = TrackedLock("segment.state")
+
+    @property
+    def supplier(self) -> str:
+        """The metric/penalty label of the CURRENT source (host when
+        routed per host, else the map id); tracks speculation wins."""
+        return self.host or self.map_id
 
     def _notify_done(self) -> None:
         span = self.trace_span
@@ -330,6 +428,10 @@ class Segment:
     def start(self) -> None:
         if self.policy.deadline_ms > 0:
             self._deadline = time.monotonic() + self.policy.deadline_ms / 1e3
+        # consult box rank BEFORE the primary pick, not only on fault:
+        # a replicated segment opens against the healthiest source
+        if len(self.hosts) > 1 and self.ledger is not None:
+            self.host = self.ledger.rank(self.hosts)[0]
         # child of the caller's current span (the fetch phase of the
         # reduce-task trace); ended by _notify_done on ANY terminal path
         self.trace_span = metrics.start_span(
@@ -351,8 +453,6 @@ class Segment:
         FIRST one for the current epoch is accepted — a late completion
         racing its own attempt timeout is dropped as stale instead of
         double-driving the state machine."""
-        req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
-                             offset, self.chunk_size, host=self.host)
         with self._lock:
             if self._done.is_set():
                 # administratively failed (fail()) while a retry backoff
@@ -362,18 +462,27 @@ class Segment:
                 return None
             self._inline = self._PENDING
             self._issuing = True
-            self._epoch += 1
+            self._next_epoch += 1
+            self._epoch = self._next_epoch
             self._epoch_settled = False
+            self._open_attempts += 1
             self._issue_t0 = time.perf_counter()
             epoch = self._epoch
+            host = self.host
+            self._attempt_hosts[epoch] = host
+        req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
+                             offset, self.chunk_size, host=host)
         # on-air accounting (reference AIOHandler on-air counters):
         # +1 per attempt epoch, -1 when that epoch settles (accepted
-        # completion, timeout-generated completion, or sync raise)
+        # completion, timeout-generated completion, sync raise, or
+        # abandonment of a speculation loser)
         metrics.gauge_add("fetch.on_air", 1)
         try:
             # the failpoint is inside the try: an injected raise takes
-            # the same sync-failure path as a stopped transport
-            failpoint("segment.fetch", key=self.map_id)
+            # the same sync-failure path as a stopped transport. The
+            # key carries map AND source so chaos schedules can target
+            # one supplier of a replicated segment (match:@host)
+            failpoint("segment.fetch", key=f"{self.map_id}@{host}")
             # the segment's span is the transport's parent for this
             # issue: spans a transport opens (e.g. net.fetch) join the
             # fetch span tree even when the issue happens on a
@@ -386,6 +495,8 @@ class Segment:
             with self._lock:
                 self._issuing = False
                 self._epoch_settled = True
+                self._open_attempts -= 1
+                self._attempt_hosts.pop(epoch, None)
             metrics.gauge_add("fetch.on_air", -1)
             return e
         with self._lock:
@@ -394,6 +505,7 @@ class Segment:
             self._inline = self._PENDING
             if r is self._PENDING and not self._epoch_settled:
                 self._arm_timeout(epoch)  # only for an async in-flight fetch
+                self._arm_speculation(epoch, offset)
         return None if r is self._PENDING else r
 
     def _arm_timeout(self, epoch: int) -> None:
@@ -409,28 +521,179 @@ class Segment:
     def _cancel_timeout(self) -> None:
         with self._lock:
             t, self._timeout_timer = self._timeout_timer, None
+            s, self._spec_timer = self._spec_timer, None
         if t is not None:
             t.cancel()
+        if s is not None:
+            s.cancel()
 
     def _on_timeout(self, epoch: int) -> None:
         with self._lock:
-            if epoch != self._epoch or self._epoch_settled:
+            spec_epoch = self._spec[0] if self._spec else None
+            if epoch not in (self._epoch, spec_epoch) \
+                    or self._epoch_settled:
                 return  # the attempt completed first
         metrics.add("fetch.timeouts", supplier=self.supplier)
         self._on_complete(TransportError(
             f"fetch of {self.map_id} attempt timed out after "
             f"{self.policy.attempt_timeout_ms:g} ms"), epoch)
 
+    # -- speculation (the straggler detector) -------------------------------
+
+    def _arm_speculation(self, epoch: int, offset: int) -> None:
+        """Arm the straggler timer for one in-flight attempt (caller
+        holds self._lock): fires at max(floor, pN of the observed
+        fetch.latency_ms histogram)."""
+        sp = self.speculation
+        if sp is None or not sp.enabled or self._spec is not None \
+                or not self.client.speculate_ok():
+            return
+        t = threading.Timer(sp.threshold_ms() / 1e3,
+                            self._maybe_speculate, args=(epoch, offset))
+        t.daemon = True
+        self._spec_timer = t
+        t.start()
+
+    def _pick_alt(self) -> str:
+        """The speculation target: best PenaltyBox-ranked candidate
+        that is not the current source; the current source itself when
+        the segment has no alternates (a duplicate fetch still races a
+        per-request stall)."""
+        ranked = (self.ledger.rank(self.hosts) if self.ledger is not None
+                  else list(self.hosts))
+        for h in ranked:
+            if h != self.host:
+                return h
+        return self.host
+
+    def _maybe_speculate(self, epoch: int, offset: int) -> None:
+        """Straggler-timer body: issue the speculative duplicate. Runs
+        on the timer thread; a speculative attempt that fails (sync or
+        async) is simply dropped — it must never fail the segment while
+        the primary race is still open."""
+        with self._lock:
+            if self._done.is_set() or self._epoch_settled \
+                    or epoch != self._epoch or self._spec is not None:
+                return
+            alt = self._pick_alt()
+            self._next_epoch += 1
+            spec_epoch = self._next_epoch
+            self._spec = (spec_epoch, alt)
+            self._attempt_hosts[spec_epoch] = alt
+            self._open_attempts += 1
+        metrics.add("fetch.speculated", supplier=alt or self.map_id)
+        metrics.gauge_add("fetch.on_air", 1)
+        log.warn(f"fetch of {self.map_id} chunk at {offset} is a "
+                 f"straggler; speculating against "
+                 f"{alt or 'the same source'}")
+        req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
+                             offset, self.chunk_size, host=alt)
+        try:
+            failpoint("segment.fetch", key=f"{self.map_id}@{alt}#spec")
+            with metrics.use_span(self.trace_span):
+                self.client.start_fetch(
+                    req, lambda res, e=spec_epoch: self._on_complete(res, e))
+        except Exception as e:  # noqa: BLE001 - a failed spec issue is
+            # a dropped duplicate, not a segment failure
+            self._drop_attempt(spec_epoch, e)
+
+    def _drop_attempt(self, epoch: int, exc: Optional[Exception]) -> None:
+        """Close ONE of two live attempts (a speculation loser that
+        errored): the race continues on the surviving attempt.
+
+        Racing failures: when BOTH attempts fail concurrently, the
+        first drop leaves one live attempt (possibly by promotion) and
+        the second drop finds ``_spec`` already None — that second
+        failure now belongs to the SOLE live attempt, so it settles
+        the group and drives the ordinary retry ladder instead of
+        being discarded (discarding it would strand the segment with
+        zero attempts in flight and nothing left to wake it)."""
+        promoted = False
+        sole_failure = False
+        with self._lock:
+            if self._epoch_settled:
+                return
+            spec = self._spec
+            host = self._attempt_hosts.pop(epoch, self.host)
+            if spec is not None and epoch == spec[0]:
+                self._spec = None
+            elif spec is not None and epoch == self._epoch:
+                # the PRIMARY died while a speculative duplicate is in
+                # flight: promote the duplicate — it is now the fetch
+                self._epoch = spec[0]
+                self._spec = None
+                self.host = spec[1]
+                promoted = True
+                old_t, self._timeout_timer = self._timeout_timer, None
+            elif spec is None and epoch == self._epoch:
+                # the other attempt was dropped/promoted first: this
+                # failure is the last live attempt's — settle and retry
+                sole_failure = True
+                self._epoch_settled = True
+                settled_n = self._open_attempts
+                self._open_attempts = 0
+            else:
+                return  # neither live attempt: stale
+            if not sole_failure:
+                self._open_attempts -= 1
+            if promoted:
+                self._arm_timeout(self._epoch)
+        if sole_failure:
+            metrics.gauge_add("fetch.on_air", -settled_n)
+            self._cancel_timeout()
+            if exc is None:
+                exc = TransportError(
+                    f"fetch of {self.map_id}: both racing attempts "
+                    f"failed")
+            attribute_supplier(exc, host or self.map_id)
+            self._drive(exc)
+            return
+        metrics.gauge_add("fetch.on_air", -1)
+        if promoted and old_t is not None:
+            old_t.cancel()
+        if exc is not None:
+            attribute_supplier(exc, host or self.map_id)
+            self._notify_fault(exc)
+
     def _on_complete(self, result, epoch: int) -> None:
         with self._lock:
-            if epoch != self._epoch or self._epoch_settled:
+            spec = self._spec
+            spec_epoch = spec[0] if spec else None
+            if self._epoch_settled or \
+                    epoch not in (self._epoch, spec_epoch):
                 metrics.add("fetch.stale_completions")
                 return  # superseded attempt (timed out or re-issued)
-            self._epoch_settled = True
-            inline = self._issuing
-            if inline:  # inline completion: hand back to _drive
-                self._inline = result
-        metrics.gauge_add("fetch.on_air", -1)
+            two_live = spec_epoch is not None
+            drop_loser = isinstance(result, Exception) and two_live
+            if not drop_loser:
+                # accepted: this completion settles the attempt GROUP;
+                # the loser of a speculation race is abandoned now (its
+                # own completion, if it ever lands, is stale)
+                self._epoch_settled = True
+                won_spec = two_live and epoch == spec_epoch
+                if won_spec:
+                    self.host = spec[1]  # sticky: the faster source
+                    # serves this segment's remaining chunks too
+                self._spec = None
+                self._attempt_hosts.clear()
+                settled = self._open_attempts
+                self._open_attempts = 0
+                inline = self._issuing
+                if inline:  # inline completion: hand back to _drive
+                    self._inline = result
+        if drop_loser:
+            # one of TWO racing attempts failed: close it and keep
+            # racing on the survivor (a failed primary promotes the
+            # speculative duplicate)
+            self._drop_attempt(epoch, result)
+            return
+        metrics.gauge_add("fetch.on_air", -settled)
+        if two_live:
+            if won_spec:
+                metrics.add("fetch.speculation.won",
+                            supplier=self.supplier)
+            else:
+                metrics.add("fetch.speculation.lost")
         if inline:
             return
         self._cancel_timeout()
@@ -454,23 +717,38 @@ class Segment:
             if isinstance(result, Exception):
                 # transport-level retry (the reference retries its
                 # connect dance 5x and RNR-retries sends,
-                # RDMAClient.cc:41, 235-344; RDMAComm.h:29): restart the
-                # WHOLE segment from offset 0 — re-fetch-the-MOF
-                # granularity, which also resets any decompressing
-                # wrapper's stream state cleanly
+                # RDMAClient.cc:41, 235-344; RDMAComm.h:29). Default:
+                # restart the WHOLE segment from offset 0 —
+                # re-fetch-the-MOF granularity, which also resets any
+                # decompressing wrapper's stream state cleanly. With
+                # uda.tpu.fetch.resume on and a resumable source
+                # (warm-restarted supplier, immutable MOF), keep the
+                # offset ledger and continue mid-partition instead —
+                # already-served bytes are never refetched.
                 deadline_hit = False
+                # transport capability probed OUTSIDE self._lock (the
+                # client has locks of its own; no order edge wanted)
+                resumable = (self.resume_enabled
+                             and isinstance(result, TransportError)
+                             and self.client.resume_ok(self.host))
                 with self._lock:
                     retry = self._retries_left > 0
                     if retry and self._deadline is not None \
                             and time.monotonic() >= self._deadline:
                         retry, deadline_hit = False, True
-                    if retry:
+                    resume = retry and resumable and self._next_offset > 0
+                    if retry and not resume:
                         self._retries_left -= 1
                         self.batches = []
                         self.num_records = 0
                         self._carry = b""
                         self._next_offset = 0
                         self._crc_refetched.clear()
+                        self._resume_check = False
+                    elif resume:
+                        self._retries_left -= 1
+                        self._resume_check = True  # revalidate identity
+                    offset = self._next_offset if resume else 0
                     attempt = self.policy.retries - self._retries_left
                 self._notify_fault(result)
                 if not retry:
@@ -478,10 +756,20 @@ class Segment:
                         metrics.add("fetch.deadline_exceeded")
                         log.warn(f"fetch of {self.map_id} gave up: "
                                  f"deadline passed with retries left")
+                    if self._try_recover(result):
+                        return  # the reconstruction rung owns the
+                        # segment now (completes it via _on_recovered)
                     self._finish(result)
                     return
-                log.warn(f"fetch of {self.map_id} failed ({result}); "
-                         f"retrying ({self._retries_left} left)")
+                if resume:
+                    metrics.add("fetch.resumed", supplier=self.supplier)
+                    metrics.add("fetch.resumed.bytes", offset)
+                    log.warn(f"fetch of {self.map_id} failed ({result}); "
+                             f"resuming at offset {offset} "
+                             f"({self._retries_left} retries left)")
+                else:
+                    log.warn(f"fetch of {self.map_id} failed ({result}); "
+                             f"retrying ({self._retries_left} left)")
                 metrics.add("fetch.retries", supplier=self.supplier)
                 delay = self.policy.backoff(attempt, self._rng)
                 if self._deadline is not None:
@@ -492,12 +780,31 @@ class Segment:
                     # (it may be a transport worker the retry needs)
                     metrics.add("fetch.backoff_seconds", delay)
                     t = threading.Timer(
-                        delay, lambda: self._drive(self._try_issue(0)))
+                        delay,
+                        lambda o=offset: self._drive(self._try_issue(o)))
                     t.daemon = True
                     t.start()
                     return
-                result = self._try_issue(0)
+                result = self._try_issue(offset)
                 continue
+            if self._resume_check:
+                # first chunk after a resumed retry: the partition's
+                # identity must match what the ledger was built from —
+                # a supplier restarted onto a DIFFERENT map attempt
+                # must not splice two attempts' bytes together. The
+                # StorageError (not a TransportError) forces the next
+                # retry to restart from zero.
+                with self._lock:
+                    prev = self.raw_length
+                    self._resume_check = False
+                if prev is not None and result.raw_length != prev:
+                    metrics.add("fetch.resume.invalidated")
+                    result = StorageError(
+                        f"partition {self.map_id} changed identity "
+                        f"across the supplier restart (raw_length "
+                        f"{result.raw_length} != {prev}); restarting "
+                        f"the fetch from zero")
+                    continue
             crc = getattr(result, "crc", None)
             if crc is not None and \
                     zlib.crc32(result.data) & 0xFFFFFFFF != crc:
@@ -562,28 +869,99 @@ class Segment:
         metrics.observe("fetch.chunk.bytes", len(res.data))
         return last
 
+    def _try_recover(self, cause: Exception) -> bool:
+        """The post-retry reconstruction rung: rebuild the partition
+        from any k of its n stripe chunks (uda_tpu.coding). One-shot;
+        returns False when coding is off or the transport cannot
+        recover — the caller then finishes the segment with ``cause``
+        exactly as before."""
+        if self.stripe is None or self._recover_tried:
+            return False
+        self._recover_tried = True
+        with self._lock:
+            # the recovery replaces the whole partition: drop whatever
+            # partial state the failed attempts left behind
+            self.batches = []
+            self.num_records = 0
+            self._carry = b""
+            self._next_offset = 0
+            self._resume_check = False
+            self._issue_t0 = time.perf_counter()
+        # anchor placement at the WRITER's primary (hosts[0] — the map
+        # entry's first host), never the current source: rank-picks and
+        # speculation wins move self.host, but the stripe was placed by
+        # rotation from where the map was written
+        req = ShuffleRequest(self.job_id, self.map_id, self.reduce_id,
+                             0, self.chunk_size, host=self.hosts[0])
+        metrics.add("coding.recover.attempts", supplier=self.supplier)
+        log.warn(f"fetch of {self.map_id} exhausted retries ({cause}); "
+                 f"attempting k-of-n stripe reconstruction")
+        try:
+            with metrics.use_span(self.trace_span):
+                return bool(self.client.recover_partition(
+                    req, self.stripe, self._on_recovered))
+        except Exception as e:  # noqa: BLE001 - a recovery that cannot
+            # even start must fall through to the terminal path, not
+            # escape into the completion thread
+            metrics.add("coding.recover.failures")
+            log.warn(f"stripe reconstruction of {self.map_id} could "
+                     f"not start: {e}")
+            return False
+
+    def _on_recovered(self, result) -> None:
+        """Reconstruction completion: a full-partition FetchResult (the
+        decoded on-disk bytes, decompressed by any wrapper on the way
+        up) or the reconstruction's terminal error."""
+        if isinstance(result, Exception):
+            metrics.add("coding.recover.failures")
+            self._finish(result)
+            return
+        try:
+            last = self._ingest(result)
+        except Exception as e:  # noqa: BLE001 - crack errors surface to
+            # the waiter like any fetched chunk's would
+            self._finish(e)
+            return
+        self._finish(None if last else MergeError(
+            f"stripe reconstruction of {self.map_id} delivered a "
+            f"non-final chunk"))
+
     def fail(self, exc: Exception) -> bool:
         """Administratively terminate the fetch (watchdog rescue / stop-
         path drain): the segment completes NOW with ``exc`` and every
-        waiter wakes. The outstanding attempt's epoch is invalidated, so
-        a transport completion that eventually arrives (e.g. a wedged
+        waiter wakes. The outstanding attempts' epochs are invalidated,
+        so a transport completion that eventually arrives (e.g. a wedged
         worker finishing minutes later) is dropped as stale instead of
         double-driving the state machine. Returns False when the segment
         had already finished (success or error) — fail() never rewrites
         history. Safe from any thread; fires on_done (credit release)
-        exactly once like every other terminal path."""
+        exactly once like every other terminal path.
+
+        The failing supplier rides the STRUCTURED cause: ``exc`` gains
+        a ``supplier`` attribute (first unset wins — a shared stop-path
+        error keeps its first attribution) and the recovery ledger gets
+        an exact per-segment record, so downstream consumers never
+        parse reason strings (UDA005)."""
         with self._lock:
             if self._done.is_set():
                 return False
-            had_open_epoch = not self._epoch_settled
-            self._epoch += 1          # outstanding completion -> stale
+            open_attempts = self._open_attempts
+            self._open_attempts = 0
+            self._next_epoch += 1     # outstanding completions -> stale
+            self._epoch = self._next_epoch
+            self._spec = None
+            self._attempt_hosts.clear()
             self._epoch_settled = True
-        if had_open_epoch:
-            # settle the abandoned attempt's on-air accounting (its own
-            # completion, if it ever lands, sees a stale epoch and must
-            # not decrement a second time)
-            metrics.gauge_add("fetch.on_air", -1)
+        if open_attempts:
+            # settle the abandoned attempts' on-air accounting (their
+            # own completions, if they ever land, see a stale epoch and
+            # must not decrement a second time)
+            metrics.gauge_add("fetch.on_air", -open_attempts)
         self._cancel_timeout()
+        attribute_supplier(exc, self.supplier)
+        if self.ledger is not None:
+            self.ledger.record("admin_fail", supplier=self.supplier,
+                               map_id=self.map_id, error=exc)
         if not self._finish(exc):
             return False  # a real terminal path won the race
         metrics.add("fetch.failed_admin")

@@ -7,34 +7,46 @@ framework also has to *produce* them (its map phase, tests, and the
 regression workloads), so the writer lives in the supplier package: one
 IFile segment per reduce partition, concatenated, with the (start,
 raw_length, part_length) index triples alongside.
+
+Erasure coding (``uda.tpu.coding.scheme=rs:k:n``, uda_tpu.coding): the
+writer grows two outputs, both derived from the same per-partition
+blobs (post-codec, so coding is byte-agnostic about compression):
+
+- the primary MOF gains a *parity section* — each partition's n-k
+  parity chunks appended AFTER all data segments, so the data region
+  stays byte-identical to the uncoded layout — recorded by the v2
+  index (:func:`uda_tpu.mofserver.index.write_index_file`);
+- :func:`write_striped_map_output` additionally fans the stripe out:
+  chunk i of every partition goes to supplier ``(p + i) % H`` (the
+  placement rule in uda_tpu.coding) as a tiny shard MOF
+  ``<map_id>~s<i>`` on that supplier's root. Chunks that land back on
+  the primary are NOT duplicated — the resolver synthesizes them from
+  the primary's file.out byte ranges.
+
+Shard index triples carry ``raw_length = the full partition's
+part_length`` (the decode-trim total) and ``part_length = the stored
+chunk bytes`` — see the index module docstring.
 """
 
 from __future__ import annotations
 
 import io
 import os
-from typing import Iterable, Sequence, Tuple
+from typing import Iterable, Optional, Sequence, Tuple
 
-from uda_tpu.mofserver.index import write_index_file
+from uda_tpu.mofserver.index import shard_map_id, write_index_file
 from uda_tpu.utils.ifile import IFileWriter
 
-__all__ = ["MOFWriter", "write_map_output"]
+__all__ = ["MOFWriter", "write_map_output", "write_striped_map_output",
+           "partition_blobs"]
 
 
-def write_map_output(map_dir: str,
-                     partitions: Sequence[Iterable[Tuple[bytes, bytes]]],
-                     codec=None) -> list[tuple[int, int, int]]:
-    """Write one map attempt's output: ``partitions[r]`` is the (already
-    sorted) record stream for reducer r. Returns the index triples.
-
-    With ``codec`` (a uda_tpu.compress.Codec) each partition's IFile
-    bytes are block-compressed; the index triple then carries
-    (start, raw_length=uncompressed, part_length=on-disk) like Hadoop's
-    spill index for compressed map outputs.
-    """
-    os.makedirs(map_dir, exist_ok=True)
-    mof = io.BytesIO()
-    triples = []
+def partition_blobs(partitions: Sequence[Iterable[Tuple[bytes, bytes]]],
+                    codec=None) -> list[tuple[bytes, int]]:
+    """Each partition as ``(on-disk bytes, raw record-byte length)``:
+    sorted records IFile-framed, then block-compressed when ``codec``
+    is given (raw == len(bytes) for uncompressed jobs)."""
+    blobs = []
     for records in partitions:
         seg = io.BytesIO()
         w = IFileWriter(seg)
@@ -42,28 +54,141 @@ def write_map_output(map_dir: str,
             w.append(k, v)
         w.close()
         raw = seg.getvalue()
-        start = mof.tell()
         if codec is not None:
             from uda_tpu.compress import compress_block_stream
-            blob = compress_block_stream(raw, codec)
+            blobs.append((compress_block_stream(raw, codec), len(raw)))
         else:
-            blob = raw
+            blobs.append((raw, len(raw)))
+    return blobs
+
+
+def _encode_parities(blobs: list, scheme) -> list[list[bytes]]:
+    """Each partition's n-k parity chunks, computed ONCE (the GF(2^8)
+    pass is the coded write's dominant CPU cost — both the primary's
+    parity section and the peer shard fan-out index into this)."""
+    from uda_tpu.coding import rs
+
+    return [rs.encode_parity(blob, scheme.k, scheme.n)
+            for blob, _ in blobs]
+
+
+def _write_primary(map_dir: str, blobs: list, scheme=None,
+                   parities=None) -> list[tuple[int, int, int]]:
+    """Write one map dir's file.out (+ parity section when coded) and
+    its index; returns the data triples."""
+    os.makedirs(map_dir, exist_ok=True)
+    mof = io.BytesIO()
+    triples = []
+    for blob, raw_len in blobs:
+        start = mof.tell()
         mof.write(blob)
-        triples.append((start, len(raw), len(blob)))
+        triples.append((start, raw_len, len(blob)))
+    stripe = None
+    if scheme is not None:
+        if parities is None:
+            parities = _encode_parities(blobs, scheme)
+        locators = []
+        for pchunks in parities:
+            locs = []
+            for pchunk in pchunks:
+                locs.append((mof.tell(), len(pchunk)))
+                mof.write(pchunk)
+            # rs:k:k (and the empty partition) has no parity chunks;
+            # the locator row must still exist per partition
+            locs += [(0, 0)] * (scheme.parity - len(locs))
+            locators.append(locs)
+        stripe = (scheme.k, scheme.n, locators)
     with open(os.path.join(map_dir, "file.out"), "wb") as f:
         f.write(mof.getvalue())
-    write_index_file(os.path.join(map_dir, "file.out.index"), triples)
+    write_index_file(os.path.join(map_dir, "file.out.index"), triples,
+                     stripe=stripe)
+    return triples
+
+
+def _write_shard(shard_dir: str, chunk_bytes: list[bytes],
+                 full_parts: list[int]) -> None:
+    """One stripe chunk's shard MOF: partition r's segment is the chunk
+    bytes; the triple's raw field carries the full partition's
+    part_length (decode-trim total)."""
+    os.makedirs(shard_dir, exist_ok=True)
+    mof = io.BytesIO()
+    triples = []
+    for ch, full in zip(chunk_bytes, full_parts):
+        start = mof.tell()
+        mof.write(ch)
+        triples.append((start, full, len(ch)))
+    with open(os.path.join(shard_dir, "file.out"), "wb") as f:
+        f.write(mof.getvalue())
+    write_index_file(os.path.join(shard_dir, "file.out.index"), triples)
+
+
+def write_map_output(map_dir: str,
+                     partitions: Sequence[Iterable[Tuple[bytes, bytes]]],
+                     codec=None, scheme=None) -> list[tuple[int, int, int]]:
+    """Write one map attempt's output: ``partitions[r]`` is the (already
+    sorted) record stream for reducer r. Returns the index triples.
+
+    With ``codec`` (a uda_tpu.compress.Codec) each partition's IFile
+    bytes are block-compressed; the index triple then carries
+    (start, raw_length=uncompressed, part_length=on-disk) like Hadoop's
+    spill index for compressed map outputs. With ``scheme`` (a
+    uda_tpu.coding.CodingScheme) the parity section and v2 index are
+    written too (data region byte-identical either way).
+    """
+    return _write_primary(map_dir, partition_blobs(partitions, codec),
+                          scheme)
+
+
+def write_striped_map_output(
+        supplier_roots: Sequence[str], primary_index: int, job_id: str,
+        map_id: str, partitions: Sequence[Iterable[Tuple[bytes, bytes]]],
+        scheme, codec=None) -> list[tuple[int, int, int]]:
+    """The coded write with cross-supplier fan-out: the primary
+    (``supplier_roots[primary_index]``) gets the full MOF + parity
+    section; every stripe chunk whose placement lands on a PEER
+    supplier gets a shard MOF under that peer's root. ``supplier_roots``
+    must be ordered like the reduce side's canonical supplier list
+    (sorted unique hosts) for the placement rules to agree."""
+    from uda_tpu.coding import rs
+
+    blobs = partition_blobs(partitions, codec)
+    h = len(supplier_roots)
+    # encode each partition's stripe ONCE; the primary's parity
+    # section AND the placement loop below both index into it (one
+    # GF(2^8) pass per blob total)
+    parities = _encode_parities(blobs, scheme)
+    triples = _write_primary(
+        os.path.join(supplier_roots[primary_index], job_id, map_id),
+        blobs, scheme, parities=parities)
+    full_parts = [len(blob) for blob, _ in blobs]
+    stripes = [rs.split_data(blob, scheme.k) + parity
+               for (blob, _), parity in zip(blobs, parities)]
+    for i in range(scheme.n):
+        target = (primary_index + i) % h
+        if target == primary_index:
+            continue  # served off the primary's file.out by synthesis
+        _write_shard(os.path.join(supplier_roots[target], job_id,
+                                  shard_map_id(map_id, i)),
+                     [stripe[i] for stripe in stripes], full_parts)
     return triples
 
 
 class MOFWriter:
     """Job-scoped writer over the DirIndexResolver layout
-    (``<root>/<job>/<map_id>/file.out[.index]``)."""
+    (``<root>/<job>/<map_id>/file.out[.index]``). With a coding scheme
+    and the job's supplier-root table it writes the striped layout
+    (``supplier_index`` names this writer's position in the canonical
+    supplier order)."""
 
-    def __init__(self, root: str, job_id: str, codec=None):
+    def __init__(self, root: str, job_id: str, codec=None, scheme=None,
+                 supplier_roots: Optional[Sequence[str]] = None,
+                 supplier_index: int = 0):
         self.root = root
         self.job_id = job_id
         self.codec = codec
+        self.scheme = scheme
+        self.supplier_roots = list(supplier_roots or [])
+        self.supplier_index = supplier_index
         self.map_ids: list[str] = []
 
     def map_dir(self, map_id: str) -> str:
@@ -71,5 +196,12 @@ class MOFWriter:
 
     def write(self, map_id: str,
               partitions: Sequence[Iterable[Tuple[bytes, bytes]]]) -> None:
-        write_map_output(self.map_dir(map_id), partitions, self.codec)
+        if self.scheme is not None and len(self.supplier_roots) > 1:
+            write_striped_map_output(self.supplier_roots,
+                                     self.supplier_index, self.job_id,
+                                     map_id, partitions, self.scheme,
+                                     self.codec)
+        else:
+            write_map_output(self.map_dir(map_id), partitions, self.codec,
+                             self.scheme)
         self.map_ids.append(map_id)

@@ -264,6 +264,12 @@ class _ClientConn:
             result = wire.decode_error(memoryview(payload))
         elif msg_type == wire.MSG_SIZE:
             result = wire.decode_size(memoryview(payload))
+        elif msg_type == wire.MSG_HELLO:
+            # the accept banner correlates with no request: record the
+            # server generation (warm-restart continuity) and move on
+            generation, warm = wire.decode_hello(bytes(payload))
+            self.client._on_hello(generation, warm)
+            return
         else:
             raise TransportError(
                 f"unexpected frame type {msg_type} on the client side")
@@ -321,6 +327,45 @@ class EvLoopFetchClient(InputClient):
         self._pending: dict = {}       # req_id -> _Waiter
         self._next_id = 0              # never reused across connections
         self._stopped = False
+        # warm-restart continuity (the HELLO accept banner): the last
+        # observed server generation, and whether a resumed offset
+        # ledger is still continuous with this supplier's bytes
+        self._generation: Optional[int] = None
+        self._resumable = True
+
+    def _on_hello(self, generation: int, warm: bool) -> None:
+        """Loop thread (first frame of every connection). A CHANGED
+        generation is a supplier restart: warm (handoff-continued)
+        keeps resume legal, cold revokes it — a cold supplier may hold
+        a different attempt's bytes, so retrying segments must restart
+        from zero (their raw_length identity check is the backstop
+        either way)."""
+        with self._lock:
+            prev = self._generation
+            self._generation = generation
+            if prev is not None and generation != prev and not warm:
+                # STICKY: a later warm bounce must not re-legalize
+                # resume — a segment's offset ledger may predate the
+                # cold generation, and the warm flag only certifies
+                # continuity with the generation it succeeded. Segments
+                # created after this client object are conservative by
+                # one refetch; correctness wins.
+                self._resumable = False
+        if prev is not None and generation != prev:
+            metrics.add("net.generation.changes", host=self.host,
+                        warm=str(bool(warm)).lower())
+            log.warn(f"net: supplier {self.host}:{self.port} restarted "
+                     f"(generation {prev} -> {generation}, "
+                     f"{'warm' if warm else 'COLD'})")
+
+    def resume_ok(self, host: str = "") -> bool:
+        """May a retrying segment keep its offset ledger against this
+        supplier? True until a COLD restart is observed (see
+        _on_hello); optimistic across an unresolved reconnect — the
+        resumed fetch's identity check revalidates on the first
+        chunk."""
+        with self._lock:
+            return self._resumable
 
     # -- connection management ----------------------------------------------
 

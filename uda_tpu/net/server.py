@@ -52,6 +52,7 @@ frame and the connection is closed deterministically after sending it.
 from __future__ import annotations
 
 import errno
+import json
 import os
 import selectors
 import socket
@@ -483,19 +484,19 @@ class _EvConn:
                 # — every chunk after a partition's first
                 plan = self.server.engine.try_plan(req)
                 if plan is not None:
-                    self._complete(req_id, plan, None, t0, span)
+                    self._complete(req_id, plan, None, t0, span, req)
                     return
                 fut = self.server.engine.submit_serve(req)
             else:
                 fut = self.server.engine.submit(req)
         except Exception as e:  # noqa: BLE001 - sync rejection (stopped
             # engine, admission push-back, bad offset) -> typed ERR
-            self._complete(req_id, None, e, t0, span)
+            self._complete(req_id, None, e, t0, span, req)
             return
         fut.add_done_callback(
-            lambda f: self._engine_done(req_id, f, t0, span))
+            lambda f: self._engine_done(req_id, f, t0, span, req))
 
-    def _engine_done(self, req_id: int, f, t0: float, span) -> None:
+    def _engine_done(self, req_id: int, f, t0: float, span, req) -> None:
         """Engine worker thread (or the loop, when the future was
         already resolved at callback registration)."""
         err = f.exception()
@@ -503,9 +504,10 @@ class _EvConn:
         if self.closed or not self.loop.alive():
             self._settle_offloop(res, span)
             return
-        self._complete(req_id, res, err, t0, span)
+        self._complete(req_id, res, err, t0, span, req)
 
-    def _complete(self, req_id: int, res, err, t0: float, span) -> None:
+    def _complete(self, req_id: int, res, err, t0: float, span,
+                  req=None) -> None:
         """Engine completion -> outbound item, on the COMPLETING thread
         (inline-write fast path). Responses complete out of order
         across requests, exactly like the threaded core's
@@ -582,6 +584,13 @@ class _EvConn:
             self.loop.call_soon(self._abandon_item,
                                 _BufItem([], credited=True, t0=t0), e)
             return
+        if err is None and req is not None:
+            # warm-restart watermark: the highest partition offset this
+            # server has answered (advisory — the resuming client's own
+            # offset ledger is authoritative; see the handoff docstring)
+            served = res.length if isinstance(res, FdSlice) \
+                else len(res.data)
+            self.server._mark_served(self.peer, req, req.offset + served)
         self._enqueue(item, head)
 
     def _start_size(self, req_id: int, body) -> None:
@@ -856,6 +865,89 @@ class EvLoopShuffleServer:
         self._conns: set = set()
         self._lock = TrackedLock("net.server")
         self._stopping = threading.Event()
+        # warm-restart handoff (uda.tpu.net.handoff.path): generation
+        # identity + served-offset watermarks; minted per start()
+        self.handoff_path = str(cfg.get("uda.tpu.net.handoff.path"))
+        self.generation = 0
+        self.warm_restart = False
+        self._marks: dict = {}  # "peer|job|map|reduce" -> served end
+        self._marks_lock = threading.Lock()
+
+    # -- warm-restart handoff -----------------------------------------------
+
+    def _load_generation(self) -> tuple[int, bool]:
+        """The advertised server generation: a persisted handoff record
+        continues as generation+1 with the warm flag (clients may keep
+        resumed offsets); without one — first boot, kill -9, unreadable
+        record — a fresh random generation is minted so a COLD restart
+        can never masquerade as the same server instance."""
+        path = self.handoff_path
+        if path:
+            try:
+                failpoint("net.handoff", key="load")
+                with open(path) as f:
+                    rec = json.load(f)
+                # CONSUME the record: it proves exactly ONE graceful
+                # stop. Left in place, a later kill -9 would replay it
+                # and the cold restart would advertise the same warm
+                # generation as the killed instance — clients would
+                # see no generation change and keep resuming against
+                # possibly-different bytes.
+                os.unlink(path)
+                gen = (int(rec["generation"]) + 1) & 0x7FFFFFFF
+                metrics.add("net.handoff.loaded")
+                return max(1, gen), True
+            except FileNotFoundError:
+                pass  # first boot: cold by definition
+            except Exception as e:  # noqa: BLE001 - a bad record is a
+                # cold start, never a refused start
+                metrics.add("errors.swallowed")
+                log.warn(f"net: handoff record {path} unreadable ({e}); "
+                         f"cold start")
+        gen = int.from_bytes(os.urandom(4), "big") & 0x7FFFFFFF
+        return max(1, gen), False
+
+    _MARKS_CAP = 4096  # bound the table: oldest partition evicted
+
+    def _mark_served(self, peer: str, req, end: int) -> None:
+        """Track the served-offset watermark per PARTITION (not per
+        conn — peers carry ephemeral ports, and keying by them would
+        grow the table one entry per reconnect for the server's
+        lifetime). Advisory: it may lead the wire by in-flight frames
+        — resume correctness never depends on it (the CLIENT's offset
+        ledger is authoritative); the record is the drain proof +
+        diagnostics a restarted supplier starts from. Bounded: beyond
+        the cap the oldest partition's mark is evicted (insertion
+        order — long-finished partitions go first)."""
+        if not self.handoff_path:
+            return
+        key = f"{req.job_id}|{req.map_id}|{req.reduce_id}"
+        with self._marks_lock:
+            if end > self._marks.get(key, -1):
+                self._marks.pop(key, None)  # refresh insertion order
+                self._marks[key] = end
+                if len(self._marks) > self._MARKS_CAP:
+                    self._marks.pop(next(iter(self._marks)))
+
+    def _write_handoff(self) -> None:
+        if not self.handoff_path:
+            return
+        with self._marks_lock:
+            marks = dict(self._marks)
+        try:
+            failpoint("net.handoff", key="save")
+            tmp = self.handoff_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"generation": self.generation,
+                           "watermarks": marks}, f)
+            os.replace(tmp, self.handoff_path)
+            metrics.add("net.handoff.persisted")
+        except Exception as e:  # noqa: BLE001 - losing the handoff
+            # downgrades the NEXT start to cold; it must not turn a
+            # graceful stop into a crash
+            metrics.add("errors.swallowed")
+            log.warn(f"net: handoff record {self.handoff_path} not "
+                     f"persisted ({e}); next start will be cold")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -867,6 +959,12 @@ class EvLoopShuffleServer:
         ls.bind((self.bind_host, self.bind_port))
         ls.listen(128)
         ls.setblocking(False)
+        # the handoff record is CONSUMED by _load_generation, so it
+        # must survive a failed start: load only after bind/listen
+        # succeeded — a transient EADDRINUSE (old socket in TIME_WAIT)
+        # must not silently downgrade the supervisor's retry to cold
+        self.generation, self.warm_restart = self._load_generation()
+        metrics.gauge("net.server.generation", self.generation)
         self._listener = ls
         self._stopping.clear()
         self._loop = EventLoop("uda-net-loop").start()
@@ -874,7 +972,9 @@ class EvLoopShuffleServer:
                              self._on_accept)
         log.info(f"shuffle server listening on {self.address[0]}:"
                  f"{self.address[1]} (credit/conn={self.credit}, "
-                 f"core=evloop, zerocopy={self.zero_copy})")
+                 f"core=evloop, zerocopy={self.zero_copy}, "
+                 f"generation={self.generation}"
+                 f"{' warm' if self.warm_restart else ''})")
         return self
 
     @property
@@ -925,6 +1025,13 @@ class EvLoopShuffleServer:
             metrics.add("net.accepts")
             metrics.gauge_add("net.server.connections", 1)
             conn.register()
+            # the accept banner: generation + warm flag, the FIRST
+            # frame on the connection (uncredited — it answers no
+            # request); rides _enqueue so the net.frame failpoint can
+            # tear it like any other frame
+            hello = wire.encode_hello(self.generation, self.warm_restart)
+            conn._enqueue(_BufItem([hello], credited=False,
+                                   t0=time.perf_counter()), hello)
 
     def _forget(self, conn: _EvConn) -> None:
         with self._lock:
@@ -970,6 +1077,11 @@ class EvLoopShuffleServer:
                 if all(c.drained() or c.closed for c in conns):
                     break
                 time.sleep(0.01)
+            # the graceful-stop handoff: everything the engine accepted
+            # has flushed (or the drain window closed) — persist the
+            # generation + watermarks so the NEXT start advertises a
+            # warm generation+1 and clients keep their resumed offsets
+            self._write_handoff()
         for c in conns:
             loop.call_soon(c.close)
         deadline = time.monotonic() + 2.0

@@ -26,6 +26,13 @@ Frame types::
     ERR        typed failure for one req  (error kind + message)
     SIZE_REQ   partition size probe       (job, reduce, map ids)
     SIZE       size reply                 (total bytes, -1 = unknown)
+    HELLO      accept banner              (server generation + warm flag;
+                                           the FIRST frame on every
+                                           accepted connection — a
+                                           warm-restarted supplier
+                                           advertises generation+1 so
+                                           clients know resumed offsets
+                                           are continuous)
 
 Decoding is STRICT: a bad magic, an unknown version or type, a length
 over :data:`MAX_FRAME`, a short buffer or trailing garbage all raise
@@ -51,10 +58,12 @@ from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
 
 __all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
            "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
+           "MSG_HELLO",
            "encode_request", "decode_request", "encode_result",
            "encode_result_head", "decode_result", "decode_result_take",
            "encode_error", "decode_error", "encode_size_request",
            "decode_size_request", "encode_size", "decode_size",
+           "encode_hello", "decode_hello",
            "encode_frame", "decode_header", "recv_frame", "close_hard",
            "tune_socket"]
 
@@ -71,14 +80,18 @@ MSG_DATA = 2
 MSG_ERR = 3
 MSG_SIZE_REQ = 4
 MSG_SIZE = 5
+MSG_HELLO = 6
 
-_TYPES = (MSG_REQ, MSG_DATA, MSG_ERR, MSG_SIZE_REQ, MSG_SIZE)
+_TYPES = (MSG_REQ, MSG_DATA, MSG_ERR, MSG_SIZE_REQ, MSG_SIZE, MSG_HELLO)
 
 _REQ = struct.Struct("!IQI")      # reduce_id, offset, chunk_size
 _DATA = struct.Struct("!QQQB")    # raw_length, part_length, offset, flags
 _CRC = struct.Struct("!I")
 _SIZE_REQ = struct.Struct("!II")  # reduce_id, num maps
 _SIZE = struct.Struct("!q")       # total bytes, -1 = unknown
+_HELLO = struct.Struct("!IB")     # server generation, flags
+
+_HELLO_WARM = 0x01  # the generation continues a persisted handoff
 
 _FLAG_LAST = 0x01
 _FLAG_CRC = 0x02
@@ -182,6 +195,21 @@ def encode_size_request(req_id: int, job_id: str, map_ids: Sequence[str],
 def encode_size(req_id: int, total: Optional[int]) -> bytes:
     return encode_frame(MSG_SIZE, req_id,
                         _SIZE.pack(-1 if total is None else total))
+
+
+def encode_hello(generation: int, warm: bool) -> bytes:
+    """The accept banner (req id 0 — it correlates with nothing)."""
+    return encode_frame(MSG_HELLO, 0,
+                        _HELLO.pack(generation & 0xFFFFFFFF,
+                                    _HELLO_WARM if warm else 0))
+
+
+def decode_hello(payload) -> tuple[int, bool]:
+    """-> (server generation, warm)."""
+    if len(payload) != _HELLO.size:
+        raise TransportError(f"malformed HELLO frame ({len(payload)} B)")
+    generation, flags = _HELLO.unpack(payload)
+    return generation, bool(flags & _HELLO_WARM)
 
 
 # -- decode ------------------------------------------------------------------

@@ -147,6 +147,44 @@ _FLAG_LIST = [
          "failpoint arming spec, same syntax as UDA_FAILPOINTS: "
          "comma-separated site=action[:arg][:trigger...] entries "
          "(uda_tpu.utils.failpoints)"),
+    # --- survivable shuffle: speculation / resume / erasure coding ---
+    Flag("uda.tpu.fetch.speculate.pn", 0, int,
+         "straggler-detector percentile (pN) of the observed "
+         "fetch.latency_ms histogram: an in-flight chunk fetch older "
+         "than max(floor, pN) gets a speculative duplicate issued to "
+         "the best PenaltyBox-ranked alternate source; first "
+         "completion wins, the loser is discarded as a stale epoch "
+         "(0 = speculation off)"),
+    Flag("uda.tpu.fetch.speculate.floor.ms", 50, int,
+         "minimum in-flight milliseconds before a fetch may be "
+         "speculated, and the whole threshold while the latency "
+         "histogram is empty (stats off or cold start)"),
+    Flag("uda.tpu.fetch.resume", False, bool,
+         "warm-resume on transport retry: keep the segment's offset "
+         "ledger (fetched batches + carry) across a connection loss "
+         "and continue mid-partition instead of refetching from zero, "
+         "when the transport reports the source resumable "
+         "(InputClient.resume_ok — warm supplier restart, immutable "
+         "MOF); the first resumed chunk revalidates the partition's "
+         "identity (raw_length) and falls back to a full restart on "
+         "mismatch. off = the seed behavior (whole-segment re-fetch)"),
+    Flag("uda.tpu.coding.scheme", "", str,
+         "k-of-n erasure coding of map outputs as 'rs:k:n' "
+         "(systematic Reed-Solomon over GF(2^8), uda_tpu.coding): "
+         "map-side emit writes n-k parity chunks per partition stripe "
+         "(parity section + v2 index) and the reduce side rebuilds a "
+         "partition from ANY k of the n stripe chunks when its "
+         "primary supplier is dead or penalized. empty = coding off; "
+         "rs:k:k = chunked layout with zero parity (byte-identical "
+         "data path)"),
+    Flag("uda.tpu.net.handoff.path", "", str,
+         "supplier warm-restart handoff record: stop(drain=True) "
+         "persists {generation, served-offset watermarks} to this "
+         "path and the next start() advertises generation+1 with the "
+         "warm flag in its accept banner, so reduce-side fetches "
+         "resume from their own offset ledgers instead of refetching "
+         "(uda.tpu.fetch.resume). empty = no persistence (every start "
+         "mints a fresh cold generation)"),
     # --- network shuffle data plane (uda_tpu/net/) ---
     Flag("uda.tpu.net.listen", False, bool,
          "start a ShuffleServer (the TCP shuffle data plane, the "

@@ -26,13 +26,36 @@ __all__ = [
     "StorageError",
     "CompressionError",
     "FallbackSignal",
+    "attribute_supplier",
 ]
+
+
+def attribute_supplier(exc: BaseException, supplier: str) -> None:
+    """Stamp the structured failing-supplier attribution onto ``exc``
+    (see :attr:`UdaError.supplier`): first writer wins, and foreign
+    exception types without attribute slots are tolerated — the ONE
+    implementation of the attribution contract every stamping site
+    shares."""
+    if getattr(exc, "supplier", None) is None:
+        try:
+            exc.supplier = supplier
+        except AttributeError:  # udalint: disable=UDA006
+            pass  # foreign exception type without attribute slots
 
 
 class UdaError(Exception):
     """Base error. Captures a formatted backtrace at construction, like the
     reference's UdaException embeds a C++ backtrace in its message
-    (IOUtility.cc:561-569, print_backtrace :479-498)."""
+    (IOUtility.cc:561-569, print_backtrace :479-498).
+
+    ``supplier`` is the STRUCTURED failing-source attribution (None =
+    unattributed): the fetch ladder stamps the supplier whose attempt
+    produced the error so the recovery ledger, penalty box and
+    speculation can key on it without parsing reason strings (udalint
+    UDA005). First writer wins — an error shared across segments (a
+    stop-path drain) keeps its original attribution."""
+
+    supplier = None  # failing supplier host/label, when attributable
 
     def __init__(self, message: str):
         self.backtrace = "".join(traceback.format_stack()[:-1])

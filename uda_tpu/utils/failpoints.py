@@ -44,7 +44,11 @@ frame, server responses and client requests — data-bearing, so
 truncate tears a frame mid-stream and the sender then closes the
 connection, a deterministic disconnect), ``net.accept`` (per accepted
 connection: delay = slow accept, error = dropped at birth) and
-``net.connect`` (per client dial).
+``net.connect`` (per client dial). The survivable-shuffle layer adds
+``coding.decode`` (the Reed-Solomon reconstruction of one partition,
+keyed ``<map>/<reduce>``) and ``net.handoff`` (the warm-restart
+handoff record, keyed ``load``/``save`` — an injected save fault
+degrades the next start to cold, never breaks the stop).
 """
 
 from __future__ import annotations
@@ -86,6 +90,11 @@ _SITE_ERRORS = {
     "net.frame": TransportError,
     "net.accept": TransportError,
     "net.connect": TransportError,
+    # survivable-shuffle paths (ISSUE 8), injectable from day one:
+    # the RS decode of a reconstruction (key "<map>/<reduce>") and the
+    # server's warm-restart handoff persistence (key "load"/"save")
+    "coding.decode": StorageError,
+    "net.handoff": StorageError,
 }
 
 # The registered-site inventory. udalint's UDA003 rule checks every

@@ -83,6 +83,51 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                    "faults [labels: supplier]"),
     "fetch.deprioritized": ("counter", "schedule rotations past a boxed "
                                        "supplier"),
+    # -- counters: survivable shuffle (speculation / resume / coding) ----
+    "fetch.speculated": ("counter", "straggler chunks that got a "
+                                    "speculative duplicate fetch "
+                                    "[labels: supplier (the alternate "
+                                    "source)]"),
+    "fetch.speculation.won": ("counter", "speculative duplicates that "
+                                         "completed first (the segment "
+                                         "switches to the faster "
+                                         "source) [labels: supplier]"),
+    "fetch.speculation.lost": ("counter", "speculative duplicates the "
+                                          "primary beat (the loser's "
+                                          "completion is discarded as "
+                                          "stale)"),
+    "fetch.resumed": ("counter", "transport retries that kept the "
+                                 "offset ledger and resumed "
+                                 "mid-partition (uda.tpu.fetch.resume) "
+                                 "[labels: supplier]"),
+    "fetch.resumed.bytes": ("counter", "already-served bytes a resumed "
+                                       "retry did NOT refetch"),
+    "fetch.resume.invalidated": ("counter", "resumed fetches whose "
+                                            "first chunk failed the "
+                                            "partition-identity check "
+                                            "(full restart from zero)"),
+    "coding.recover.attempts": ("counter", "segments that entered the "
+                                           "k-of-n reconstruction rung "
+                                           "after exhausting retries "
+                                           "[labels: supplier (the "
+                                           "failed primary)]"),
+    "coding.recover.failures": ("counter", "reconstructions that failed "
+                                           "(fewer than k chunks "
+                                           "reachable, or decode "
+                                           "error)"),
+    "coding.reconstructed.partitions": ("counter", "partitions rebuilt "
+                                        "from stripe chunks instead of "
+                                        "the dead/penalized primary"),
+    "coding.reconstructed.bytes": ("counter", "on-disk partition bytes "
+                                              "produced by the RS "
+                                              "decoder"),
+    "coding.shard.fetches": ("counter", "stripe shard streams fetched "
+                                        "to completion [labels: "
+                                        "supplier]"),
+    "coding.shard.failures": ("counter", "stripe shard streams that "
+                                         "failed (next candidate is "
+                                         "promoted) [labels: "
+                                         "supplier]"),
     "fallback.signals": ("counter", "terminal engine failures converted "
                                     "to FallbackSignal"),
     # -- counters: memory admission / pressure response ------------------
@@ -171,6 +216,16 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                   "mmap (the zerocopy mmap mode) "
                                   "without transiting the Python "
                                   "heap"),
+    "net.generation.changes": ("counter", "reconnects that observed a "
+                                          "DIFFERENT server generation "
+                                          "in the accept banner (a "
+                                          "supplier restart) [labels: "
+                                          "host, warm]"),
+    "net.handoff.persisted": ("counter", "handoff records written by "
+                                         "stop(drain=True)"),
+    "net.handoff.loaded": ("counter", "warm restarts that resumed a "
+                                      "persisted handoff record "
+                                      "(generation continuity)"),
     # -- gauges ----------------------------------------------------------
     "fetch.on_air": ("gauge", "fetch attempts currently in flight "
                               "(reference AIO on-air counter)"),
@@ -189,6 +244,10 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                      "pipeline (engine + outbound "
                                      "queue; bounded per conn by "
                                      "mapred.rdma.wqe.per.conn)"),
+    "net.server.generation": ("gauge", "this process's shuffle-server "
+                                       "generation (advertised in the "
+                                       "accept banner; warm restarts "
+                                       "increment the persisted one)"),
     # -- histograms (recorded only while stats are enabled) --------------
     "fetch.latency_ms": ("histogram", "per-chunk fetch latency "
                                       "[labels: supplier]"),
@@ -435,6 +494,19 @@ class Metrics:
     def histogram_summaries(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {k: h.summary() for k, h in self.histograms.items()}
+
+    def percentile(self, name: str, p: float,
+                   **labels) -> Optional[float]:
+        """A live percentile estimate of one histogram series, or None
+        when the series has no samples (stats disabled, or nothing
+        observed yet) — callers degrade to their own floor. Used by the
+        fetch straggler detector (SpeculationPolicy.threshold_ms)."""
+        key = _series_key(name, labels) if labels else name
+        with self._lock:
+            h = self.histograms.get(key)
+            if h is None or h.count == 0:
+                return None
+            return h.percentile(p)
 
     # -- spans --------------------------------------------------------------
 

@@ -31,7 +31,7 @@ import dataclasses
 import random
 from typing import Optional
 
-__all__ = ["RetryPolicy"]
+__all__ = ["RetryPolicy", "SpeculationPolicy"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,4 +69,35 @@ class RetryPolicy:
             attempt_timeout_ms=float(
                 cfg.get("mapred.rdma.fetch.attempt.timeout.ms")),
             deadline_ms=float(cfg.get("mapred.rdma.fetch.deadline.ms")),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculationPolicy:
+    """The straggler detector's knobs (speculative dual-source fetch,
+    uda_tpu.merger.segment): an in-flight chunk fetch that outlives
+    ``max(floor_ms, pN of the observed fetch.latency_ms histogram)``
+    gets a duplicate issued to an alternate source. ``pn == 0`` (the
+    default) disables speculation; with stats off (no histogram) the
+    floor alone is the threshold."""
+
+    pn: int = 0           # latency percentile (e.g. 95); 0 = off
+    floor_ms: float = 50.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.pn > 0
+
+    def threshold_ms(self) -> float:
+        from uda_tpu.utils.metrics import metrics
+
+        q = metrics.percentile("fetch.latency_ms", float(self.pn))
+        return max(self.floor_ms, q or 0.0)
+
+    @classmethod
+    def from_config(cls, cfg) -> "SpeculationPolicy":
+        return cls(
+            pn=max(0, min(100, int(cfg.get("uda.tpu.fetch.speculate.pn")))),
+            floor_ms=max(0.0, float(
+                cfg.get("uda.tpu.fetch.speculate.floor.ms"))),
         )
