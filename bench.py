@@ -245,9 +245,15 @@ total_fetch_time / total_merge_time; histogram percentiles appear when
 the run recorded samples (UDA_TPU_STATS=1 enables histograms+spans).
 BENCH_*.json files across rounds stay directly diffable on this block.
 
+The "small_batch" block is the interactive-traffic tier (2^16-2^19
+rows): per size, the engine chosen by the batch-size-aware router
+(uda_tpu.ops.sort.route_engine) and its measured GB/s — the take-ramp
+regime the headline number cannot see.
+
 env knobs: UDA_TPU_BENCH_LOG2 (records=2^N), UDA_TPU_BENCH_PATHS,
 UDA_TPU_BENCH_PROBE_TIMEOUT, UDA_TPU_BENCH_INTERPRET=1,
-UDA_TPU_BENCH_TRY_CARRY=1, UDA_TPU_XPROF=<dir> (device trace),
+UDA_TPU_BENCH_TRY_CARRY=1, UDA_TPU_BENCH_SMALL=0 (skip the
+small-batch tier), UDA_TPU_XPROF=<dir> (device trace),
 UDA_TPU_STATS=1 (host-side histograms/spans in the telemetry block).
 """
 
@@ -362,8 +368,65 @@ def main() -> None:
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        "engine": {"path": chosen[0], "tile": chosen[1]},
+        "small_batch": _small_batch_tier(),
         "telemetry": telemetry_block(),
     }))
+
+
+# interactive-traffic tier: the take-ramp showed the gather-bound
+# engines collapse to 0.15 GB/s at 2^16 rows (latency-bound regime,
+# BENCH_NOTES_r05) — these sizes track that shape per round, and the
+# per-size engine chosen by the batch-size-aware router
+# (ops.sort.route_engine) rides the same JSON so routing regressions
+# are diffable across BENCH_*.json artifacts. UDA_TPU_BENCH_SMALL=0
+# skips the tier (short pool windows).
+SMALL_BATCH_LOG2 = (16, 17, 19)
+
+
+def _small_batch_tier() -> dict:
+    if os.environ.get("UDA_TPU_BENCH_SMALL") == "0":
+        return {}
+    import jax
+    import numpy as np
+
+    from uda_tpu.models import terasort
+    from uda_tpu.ops import sort as sort_ops
+
+    tier: dict = {}
+    for log2 in SMALL_BATCH_LOG2:
+        if log2 >= LOG2_RECORDS:
+            continue  # smoke-sized runs: no tier below the headline
+        n = 1 << log2
+        entry: dict = {"rows": n}
+        try:
+            # lanes_ok mirrors the production surface (single_chip_sort):
+            # a deployed lanes-engine winner routes here exactly as it
+            # would in the real sort. Inside the try: a bad
+            # UDA_TPU_SORT_PATH must cost this tier entry, not the
+            # headline JSON line.
+            path = sort_ops.route_engine(n, "auto", lanes_ok=True)
+            tile = min(_tile_for(path), n)
+            entry["engine"] = path
+            entry["tile"] = tile
+            gb = n * terasort.RECORD_BYTES * ROUNDS_PER_DISPATCH / 1e9
+
+            def one(seed):
+                t0 = time.perf_counter()
+                viol, ck_in, ck_out = terasort.bench_step(
+                    jax.random.key(seed), n, ROUNDS_PER_DISPATCH,
+                    path=path, tile=tile, interpret=INTERPRET)
+                assert int(viol) == 0
+                assert np.uint32(ck_in) == np.uint32(ck_out)
+                return time.perf_counter() - t0
+
+            one(999)  # warmup/compile (small shapes compile fast)
+            entry["gbps"] = round(gb / min(one(998), one(997)), 3)
+        except Exception as e:  # noqa: BLE001 - the headline must print
+            entry["error"] = f"{type(e).__name__}: {e}"
+            print(f"# small-batch 2^{log2} failed: {e}", file=sys.stderr)
+        tier[str(n)] = entry
+    return tier
 
 
 if __name__ == "__main__":

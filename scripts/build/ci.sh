@@ -74,6 +74,16 @@ env -u PALLAS_AXON_POOL_IPS \
   python scripts/exchange_bench.py --quick \
   --out "$ART/exchange_bench.json" 2>&1 | tee -a "$ART/ci.log" | tail -5
 
+# Staging-pipeline gate, quick mode: the pipelined stage pool must be
+# BYTE-IDENTICAL to the serial staging twin across sorted/shuffled
+# input, spool mode and a compressed end-to-end run (exit 3 on any
+# divergence). Throughput is reported, not gated, in quick mode — the
+# 64x64 MB speedup gate rides the full run's BENCH_PIPELINE_r*.json.
+echo "-- staging pipeline A/B (quick)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/bench_pipeline.py --quick \
+  --out "$ART/bench_pipeline.json" 2>&1 | tee -a "$ART/ci.log" | tail -2
+
 # CPU-only gates run with the accelerator-pool env stripped: the pool's
 # sitecustomize otherwise dials the pool from every spawned interpreter
 # and can hang at startup while the pool is wedged (pytest strips it

@@ -111,6 +111,27 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 UDA_TPU_CHAOS_SEED="${SEED}" \
     python -m pytest tests/test_coding.py -m faults -q -p no:cacheprovider \
     --continue-on-collection-errors "$@" || crc=$?
 
+# Pipeline rung: the staged fetch->decompress->pack->stage pipeline
+# (ISSUE 9) under a schedule biased toward the pipeline's two injection
+# sites — slow/failing preads feeding the stage pool and delayed block
+# decompression inside it. The faults-marked pipeline tests assert the
+# drain contract (abort stops every worker, the in-flight byte gauge
+# returns to zero); the rung runs them with lockdep watching the new
+# lock classes (stage.inflight, stage.bufpool) against everything the
+# stage pool touches mid-fault.
+PIPESPEC="data_engine.pread=delay:$((SEED % 15 + 5)):prob:0.25:seed:${SEED},decompress.block=delay:$((SEED % 5 + 1)):prob:0.15:seed:${SEED}"
+PICOUNTERS="$(mktemp)"
+PICYCLES="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${PICOUNTERS}" "${PICYCLES}"' EXIT
+echo "pipeline schedule:   ${PIPESPEC} (UDA_TPU_LOCKDEP=1)"
+pirc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PIPESPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${PICYCLES}" \
+    UDA_TPU_CHAOS_TELEMETRY="${PICOUNTERS}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    -k "pipeline" \
+    --continue-on-collection-errors "$@" || pirc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -121,7 +142,7 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 UDA_TPU_CHAOS_SEED="${SEED}" \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${PICOUNTERS}" "${PICYCLES}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -136,13 +157,15 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${NSPEC}" "${NCOUNTERS}" "${nrc}" "${NCYCLES}" \
     "${ECOUNTERS}" "${erc}" "${ECYCLES}" \
     "${CCOUNTERS}" "${crc}" "${CCYCLES}" \
+    "${PIPESPEC}" "${PICOUNTERS}" "${pirc}" "${PICYCLES}" \
     "${LCOUNTERS}" "${lrc}" "${LCYCLES}" <<'EOF' || mrc=$?
 import json, sys
 (seed, spec, counters_path, out, rc, pspec, pcounters, prc,
  nspec, ncounters, nrc, ncycles,
  ecounters, erc, ecycles,
  ccounters, crc_, ccycles,
- lcounters, lrc, lcycles) = sys.argv[1:22]
+ pipespec, picounters, pirc, picycles,
+ lcounters, lrc, lcycles) = sys.argv[1:26]
 def load(path):
     try:
         with open(path) as f:
@@ -182,6 +205,20 @@ completion["survived"] = {
     "speculation_won": cc.get("fetch.speculation.won", 0),
     "fallback_signals": cc.get("fallback.signals", 0),
 }
+pipeline, pi_reports = lockdep_block(pipespec, pirc, picounters,
+                                     picycles)
+# the drain contract, surfaced: staged runs consumed, backpressure
+# blocks observed, and zero bytes left in flight after every
+# faulted-and-aborted pipeline (the per-test asserts enforce the
+# gauge; this is the cross-round diffable record)
+pc = pipeline["telemetry"].get("counters", {})
+pipeline["drained"] = {
+    "pipeline_runs": pc.get("merge.pipeline.runs", 0),
+    "backpressure_events": pc.get("stage.backpressure_events", 0),
+    "staged_bytes": pc.get("stage.bytes", 0),
+    "inflight_bytes_left": pipeline["telemetry"].get(
+        "gauges", {}).get("stage.inflight.bytes", 0),
+}
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
@@ -191,10 +228,12 @@ with open(out, "w") as f:
                "network": network,
                "exchange": exchange,
                "completion": completion,
+               "pipeline": pipeline,
                "lockdep": lockdep},
               f, indent=1, sort_keys=True)
     f.write("\n")
-ncyc = len(n_reports) + len(e_reports) + len(c_reports) + len(l_reports)
+ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
+        + len(pi_reports) + len(l_reports))
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc})")
 # the zero-cycles-on-real-code guarantee is ENFORCED, not just
 # printed: a detected inversion that never got the unlucky scheduling
@@ -205,6 +244,7 @@ if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
 if [ "${erc}" -ne 0 ]; then rc="${erc}"; fi
 if [ "${crc}" -ne 0 ]; then rc="${crc}"; fi
+if [ "${pirc}" -ne 0 ]; then rc="${pirc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP: cycle reports on real code (see CHAOS_TELEMETRY.json)" >&2

@@ -18,17 +18,30 @@ mid-sequence still leaves a usable record:
 4. bench       — python bench.py (the official JSON line; its fly-off
                  probes keys8f/keys8/lanes2/... with per-path budgets)
 5. regression  — the ambient workload ladder artifact
-6. gatherprobe — in-kernel Mosaic gather formulations (exploratory,
+6. ccsweep     — the staged-lever re-probe (scripts/sweep_carrychunk.py):
+                 the carrychunk chunk-width ladder cc=8/12/23
+                 (ops.sort.CC_LADDER) plus the two engines fixed
+                 post-r05 — keys8f (select-on-i1) and lanes2 (narrowing
+                 gather). "Fixed post-run, re-probe pending" since r05;
+                 this stage is what turns them into measured numbers
+                 the next time a pool window opens
+7. pipeline_ab — staging-pipeline A/B (scripts/bench_pipeline.py) on
+                 the pool host: pipelined stage pool vs the serial
+                 stage_sorted_x1 baseline feeding the real device
+                 (BENCH_PIPELINE_hw.json; the CPU-gated twin of the r09
+                 artifact)
+8. gatherprobe — in-kernel Mosaic gather formulations (exploratory,
                  lanes2 viability) — AFTER the primary artifacts, so a
                  hung variant compile cannot cost them the window
-7. profile     — keys8/keys8f/lanes tile sweep
-8. overlap     — overlap-forest vs post-hoc global sort (the
+9. profile     — keys8/keys8f/lanes tile sweep
+10. overlap    — overlap-forest vs post-hoc global sort (the
                  network-levitated perf datum, scripts/bench_overlap.py)
 
 Stage order is the priority order; pass --stop-after N to cut the tail
 (the three take-ramp sizes count separately: --stop-after 6 = take16,
 take19, take22, bench_lanes, bench, regression — the primary
-artifacts, skipping the exploratory stages).
+artifacts; --stop-after 8 adds the ccsweep + pipeline_ab staged-lever
+re-probes, skipping the exploratory stages).
 
 Discipline encoded here (learned from the 2026-07-30 wedges):
 stages run strictly sequentially; a timed-out stage is killed as a
@@ -111,6 +124,18 @@ def main() -> int:
         ("regression", [py, "scripts/regression/run_regression.py",
                         "--platform", "ambient", "--size", "small",
                         "--out", os.path.join(args.log_dir, "ambient")],
+         3600, None),
+        # the staged-lever re-probes (pending since r05): cc-ladder +
+        # keys8f + lanes2 in their own budgeted subprocesses, then the
+        # staging-pipeline A/B on this host. sweep_carrychunk manages
+        # its own per-candidate budgets; the outer budget is the sum
+        # guard
+        ("ccsweep", [py, "scripts/sweep_carrychunk.py",
+                     "--log-dir", os.path.join(args.log_dir, "ccsweep")],
+         7200, None),
+        ("pipeline_ab", [py, "scripts/bench_pipeline.py", "--out",
+                         os.path.join(args.log_dir,
+                                      "BENCH_PIPELINE_hw.json")],
          3600, None),
         ("gatherprobe", [py, "scripts/probe_gather.py"], 1200, None),
         ("profile", [py, "scripts/profile_lanes.py"], 3600, None),

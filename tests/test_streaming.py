@@ -265,9 +265,9 @@ def test_backpressure_bounded_queue(tmp_path):
     om = OverlappedMerger(kt, 16, run_store=store, max_pending=2)
     orig_stage = om._stage
 
-    def slow_stage(i, src):
+    def slow_stage(i, src, fed_t):
         time.sleep(0.02)
-        orig_stage(i, src)
+        orig_stage(i, src, fed_t)
 
     om._stage = slow_stage
     batches = [crack(write_records(sorted(
@@ -347,9 +347,9 @@ def test_staging_pool_stress_parity(tmp_path):
             orig = om._stage
             delay = _random.Random(7)
 
-            def jitter_stage(i, src, _orig=orig, _d=delay):
+            def jitter_stage(i, src, fed_t, _orig=orig, _d=delay):
                 time.sleep(_d.random() * 0.004)
-                _orig(i, src)
+                _orig(i, src, fed_t)
 
             om._stage = jitter_stage
         for s, b in enumerate(batches):
@@ -370,7 +370,7 @@ def test_abort_with_full_queue_does_not_deadlock(tmp_path):
     # wedge the stager so the queue stays full
     import threading
     gate = threading.Event()
-    om._stage = lambda i, src: gate.wait(5)
+    om._stage = lambda i, src, fed_t: gate.wait(5)
     b = crack(write_records([(b"k", b"v")]))
     om.feed(0, b)
     om.feed(1, b)

@@ -31,6 +31,7 @@ from typing import Callable, Dict, Optional
 from uda_tpu.merger.segment import InputClient
 from uda_tpu.mofserver.data_engine import FetchResult, ShuffleRequest
 from uda_tpu.utils.errors import CompressionError, StorageError
+from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -360,6 +361,11 @@ class DecompressingClient(InputClient):
                 break
             body = bytes(data[pos + BLOCK_HEADER.size:
                               pos + BLOCK_HEADER.size + comp_len])
+            # injectable per decoded block (keyed "<map>@<wire offset>"):
+            # a decompress fault mid-pipeline must surface as this
+            # stream's terminal error and drain the stage pool cleanly
+            failpoint("decompress.block",
+                      key=f"{req.map_id}@{res.offset}")
             out += self.codec.decompress(body, raw_len)
             pos += BLOCK_HEADER.size + comp_len
         st.carry = bytes(data[pos:])

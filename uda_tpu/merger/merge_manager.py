@@ -37,7 +37,7 @@ from uda_tpu.merger.emitter import FramedEmitter
 from uda_tpu.merger.recovery import RecoveryLedger
 from uda_tpu.merger.segment import InputClient, Segment
 from uda_tpu.ops import merge as merge_ops
-from uda_tpu.utils.budget import MemoryBudget
+from uda_tpu.utils.budget import MemoryBudget, stage_inflight_cap
 from uda_tpu.utils.comparators import KeyType, get_key_type
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import FallbackSignal, MergeError, UdaError
@@ -397,10 +397,18 @@ class MergeManager:
     # -- merge phase --------------------------------------------------------
 
     def merge_segments(self, segments: Sequence[Segment]) -> RecordBatch:
-        """Device-merge all fetched segments into one sorted batch."""
+        """Device-merge all fetched segments into one sorted batch.
+        Routed by ``uda.tpu.merge.two_phase``: the two-phase device sort
+        (per-run partial sort + HBM-resident merge tree) or the
+        whole-shuffle re-sort — byte-identical either way."""
         batches = [s.record_batch() for s in segments]
         metrics.add("merge.records", sum(b.num_records for b in batches))
+        mode = merge_ops.resolve_merge_mode(
+            str(self.cfg.get("uda.tpu.merge.two_phase")), len(batches))
         with metrics.timer("merge"):
+            if mode == "two_phase":
+                return merge_ops.merge_batches_two_phase(
+                    batches, self.key_type, self.key_width)
             return merge_ops.merge_batches(batches, self.key_type,
                                            self.key_width)
 
@@ -608,11 +616,22 @@ class MergeManager:
         adm = self.last_admission
         bounded_device = (streaming and adm is not None
                           and adm.cause == "hbm")
+        # staged pipeline (uda.tpu.stage.pipeline, default on): stage
+        # pool + merge consumer with an in-flight byte budget; off =
+        # the serial stage loop (the A/B twin). Pool width:
+        # uda.tpu.stage.pool, else the legacy stagers knob, else auto.
+        pipelined = bool(self.cfg.get("uda.tpu.stage.pipeline"))
+        pool = int(self.cfg.get("uda.tpu.stage.pool"))
+        stagers = int(self.cfg.get("uda.tpu.online.stagers"))
         om = OverlappedMerger(
             self.key_type, self.key_width, run_store=store,
             max_pending=self.window if streaming else 0,
-            stagers=self.cfg.get("uda.tpu.online.stagers"),
-            device_runs=not bounded_device)
+            stagers=pool if (pipelined and pool > 0) else stagers,
+            device_runs=not bounded_device,
+            pipeline=pipelined,
+            inflight_bytes=stage_inflight_cap(
+                self.cfg, self.window, self.chunk_size,
+                budget=self._budget_obj))
         self._active_overlap = om  # observability (tests/diagnostics)
         try:
             # feed the Segment itself: record_batch() (a full concat of

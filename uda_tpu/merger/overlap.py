@@ -22,11 +22,40 @@ VPU) but a **log-structured run forest**:
 - ``finish()`` merges the O(log k) leftover runs, largest-capacity
   last, and gathers the final byte permutation on host.
 
+**Staging pipeline** (``pipeline=True``, the deployment default via
+``uda.tpu.stage.pipeline``): staging is a true fetch→decompress→pack→
+stage pipeline instead of one stage-a-whole-segment-at-a-time loop. A
+bounded pool of stage workers runs the host-side work — segment
+materialization (which includes the decompress tail and any pure-Python
+LZO blocks), vint-decode/pack, row-matrix build on reusable
+pre-allocated host buffers, run spooling — concurrently across
+DIFFERENT segments, while ONE merge consumer drains the staged-run
+queue: it dispatches ``jax.device_put`` of the next run while the
+device merges of the previous run are still executing (JAX dispatch is
+async; the consumer blocks only at accounting points — the host-buffer
+recycle after a transfer completes, and the finish drain). In-flight
+bytes are budgeted (``uda.tpu.stage.inflight.mb``): ``feed()`` blocks
+while fed-but-unmerged bytes would exceed the cap, which is the same
+credit-flow backpressure posture the bounded queue gives streaming mode
+(the reference's RDMA credit flow, MergeManager.cc:47-63). The serial
+path (``pipeline=False``) is kept verbatim as the correctness twin the
+A/B bench and the byte-identity tests diff against
+(scripts/bench_pipeline.py).
+
+``merge.wait_ms`` measures how long the merge waited for each run to
+become mergeable: feed()-to-staged latency (queue wait + decompress +
+pack + spool). Its complement is the ``feed()`` backpressure block
+(``stage.backpressure_events``) — together they say whether the device
+is starved by the host (high wait) or the host is throttled by the
+device (backpressure).
+
 Stability contract (identical to ops.merge.merge_batches): the device
 rows carry (key words, content length, segment index, row index) as the
 composite sort key, so equal comparator keys order by original (segment,
 row) arrival — independent of fetch COMPLETION order, which under a
-randomized fetch schedule is nondeterministic.
+randomized fetch schedule is nondeterministic. Pipelined and serial
+staging are byte-identical by construction for the same reason: forest
+insertion order never decides anything.
 
 Overflow fallback: keys whose content exceeds the carried width compare
 by overflow *rank*, which is only meaningful computed across ALL records
@@ -40,6 +69,7 @@ always stay on the fast path.
 from __future__ import annotations
 
 import functools
+import os
 import queue
 import threading
 import time
@@ -51,10 +81,10 @@ import numpy as np
 
 from uda_tpu.ops import merge as merge_ops
 from uda_tpu.ops import packing
-from uda_tpu.ops.pallas_merge import merge_sorted_pair
-from uda_tpu.utils.comparators import KeyType
+from uda_tpu.utils.comparators import KeyType, uses_default_bytewise
 from uda_tpu.utils.errors import MergeError
 from uda_tpu.utils.ifile import EOF_MARKER, RecordBatch
+from uda_tpu.utils.locks import TrackedCondition, TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -62,33 +92,15 @@ __all__ = ["OverlappedMerger", "MIN_RUN_CAPACITY"]
 
 log = get_logger()
 
-MIN_RUN_CAPACITY = 512  # smallest padded run (= default merge tile)
+MIN_RUN_CAPACITY = merge_ops.MIN_RUN_CAPACITY
 
-_PAD_WORD = np.uint32(0xFFFFFFFF)
+_PAD_WORD = merge_ops.PAD_WORD
 
+_next_pow2 = merge_ops.next_run_capacity
 
-def _next_pow2(n: int) -> int:
-    p = MIN_RUN_CAPACITY
-    while p < n:
-        p *= 2
-    return p
-
-
-def _rows_sorted(rows: np.ndarray) -> bool:
-    """Vectorized lexicographic monotonicity of uint32 rows: True when
-    every adjacent pair is non-decreasing under column-major priority
-    (O(n·k), the already-sorted fast path of _stage)."""
-    n = rows.shape[0]
-    if n < 2:
-        return True
-    a, b = rows[:-1], rows[1:]
-    # decided: a prior column already ordered the pair strictly
-    lt = a[:, 0] < b[:, 0]
-    eq = a[:, 0] == b[:, 0]
-    for c in range(1, rows.shape[1]):
-        lt = lt | (eq & (a[:, c] < b[:, c]))
-        eq = eq & (a[:, c] == b[:, c])
-    return bool(np.all(lt | eq))
+# widest per-key content the vectorized overflow lexsort materializes
+# as an n-by-width matrix; rarer/wider keys keep the comparator loop
+_LEXSORT_MAX_KEY = 4096
 
 
 class _Run:
@@ -103,19 +115,55 @@ class _Run:
 
     ``bucket`` is the binary-counter size class: staging assigns
     next_pow2(valid), each merge doubles it — so every record passes
-    through at most log2(k) merges regardless of engine.
+    through at most log2(k) merges regardless of engine. ``lease`` is
+    the pool-owned host buffer backing ``rows`` (host-engine pipeline
+    mode), recycled when this run merges into a larger one.
     """
 
-    __slots__ = ("rows", "valid", "bucket")
+    __slots__ = ("rows", "valid", "bucket", "lease")
 
-    def __init__(self, rows, valid: int, bucket: int):
+    def __init__(self, rows, valid: int, bucket: int, lease=None):
         self.rows = rows
         self.valid = valid
         self.bucket = bucket
+        self.lease = lease
 
     @property
     def capacity(self) -> int:
         return int(self.rows.shape[0])
+
+
+class _StagedRun:
+    """A stage worker's output awaiting the merge consumer: sorted host
+    rows (possibly a leased pool buffer), fed timestamp (the
+    merge.wait_ms anchor) and the in-flight byte charge it releases
+    once merged."""
+
+    __slots__ = ("seg_index", "rows", "valid", "lease", "fed_t", "charge")
+
+    def __init__(self, seg_index: int, rows, valid: int, lease,
+                 fed_t: float, charge: int):
+        self.seg_index = seg_index
+        self.rows = rows
+        self.valid = valid
+        self.lease = lease
+        self.fed_t = fed_t
+        self.charge = charge
+
+
+# Reusable pre-allocated host row buffers (ops.merge.RowBufferPool).
+# Pallas engine: stage workers lease, the merge consumer recycles once
+# the jax.device_put transfer completes. Host engine (pipeline mode):
+# staged runs AND merge outputs lease, each buffer recycled when its
+# run merges into a larger one — killing the per-merge large-alloc
+# page-fault churn that would otherwise dominate k*log2(k) merge
+# traffic on this class of host.
+_RowBufferPool = merge_ops.RowBufferPool
+
+# host-engine merges at/above this many output rows split across
+# threads at merge-path partition points (ops.merge.merge_rows_split_into)
+# — below it the split/join overhead beats the win
+_MERGE_SPLIT_MIN_ROWS = 1 << 18
 
 
 class OverlappedMerger:
@@ -128,11 +176,19 @@ class OverlappedMerger:
     only accelerator is the XLA CPU backend, whose interpret-mode Pallas
     emulation compiles an unrolled grid per shape), or "auto" (host on
     CPU, pallas elsewhere).
+
+    ``pipeline`` selects the staging architecture: False = the serial
+    stage-then-merge loop (one thread per ``stagers``, the r8 behavior
+    and the A/B baseline); True = the bounded stage pool + single merge
+    consumer (see module docstring). ``inflight_bytes`` > 0 bounds the
+    fed-but-unmerged bytes in either mode (feed() blocks — the
+    credit-flow backpressure).
     """
 
     def __init__(self, key_type: KeyType, width: int, engine: str = "auto",
                  run_store=None, max_pending: int = 0, stagers: int = 0,
-                 device_runs: bool = True):
+                 device_runs: bool = True, pipeline: bool = False,
+                 inflight_bytes: int = 0):
         self.key_type = key_type
         self.width = width
         # device_runs=False (streaming mode only): admission control
@@ -147,11 +203,7 @@ class OverlappedMerger:
         if not self.device_runs and run_store is None:
             raise MergeError("device_runs=False requires streaming mode "
                              "(a run store)")
-        if engine == "auto":
-            engine = "host" if jax.default_backend() == "cpu" else "pallas"
-        if engine not in ("host", "pallas"):
-            raise MergeError(f"unknown overlap merge engine {engine!r}")
-        self.engine = engine
+        self.engine = merge_ops.resolve_run_engine(engine)
         # off-TPU, a forced pallas engine runs in interpret mode
         self.interpret = jax.default_backend() == "cpu"
         # streaming mode (uda.tpu.online.streaming): segments spool to
@@ -172,6 +224,11 @@ class OverlappedMerger:
         self._error: Optional[Exception] = None
         self._merges = 0
         self._staged = 0
+        # in-flight bytes budget: feed() charges, the merge consumer
+        # (or the spool/drop path) releases; 0 = unbounded
+        self._inflight_cap = max(0, int(inflight_bytes))
+        self._inflight = 0
+        self._inflight_cv = TrackedCondition(TrackedLock("stage.inflight"))
         self._native_rows_merge = None
         if self.engine == "host":
             # the host merge path dispatches to the native row merge;
@@ -179,19 +236,57 @@ class OverlappedMerger:
             # carry runs under _forest_lock (a make inside the lock
             # would stall the whole staging pool) and the per-merge hot
             # path pays no imports
-            from uda_tpu import native
-            from uda_tpu.utils.ifile import native_enabled
-
-            if native_enabled() and native.build():
-                self._native_rows_merge = native.merge_rows_native
-        # staging pool (uda.tpu.online.stagers): pack+sort+spool of
-        # DIFFERENT segments parallelize; forest carries serialize under
-        # _forest_lock (the merge chain itself is one run at a time
-        # anyway). One thread when unset — the r4 behavior.
-        self._threads = [
-            threading.Thread(target=self._loop, daemon=True,
-                             name=f"uda-overlap-merge-{i}")
-            for i in range(max(1, stagers))]
+            self._native_rows_merge = merge_ops.resolve_native_rows_merge()
+        self.pipeline = bool(pipeline)
+        self._consumer_thread: Optional[threading.Thread] = None
+        if self.pipeline:
+            # bounded stage pool + single merge consumer. Pool width:
+            # explicit ``stagers`` wins; auto = a few workers (staging
+            # is numpy-heavy and releases the GIL, so width ~ cores).
+            width_auto = max(2, min(4, os.cpu_count() or 2))
+            nworkers = stagers if stagers > 0 else width_auto
+            # staged-run queue is bounded: a slow device consumer
+            # backpressures the workers (and, through the in-flight
+            # budget, the transports feeding feed())
+            self._staged_q: "queue.Queue" = queue.Queue(maxsize=nworkers + 2)
+            # host-buffer reuse where ownership hands off cleanly:
+            # pallas = rows are COPIED to the device (recycle after the
+            # transfer; interpret-mode device_put may alias numpy memory,
+            # so it owns its arrays), host+native = staged runs AND
+            # merge outputs lease (recycle when a run merges away), and
+            # large host merges split across threads at merge-path
+            # partition points — the merge half of the pipeline uses
+            # the cores the stage half leaves idle
+            self._buf_pool = None
+            self._merge_parts = 1
+            if self.engine == "pallas" and not self.interpret:
+                self._buf_pool = _RowBufferPool()
+            elif (self.engine == "host"
+                  and self._native_rows_merge is not None):
+                self._buf_pool = _RowBufferPool()
+                self._merge_parts = max(2, min(4, os.cpu_count() or 2))
+            self._workers = [
+                threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"uda-stage-w{i}")
+                for i in range(nworkers)]
+            self._consumer_thread = threading.Thread(
+                target=self._consumer_loop, daemon=True,
+                name="uda-overlap-merge")
+            self._threads = self._workers + [self._consumer_thread]
+        else:
+            # serial staging (uda.tpu.online.stagers): pack+sort+spool
+            # of DIFFERENT segments parallelize; forest carries
+            # serialize under _forest_lock (the merge chain itself is
+            # one run at a time anyway). One thread when unset — the r4
+            # behavior.
+            self._staged_q = None
+            self._buf_pool = None
+            self._merge_parts = 1
+            self._workers = [
+                threading.Thread(target=self._loop, daemon=True,
+                                 name=f"uda-overlap-merge-{i}")
+                for i in range(max(1, stagers))]
+            self._threads = list(self._workers)
         for t in self._threads:
             t.start()
 
@@ -201,26 +296,89 @@ class OverlappedMerger:
         """Stage one completed segment's records (safe to call from a
         transport completion thread). ``source`` is either a RecordBatch
         or an object with a ``record_batch()`` method (a Segment) —
-        materialization happens on the merge thread. With a bounded
-        queue (streaming mode) this call BLOCKS when staging lags, which
+        materialization happens on a stage thread. This call BLOCKS when
+        staging lags — on the bounded queue (streaming mode) and on the
+        in-flight bytes budget (``uda.tpu.stage.inflight.mb``) — which
         is the intended backpressure: the transport thread holds off
         until host memory frees (the reference's RDMA credit-flow
         posture, MergeManager.cc:47-63)."""
+        charge = self._charge(source)
+        if charge < 0:
+            return  # aborted while waiting on the budget
+        item = (seg_index, source, time.perf_counter(), charge)
         if self._q.maxsize <= 0:
-            self._q.put((seg_index, source))
-            return
-        while not self._aborted:
-            try:
-                self._q.put((seg_index, source), timeout=0.1)
-                return
-            except queue.Full:
-                continue
+            self._q.put(item)
+        else:
+            while True:
+                if self._aborted:
+                    self._release_charge(charge)
+                    return
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+        if self._aborted:
+            # the put may have raced abort(): _charge() saw the flag
+            # unset, abort() then drained _q (threads already joined)
+            # before our item landed — nothing would ever release its
+            # charge. Re-drain: either a still-live worker consumed the
+            # item (drain is a no-op) or we reap it here; a queue item
+            # is consumed exactly once, so the charge releases exactly
+            # once either way.
+            self._reap_input_queue()
 
-    # -- merge thread --------------------------------------------------------
+    @staticmethod
+    def _source_bytes(source) -> int:
+        """Best-effort byte size of a fed segment for the in-flight
+        budget: a Segment's raw_length (uncompressed record bytes), a
+        RecordBatch's buffer size."""
+        raw = getattr(source, "raw_length", None)
+        if raw:
+            return int(raw)
+        data = getattr(source, "data", None)
+        if data is not None:
+            return int(len(data))
+        return 0
+
+    def _charge(self, source) -> int:
+        """Charge the segment against the in-flight budget, blocking
+        (abort-responsive) while over it. Returns the charged bytes, or
+        -1 when the merger aborted during the wait. A single oversized
+        segment is admitted when nothing else is in flight (the same
+        escape the supplier read budget has) — the budget bounds
+        concurrency, it never wedges progress."""
+        if self._inflight_cap <= 0:
+            return 0
+        charge = self._source_bytes(source)
+        if charge <= 0:
+            return 0
+        blocked = False
+        with self._inflight_cv:
+            while (not self._aborted and self._inflight > 0
+                   and self._inflight + charge > self._inflight_cap):
+                if not blocked:
+                    blocked = True
+                    metrics.add("stage.backpressure_events")
+                self._inflight_cv.wait(timeout=0.1)
+            if self._aborted:
+                return -1
+            self._inflight += charge
+        metrics.gauge_add("stage.inflight.bytes", charge)
+        return charge
+
+    def _release_charge(self, charge: int) -> None:
+        if charge <= 0:
+            return
+        with self._inflight_cv:
+            self._inflight -= charge
+            self._inflight_cv.notify_all()
+        metrics.gauge_add("stage.inflight.bytes", -charge)
+
+    # -- serial merge thread (pipeline=False; the A/B baseline) -------------
 
     def _loop(self) -> None:
         with metrics.use_span(self._parent_span):
-            wait_t0 = time.perf_counter()
             while True:
                 try:
                     item = self._q.get(timeout=0.25)
@@ -230,20 +388,119 @@ class OverlappedMerger:
                     continue
                 if item is None:
                     return
-                # merge-wait: how long this stager idled for a completed
-                # segment (the fetch-bound signal; its complement is the
-                # feed() backpressure block, the staging-bound signal)
-                metrics.observe(
-                    "merge.wait_ms",
-                    (time.perf_counter() - wait_t0) * 1e3)
+                seg_index, source, fed_t, charge = item
                 if self._error is not None or self._aborted:
-                    wait_t0 = time.perf_counter()
+                    self._release_charge(charge)
                     continue  # drain; finish() will surface the error
                 try:
-                    self._stage(*item)
+                    self._stage(seg_index, source, fed_t)
                 except Exception as e:  # surfaced at finish()
                     self._error = e
-                wait_t0 = time.perf_counter()
+                finally:
+                    self._release_charge(charge)
+
+    def _stage(self, seg_index: int, source, fed_t: float) -> None:
+        staged = self._prepare(seg_index, source, fed_t)
+        if staged is None:
+            return
+        self._observe_wait(fed_t)
+        self._consume_run(staged)
+
+    # -- pipelined staging (pipeline=True) -----------------------------------
+
+    def _worker_loop(self) -> None:
+        """Stage worker: decompress/materialize + pack + row build +
+        spool for ONE segment at a time, concurrently across workers;
+        finished runs queue for the merge consumer."""
+        with metrics.use_span(self._parent_span):
+            while True:
+                try:
+                    item = self._q.get(timeout=0.25)
+                except queue.Empty:
+                    if self._aborted:
+                        return
+                    continue
+                if item is None:
+                    return
+                seg_index, source, fed_t, charge = item
+                if self._error is not None or self._aborted:
+                    self._release_charge(charge)
+                    continue
+                try:
+                    staged = self._prepare(seg_index, source, fed_t)
+                except Exception as e:  # surfaced at finish()
+                    self._error = e
+                    self._release_charge(charge)
+                    continue
+                if staged is None:
+                    self._release_charge(charge)
+                    continue
+                staged.charge = charge
+                self._put_staged(staged)
+
+    def _put_staged(self, staged: _StagedRun) -> None:
+        while not self._aborted:
+            try:
+                self._staged_q.put(staged, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+        self._discard(staged)
+
+    def _consumer_loop(self) -> None:
+        """The merge loop as a consumer of staged runs: device_put of
+        the next run is dispatched while the previous run's merges are
+        still executing (async dispatch); the forest carry serializes
+        here, which also makes _forest_lock uncontended in pipeline
+        mode."""
+        with metrics.use_span(self._parent_span):
+            while True:
+                try:
+                    staged = self._staged_q.get(timeout=0.25)
+                except queue.Empty:
+                    if self._aborted:
+                        return
+                    continue
+                if staged is None:
+                    return
+                if self._error is not None or self._aborted:
+                    self._discard(staged)
+                    continue
+                try:
+                    self._observe_wait(staged.fed_t)
+                    self._consume_run(staged)
+                    metrics.add("merge.pipeline.runs")
+                except Exception as e:  # surfaced at finish()
+                    self._error = e
+                    self._recycle(staged)
+                finally:
+                    self._release_charge(staged.charge)
+                    staged.charge = 0
+
+    def _discard(self, staged: _StagedRun) -> None:
+        """Drop a staged run without merging (abort/error drain):
+        release its budget charge and recycle its buffer lease."""
+        self._release_charge(staged.charge)
+        staged.charge = 0
+        self._recycle(staged)
+
+    def _recycle(self, staged: _StagedRun) -> None:
+        if staged.lease is not None and self._buf_pool is not None:
+            self._buf_pool.release(staged.lease)
+        staged.lease = None
+
+    @staticmethod
+    def _observe_wait(fed_t: float) -> None:
+        # merge-wait: how long the merge waited for this run to become
+        # mergeable after its segment was fed (queue wait + decompress
+        # tail + pack + spool). Its complement is the feed()
+        # backpressure block (stage.backpressure_events): high wait =
+        # the device is starved by the host, backpressure = the host is
+        # throttled by the device.
+        metrics.observe("merge.wait_ms",
+                        (time.perf_counter() - fed_t) * 1e3)
+
+    # -- staging ------------------------------------------------------------
 
     @staticmethod
     def _release(source) -> None:
@@ -253,75 +510,126 @@ class OverlappedMerger:
         if release is not None:
             release()
 
-    def _stage(self, seg_index: int, source) -> None:
+    def _prepare(self, seg_index: int, source,
+                 fed_t: float) -> Optional[_StagedRun]:
+        """The host half of staging: materialize (the decompress tail
+        runs here for Segment sources), pack, per-run sort, spool.
+        Returns the device-bound staged run, or None when nothing needs
+        the forest (empty segment, spool-only modes, overflow)."""
         streaming = self.run_store is not None
         if self._overflow and not streaming:
-            return  # fast path already disabled; finish() re-sorts all
+            return None  # fast path already disabled; finish() re-sorts
         batch = (source if isinstance(source, RecordBatch)
                  else source.record_batch())
-        if batch.num_records == 0:
+        n = batch.num_records
+        if n == 0:
             if streaming:
                 self._release(source)
-            return
+            return None
         with metrics.timer("overlap_pack"):
             packed = packing.pack_keys(batch, self.key_type, self.width)
-        n = batch.num_records
         kw = packed.key_words.shape[1]
+        metrics.add("stage.bytes",
+                    int(batch.key_len.sum() + batch.val_len.sum()))
         if int(np.max(packed.key_lens, initial=0)) > self.width:
             # rank-bearing keys: cross-run rank consistency needs the
             # global view; disable the fast path (see module docstring)
             self._overflow = True
             if not streaming:
-                return
+                return None
             # streaming keeps spooling: this run is ordered by the FULL
-            # comparator (rare, per-record Python), so finish falls back
-            # to the comparator-level k-way merge over the run files —
-            # still O(window) host memory
-            cmp = self.key_type.compare
-            keys = [batch.key(i) for i in range(n)]
-            order = np.asarray(sorted(range(n), key=functools.cmp_to_key(
-                lambda i, j: cmp(keys[i], keys[j]) or (i - j))), np.int64)
+            # comparator, so finish falls back to the comparator-level
+            # k-way merge over the run files — still O(window) host
+            # memory
+            order = self._overflow_order(batch, n)
             self.run_store.write_run(seg_index, batch, order)
             with self._state_lock:
                 self._staged += 1
             metrics.add("merge.records", n)
+            self._observe_wait(fed_t)
             self._release(source)
-            return
-        # device runs pad to a power-of-two capacity (bounded set of
-        # kernel shapes); host runs stay exact-sized
-        cap = _next_pow2(n) if self.engine == "pallas" else n
-        rows = np.full((cap, kw + 3), _PAD_WORD, np.uint32)
-        rows[:n, :kw] = packed.key_words
-        rows[:n, kw] = packed.key_lens.astype(np.uint32)
-        rows[:n, kw + 1] = np.uint32(seg_index)
-        rows[:n, kw + 2] = np.arange(n, dtype=np.uint32)
-        # per-segment sort on host key order. Hadoop map outputs arrive
-        # ALREADY comparator-sorted (the map-side sort contract the
-        # reference's merge leaned on — it never re-sorted segments,
-        # MergeManager.cc:47-63), and for within-width keys comparator
-        # order == (words, len) order, so an O(n·k) monotonicity check
-        # usually replaces the O(n log n) lexsort — the staging hot
-        # path collapses to pack+spool at memory bandwidth. Unsorted
-        # input (exchange-path buckets, foreign writers) still sorts.
-        if _rows_sorted(rows[:n, :kw + 1]):
-            order = np.arange(n, dtype=np.int64)
-        else:
-            order = np.lexsort(tuple(rows[:n, c]
-                                     for c in range(kw, -1, -1)))
-            rows[:n] = rows[:n][order]
+            return None
+        # per-segment sort on host key order: Hadoop map outputs arrive
+        # ALREADY comparator-sorted (the map-side sort contract), and
+        # for within-width keys comparator order == (words, len) order,
+        # so the O(n·k) monotonicity check usually replaces the
+        # O(n log n) lexsort (run_row_order) — the staging hot path
+        # collapses to pack+spool at memory bandwidth. Unsorted input
+        # (exchange-path buckets, foreign writers) still sorts.
+        order = merge_ops.run_row_order(packed)
         if streaming:
-            self.run_store.write_run(seg_index, batch,
-                                     order.astype(np.int64))
+            spool_order = (np.arange(n, dtype=np.int64) if order is None
+                           else order)
+            self.run_store.write_run(seg_index, batch, spool_order)
             self._release(source)
         with self._state_lock:
             self._staged += 1
         metrics.add("merge.records", n)
         if self._overflow or not self.device_runs:
-            return  # forest output won't be consumed; runs are enough
+            self._observe_wait(fed_t)
+            return None  # forest output won't be consumed; runs suffice
+        # device runs pad to a power-of-two capacity (bounded set of
+        # kernel shapes); host runs stay exact-sized
+        cap = _next_pow2(n) if self.engine == "pallas" else n
+        lease = None
+        if self._buf_pool is not None:
+            lease = self._buf_pool.lease(cap, kw + merge_ops.ROW_EXTRA_COLS)
+            rows = lease
+        else:
+            rows = np.empty((cap, kw + merge_ops.ROW_EXTRA_COLS), np.uint32)
+        merge_ops.fill_run_rows(rows, packed, order, seg_index)
+        return _StagedRun(seg_index, rows, n, lease, fed_t, 0)
+
+    def _overflow_order(self, batch: RecordBatch, n: int) -> np.ndarray:
+        """Full-comparator sort order for an oversize-key run. Default
+        bytewise comparators vectorize: memcmp-with-shorter-is-smaller
+        order == lexsort over (zero-padded content bytes, content
+        length) — no O(n log n) interpreter-level compares on the hot
+        path. A custom ``compare`` override (or pathologically wide
+        keys) keeps the comparator-faithful cmp_to_key path."""
+        kt = self.key_type
+        if uses_default_bytewise(kt):
+            contents = [kt.content(batch.key(i)) for i in range(n)]
+            lens = np.fromiter((len(c) for c in contents),
+                               np.int64, count=n)
+            width = int(lens.max(initial=0))
+            if 0 < width <= _LEXSORT_MAX_KEY:
+                mat = np.zeros((n, width), np.uint8)
+                for i, c in enumerate(contents):
+                    mat[i, :len(c)] = np.frombuffer(c, np.uint8)
+                cols = [mat[:, j] for j in range(width)] + [lens]
+                # np.lexsort is stable -> ties keep arrival order, the
+                # same (i - j) tiebreak the comparator path applies
+                return np.lexsort(tuple(reversed(cols))).astype(np.int64)
+        cmp = kt.compare
+        keys = [batch.key(i) for i in range(n)]
+        return np.asarray(sorted(range(n), key=functools.cmp_to_key(
+            lambda i, j: cmp(keys[i], keys[j]) or (i - j))), np.int64)
+
+    def _consume_run(self, staged: _StagedRun) -> None:
+        """The device half of staging: transfer + forest insert. The
+        merges this triggers dispatch asynchronously; the only block is
+        the transfer completion that frees a leased host buffer."""
+        rows = staged.rows
         with metrics.timer("overlap_stage"):
             if self.engine == "pallas":
-                rows = jax.device_put(rows)
-            self._insert(_Run(rows, n, _next_pow2(n)))
+                dev = jax.device_put(rows)
+                if staged.lease is not None:
+                    # accounting point: the host buffer may only be
+                    # reused once the transfer is done. Merges of the
+                    # PREVIOUS run keep executing under this wait.
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(dev)
+                    metrics.observe("merge.pipeline.put_ms",
+                                    (time.perf_counter() - t0) * 1e3)
+                    self._recycle(staged)
+                rows = dev
+            # host engine: the run KEEPS its pool lease (recycled when
+            # it merges away); ownership moves to the _Run so an
+            # error-path _recycle can never double-release it
+            lease, staged.lease = staged.lease, None
+            self._insert(_Run(rows, staged.valid, _next_pow2(staged.valid),
+                              lease=lease))
 
     def _insert(self, run: _Run) -> None:
         # binary-counter carry: equal size classes merge immediately.
@@ -336,32 +644,35 @@ class OverlappedMerger:
     def _merge(self, a: _Run, b: _Run) -> _Run:
         bucket = 2 * max(a.bucket, b.bucket)
         with metrics.timer("overlap_device_merge"):
-            if self.engine == "host":
-                # linear two-pointer native merge when built (ties to
-                # `a` = the earlier run, preserving the composite-key
-                # stability); lexsort of the concatenation otherwise
-                merged = None
-                if self._native_rows_merge is not None:
-                    merged = self._native_rows_merge(
-                        np.asarray(a.rows[:a.valid]),
-                        np.asarray(b.rows[:b.valid]))
-                if merged is None:
-                    rows = np.concatenate(
-                        [a.rows[:a.valid], b.rows[:b.valid]])
-                    order = np.lexsort(tuple(
-                        rows[:, c]
-                        for c in range(rows.shape[1] - 1, -1, -1)))
-                    merged = rows[order]
-            else:
-                # every column is part of the composite key (words, len,
-                # seg, row) — rows are totally ordered, so the kernel's
-                # internal tie-break never decides anything
-                merged = merge_sorted_pair(a.rows, b.rows,
-                                           num_keys=int(a.rows.shape[1]),
-                                           interpret=self.interpret)
+            merged, lease = self._merge_rows(a, b)
         with self._state_lock:
             self._merges += 1
-        return _Run(merged, a.valid + b.valid, bucket)
+        return _Run(merged, a.valid + b.valid, bucket, lease)
+
+    def _merge_rows(self, a: _Run, b: _Run):
+        """One pairwise run merge. Host engine in pipeline mode merges
+        into a pool-leased output buffer (no per-merge large-alloc
+        page faults) and splits large merges across threads at
+        merge-path partition points (the native call releases the GIL);
+        the inputs' leases recycle immediately. Every other
+        engine/mode keeps the plain merge_row_pair path."""
+        if self.engine == "host" and self._buf_pool is not None:
+            total = a.valid + b.valid
+            out = self._buf_pool.lease(total, int(a.rows.shape[1]))
+            parts = (self._merge_parts
+                     if total >= _MERGE_SPLIT_MIN_ROWS else 1)
+            if merge_ops.merge_rows_split_into(
+                    a.rows[:a.valid], b.rows[:b.valid], out, parts):
+                self._buf_pool.release(a.lease)
+                self._buf_pool.release(b.lease)
+                a.lease = b.lease = None
+                return out, out
+            self._buf_pool.release(out)  # native .so went missing
+        merged = merge_ops.merge_row_pair(
+            a.rows, b.rows, a.valid, b.valid, self.engine,
+            interpret=self.interpret,
+            native_merge=self._native_rows_merge)
+        return merged, None
 
     # -- consumer side -------------------------------------------------------
 
@@ -369,15 +680,54 @@ class OverlappedMerger:
     def stats(self) -> dict:
         """Counters for observability/tests: merges that have completed
         and segments staged so far (both monotone)."""
+        pending = self._q.qsize()
+        if self._staged_q is not None:
+            pending += self._staged_q.qsize()
         return {"device_merges": self._merges, "staged_runs": self._staged,
-                "pending": self._q.qsize(), "overflow": self._overflow}
+                "pending": pending, "overflow": self._overflow,
+                "pipeline": self.pipeline,
+                "inflight_bytes": self._inflight}
+
+    def _reap_input_queue(self) -> None:
+        """Release the budget charge of every item still in the input
+        queue. Safe concurrently with live workers (each item is
+        consumed exactly once — by a worker or by this drain, never
+        both)."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._release_charge(item[3])
+
+    def _reap_pending(self) -> None:
+        """With every stage thread stopped, anything still queued holds
+        budget charges (and possibly buffer leases): release them so an
+        abort/error drain never leaks in-flight bytes (the gauge must
+        return to zero)."""
+        self._reap_input_queue()
+        if self._staged_q is None:
+            return
+        while True:
+            try:
+                staged = self._staged_q.get_nowait()
+            except queue.Empty:
+                break
+            if staged is not None:
+                self._discard(staged)
 
     def _drain(self) -> None:
         """Signal end of input and wait for staging to finish."""
-        for _ in self._threads:
+        for _ in self._workers:
             self._q.put(None)
-        for t in self._threads:
+        for t in self._workers:
             t.join()
+        if self._consumer_thread is not None:
+            self._staged_q.put(None)
+            self._consumer_thread.join()
+        # error paths drop their items without consuming them
+        self._reap_pending()
         if self._error is not None:
             raise self._error
 
@@ -395,11 +745,8 @@ class OverlappedMerger:
         acc = runs[0]
         for nxt in runs[1:]:
             if self.engine == "pallas" and acc.capacity < nxt.capacity:
-                pad = np.full((nxt.capacity - acc.capacity,
-                               int(acc.rows.shape[1])), _PAD_WORD, np.uint32)
-                acc = _Run(jnp.concatenate(
-                    [acc.rows, jax.device_put(pad)], axis=0), acc.valid,
-                    acc.bucket)
+                acc = _Run(merge_ops.pad_rows_to(acc.rows, nxt.capacity),
+                           acc.valid, acc.bucket)
             acc = self._merge(acc, nxt)
         return acc
 
@@ -541,22 +888,35 @@ class OverlappedMerger:
     def abort(self) -> None:
         """Stop the staging threads without producing output. Safe with
         a bounded queue: ``_aborted`` unblocks any transport thread
-        waiting in feed() and makes the stager loops drain-and-exit even
-        if no poison pill can land (they poll the flag on an empty
-        queue). The run store is only cleaned once every stager has
-        stopped — never under a concurrent write_run."""
+        waiting in feed() (queue OR in-flight budget) and makes the
+        stage loops drain-and-exit even if no poison pill can land (they
+        poll the flag on an empty queue). Queued items' budget charges
+        and buffer leases are reaped once every thread has stopped — an
+        abort never leaks in-flight bytes. The run store is only cleaned
+        once every stager has stopped — never under a concurrent
+        write_run."""
         self._aborted = True
         try:
             self._q.put_nowait(None)  # best effort: wake one instantly
         except queue.Full:
             pass
+        if self._staged_q is not None:
+            try:
+                self._staged_q.put_nowait(None)
+            except queue.Full:
+                pass
+        with self._inflight_cv:
+            self._inflight_cv.notify_all()  # wake budget-blocked feeds
         deadline = 10.0
         for t in self._threads:
             t0 = time.monotonic()
             t.join(timeout=max(0.1, deadline))
             deadline -= time.monotonic() - t0
+        stragglers = any(t.is_alive() for t in self._threads)
+        if not stragglers:
+            self._reap_pending()
         if self.run_store is not None:
-            if any(t.is_alive() for t in self._threads):
+            if stragglers:
                 log.warn("overlap abort: stager still running; leaving "
                          "scratch runs for it to fail safely")
             else:

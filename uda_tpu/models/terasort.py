@@ -32,7 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from uda_tpu.ops import pallas_sort
-from uda_tpu.ops.sort import resolve_sort_path
+from uda_tpu.ops.sort import route_engine
 from uda_tpu.parallel.distributed import (DistributedSortResult,
                                           distributed_sort_step,
                                           uniform_splitters)
@@ -160,9 +160,11 @@ def single_chip_sort(words: jax.Array, path: str = "auto",
     (per-column gathers / one minor-dim gather / chunked carry sorts —
     "carrychunk" is the TPU default via "auto": measured fly-off
     champion, BENCH_HW_r05.json). "auto" resolves per the ambient
-    backend at call time (resolve_sort_path).
+    backend — and the deployed UDA_TPU_SORT_PATH winner — at call time,
+    with small batches steered off gather-bound engines
+    (ops.sort.route_engine).
     """
-    path = resolve_sort_path(path, lanes_ok=True)
+    path = route_engine(int(words.shape[0]), path, lanes_ok=True)
     if path in ("lanes", "lanes2", "keys8", "keys8f"):
         if int(words.shape[0]) == 0:
             return jnp.asarray(words, jnp.uint32)

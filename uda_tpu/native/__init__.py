@@ -405,6 +405,31 @@ def merge_rows_native(a: np.ndarray, b: np.ndarray) -> Optional[np.ndarray]:
     return out
 
 
+def merge_rows_native_into(a: np.ndarray, b: np.ndarray,
+                           out: np.ndarray) -> bool:
+    """merge_rows_native writing into a caller-owned ``out`` buffer
+    (must be C-contiguous uint32 with a.shape[0]+b.shape[0] rows).
+    Reusing merge outputs matters on this path: the overlap forest's
+    merge traffic is k*log2(k) segment-loads, and a fresh np.empty per
+    merge page-faults every output byte (the PR 6 large-alloc lesson) —
+    the staging pipeline leases outputs from a buffer pool instead.
+    Returns False when the native library isn't available."""
+    lib = _load()
+    if lib is None:
+        return False
+    assert a.flags.c_contiguous and b.flags.c_contiguous \
+        and out.flags.c_contiguous
+    assert out.shape[0] == a.shape[0] + b.shape[0] \
+        and out.shape[1] == a.shape[1] == b.shape[1]
+
+    def u32(arr):
+        return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+    lib.uda_merge_rows(u32(a), a.shape[0], u32(b), b.shape[0],
+                       a.shape[1], u32(out))
+    return True
+
+
 class ReadPool:
     """Async read pool over the native worker threads — the AIOHandler
     submit/get_events contract (reference AIOHandler.cc:122-235)."""

@@ -11,23 +11,291 @@ and gathers bytes at emission.
 correctness oracle the device path is diffed against, and (b) as the
 actual merge path when no accelerator is present — mirroring the
 reference's fallback-to-vanilla philosophy (SURVEY §5) inside the engine.
+
+``merge_batches_two_phase`` is the TopSort-shaped alternative
+(arXiv:2205.07991: structure the sorter around HBM bandwidth, not
+compute): instead of re-sorting the concatenation of k sorted runs —
+O(n log n) compare-exchange over the whole shuffle — each run is
+partially sorted on its own (usually just the monotonicity check: Hadoop
+map outputs arrive comparator-sorted) and the runs then fold through an
+HBM-resident pairwise merge tree (the O(n log k) merge-path kernel /
+native linear merge), so every record moves through at most log2(k)
+merges and the gather-bound small-batch regime never pays a whole-
+shuffle re-sort. The row-building helpers here are shared with the
+overlapped merger (uda_tpu.merger.overlap), which is the same merge
+tree fed online.
 """
 
 from __future__ import annotations
 
 import functools
 import heapq
+import threading
 from typing import Iterator, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from uda_tpu.ops import packing, sort
+from uda_tpu.ops.pallas_merge import merge_sorted_pair
 from uda_tpu.utils.comparators import KeyType
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.metrics import metrics
 
 __all__ = ["merge_batches", "merge_batches_host", "merge_iter_host",
-           "merge_record_streams", "sorted_batch_order"]
+           "merge_record_streams", "sorted_batch_order",
+           "merge_batches_two_phase", "resolve_merge_mode",
+           "resolve_run_engine", "resolve_native_rows_merge",
+           "lex_cols_sorted", "run_row_order", "fill_run_rows",
+           "merge_row_pair", "merge_split_point", "merge_rows_split_into",
+           "RowBufferPool", "next_run_capacity", "pad_rows_to",
+           "PAD_WORD", "MIN_RUN_CAPACITY", "ROW_EXTRA_COLS"]
+
+# -- shared run-row machinery (the overlap forest + two-phase merge) --------
+
+# Padding word for device runs: all-0xFFFFFFFF rows sort strictly after
+# every real row (a real row's length column is a content length < 2^31),
+# so valid rows stay a prefix through any merge.
+PAD_WORD = np.uint32(0xFFFFFFFF)
+
+MIN_RUN_CAPACITY = 512  # smallest padded run (= default merge tile)
+
+# composite-key columns appended after the key words:
+# (content length, segment index, row index)
+ROW_EXTRA_COLS = 3
+
+
+def next_run_capacity(n: int) -> int:
+    """Smallest power-of-two run capacity >= n (>= MIN_RUN_CAPACITY):
+    bounds the set of pallas merge-kernel shapes to O(log) per job."""
+    p = MIN_RUN_CAPACITY
+    while p < n:
+        p *= 2
+    return p
+
+
+def resolve_run_engine(engine: str) -> str:
+    """Resolve the pairwise run-merge backend: "pallas" (the device
+    merge-path kernel), "host" (vectorized numpy/native merge — the
+    correctness twin, and the fast choice on the XLA CPU backend), or
+    "auto" (host on CPU, pallas elsewhere)."""
+    if engine == "auto":
+        return "host" if jax.default_backend() == "cpu" else "pallas"
+    if engine not in ("host", "pallas"):
+        from uda_tpu.utils.errors import MergeError
+
+        raise MergeError(f"unknown run merge engine {engine!r}")
+    return engine
+
+
+def resolve_native_rows_merge():
+    """The native linear two-pointer row merge when built, else None.
+    Resolved ONCE per consumer so a cold .so compiles before any merge
+    runs under a forest lock (a make inside the lock would stall the
+    whole staging pool)."""
+    from uda_tpu import native
+    from uda_tpu.utils.ifile import native_enabled
+
+    if native_enabled() and native.build():
+        return native.merge_rows_native
+    return None
+
+
+def merge_split_point(a_rows: np.ndarray, b_rows: np.ndarray,
+                      m: int) -> int:
+    """Merge-path partition with the ties-to-``a`` rule: the unique
+    ``ia`` (with ``ib = m - ia``) such that the first ``m`` rows of the
+    stable merge are exactly ``merge(a[:ia], b[:ib])`` — i.e.
+    ``a[ia-1] <= b[ib]`` (a tie sends the ``a`` row first, so equality
+    keeps it in the prefix) and ``b[ib-1] < a[ia]`` (an equal ``a`` row
+    would precede, so the ``b`` prefix row must be strictly smaller).
+    O(log n) full-row lexicographic compares; used to split one large
+    pairwise merge across threads without breaking stability."""
+    na, nb = int(a_rows.shape[0]), int(b_rows.shape[0])
+    lo, hi = max(0, m - nb), min(na, m)
+    while lo < hi:
+        ia = (lo + hi) // 2
+        ib = m - ia
+        # a[ia] <= b[ib-1]: that a row ties-or-precedes the b prefix
+        # row, so it belongs in the prefix too -> ia is too small
+        if ia < na and ib > 0 and tuple(a_rows[ia]) <= tuple(b_rows[ib - 1]):
+            lo = ia + 1
+        else:
+            hi = ia
+    return lo
+
+
+def merge_rows_split_into(a_rows: np.ndarray, b_rows: np.ndarray,
+                          out: np.ndarray, parts: int = 2) -> bool:
+    """Native linear merge of two sorted row runs into a caller-owned
+    ``out`` buffer, split across ``parts`` threads at merge-path
+    partition points (each part is an independent contiguous-slice
+    merge; the native call releases the GIL, so parts genuinely run in
+    parallel). Stability (ties to ``a``) is preserved by construction —
+    see :func:`merge_split_point`. Returns False when the native
+    library isn't built (caller falls back); single-part calls degrade
+    to one plain native merge."""
+    from uda_tpu import native
+
+    na, nb = int(a_rows.shape[0]), int(b_rows.shape[0])
+    total = na + nb
+    parts = max(1, min(int(parts), max(1, total)))
+    if parts == 1:
+        return native.merge_rows_native_into(a_rows, b_rows, out)
+    if not native.available():
+        return False
+    cuts_a = [0]
+    for p in range(1, parts):
+        cuts_a.append(merge_split_point(a_rows, b_rows, total * p // parts))
+    cuts_a.append(na)
+    # every part reports into ok: a part whose native call refuses
+    # (e.g. the .so momentarily unloaded by a concurrent rebuild) left
+    # stale pool-lease bytes in its out slice — the caller MUST fall
+    # back, so a single False fails the whole split
+    ok = [False] * parts
+
+    def _part(idx: int, a: np.ndarray, b: np.ndarray, o: np.ndarray):
+        ok[idx] = bool(native.merge_rows_native_into(a, b, o))
+
+    threads = []
+    for p in range(parts):
+        mlo = total * p // parts if p else 0
+        mhi = total * (p + 1) // parts if p < parts - 1 else total
+        alo, ahi = cuts_a[p], cuts_a[p + 1]
+        blo, bhi = mlo - alo, mhi - ahi
+        args = (p, a_rows[alo:ahi], b_rows[blo:bhi], out[mlo:mhi])
+        if p < parts - 1:
+            t = threading.Thread(target=_part, args=args, daemon=True)
+            t.start()
+            threads.append(t)
+        else:
+            _part(*args)  # last part inline
+    for t in threads:
+        t.join()
+    return all(ok)
+
+
+class RowBufferPool:
+    """Reusable pre-allocated host uint32 row buffers.
+
+    Two hot paths lease from it: stage workers building device-bound
+    row matrices (recycled once the jax.device_put transfer completes)
+    and the host-engine pipeline's merge outputs (recycled when the run
+    merges into a larger one) — the forest's merge traffic is
+    k*log2(k) segment-loads, and a fresh np.empty per merge would
+    page-fault every output byte (the PR 6 large-alloc lesson).
+    Buffers are flat uint32 arrays reshaped per lease, so one big
+    early buffer serves every later exact-size request; the free list
+    is bounded so a pathological size spread cannot hoard host
+    memory."""
+
+    MAX_FREE = 8
+
+    def __init__(self, lock_class: str = "stage.bufpool"):
+        from uda_tpu.utils.locks import TrackedLock
+
+        self._lock = TrackedLock(lock_class)
+        self._free: list[np.ndarray] = []
+
+    def lease(self, rows: int, cols: int) -> np.ndarray:
+        need = rows * cols
+        with self._lock:
+            for i, buf in enumerate(self._free):
+                if buf.size >= need:
+                    got = self._free.pop(i)
+                    metrics.add("stage.buffer.reuses")
+                    return got[:need].reshape(rows, cols)
+        return np.empty((rows, cols), np.uint32)
+
+    def release(self, view: Optional[np.ndarray]) -> None:
+        if view is None:
+            return
+        base = view
+        while base.base is not None:
+            base = base.base
+        flat = np.asarray(base, np.uint32).reshape(-1)
+        with self._lock:
+            self._free.append(flat)
+            self._free.sort(key=lambda b: b.size)
+            del self._free[self.MAX_FREE:]
+
+
+def lex_cols_sorted(cols: Sequence[np.ndarray]) -> bool:
+    """Vectorized lexicographic monotonicity over parallel uint columns:
+    True when every adjacent pair is non-decreasing under first-column
+    priority (O(n·k) — the already-sorted fast path that replaces an
+    O(n log n) lexsort for Hadoop's map-side-sorted segments)."""
+    n = cols[0].shape[0]
+    if n < 2:
+        return True
+    lt = cols[0][:-1] < cols[0][1:]
+    eq = cols[0][:-1] == cols[0][1:]
+    for c in cols[1:]:
+        lt = lt | (eq & (c[:-1] < c[1:]))
+        eq = eq & (c[:-1] == c[1:])
+    return bool(np.all(lt | eq))
+
+
+def run_row_order(packed: packing.PackedKeys) -> Optional[np.ndarray]:
+    """Per-run sort order under (words, len) — which equals comparator
+    order for within-width keys. Returns None when the run is already
+    sorted (identity order; the map-side sort contract the reference's
+    merge leaned on — it never re-sorted segments, MergeManager.cc:
+    47-63), else the int64 lexsort permutation. Stable: equal keys keep
+    arrival order."""
+    kw = packed.key_words.shape[1]
+    cols = [packed.key_words[:, c] for c in range(kw)] \
+        + [packed.key_lens.astype(np.uint32)]
+    if lex_cols_sorted(cols):
+        return None
+    # np.lexsort: LAST key is primary -> reversed column priority
+    return np.lexsort(tuple(reversed(cols))).astype(np.int64)
+
+
+def fill_run_rows(rows: np.ndarray, packed: packing.PackedKeys,
+                  order: Optional[np.ndarray], seg_index: int) -> None:
+    """Fill a (cap >= n, kw+3) uint32 row matrix with the sorted
+    composite-key rows (words..., content length, segment index,
+    ORIGINAL row index) and PAD_WORD tail. Writes the sorted rows
+    directly (no build-then-permute copy); ``order=None`` = identity."""
+    n = packed.num_records
+    kw = packed.key_words.shape[1]
+    if order is None:
+        rows[:n, :kw] = packed.key_words
+        rows[:n, kw] = packed.key_lens.astype(np.uint32)
+        rows[:n, kw + 2] = np.arange(n, dtype=np.uint32)
+    else:
+        rows[:n, :kw] = packed.key_words[order]
+        rows[:n, kw] = packed.key_lens[order].astype(np.uint32)
+        rows[:n, kw + 2] = order.astype(np.uint32)
+    rows[:n, kw + 1] = np.uint32(seg_index)
+    if rows.shape[0] > n:
+        rows[n:] = PAD_WORD
+
+
+def merge_row_pair(a_rows, b_rows, a_valid: int, b_valid: int,
+                   engine: str, interpret: bool = False,
+                   native_merge=None):
+    """Merge two sorted composite-key row runs into one. Host engine:
+    linear two-pointer native merge when built (ties to ``a`` = the
+    earlier run, preserving the composite-key stability); lexsort of
+    the concatenation otherwise. Pallas engine: the O(n) merge-path
+    kernel — every column is part of the composite key (words, len,
+    seg, row), rows are totally ordered, so the kernel's internal
+    tie-break never decides anything."""
+    if engine == "host":
+        if native_merge is not None:
+            merged = native_merge(np.asarray(a_rows[:a_valid]),
+                                  np.asarray(b_rows[:b_valid]))
+            if merged is not None:
+                return merged
+        rows = np.concatenate([a_rows[:a_valid], b_rows[:b_valid]])
+        order = np.lexsort(tuple(rows[:, c]
+                                 for c in range(rows.shape[1] - 1, -1, -1)))
+        return rows[order]
+    return merge_sorted_pair(a_rows, b_rows,
+                             num_keys=int(a_rows.shape[1]),
+                             interpret=interpret)
 
 
 def sorted_batch_order(batch: RecordBatch, kt: KeyType, width: int) -> np.ndarray:
@@ -108,3 +376,127 @@ def merge_iter_host(batches: Sequence[RecordBatch],
                     kt: KeyType) -> Iterator[Tuple[bytes, bytes]]:
     """merge_record_streams over in-memory batches."""
     return merge_record_streams([b.iter_records() for b in batches], kt)
+
+
+# -- two-phase device sort ---------------------------------------------------
+
+def resolve_merge_mode(mode: str, num_batches: int) -> str:
+    """Batch-count/backend-aware routing between the whole-shuffle
+    re-sort ("resort") and the two-phase partial-sort + HBM merge tree
+    ("two_phase"). "auto" takes two-phase on real accelerators (the
+    re-sort's final permutation gather is the small-batch bottleneck
+    the take-ramp exposed: 0.15 GB/s at 2^16 rows, BENCH_NOTES_r05) and
+    keeps the re-sort on the XLA CPU backend, where one lexsort-shaped
+    sort beats Python-orchestrated pairwise folds. Resolution is EAGER,
+    never inside a jitted trace."""
+    if mode not in ("auto", "on", "off"):
+        from uda_tpu.utils.errors import MergeError
+
+        raise MergeError(f"unknown merge two-phase mode {mode!r}")
+    if num_batches < 2:
+        return "resort"
+    if mode == "on":
+        return "two_phase"
+    if mode == "off":
+        return "resort"
+    return "two_phase" if jax.default_backend() == "tpu" else "resort"
+
+
+def merge_batches_two_phase(batches: Sequence[RecordBatch], kt: KeyType,
+                            width: int, engine: str = "auto",
+                            interpret: Optional[bool] = None) -> RecordBatch:
+    """Two-phase merge of k segments: per-run partial sort (usually just
+    the monotonicity check) + pairwise HBM-resident merge tree, instead
+    of re-sorting the concatenation (see module docstring).
+
+    Byte-identical to :func:`merge_batches` by construction: the rows
+    carry (words, len, segment, row) as a total composite key, so equal
+    comparator keys order by original (segment, row) arrival — exactly
+    the stable-sort contract. Overflow keys (content wider than the
+    carried width) need a globally consistent rank column, which only
+    the concatenation view can provide — those fall back to
+    :func:`merge_batches` (correctness never depends on the fast path
+    applying)."""
+    # the concatenation is only needed for the final take — defer it so
+    # the fallback paths (which concat inside merge_batches) never hold
+    # two transient copies of a multi-GB shuffle
+    if sum(b.num_records for b in batches) == 0 or len(batches) < 2:
+        return merge_batches(batches, kt, width)
+    engine = resolve_run_engine(engine)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    native_merge = resolve_native_rows_merge() if engine == "host" else None
+    runs: list[tuple] = []  # (rows, valid) per non-empty segment
+    kw = width // 4
+    for seg_index, b in enumerate(batches):
+        n = b.num_records
+        if n == 0:
+            continue
+        packed = packing.pack_keys(b, kt, width)
+        if int(np.max(packed.key_lens, initial=0)) > width:
+            return merge_batches(batches, kt, width)  # overflow fallback
+        cap = next_run_capacity(n) if engine == "pallas" else n
+        rows = np.empty((cap, kw + ROW_EXTRA_COLS), np.uint32)
+        fill_run_rows(rows, packed, run_row_order(packed), seg_index)
+        if engine == "pallas":
+            rows = jax.device_put(rows)
+        runs.append((rows, n))
+    if not runs:  # unreachable given the record-count early-out; guard
+        return merge_batches(batches, kt, width)
+    metrics.add("merge.pipeline.two_phase")
+    rows, valid = _fold_runs(runs, engine, interpret, native_merge)
+    rows = np.asarray(rows)[:valid]
+    seg_col = rows[:, kw + 1].astype(np.int64)
+    row_col = rows[:, kw + 2].astype(np.int64)
+    sizes = np.asarray([b.num_records for b in batches], np.int64)
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    cat = RecordBatch.concat(list(batches))
+    return cat.take(offsets[seg_col] + row_col)
+
+
+def pad_rows_to(rows, capacity: int):
+    """Pad a device run up to ``capacity`` rows with PAD_WORD rows.
+    Padding rows sort strictly last, so the validity prefix is
+    preserved; capacities stay powers of two, keeping pallas kernel
+    shapes in the O(log) compiled set. The ONE implementation of the
+    pad-up invariant — shared by :func:`_fold_runs` and the overlap
+    forest's leftover merge (merger.overlap), which encode the same
+    binary-counter fold over different run carriers."""
+    cur = int(rows.shape[0])
+    if cur >= capacity:
+        return rows
+    pad = np.full((capacity - cur, int(rows.shape[1])), PAD_WORD,
+                  np.uint32)
+    return jax.numpy.concatenate([rows, jax.device_put(pad)], axis=0)
+
+
+def _fold_runs(runs: list, engine: str, interpret: bool, native_merge):
+    """Binary-counter fold of sorted (rows, valid) runs: equal
+    capacity classes merge immediately, leftovers merge smallest-first
+    (pallas runs pad the smaller operand up to the larger capacity —
+    :func:`pad_rows_to`). Same fold shape as the overlap forest's
+    _insert/_merge_leftovers (merger.overlap), which carries _Run
+    objects with locks and pool leases instead of bare (rows, valid)
+    tuples — a semantic change here must land there too."""
+    forest: dict[int, tuple] = {}  # bucket -> (rows, valid)
+    for rows, valid in runs:
+        bucket = next_run_capacity(valid)
+        while bucket in forest:
+            o_rows, o_valid = forest.pop(bucket)
+            rows = merge_row_pair(o_rows, rows, o_valid, valid, engine,
+                                  interpret, native_merge)
+            valid += o_valid
+            bucket *= 2
+        forest[bucket] = (rows, valid)
+    acc_rows, acc_valid = None, 0
+    for bucket in sorted(forest):
+        rows, valid = forest[bucket]
+        if acc_rows is None:
+            acc_rows, acc_valid = rows, valid
+            continue
+        if engine == "pallas" and acc_rows.shape[0] < rows.shape[0]:
+            acc_rows = pad_rows_to(acc_rows, int(rows.shape[0]))
+        acc_rows = merge_row_pair(acc_rows, rows, acc_valid, valid, engine,
+                                  interpret, native_merge)
+        acc_valid += valid
+    return acc_rows, acc_valid

@@ -42,7 +42,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from uda_tpu.ops.pallas_sort import _LANE, _lex_lt, _pass_splits
+from uda_tpu.ops.pallas_sort import (_LANE, _lex_lt, _pass_splits,
+                                      _uint32_struct)
 
 __all__ = ["sort_lanes_folded", "sort_lanes_folded4"]
 

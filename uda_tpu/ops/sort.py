@@ -38,8 +38,9 @@ from uda_tpu.ops.packing import PackedKeys
 
 __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
            "concat_packed", "resolve_sort_path", "apply_perm_chunked",
-           "LANES_ENGINES", "FLYOFF_ENGINES", "BENCH_FLYOFF",
-           "ALL_SORT_PATHS"]
+           "route_engine", "LANES_ENGINES", "FLYOFF_ENGINES",
+           "BENCH_FLYOFF", "ALL_SORT_PATHS", "GATHER_BOUND_ENGINES",
+           "CC_LADDER", "SMALL_BATCH_ROWS"]
 
 # The single source of truth for engine path names. LANES_ENGINES are
 # the Pallas-pipeline variants (bounded compile; interpret mode on CPU
@@ -67,10 +68,39 @@ __all__ = ["sort_permutation", "merge_runs", "sort_records_fixed",
 # (resolved once at import — see apply_perm_chunked)
 DEFAULT_CHUNK_COLS = int(os.environ.get("UDA_TPU_CHUNK_COLS", "6"))
 
+# The engine the "auto" policy deploys — how a fly-off/sweep winner
+# reaches every production call site at once (the engine analogue of
+# UDA_TPU_CHUNK_COLS; scripts/sweep_carrychunk.py + bench.py produce
+# the datum). Empty = the built-in per-backend defaults below. Read
+# ONCE at import, never inside a jitted trace. A deployed LANES engine
+# applies only to lanes-capable callers (lanes_ok=True); others keep
+# the built-in default rather than failing — the deploy var must never
+# break a pure-XLA code path.
+DEPLOYED_SORT_PATH = os.environ.get("UDA_TPU_SORT_PATH", "")
+
 LANES_ENGINES = ("lanes", "lanes2", "keys8", "keys8f")
 FLYOFF_ENGINES = ("lanes", "lanes2", "keys8", "gather2", "carrychunk")
 BENCH_FLYOFF = FLYOFF_ENGINES + ("keys8f",)
 ALL_SORT_PATHS = ("carry", "gather") + BENCH_FLYOFF
+
+# Engines whose payload movement is one (or more) global HBM gathers.
+# The take-ramp probe (BENCH_NOTES_r05: 0.15 GB/s at 2^16 rows vs
+# 2.15 GB/s at 2^22) shows the gather is LATENCY-bound below
+# SMALL_BATCH_ROWS — fixed per-row random-access cost dominates before
+# the streaming rate amortizes it — so small batches route to a
+# gather-free engine (route_engine below).
+GATHER_BOUND_ENGINES = ("gather", "gather2", "keys8", "keys8f")
+SMALL_BATCH_ROWS = 1 << 20
+
+# carrychunk chunk-width ladder (words per payload-chunk sort). For the
+# TeraSort shape's 23 payload words: cc=6 -> 4 chunk sorts moving 27
+# operand-words/record, cc=8 -> 3 (26), cc=12 -> 2 (25), cc=23 -> the
+# single-sort extreme (24 words/record — the ROADMAP "27->24" lever).
+# Larger cc strictly reduces sort-network traffic, bounded by XLA's
+# superlinear variadic-sort compile time; the ladder is what
+# scripts/sweep_carrychunk.py and the tpu_return re-probe measure, and
+# the sweep's winner deploys via UDA_TPU_CHUNK_COLS.
+CC_LADDER = (8, 12, 23)
 
 
 def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
@@ -91,6 +121,15 @@ def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
              else tuple(p for p in ALL_SORT_PATHS
                         if p not in LANES_ENGINES))
     if path == "auto":
+        if DEPLOYED_SORT_PATH:
+            if DEPLOYED_SORT_PATH not in ALL_SORT_PATHS:
+                raise ValueError(
+                    f"UDA_TPU_SORT_PATH={DEPLOYED_SORT_PATH!r} is not a "
+                    f"known sort path {ALL_SORT_PATHS}")
+            if DEPLOYED_SORT_PATH in valid:
+                return DEPLOYED_SORT_PATH
+            # deployed lanes engine, lanes-incapable caller: keep the
+            # built-in default
         backend = jax.default_backend()
         if backend == "cpu":
             path = "carry"
@@ -101,6 +140,31 @@ def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
     if path not in valid:
         raise ValueError(f"unknown sort path {path!r}")
     return path
+
+
+def route_engine(n_rows: int, path: str = "auto",
+                 lanes_ok: bool = False) -> str:
+    """Batch-size-aware engine routing: resolve ``path`` like
+    :func:`resolve_sort_path`, then — for "auto" only — steer batches
+    below :data:`SMALL_BATCH_ROWS` away from :data:`GATHER_BOUND_ENGINES`
+    onto "carrychunk" on TPU (its permutation apply rides small sort
+    networks, no global gather — the only engine shape that holds up in
+    the latency-bound take-ramp regime). The steering matters once a
+    gather-bound fly-off winner (keys8f/gather2/...) deploys as the
+    auto default via ``UDA_TPU_SORT_PATH`` — the built-in defaults are
+    never gather-bound, so without a deploy the route equals
+    :func:`resolve_sort_path`. An EXPLICIT path is always honored:
+    routing refines the default, it never overrides the operator.
+    This is the resolution entry for the production sort surfaces
+    (models.terasort.single_chip_sort, parallel.distributed).
+    Resolution is eager, never inside a jitted trace."""
+    if path != "auto":
+        return resolve_sort_path(path, lanes_ok)
+    resolved = resolve_sort_path("auto", lanes_ok)
+    if (n_rows < SMALL_BATCH_ROWS and jax.default_backend() == "tpu"
+            and resolved in GATHER_BOUND_ENGINES):
+        return "carrychunk"
+    return resolved
 
 
 def apply_perm_chunked(perm, cols, chunk_cols: int | None = None) -> list:

@@ -55,19 +55,24 @@ def _lanes_interpret(payload_path: str, mesh: Mesh) -> bool:
             and mesh.devices.flat[0].platform == "cpu")
 
 
-def _resolve_payload_path(path: str, wcols: int, num_keys: int) -> str:
-    """resolve_sort_path with the lanes engines admitted. "auto" never
-    resolves to a lanes engine anymore (TPU auto = carrychunk, the
-    fly-off champion, which has no record-width limit — see
-    resolve_sort_path), so no width gate is needed here; an EXPLICIT
-    lanes-engine request is passed through and fails loudly in
+def _resolve_payload_path(path: str, wcols: int, num_keys: int,
+                          n_rows: int = 0) -> str:
+    """route_engine with the lanes engines admitted. The built-in
+    "auto" defaults never resolve to a lanes engine (TPU auto =
+    carrychunk, the fly-off champion, which has no record-width limit
+    — see resolve_sort_path), so no width gate is needed here; an
+    EXPLICIT lanes-engine request (or a deployed UDA_TPU_SORT_PATH
+    winner) is passed through and fails loudly in
     _sort_valid_rows_lanes if the record exceeds the 32-row layout.
+    ``n_rows`` is the GLOBAL row count — per-device shards are smaller,
+    so the small-batch steering (route_engine) is conservative: a
+    globally-small batch is certainly small per device.
     ``wcols``/``num_keys`` stay in the signature for that error path's
     callers and for any future auto policy that reconsiders lanes."""
     del wcols, num_keys  # no auto path needs the width today
-    from uda_tpu.ops.sort import resolve_sort_path
+    from uda_tpu.ops.sort import route_engine
 
-    return resolve_sort_path(path, lanes_ok=True)
+    return route_engine(n_rows, path, lanes_ok=True)
 
 
 def uniform_splitters(num_partitions: int) -> np.ndarray:
@@ -367,7 +372,7 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
                                            resolve_exchange_mode)
 
     payload_path = _resolve_payload_path(payload_path, int(words.shape[1]),
-                                         num_keys)
+                                         num_keys, int(words.shape[0]))
     if multiround not in ("auto", "never", "always"):
         raise ValueError(f"unknown multiround policy {multiround!r}")
     topo, hier = resolve_exchange_mode(mesh, axis, exchange_mode)
@@ -476,7 +481,7 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
                                           record_plan_skips)
 
     payload_path = _resolve_payload_path(payload_path, int(words.shape[1]),
-                                         num_keys)
+                                         num_keys, int(words.shape[0]))
     p = int(np.prod(list(mesh.shape.values())))
     spec = NamedSharding(mesh, P(axis))
     words = put_rows(words, mesh, axis)

@@ -46,12 +46,41 @@ from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
 __all__ = ["MemoryBudget", "Admission", "device_bytes_estimate",
-           "ROW_OVERHEAD_WORDS", "WORKING_SET_FACTOR",
-           "HBM_RESERVE_FRACTION", "PLATFORM_HBM_MB"]
+           "stage_inflight_cap", "ROW_OVERHEAD_WORDS",
+           "WORKING_SET_FACTOR", "HBM_RESERVE_FRACTION",
+           "PLATFORM_HBM_MB", "STAGE_INFLIGHT_FLOOR_MB"]
 
 log = get_logger()
 
 MB = 1 << 20
+
+# floor for the auto-derived staging-pipeline in-flight byte budget
+STAGE_INFLIGHT_FLOOR_MB = 256
+
+
+def stage_inflight_cap(cfg, window: int, chunk_size: int,
+                       budget: Optional["MemoryBudget"] = None) -> int:
+    """In-flight byte budget for the staging pipeline (bytes fed to the
+    overlap merger but not yet merged/spooled — uda_tpu.merger.overlap
+    charges/releases them; the gauge is ``stage.inflight.bytes``).
+
+    ``uda.tpu.stage.inflight.mb`` wins when set; the auto default is
+    max(STAGE_INFLIGHT_FLOOR_MB, 2x the fetch window's wire bytes) —
+    enough that staging never throttles a healthy fetch window, small
+    enough that a stalled device consumer cannot pile the whole shuffle
+    into host RSS. When a MemoryBudget has ALREADY been built (the auto
+    merge-approach path), the cap additionally clamps to half its host
+    budget; a budget is deliberately NOT constructed here — platform
+    detection must not run for explicitly-configured approaches (the
+    same laziness MergeManager.budget() preserves)."""
+    mb = int(cfg.get("uda.tpu.stage.inflight.mb"))
+    if mb > 0:
+        return mb * MB
+    cap = max(STAGE_INFLIGHT_FLOOR_MB * MB,
+              2 * max(1, int(window)) * max(1, int(chunk_size)))
+    if budget is not None:
+        cap = min(cap, max(MB, budget.host_budget_bytes // 2))
+    return cap
 
 # -- the device-bytes model (VERDICT.md Missing #4) -------------------------
 #

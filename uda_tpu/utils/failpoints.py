@@ -62,8 +62,9 @@ import zlib
 from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
-from uda_tpu.utils.errors import (ConfigError, MergeError, ProtocolError,
-                                  StorageError, TransportError, UdaError)
+from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
+                                  ProtocolError, StorageError,
+                                  TransportError, UdaError)
 from uda_tpu.utils.metrics import metrics
 
 __all__ = ["Failpoint", "FailpointRegistry", "failpoints", "failpoint",
@@ -78,6 +79,7 @@ _ERROR_KINDS = {
     "protocol": ProtocolError,
     "config": ConfigError,
     "uda": UdaError,
+    "compression": CompressionError,
 }
 
 # default injected-error class per site: match what the real fault at
@@ -95,6 +97,10 @@ _SITE_ERRORS = {
     # server's warm-restart handoff persistence (key "load"/"save")
     "coding.decode": StorageError,
     "net.handoff": StorageError,
+    # block decompression on the staging pipeline's hot path (keyed by
+    # "<map>@<offset>"): a corrupt/injected block must abort the fetch
+    # cleanly — the stage pool drains, no in-flight budget bytes leak
+    "decompress.block": CompressionError,
 }
 
 # The registered-site inventory. udalint's UDA003 rule checks every

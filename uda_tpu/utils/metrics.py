@@ -162,6 +162,27 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                  "(staged or device-merged)"),
     "spool.bytes": ("counter", "bytes spooled to sorted run files "
                                "(streaming online mode)"),
+    # -- counters: staging pipeline (merger/overlap stage pool) ----------
+    "stage.bytes": ("counter", "record content bytes through the "
+                               "staging path (pack + row build)"),
+    "stage.backpressure_events": ("counter", "feed() calls that blocked "
+                                            "on the in-flight staging "
+                                            "byte budget "
+                                            "(uda.tpu.stage.inflight.mb)"),
+    "stage.buffer.reuses": ("counter", "row-matrix builds served from "
+                                       "the pre-allocated host buffer "
+                                       "pool instead of a fresh "
+                                       "allocation"),
+    "merge.pipeline.runs": ("counter", "staged runs consumed by the "
+                                       "pipeline's merge consumer "
+                                       "(device_put overlapped with "
+                                       "the previous run's merges)"),
+    "merge.pipeline.two_phase": ("counter", "non-overlapped merges "
+                                            "routed to the two-phase "
+                                            "device sort (partial "
+                                            "sort + HBM merge tree) "
+                                            "instead of the "
+                                            "concatenation re-sort"),
     "exchange.rounds": ("counter", "all-to-all exchange rounds executed"),
     "exchange.rounds.skipped": ("counter", "planned exchange windows the "
                                            "host round planner dropped "
@@ -248,14 +269,30 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                        "generation (advertised in the "
                                        "accept banner; warm restarts "
                                        "increment the persisted one)"),
+    "stage.inflight.bytes": ("gauge", "bytes fed to the overlap merger "
+                                      "but not yet merged/spooled (the "
+                                      "staging-pipeline admission "
+                                      "level; bounded by "
+                                      "uda.tpu.stage.inflight.mb)"),
     # -- histograms (recorded only while stats are enabled) --------------
     "fetch.latency_ms": ("histogram", "per-chunk fetch latency "
                                       "[labels: supplier]"),
     "fetch.chunk.bytes": ("histogram", "fetched chunk sizes"),
     "supplier.read.latency_ms": ("histogram", "DataEngine chunk read+"
                                               "resolve latency"),
-    "merge.wait_ms": ("histogram", "staging-thread wait for the next "
-                                   "completed segment"),
+    "merge.wait_ms": ("histogram", "how long the merge waited for a "
+                                   "run to become mergeable after its "
+                                   "segment was fed (queue wait + "
+                                   "decompress tail + pack + spool) — "
+                                   "the device-starvation signal; its "
+                                   "complement is the feed() "
+                                   "backpressure block "
+                                   "(stage.backpressure_events)"),
+    "merge.pipeline.put_ms": ("histogram", "merge-consumer wait for a "
+                                           "jax.device_put transfer to "
+                                           "release its leased host "
+                                           "buffer (the pipeline's one "
+                                           "per-run accounting block)"),
     "net.frame.latency_ms": ("histogram", "request->response frame "
                                           "latency [labels: role — "
                                           "server: REQ read to reply "
