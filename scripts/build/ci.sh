@@ -40,10 +40,19 @@ fi
 # Static analysis gate: the project invariants (metrics registry,
 # config-key declaration, failpoint sites, shutdown-before-close,
 # structured-cause branching, no silent swallows, no blocking under a
-# lock) are machine-enforced BEFORE any test runs — a violation is a
+# lock) AND the udaflow dataflow tier (UDA101 resource balance on
+# every CFG path, UDA102 transitive blocking, UDA103 static lock
+# order) are machine-enforced BEFORE any test runs — a violation is a
 # build failure, like the reference's scripts/build check_* gates.
-echo "-- udalint static analysis" | tee -a "$ART/ci.log"
+# The machine-readable findings land in the artifacts (udalint.json)
+# so downstream gates consume them structurally, never by grep.
+echo "-- udalint static analysis (incl. udaflow UDA101-UDA103)" \
+  | tee -a "$ART/ci.log"
+# human-readable gate FIRST (findings must land in ci.log/console);
+# the machine-readable artifact only runs on a clean tree, where the
+# second pass is cheap
 python scripts/udalint.py uda_tpu scripts 2>&1 | tee -a "$ART/ci.log" | tail -1
+python scripts/udalint.py --json uda_tpu scripts > "$ART/udalint.json"
 
 echo "-- unit + engine tests" | tee -a "$ART/ci.log"
 python -m pytest tests/ -q 2>&1 | tee "$ART/pytest.log" | tail -2

@@ -61,11 +61,13 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PSPEC}" UDA_TPU_STATS=1 \
 NSPEC="$(python -c "from uda_tpu.utils.failpoints import net_chaos_spec; print(net_chaos_spec(${SEED}))")"
 NCOUNTERS="$(mktemp)"
 NCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}"' EXIT
-echo "network schedule:    ${NSPEC} (UDA_TPU_LOCKDEP=1)"
+NLEAKS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}"' EXIT
+echo "network schedule:    ${NSPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
 nrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${NCYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${NLEAKS}" \
     UDA_TPU_CHAOS_TELEMETRY="${NCOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
     -k "net" \
@@ -81,7 +83,7 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
 # the device exchange shares with everything else.
 ECOUNTERS="$(mktemp)"
 ECYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}"' EXIT
 echo "exchange rung:       scoped exchange.round schedules (UDA_TPU_LOCKDEP=1)"
 erc=0
 env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
@@ -102,11 +104,13 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
 # must add no lock-order cycles.
 CCOUNTERS="$(mktemp)"
 CCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}"' EXIT
-echo "completion rung:     seeded supplier kill + warm restart (seed ${SEED}, UDA_TPU_LOCKDEP=1)"
+CLEAKS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}"' EXIT
+echo "completion rung:     seeded supplier kill + warm restart (seed ${SEED}, UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
 crc=0
 env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 UDA_TPU_CHAOS_SEED="${SEED}" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${CCYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${CLEAKS}" \
     UDA_TPU_CHAOS_TELEMETRY="${CCOUNTERS}" \
     python -m pytest tests/test_coding.py -m faults -q -p no:cacheprovider \
     --continue-on-collection-errors "$@" || crc=$?
@@ -122,11 +126,13 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 UDA_TPU_CHAOS_SEED="${SEED}" \
 PIPESPEC="data_engine.pread=delay:$((SEED % 15 + 5)):prob:0.25:seed:${SEED},decompress.block=delay:$((SEED % 5 + 1)):prob:0.15:seed:${SEED}"
 PICOUNTERS="$(mktemp)"
 PICYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${PICOUNTERS}" "${PICYCLES}"' EXIT
-echo "pipeline schedule:   ${PIPESPEC} (UDA_TPU_LOCKDEP=1)"
+PILEAKS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}"' EXIT
+echo "pipeline schedule:   ${PIPESPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
 pirc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PIPESPEC}" UDA_TPU_STATS=1 \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${PICYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${PILEAKS}" \
     UDA_TPU_CHAOS_TELEMETRY="${PICOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
     -k "pipeline" \
@@ -142,7 +148,7 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PIPESPEC}" UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${PICOUNTERS}" "${PICYCLES}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -158,14 +164,16 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${ECOUNTERS}" "${erc}" "${ECYCLES}" \
     "${CCOUNTERS}" "${crc}" "${CCYCLES}" \
     "${PIPESPEC}" "${PICOUNTERS}" "${pirc}" "${PICYCLES}" \
-    "${LCOUNTERS}" "${lrc}" "${LCYCLES}" <<'EOF' || mrc=$?
+    "${LCOUNTERS}" "${lrc}" "${LCYCLES}" \
+    "${NLEAKS}" "${CLEAKS}" "${PILEAKS}" <<'EOF' || mrc=$?
 import json, sys
 (seed, spec, counters_path, out, rc, pspec, pcounters, prc,
  nspec, ncounters, nrc, ncycles,
  ecounters, erc, ecycles,
  ccounters, crc_, ccycles,
  pipespec, picounters, pirc, picycles,
- lcounters, lrc, lcycles) = sys.argv[1:26]
+ lcounters, lrc, lcycles,
+ nleaks_path, cleaks_path, pileaks_path) = sys.argv[1:29]
 def load(path):
     try:
         with open(path) as f:
@@ -187,12 +195,22 @@ def lockdep_block(schedule, exit_code, telem_path, cycles_path):
             "cycles": int(telem.get("counters", {})
                           .get("lockdep.cycles", 0)),
             "cycle_reports": reports, "telemetry": telem}, reports
+def resledger_block(block, leaks_path):
+    """Fold the rung's leaked-obligation reports (UDA_TPU_RESLEDGER_
+    JSON lines) into its telemetry block; returns the reports so the
+    zero-leaks guarantee is ENFORCED below, like lockdep cycles."""
+    reports = load_cycles(leaks_path)
+    block["resledger"] = {"armed": True, "leaks": len(reports),
+                          "leak_reports": reports}
+    return reports
 network, n_reports = lockdep_block(nspec, nrc, ncounters, ncycles)
+n_leaks = resledger_block(network, nleaks_path)
 exchange, e_reports = lockdep_block("scoped exchange.round (per-test)",
                                     erc, ecounters, ecycles)
 completion, c_reports = lockdep_block(
     f"seeded supplier kill + warm restart (seed {seed})",
     crc_, ccounters, ccycles)
+c_leaks = resledger_block(completion, cleaks_path)
 # the completion guarantee, surfaced in the telemetry: reconstructed
 # partitions and resumed fetches with ZERO fallbacks (the per-test
 # asserts enforce it; this block is the cross-round diffable record)
@@ -207,6 +225,7 @@ completion["survived"] = {
 }
 pipeline, pi_reports = lockdep_block(pipespec, pirc, picounters,
                                      picycles)
+pi_leaks = resledger_block(pipeline, pileaks_path)
 # the drain contract, surfaced: staged runs consumed, backpressure
 # blocks observed, and zero bytes left in flight after every
 # faulted-and-aborted pipeline (the per-test asserts enforce the
@@ -220,6 +239,7 @@ pipeline["drained"] = {
         "gauges", {}).get("stage.inflight.bytes", 0),
 }
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
+nleak = len(n_leaks) + len(c_leaks) + len(pi_leaks)
 with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
                "pytest_exit": int(rc), "telemetry": load(counters_path),
@@ -229,16 +249,21 @@ with open(out, "w") as f:
                "exchange": exchange,
                "completion": completion,
                "pipeline": pipeline,
-               "lockdep": lockdep},
+               "lockdep": lockdep,
+               "resledger": {"armed_rungs": ["network", "completion",
+                                             "pipeline"],
+                             "leaks": nleak}},
               f, indent=1, sort_keys=True)
     f.write("\n")
 ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
         + len(pi_reports) + len(l_reports))
-print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc})")
-# the zero-cycles-on-real-code guarantee is ENFORCED, not just
-# printed: a detected inversion that never got the unlucky scheduling
-# still fails the tier (that is the entire point of lockdep)
-sys.exit(3 if ncyc else 0)
+print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc}, "
+      f"resledger leaks: {nleak})")
+# the zero-cycles / zero-leaks guarantees are ENFORCED, not just
+# printed: a detected inversion (or a leaked obligation that never got
+# the unlucky scheduling to become a visible wedge) still fails the
+# tier — that is the entire point of lockdep and the ledger
+sys.exit(3 if (ncyc or nleak) else 0)
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
@@ -247,7 +272,8 @@ if [ "${crc}" -ne 0 ]; then rc="${crc}"; fi
 if [ "${pirc}" -ne 0 ]; then rc="${pirc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
-  echo "LOCKDEP: cycle reports on real code (see CHAOS_TELEMETRY.json)" >&2
+  echo "LOCKDEP/RESLEDGER: cycle or leaked-obligation reports on real" \
+       "code (see CHAOS_TELEMETRY.json)" >&2
   rc="${mrc}"
 fi
 exit "${rc}"

@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """udalint CLI: the shuffle stack's AST invariant linter.
 
-Runs the uda_tpu.analysis rule suite (UDA001-UDA007, see
-``--list-rules``) over the given files/directories and prints findings
+Runs the uda_tpu.analysis rule suite — the syntactic tier (UDA001-
+UDA008) and the udaflow CFG/dataflow tier (UDA101-UDA103), see
+``--list-rules`` — over the given files/directories and prints findings
 as ``file:line:col: RULE message [fix: hint]``. Exit 1 when any
 non-suppressed finding exists, 0 on a clean tree.
 
@@ -11,6 +12,13 @@ Usage::
     python scripts/udalint.py [paths ...]       # default: uda_tpu scripts
     python scripts/udalint.py --list-rules
     python scripts/udalint.py --rule UDA004 uda_tpu/net
+    python scripts/udalint.py --json uda_tpu    # machine-readable
+
+``--json`` prints one JSON object to stdout — ``{"files": N,
+"findings": [{file, line, col, rule, message, hint, data}, ...]}`` —
+so the CI and chaos gates consume findings structurally instead of
+grepping human output (the check_metrics_names.py wrapper contract).
+Exit codes are identical to the human mode.
 
 Suppression: append ``# udalint: disable=<RULE>[,<RULE>...]`` (or
 ``disable=all``) to the offending line. ``scripts/build/ci.sh`` runs
@@ -21,6 +29,7 @@ whole tree clean in tier-1.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -40,6 +49,9 @@ def main(argv=None) -> int:
     ap.add_argument("--rule", action="append", default=None,
                     metavar="ID", help="run only these rule ids "
                                        "(repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable findings on stdout "
+                         "(file/line/col/rule/message/hint/data)")
     args = ap.parse_args(argv)
 
     from uda_tpu.analysis.core import Engine, iter_py_files
@@ -67,9 +79,18 @@ def main(argv=None) -> int:
 
     engine = Engine(rules, root=REPO)
     findings = engine.lint_paths(paths)
+    nfiles = len(iter_py_files(paths))
+    if args.json:
+        print(json.dumps(
+            {"files": nfiles, "rules": [r.rule_id for r in rules],
+             "findings": [{"file": f.file, "line": f.line, "col": f.col,
+                           "rule": f.rule, "message": f.message,
+                           "hint": f.hint, "data": f.data}
+                          for f in findings]},
+            indent=1, sort_keys=True))
+        return 1 if findings else 0
     for f in findings:
         print(f.render(), file=sys.stderr)
-    nfiles = len(iter_py_files(paths))
     if findings:
         print(f"udalint: {len(findings)} finding(s) in {nfiles} file(s)",
               file=sys.stderr)

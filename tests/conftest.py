@@ -99,13 +99,59 @@ _SESSION_COUNTERS: dict = collections.defaultdict(float)
 
 
 @pytest.fixture(autouse=True)
+def _failpoint_phase_reset():
+    """Each test sees the ambient chaos schedule (UDA_FAILPOINTS, the
+    run_chaos.sh rungs) from phase 0: trigger counters and seeded
+    probability draws restart per test via the documented
+    disarm-then-rearm idiom. Without this, whether an `every:N` error
+    hits a given test depends on how many failpoint evaluations every
+    EARLIER test consumed — suite composition becomes schedule phase
+    (the PR 9 "suite doubling shifted failpoint phase" class), and a
+    chaos-rung failure does not even reproduce standalone. Tests that
+    arm their own scoped() schedules are unaffected (the scope saves
+    and restores around this)."""
+    from uda_tpu.utils.failpoints import failpoints
+
+    for site, spec in failpoints.active().items():
+        failpoints.disarm(site)
+        failpoints.arm(site, spec)
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _metrics_hygiene():
     yield
     from uda_tpu.utils.metrics import metrics
+    from uda_tpu.utils.resledger import PAIRED_GAUGES, resledger
 
+    # paired-gauge balance: every +N on the increment-must-meet-
+    # decrement set (fetch.on_air, stage.inflight.bytes, ...) must have
+    # met its -N by test end — metrics.reset() starts each test at
+    # zero, so a nonzero here is THIS test's leak, reported at the
+    # leaking test instead of silently polluting a later assertion
+    unbalanced = {
+        name: val
+        for name, val in metrics.gauges_snapshot().items()
+        if name in PAIRED_GAUGES and abs(val) > 1e-9
+    }
+    # runtime obligation books (armed runs only, e.g. the chaos rungs
+    # under UDA_TPU_RESLEDGER=1): anything still open is a leak —
+    # drain() reports each with its acquire stack, counts
+    # resledger.leaks and appends to UDA_TPU_RESLEDGER_JSON, and the
+    # pop guarantees the NEXT test starts with empty books
+    leaked = resledger.drain("test.teardown")
     for name, value in metrics.snapshot().items():
         _SESSION_COUNTERS[name] += value
     metrics.reset()
+    if unbalanced or leaked:
+        parts = []
+        if unbalanced:
+            parts.append(f"paired gauges not back to zero: {unbalanced}")
+        if leaked:
+            opened = ", ".join(sorted({r["pair"] for r in leaked}))
+            parts.append(f"{len(leaked)} leaked resledger obligation(s) "
+                         f"({opened}) — acquire stacks in the log")
+        pytest.fail("resource-balance teardown: " + "; ".join(parts))
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -701,6 +701,38 @@ def test_completion_warm_restart_resumes_from_offset_ledger(tmp_path):
     assert metrics.get("fallback.signals") == 0
 
 
+@pytest.mark.faults
+def test_remote_pread_error_resumes_mid_partition(tmp_path):
+    """A transient REMOTE StorageError — a typed ERR frame on a healthy
+    stream (structured remote_kind stamp, net/wire.py) — must not cost
+    a full refetch: every chunk ingested before it is valid, so the
+    segment keeps its offset ledger and resumes. Under a periodic
+    per-call error schedule a refetch-from-zero retry loop re-hits the
+    fault at the same phase every attempt and exhausts any retry
+    budget deterministically (the chaos-rung livelock this pins); with
+    resume each attempt banks its progress and the fetch converges."""
+    expected = make_mof_tree(str(tmp_path), JOB, 1, 1, 2500, seed=21)
+    eng, srv, _ = _netted_supplier(tmp_path)
+    router = HostRoutingClient(config=Config())
+    seg = Segment(router, JOB, map_ids(JOB, 1)[0], 0, 8192,
+                  host=f"127.0.0.1:{srv.port}",
+                  policy=RetryPolicy(retries=8, backoff_ms=20),
+                  resume=True)
+    try:
+        # every 3rd pread errors: < the partition's chunk count, so
+        # without resume NO attempt can ever finish (the livelock)
+        with failpoints.scoped("data_engine.pread=error:every:3"):
+            seg.start()
+            seg.wait(20.0)
+    finally:
+        srv.stop()
+        router.stop()
+        eng.stop()
+    assert seg.num_records == len(expected[0])
+    assert metrics.get("fetch.resumed") >= 1
+    assert metrics.get("fetch.resumed.bytes") > 0  # ground held
+
+
 def test_cold_restart_revokes_resume(tmp_path):
     """Without a handoff record the restarted server mints a FRESH
     generation and advertises cold — the client revokes resume for

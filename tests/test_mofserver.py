@@ -226,8 +226,13 @@ def test_sync_fetch_timeout_releases_admission_budget(tmp_path):
         assert engine._admitted_bytes == 0
         assert metrics.get_gauge("supplier.read.bytes.on_air") == 0
         assert metrics.get_gauge("supplier.reads.on_air") == 0
-        # and the engine is NOT spuriously "exhausted" afterwards
-        res = engine.fetch(ShuffleRequest("job9", mid, 0, 0, 1 << 20))
+        # and the engine is NOT spuriously "exhausted" afterwards —
+        # probed with the ambient chaos-rung pread schedule pinned out
+        # (this fetch asserts admission recovery, not fault recovery;
+        # an injected error here would fail the wrong invariant)
+        with failpoints.scoped(""):
+            failpoints.disarm("data_engine.pread")
+            res = engine.fetch(ShuffleRequest("job9", mid, 0, 0, 1 << 20))
         assert res.data
     finally:
         engine.stop()

@@ -1,0 +1,399 @@
+"""Per-function control-flow graphs for the udaflow dataflow tier.
+
+udalint's original rules (UDA001-UDA008) are *syntactic*: one node, one
+verdict. The leak class that cost three consecutive PRs a review round
+— a resource charged on one path and never released on an exception or
+early-return path (PR 6's ``try_plan`` admission-byte leak, the PR 5
+cancel-while-queued leak, PR 9's ``feed()``/``abort()`` race) — is a
+*path* property: the bug is not any single statement but the existence
+of a route from the acquire to function exit that skips the release.
+This module builds the graph those rules reason over.
+
+Shape of the graph
+------------------
+
+One :class:`CFG` per function (``FunctionDef`` / ``AsyncFunctionDef``;
+nested defs are opaque single statements of the enclosing function —
+deferred code runs on its own CFG). Nodes are statement *headers*: a
+compound statement contributes one node carrying only its header
+expressions (``if``/``while`` tests, ``for`` iterables, ``with`` items)
+— bodies become their own nodes — so a node's effect set never double
+counts a nested statement. Two synthetic terminals:
+
+- ``EXIT`` — normal completion (fall off the end, ``return``);
+- ``RAISE`` — exceptional exit (an uncaught exception propagates).
+
+Edges:
+
+- **normal**: statement order, branch arms, loop back-edges,
+  ``break``/``continue`` to their loop targets;
+- **exception**: any node that *can raise* (it contains a ``Call``, is
+  a ``raise``/``assert``, or is a ``with`` header — ``__enter__`` runs
+  there) gets an edge to the innermost enclosing handler dispatch, or
+  to ``RAISE`` when none encloses it. Handler dispatch fans out to
+  every ``except`` body and, unless some handler is broad (bare /
+  ``Exception`` / ``BaseException``), onward to the next outer target
+  (the not-caught-here path);
+- **finally routing**: ``finally`` bodies are *copied per
+  continuation* — the normal path, the exception path and each
+  ``return``/``break``/``continue`` that crosses the ``try`` get their
+  own copy of the finally subgraph wired to their own continuation, so
+  "the release lives in a finally" is visible as "every path to EXIT
+  passes a release node" without merging normal and exceptional
+  contexts (a single shared finally block would manufacture paths that
+  do not exist, e.g. normal completion -> exceptional exit).
+
+``with`` headers do not suppress exceptions (true for every context
+manager in this tree — locks, scoped failpoints, spans); body
+exceptions propagate past them to the enclosing target.
+
+The graph is deliberately an over-approximation in one direction only:
+it may contain a path the program cannot take (any call "can" raise),
+never the reverse — so a dataflow verdict of "no path leaks" is sound,
+and a finding is a path the runtime *could* plausibly walk.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["CFG", "CFGNode", "build_cfg"]
+
+# broad handler type names: a `try` with one of these catches everything
+# we model (the graph drops the propagate edge past it)
+_BROAD = {"Exception", "BaseException"}
+
+
+@dataclasses.dataclass
+class CFGNode:
+    """One CFG node: a statement header (or synthetic terminal).
+
+    ``exprs`` holds exactly the AST fragments evaluated *at this node*
+    (a compound statement's bodies live in their own nodes); effect
+    extraction (acquire/release matching) scans these and nothing else.
+    ``kind`` tags synthetics ("exit", "raise") and headers ("with",
+    "return", ...) the analysis treats specially.
+    """
+
+    index: int
+    kind: str                      # "stmt" | "with" | "return" | "exit" | ...
+    stmt: Optional[ast.AST]        # the owning statement (None: synthetic)
+    exprs: Tuple[ast.AST, ...]     # fragments evaluated at this node
+    # normal-completion vs exception successors are SEPARATE: a
+    # dataflow client must know which state leaves on which edge (an
+    # acquire that raises did not acquire — its own exception edge
+    # carries the pre-acquire state)
+    norm_succs: List[int] = dataclasses.field(default_factory=list)
+    exc_succs: List[int] = dataclasses.field(default_factory=list)
+
+    def add(self, target: int, exc: bool = False) -> None:
+        lst = self.exc_succs if exc else self.norm_succs
+        if target not in lst:
+            lst.append(target)
+
+    @property
+    def succs(self) -> List[int]:
+        """All successors (normal first), deduplicated."""
+        out = list(self.norm_succs)
+        out.extend(t for t in self.exc_succs if t not in out)
+        return out
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+
+class CFG:
+    """The per-function graph: ``nodes[entry]`` starts the body,
+    ``nodes[exit_id]`` / ``nodes[raise_id]`` are the two terminals."""
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.nodes: List[CFGNode] = []
+        self.exit_id = self._new("exit", None, ())
+        self.raise_id = self._new("raise", None, ())
+        self.entry = self.exit_id  # replaced by build()
+
+    def _new(self, kind: str, stmt: Optional[ast.AST],
+             exprs: Tuple[ast.AST, ...]) -> int:
+        node = CFGNode(len(self.nodes), kind, stmt, tuple(exprs))
+        self.nodes.append(node)
+        return node.index
+
+    def node(self, idx: int) -> CFGNode:
+        return self.nodes[idx]
+
+    def preds(self) -> Dict[int, List[Tuple[int, bool]]]:
+        """target -> [(pred index, is_exception_edge), ...]."""
+        out: Dict[int, List[Tuple[int, bool]]] = {
+            n.index: [] for n in self.nodes}
+        for n in self.nodes:
+            for s in n.norm_succs:
+                out[s].append((n.index, False))
+            for s in n.exc_succs:
+                out[s].append((n.index, True))
+        return out
+
+    # -- debug/tests ---------------------------------------------------------
+
+    def render(self) -> str:
+        lines = []
+        for n in self.nodes:
+            label = n.kind
+            if n.stmt is not None:
+                label += f"@{n.line}"
+            succ = ",".join([str(s) for s in n.norm_succs]
+                            + [f"{s}!" for s in n.exc_succs])
+            lines.append(f"{n.index}:{label} -> [{succ}]")
+        return "\n".join(lines)
+
+
+# Callees whose failure modes the graph does NOT model: observability
+# (metrics counters/gauges are dict writes under a leaf lock; loggers
+# absorb their own failures) and the infallible release wrappers of the
+# obligation registry (settle-then-nothing bodies). Without this set,
+# every `metrics.add` between an acquire and its release manufactures a
+# cleanup-code-raised leak path — the classic false-positive source of
+# path checkers. Extendable per-build via ``build_cfg(no_raise=...)``.
+DEFAULT_NO_RAISE = frozenset({
+    # metrics hub
+    "add", "gauge", "gauge_add", "observe",
+    # loggers / stdout
+    "debug", "info", "warn", "warning", "error", "exception", "print",
+    # infallible releases (pair-registry release wrappers + primitives)
+    "release", "_unadmit", "_release_charge", "close_hard",
+    "notify", "notify_all", "append",
+})
+
+
+def _can_raise(exprs: Tuple[ast.AST, ...],
+               no_raise: frozenset = DEFAULT_NO_RAISE) -> bool:
+    """Conservative can-this-node-raise: it evaluates a call (or is an
+    explicit raise/assert — handled by the builder). Attribute access
+    and arithmetic are deliberately not counted: in this tree they do
+    not fail in practice, and counting them would manufacture leak
+    paths out of every statement. Calls whose callee's last segment is
+    in ``no_raise`` are likewise exempt (see DEFAULT_NO_RAISE)."""
+    for e in exprs:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Call):
+                func = sub.func
+                name = None
+                if isinstance(func, ast.Attribute):
+                    name = func.attr
+                elif isinstance(func, ast.Name):
+                    name = func.id
+                if name not in no_raise:
+                    return True
+    return False
+
+
+class _Ctx:
+    """Where non-local control transfers go from the current position:
+    raise -> ``exc``, return -> ``ret``, break/continue -> ``brk`` /
+    ``cont`` (None outside a loop). try/finally rebinds all four
+    through finally copies."""
+
+    __slots__ = ("exc", "ret", "brk", "cont")
+
+    def __init__(self, exc: int, ret: int, brk: Optional[int],
+                 cont: Optional[int]):
+        self.exc = exc
+        self.ret = ret
+        self.brk = brk
+        self.cont = cont
+
+    def replace(self, **kw) -> "_Ctx":
+        new = _Ctx(self.exc, self.ret, self.brk, self.cont)
+        for k, v in kw.items():
+            setattr(new, k, v)
+        return new
+
+
+class _Builder:
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+
+    # Each _build_* returns the ENTRY node id of the construct, wired so
+    # that normal completion continues at `nxt`.
+
+    def build_block(self, stmts: List[ast.stmt], nxt: int,
+                    ctx: _Ctx) -> int:
+        entry = nxt
+        for stmt in reversed(stmts):
+            entry = self.build_stmt(stmt, entry, ctx)
+        return entry
+
+    def build_stmt(self, stmt: ast.stmt, nxt: int, ctx: _Ctx) -> int:
+        cfg = self.cfg
+        if isinstance(stmt, ast.Return):
+            exprs = (stmt.value,) if stmt.value is not None else ()
+            idx = cfg._new("return", stmt, exprs)
+            node = cfg.node(idx)
+            node.add(ctx.ret)
+            if _can_raise(exprs):
+                node.add(ctx.exc, exc=True)
+            return idx
+        if isinstance(stmt, ast.Raise):
+            exprs = tuple(e for e in (stmt.exc, stmt.cause)
+                          if e is not None)
+            idx = cfg._new("raise_stmt", stmt, exprs)
+            cfg.node(idx).add(ctx.exc, exc=True)
+            return idx
+        if isinstance(stmt, ast.Break):
+            idx = cfg._new("break", stmt, ())
+            cfg.node(idx).add(ctx.brk if ctx.brk is not None else nxt)
+            return idx
+        if isinstance(stmt, ast.Continue):
+            idx = cfg._new("continue", stmt, ())
+            cfg.node(idx).add(ctx.cont if ctx.cont is not None else nxt)
+            return idx
+        if isinstance(stmt, ast.If):
+            body = self.build_block(stmt.body, nxt, ctx)
+            orelse = self.build_block(stmt.orelse, nxt, ctx) \
+                if stmt.orelse else nxt
+            idx = cfg._new("if", stmt, (stmt.test,))
+            node = cfg.node(idx)
+            node.add(body)
+            node.add(orelse)
+            if _can_raise((stmt.test,)):
+                node.add(ctx.exc, exc=True)
+            return idx
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._build_loop(stmt, nxt, ctx)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._build_with(stmt, nxt, ctx)
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt, nxt, ctx)
+        if isinstance(stmt, ast.Assert):
+            # a failing assert raises; the test itself may call
+            exprs = tuple(e for e in (stmt.test, stmt.msg) if e is not None)
+            idx = cfg._new("assert", stmt, exprs)
+            node = cfg.node(idx)
+            node.add(nxt)
+            node.add(ctx.exc, exc=True)
+            return idx
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # nested defs are opaque: their bodies run later (or never),
+            # on their own CFG; only decorators/defaults evaluate here
+            exprs = tuple(stmt.decorator_list)
+            idx = cfg._new("def", stmt, exprs)
+            node = cfg.node(idx)
+            node.add(nxt)
+            if _can_raise(exprs):
+                node.add(ctx.exc, exc=True)
+            return idx
+        # simple statement: Expr/Assign/AugAssign/AnnAssign/Delete/
+        # Global/Import/Pass/...
+        idx = cfg._new("stmt", stmt, (stmt,))
+        node = cfg.node(idx)
+        node.add(nxt)
+        if _can_raise((stmt,)):
+            node.add(ctx.exc, exc=True)
+        return idx
+
+    def _build_loop(self, stmt, nxt: int, ctx: _Ctx) -> int:
+        cfg = self.cfg
+        if isinstance(stmt, ast.While):
+            exprs: Tuple[ast.AST, ...] = (stmt.test,)
+        else:
+            exprs = (stmt.target, stmt.iter)
+        header = cfg._new("loop", stmt, exprs)
+        after = self.build_block(stmt.orelse, nxt, ctx) \
+            if stmt.orelse else nxt
+        body_ctx = ctx.replace(brk=nxt, cont=header)
+        body = self.build_block(stmt.body, header, body_ctx)
+        node = cfg.node(header)
+        node.add(body)
+        node.add(after)
+        if _can_raise(exprs):
+            node.add(ctx.exc, exc=True)
+        return header
+
+    def _build_with(self, stmt, nxt: int, ctx: _Ctx) -> int:
+        cfg = self.cfg
+        # one header node evaluates every item's context expression
+        # (__enter__ runs here and can raise BEFORE the body is
+        # guarded); the body's own exceptions propagate to the same
+        # enclosing target — our context managers never suppress
+        exprs = tuple(item.context_expr for item in stmt.items)
+        idx = cfg._new("with", stmt, exprs)
+        body = self.build_block(stmt.body, nxt, ctx)
+        node = cfg.node(idx)
+        node.add(body)
+        node.add(ctx.exc, exc=True)  # __enter__ may raise
+        return idx
+
+    def _build_try(self, stmt: ast.Try, nxt: int, ctx: _Ctx) -> int:
+        cfg = self.cfg
+        if stmt.finalbody:
+            # route EVERY way out of the try through its own copy of
+            # the finally body (see module docstring); cache one copy
+            # per distinct continuation
+            copies: Dict[Tuple[int, bool], int] = {}
+
+            def through_finally(cont: int, exceptional: bool = False) -> int:
+                key = (cont, exceptional)
+                if key not in copies:
+                    # the finally body itself runs under the OUTER
+                    # context (its own raise replaces the in-flight one)
+                    copies[key] = self.build_block(
+                        list(stmt.finalbody), cont, ctx)
+                return copies[key]
+
+            inner_ctx = ctx.replace(
+                exc=through_finally(ctx.exc, exceptional=True),
+                ret=through_finally(ctx.ret))
+            if ctx.brk is not None:
+                inner_ctx = inner_ctx.replace(
+                    brk=through_finally(ctx.brk))
+            if ctx.cont is not None:
+                inner_ctx = inner_ctx.replace(
+                    cont=through_finally(ctx.cont))
+            inner_nxt = through_finally(nxt)
+            return self._build_try_core(stmt, inner_nxt, inner_ctx)
+        return self._build_try_core(stmt, nxt, ctx)
+
+    def _build_try_core(self, stmt: ast.Try, nxt: int, ctx: _Ctx) -> int:
+        """The handlers half (callers have already wrapped ``nxt``/
+        ``ctx`` in finally routing when a finalbody exists)."""
+        cfg = self.cfg
+        if not stmt.handlers:
+            body_entry = self.build_block(
+                stmt.body + list(stmt.orelse), nxt, ctx)
+            return body_entry
+        dispatch = cfg._new("except_dispatch", stmt, ())
+        broad = False
+        for handler in stmt.handlers:
+            t = handler.type
+            if t is None:
+                broad = True
+            elif isinstance(t, ast.Name) and t.id in _BROAD:
+                broad = True
+            elif isinstance(t, ast.Tuple) and any(
+                    isinstance(e, ast.Name) and e.id in _BROAD
+                    for e in t.elts):
+                broad = True
+            h_entry = self.build_block(handler.body, nxt, ctx)
+            cfg.node(dispatch).add(h_entry)
+        if not broad:
+            # no handler is broad: the exception may not match any and
+            # keeps propagating
+            cfg.node(dispatch).add(ctx.exc, exc=True)
+        body_ctx = ctx.replace(exc=dispatch)
+        orelse_entry = self.build_block(stmt.orelse, nxt, ctx) \
+            if stmt.orelse else nxt
+        return self.build_block(stmt.body, orelse_entry, body_ctx)
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """Build the CFG of one ``FunctionDef`` / ``AsyncFunctionDef`` (or
+    any object with a ``body`` list of statements)."""
+    cfg = CFG(func)
+    ctx = _Ctx(exc=cfg.raise_id, ret=cfg.exit_id, brk=None, cont=None)
+    cfg.entry = _Builder(cfg).build_block(list(func.body),
+                                          cfg.exit_id, ctx)
+    return cfg

@@ -110,6 +110,13 @@ class Rule:
     def end_file(self, ctx: FileContext) -> Iterable[Finding]:
         return ()
 
+    def finalize(self) -> Iterable[Finding]:
+        """Tree-wide findings, reported once after the LAST file (rules
+        that accumulate cross-file state: call graphs, lock-order
+        edges). The engine applies each finding's own file's
+        suppressions, same as per-file findings."""
+        return ()
+
     def finding(self, ctx: FileContext, node: ast.AST, message: str,
                 data: Optional[dict] = None) -> Finding:
         return Finding(ctx.rel, getattr(node, "lineno", 0),
@@ -175,6 +182,9 @@ class Engine:
         self.rules = list(rules)
         self.root = root  # rel-path anchor; None = leave paths as given
         self._dispatch: Dict[type, List[Rule]] = {}
+        # per-file suppression tables, kept so finalize() findings (the
+        # tree-wide rules) honor the same disable= comments
+        self._suppressed: Dict[str, Dict[int, Set[str]]] = {}
         for rule in self.rules:
             for t in rule.node_types:
                 self._dispatch.setdefault(t, []).append(rule)
@@ -196,6 +206,7 @@ class Engine:
                             "fix the syntax error")]
         ctx = FileContext(rel, source, tree)
         suppressed = _suppressions(source)
+        self._suppressed[rel] = suppressed
         findings: List[Finding] = []
         for rule in self.rules:
             rule.begin_file(ctx)
@@ -217,10 +228,26 @@ class Engine:
             source = f.read()
         return self.lint_source(source, self._rel(path))
 
+    def finish(self) -> List[Finding]:
+        """Run every rule's tree-wide ``finalize`` hook (after all
+        files have been linted) and filter the results through each
+        finding's own file's suppression table."""
+        findings: List[Finding] = []
+        for rule in self.rules:
+            findings.extend(rule.finalize())
+        out = []
+        for f in findings:
+            supp = self._suppressed.get(f.file, {}).get(f.line)
+            if supp and ("ALL" in supp or f.rule.upper() in supp):
+                continue
+            out.append(f)
+        return out
+
     def lint_paths(self, paths: Sequence[str]) -> List[Finding]:
         findings: List[Finding] = []
         for path in iter_py_files(paths):
             findings.extend(self.lint_file(path))
+        findings.extend(self.finish())
         findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
         return findings
 

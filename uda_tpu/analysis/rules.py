@@ -24,6 +24,15 @@ UDA008 no blocking call (``recv``/``sendall``/unbounded ``.result()``/
        in uda_tpu/net/ — registered callbacks are the functions marked
        ``@loop_callback`` (uda_tpu/net/evloop.py); the loop thread's
        own run loop is exempt (parking in select() is its job)
+UDA101 resource balance over the per-function CFG: every registered
+       acquire (uda_tpu/analysis/flow.py DEFAULT_PAIRS) must reach a
+       release/transfer/with-guard on EVERY path, exception edges
+       included (the udaflow dataflow tier, uda_tpu/analysis/cfg.py)
+UDA102 transitive blocking-under-lock / blocking-in-loop-callback via
+       the intra-package call graph (the helper hop UDA007/UDA008
+       cannot see)
+UDA103 static TrackedLock with-nesting order must be acyclic tree-wide
+       (the compile-time complement of runtime lockdep)
 ====== ==============================================================
 
 Every rule is constructor-injectable (registry/sites/flags overrides)
@@ -38,12 +47,15 @@ import re
 from typing import Iterable, List, Optional, Set, Tuple
 
 from uda_tpu.analysis.core import FileContext, Finding, Rule
+from uda_tpu.analysis.flow import (ResourceBalanceRule, StaticLockOrderRule,
+                                   TransitiveBlockingRule)
 
 __all__ = ["ALL_RULES", "default_engine",
            "ConfigKeyRule", "MetricsNameRule", "FailpointSiteRule",
            "RawSocketCloseRule", "ReasonStringBranchRule",
            "SwallowedExceptionRule", "BlockingInLockRule",
-           "EventLoopBlockingRule"]
+           "EventLoopBlockingRule", "ResourceBalanceRule",
+           "TransitiveBlockingRule", "StaticLockOrderRule"]
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -548,7 +560,10 @@ class EventLoopBlockingRule(Rule):
 ALL_RULES = (ConfigKeyRule, MetricsNameRule, FailpointSiteRule,
              RawSocketCloseRule, ReasonStringBranchRule,
              SwallowedExceptionRule, BlockingInLockRule,
-             EventLoopBlockingRule)
+             EventLoopBlockingRule,
+             # the udaflow dataflow tier (uda_tpu/analysis/flow.py)
+             ResourceBalanceRule, TransitiveBlockingRule,
+             StaticLockOrderRule)
 
 
 def default_engine(root: Optional[str] = None):

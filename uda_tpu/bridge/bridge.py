@@ -44,6 +44,7 @@ from uda_tpu.utils.errors import FallbackSignal, ProtocolError, UdaError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.logging import LogLevel, get_logger
 from uda_tpu.utils.metrics import metrics, stats_enabled_from_env
+from uda_tpu.utils.resledger import resledger
 from uda_tpu.utils.stats import (StatsReporter, reporter_output_from_env,
                                  telemetry_block)
 
@@ -370,6 +371,10 @@ class UdaBridge:
             if self._stats is not None:
                 self._stats.stop(final=False)
                 self._stats = None
+            # the reduce task is over: EVERY obligation — leases, fd
+            # pins, paired-gauge increments, scoped failpoints — must
+            # be settled (the process-end full drain, no pair filter)
+            resledger.drain("bridge.exit")
         else:
             raise ProtocolError(f"unexpected command {header.name} for "
                                 "NetMerger role")
@@ -563,6 +568,10 @@ class UdaBridge:
             if self._stats is not None:
                 self._stats.stop(final=True)
                 self._stats = None
+            # supplier side of the process-end full drain: with the
+            # server stopped and the engine shut down, the books must
+            # be empty (anything open leaked past both scoped drains)
+            resledger.drain("bridge.exit")
         else:
             raise ProtocolError(f"unexpected command {header.name} for "
                                 "MOFSupplier role")

@@ -87,6 +87,7 @@ from uda_tpu.utils.ifile import EOF_MARKER, RecordBatch
 from uda_tpu.utils.locks import TrackedCondition, TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
+from uda_tpu.utils.resledger import resledger
 
 __all__ = ["OverlappedMerger", "MIN_RUN_CAPACITY"]
 
@@ -364,7 +365,10 @@ class OverlappedMerger:
             if self._aborted:
                 return -1
             self._inflight += charge
-        metrics.gauge_add("stage.inflight.bytes", charge)
+        # the +charge rides the returned int: feed() pairs every
+        # non-negative _charge() with exactly one _release_charge()
+        # (consumer dispatch, abort drain, or its own unwind)
+        metrics.gauge_add("stage.inflight.bytes", charge)  # udalint: disable=UDA101
         return charge
 
     def _release_charge(self, charge: int) -> None:
@@ -571,14 +575,21 @@ class OverlappedMerger:
         # device runs pad to a power-of-two capacity (bounded set of
         # kernel shapes); host runs stay exact-sized
         cap = _next_pow2(n) if self.engine == "pallas" else n
-        lease = None
         if self._buf_pool is not None:
             lease = self._buf_pool.lease(cap, kw + merge_ops.ROW_EXTRA_COLS)
-            rows = lease
-        else:
-            rows = np.empty((cap, kw + merge_ops.ROW_EXTRA_COLS), np.uint32)
+            try:
+                merge_ops.fill_run_rows(lease, packed, order, seg_index)
+                return _StagedRun(seg_index, lease, n, lease, fed_t, 0)
+            except BaseException:
+                # a packing failure (bad order vector, width drift)
+                # must not strand the host buffer: the abort drain
+                # asserts the pool is whole, and a leaked lease pins
+                # staging budget forever
+                self._buf_pool.release(lease)
+                raise
+        rows = np.empty((cap, kw + merge_ops.ROW_EXTRA_COLS), np.uint32)
         merge_ops.fill_run_rows(rows, packed, order, seg_index)
-        return _StagedRun(seg_index, rows, n, lease, fed_t, 0)
+        return _StagedRun(seg_index, rows, n, None, fed_t, 0)
 
     def _overflow_order(self, batch: RecordBatch, n: int) -> np.ndarray:
         """Full-comparator sort order for an oversize-key run. Default
@@ -638,7 +649,11 @@ class OverlappedMerger:
         with self._forest_lock:
             while run.bucket in self._forest:
                 other = self._forest.pop(run.bucket)
-                run = self._merge(other, run)
+                # the transitive join() is the split merge waiting on
+                # its OWN compute workers — bounded work on data already
+                # in hand, not a wait on external progress; serializing
+                # carries under the lock is the forest design
+                run = self._merge(other, run)  # udalint: disable=UDA102
             self._forest[run.bucket] = run
 
     def _merge(self, a: _Run, b: _Run) -> _Run:
@@ -661,8 +676,16 @@ class OverlappedMerger:
             out = self._buf_pool.lease(total, int(a.rows.shape[1]))
             parts = (self._merge_parts
                      if total >= _MERGE_SPLIT_MIN_ROWS else 1)
-            if merge_ops.merge_rows_split_into(
-                    a.rows[:a.valid], b.rows[:b.valid], out, parts):
+            try:
+                ok = merge_ops.merge_rows_split_into(
+                    a.rows[:a.valid], b.rows[:b.valid], out, parts)
+            except BaseException:
+                # a failed native merge fails the segment upstream; the
+                # output lease must go back to the pool on that path
+                # too, or every retry shrinks the staging budget
+                self._buf_pool.release(out)
+                raise
+            if ok:
                 self._buf_pool.release(a.lease)
                 self._buf_pool.release(b.lease)
                 a.lease = b.lease = None
@@ -731,6 +754,51 @@ class OverlappedMerger:
         if self._error is not None:
             raise self._error
 
+    def _release_run(self, run) -> None:
+        """Recycle a run's pool lease (idempotent: lease goes to None)."""
+        if run is not None and run.lease is not None \
+                and self._buf_pool is not None:
+            self._buf_pool.release(run.lease)
+            run.lease = None
+
+    def _release_forest(self) -> None:
+        """Recycle every forest run's pool lease (the abort / overflow-
+        fallback paths abandon the forest without merging it — the
+        leases must still go home or the drain point reports them)."""
+        with self._forest_lock:
+            runs, self._forest = list(self._forest.values()), {}
+        for run in runs:
+            self._release_run(run)
+
+    def _finish_cleanup(self, acc) -> None:
+        """THE finish-path cleanup contract, shared by every finish
+        flavor's ``finally``: the final accumulated run's lease and any
+        abandoned forest runs' leases go home, then the drain point
+        asserts this merger's pool books are empty."""
+        self._release_run(acc)
+        self._release_forest()
+        self._ledger_drain("merger.finish")
+
+    def _ledger_drain(self, point: str) -> None:
+        """ResourceLedger drain point (UDA_TPU_RESLEDGER=1): with this
+        merger finished or aborted, its pool leases must all be
+        settled — anything open is the lost-worker-buffer leak shape,
+        reported with its acquire stack. Drained under this merger's
+        pool OWNER scope, so a concurrent merger's legitimately-open
+        leases are untouched. The staging GAUGES are deliberately not
+        drained here: their ledger records are process-global
+        (owner-less), so a per-merger drain would confiscate a
+        concurrent merger's live charges — and abort() additionally
+        races in-flight feed() calls whose charges the PR 9 re-drain
+        settles only after abort returns. Gauge obligations are
+        asserted at the genuinely quiescent points instead: the
+        per-test conftest teardown and the bridge-EXIT full drain."""
+        if not resledger.enabled:
+            return
+        if self._buf_pool is not None:
+            resledger.drain(point, pairs=("pool.lease",),
+                            owner=id(self._buf_pool))
+
     def _merge_leftovers(self) -> Optional[_Run]:
         """Merge the O(log k) leftover forest runs, smallest-first; on
         the pallas engine, pad the smaller run up to the larger capacity
@@ -775,23 +843,27 @@ class OverlappedMerger:
         """Drain, merge the leftover forest, and materialize the sorted
         batch. ``batches`` must be ALL segments' batches in original
         segment-index order (the indices fed to :meth:`feed`)."""
-        self._drain()
-        if self._overflow:
-            self._warn_overflow("global device re-sort")
-            return merge_ops.merge_batches(batches, self.key_type,
-                                           self.width)
-        cat = RecordBatch.concat(list(batches))
-        acc = self._merge_leftovers()
-        if not self._check_accounting(acc, cat.num_records):
-            return cat  # all segments legitimately empty
-        rows = np.asarray(acc.rows)[:acc.valid]
-        kw = rows.shape[1] - 3
-        seg_col = rows[:, kw + 1].astype(np.int64)
-        row_col = rows[:, kw + 2].astype(np.int64)
-        sizes = np.asarray([b.num_records for b in batches], np.int64)
-        offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
-        perm = offsets[seg_col] + row_col
-        return cat.take(perm)
+        acc = None
+        try:
+            self._drain()
+            if self._overflow:
+                self._warn_overflow("global device re-sort")
+                return merge_ops.merge_batches(batches, self.key_type,
+                                               self.width)
+            cat = RecordBatch.concat(list(batches))
+            acc = self._merge_leftovers()
+            if not self._check_accounting(acc, cat.num_records):
+                return cat  # all segments legitimately empty
+            rows = np.asarray(acc.rows)[:acc.valid]
+            kw = rows.shape[1] - 3
+            seg_col = rows[:, kw + 1].astype(np.int64)
+            row_col = rows[:, kw + 2].astype(np.int64)
+            sizes = np.asarray([b.num_records for b in batches], np.int64)
+            offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+            perm = offsets[seg_col] + row_col
+            return cat.take(perm)
+        finally:
+            self._finish_cleanup(acc)
 
     def emit_stream(self, batches: Sequence[RecordBatch], emitter,
                     consumer) -> int:
@@ -803,33 +875,39 @@ class OverlappedMerger:
         staging-loop memory model over memory-resident segments)."""
         from uda_tpu.merger import streaming as stream_mod
 
-        with metrics.timer("merge"):
-            self._drain()
-            merged = None
-            if self._overflow:
-                self._warn_overflow("global device re-sort")
-                merged = merge_ops.merge_batches(batches, self.key_type,
-                                                 self.width)
-            else:
-                total = sum(b.num_records for b in batches)
-                acc = self._merge_leftovers()
-        if merged is not None:
-            return emitter.emit_batch(merged, consumer)
-        if not self._check_accounting(acc, total):
-            return emitter.emit_framed(iter([EOF_MARKER]), consumer)
-        kw = int(acc.rows.shape[1]) - 3
+        acc = None
+        try:
+            with metrics.timer("merge"):
+                self._drain()
+                merged = None
+                if self._overflow:
+                    self._warn_overflow("global device re-sort")
+                    merged = merge_ops.merge_batches(batches, self.key_type,
+                                                     self.width)
+                else:
+                    total = sum(b.num_records for b in batches)
+                    acc = self._merge_leftovers()
+            if merged is not None:
+                return emitter.emit_batch(merged, consumer)
+            if not self._check_accounting(acc, total):
+                return emitter.emit_framed(iter([EOF_MARKER]), consumer)
+            kw = int(acc.rows.shape[1]) - 3
 
-        def pieces():
-            from uda_tpu import native
+            def pieces():
+                from uda_tpu import native
 
-            for rows in stream_mod.iter_row_slabs(acc.rows, acc.valid):
-                seg = rows[:, kw + 1].astype(np.int64)
-                row = rows[:, kw + 2].astype(np.int64)
-                sub = stream_mod.slab_batch(batches, seg, row)
-                yield native.frame_batch(sub, write_eof=False)
-            yield EOF_MARKER
+                for rows in stream_mod.iter_row_slabs(acc.rows, acc.valid):
+                    seg = rows[:, kw + 1].astype(np.int64)
+                    row = rows[:, kw + 2].astype(np.int64)
+                    sub = stream_mod.slab_batch(batches, seg, row)
+                    yield native.frame_batch(sub, write_eof=False)
+                yield EOF_MARKER
 
-        return emitter.emit_framed(pieces(), consumer)
+            return emitter.emit_framed(pieces(), consumer)
+        finally:
+            # emit_framed fully consumes pieces() before returning, so
+            # the lease recycle here never races the emission
+            self._finish_cleanup(acc)
 
     def finish_streaming(self, emitter, consumer,
                          expected_records: Optional[int] = None) -> int:
@@ -845,6 +923,7 @@ class OverlappedMerger:
         store = self.run_store
         if store is None:
             raise MergeError("finish_streaming without a run store")
+        acc = None
         try:
             no_forest = self._overflow or not self.device_runs
             with metrics.timer("merge"):
@@ -884,6 +963,7 @@ class OverlappedMerger:
                 stream_mod.interleave_runs(slabs, store, kw), consumer)
         finally:
             store.cleanup()
+            self._finish_cleanup(acc)
 
     def abort(self) -> None:
         """Stop the staging threads without producing output. Safe with
@@ -921,3 +1001,9 @@ class OverlappedMerger:
                          "scratch runs for it to fail safely")
             else:
                 self.run_store.cleanup()
+        if not stragglers:
+            # the abandoned forest's leases go home, then the drain
+            # point asserts nothing else is still open (a straggler
+            # thread may still legitimately hold leases — no drain)
+            self._release_forest()
+            self._ledger_drain("merger.abort")

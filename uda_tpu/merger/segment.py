@@ -476,7 +476,10 @@ class Segment:
         # +1 per attempt epoch, -1 when that epoch settles (accepted
         # completion, timeout-generated completion, sync raise, or
         # abandonment of a speculation loser)
-        metrics.gauge_add("fetch.on_air", 1)
+        # the +1 hands off to the attempt epoch: _on_complete (accepted
+        # or timeout-generated completion) owns the -1; only the sync
+        # raise below settles it here
+        metrics.gauge_add("fetch.on_air", 1)  # udalint: disable=UDA101
         try:
             # the failpoint is inside the try: an injected raise takes
             # the same sync-failure path as a stopped transport. The
@@ -494,10 +497,18 @@ class Segment:
             # the segment, never escape into the transport's thread
             with self._lock:
                 self._issuing = False
-                self._epoch_settled = True
-                self._open_attempts -= 1
-                self._attempt_hosts.pop(epoch, None)
-            metrics.gauge_add("fetch.on_air", -1)
+                # settle only a LIVE attempt: fail() (watchdog rescue /
+                # stop drain) may have settled this epoch's on-air
+                # charge while we were wedged inside the issue — a
+                # second decrement here would push the gauge negative
+                # forever (found by the ResourceLedger teardown gate)
+                live = epoch in self._attempt_hosts
+                if live:
+                    self._epoch_settled = True
+                    self._open_attempts -= 1
+                    self._attempt_hosts.pop(epoch, None)
+            if live:
+                metrics.gauge_add("fetch.on_air", -1)
             return e
         with self._lock:
             self._issuing = False
@@ -582,7 +593,9 @@ class Segment:
             self._attempt_hosts[spec_epoch] = alt
             self._open_attempts += 1
         metrics.add("fetch.speculated", supplier=alt or self.map_id)
-        metrics.gauge_add("fetch.on_air", 1)
+        # hands off to the speculative epoch: _on_complete settles the
+        # winner, _drop_attempt the loser (and the sync-raise path)
+        metrics.gauge_add("fetch.on_air", 1)  # udalint: disable=UDA101
         log.warn(f"fetch of {self.map_id} chunk at {offset} is a "
                  f"straggler; speculating against "
                  f"{alt or 'the same source'}")
@@ -727,11 +740,34 @@ class Segment:
                 # already-served bytes are never refetched.
                 deadline_hit = False
                 # transport capability probed OUTSIDE self._lock (the
-                # client has locks of its own; no order edge wanted)
+                # client has locks of its own; no order edge wanted).
+                # Resumable failures: a disconnect (TransportError), or
+                # a REMOTE StorageError (structured remote_kind stamp,
+                # net/wire.py) — the supplier answered with a typed ERR
+                # frame on a healthy stream, so every chunk ingested
+                # before it is valid and a transient pread failure must
+                # not cost a full refetch (a per-call fault probability
+                # compounds over a partition's chunk count, so refetch-
+                # from-zero retries lose ground they never recover —
+                # the chaos error-schedule livelock shape). A LOCAL
+                # StorageError (no remote_kind) still restarts from
+                # zero: that class includes the resume-identity
+                # invalidation below, which exists to force exactly
+                # that restart.
+                remote_storage = (isinstance(result, StorageError)
+                                  and getattr(result, "remote_kind",
+                                              None) is not None)
                 resumable = (self.resume_enabled
-                             and isinstance(result, TransportError)
+                             and (isinstance(result, TransportError)
+                                  or remote_storage)
                              and self.client.resume_ok(self.host))
                 with self._lock:
+                    if self._done.is_set():
+                        # administratively failed (fail()) while this
+                        # attempt was in flight: the segment's fate is
+                        # sealed — retrying into a dead job would only
+                        # burn backoff timers and churn the penalty box
+                        return
                     retry = self._retries_left > 0
                     if retry and self._deadline is not None \
                             and time.monotonic() >= self._deadline:

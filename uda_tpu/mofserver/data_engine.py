@@ -42,6 +42,7 @@ from uda_tpu.utils.errors import StorageError
 from uda_tpu.utils.failpoints import failpoint, failpoints
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
+from uda_tpu.utils.resledger import resledger
 
 __all__ = ["ShuffleRequest", "FetchResult", "FdSlice", "DataEngine"]
 
@@ -176,6 +177,7 @@ class _FdCache:
                 if ent[1] == 0:
                     self._idle.remove(path)
                 ent[1] += 1
+                resledger.acquire("engine.fd", key=path, owner=id(self))
                 return ent[0]
         fd = os.open(path, os.O_RDONLY)
         with self._lock:
@@ -185,8 +187,10 @@ class _FdCache:
                     self._idle.remove(path)
                 ent[1] += 1
                 os.close(fd)
+                resledger.acquire("engine.fd", key=path, owner=id(self))
                 return ent[0]
             self._fds[path] = [fd, 1, None]
+            resledger.acquire("engine.fd", key=path, owner=id(self))
             return fd
 
     def mmap_for(self, path: str):
@@ -235,6 +239,9 @@ class _FdCache:
             if not ent:
                 return
             ent[1] -= 1
+            # one ref settled (the ledger ignores an over-release's
+            # unmatched settle, same as the refcount clamp below)
+            resledger.settle("engine.fd", key=path, owner=id(self))
             if ent[1] > 0:
                 return
             ent[1] = 0
@@ -380,7 +387,10 @@ class DataEngine:
             raise StorageError("DataEngine is stopped")
         want = req.chunk_size or self.chunk_size_default
         self._admit_bytes(want)
-        metrics.gauge_add("supplier.reads.on_air", 1)
+        # the +1 rides the returned Future: _serve's finally owns the
+        # -1 on every outcome; the except below covers the one path
+        # where the pool never ran it
+        metrics.gauge_add("supplier.reads.on_air", 1)  # udalint: disable=UDA101
         try:
             return self._pool.submit(self._serve, req, want)
         except BaseException:  # pool shutdown race: undo the accounting
@@ -432,7 +442,8 @@ class DataEngine:
             raise StorageError("DataEngine is stopped")
         want = req.chunk_size or self.chunk_size_default
         self._admit_bytes(want)
-        metrics.gauge_add("supplier.reads.on_air", 1)
+        # same handoff as submit(): _serve_plan's finally owns the -1
+        metrics.gauge_add("supplier.reads.on_air", 1)  # udalint: disable=UDA101
         try:
             return self._pool.submit(self._serve_plan, req, want)
         except BaseException:  # pool shutdown race: undo the accounting
@@ -513,12 +524,20 @@ class DataEngine:
         want = min(req.chunk_size or self.chunk_size_default,
                    served - req.offset)
         fd = self._fds.acquire(rec.path)
-        metrics.add("supplier.bytes", want)
-        return FdSlice(fd=fd, file_offset=rec.start_offset + req.offset,
-                       length=want, raw_length=rec.raw_length,
-                       part_length=rec.part_length, offset=req.offset,
-                       path=rec.path, last=req.offset + want >= served,
-                       _engine=self, _admitted=admitted)
+        try:
+            metrics.add("supplier.bytes", want)
+            return FdSlice(fd=fd, file_offset=rec.start_offset + req.offset,
+                           length=want, raw_length=rec.raw_length,
+                           part_length=rec.part_length, offset=req.offset,
+                           path=rec.path, last=req.offset + want >= served,
+                           _engine=self, _admitted=admitted)
+        except BaseException:
+            # the slice never existed, so its release() never runs: the
+            # fd pin must unwind here or the cache entry's refcount rots
+            # and the MOF's fd outlives every request (refcount-rot is
+            # the RDMAbox-class failure the ledger exists to catch)
+            self._fds.release(rec.path)
+            raise
 
     def fetch(self, req: ShuffleRequest) -> FetchResult:
         """Synchronous fetch with a deadline. A wedged read (native pool
@@ -598,6 +617,14 @@ class DataEngine:
         if self._native is not None:
             self._native.close()
         self._fds.close_all()
+        # ResourceLedger drain point (UDA_TPU_RESLEDGER=1): with the
+        # pool drained and the fd cache closed, every fd pin handed out
+        # by THIS engine's cache (owner scope: a concurrently-live
+        # peer engine's pins are untouched — the killed-supplier chaos
+        # shape) must have been released; an open one is an FdSlice
+        # that never ran release() — the refcount-rot leak class
+        resledger.drain("data_engine.stop", pairs=("engine.fd",),
+                        owner=id(self._fds))
 
     def __enter__(self) -> "DataEngine":
         return self

@@ -190,7 +190,9 @@ class _ClientConn:
         if self.dead:
             return
         if mask & _READ:
-            self._do_read()
+            # the transitive recv_into is on THIS loop's non-blocking
+            # socket: it returns EWOULDBLOCK instead of parking
+            self._do_read()  # udalint: disable=UDA102
 
     def _do_read(self) -> None:
         # Fill-based recv batching, straight into the final destination

@@ -322,7 +322,9 @@ class _EvConn:
         if self.closed:
             return
         if mask & _READ and not self._read_paused and not self.draining:
-            self._do_read()
+            # the transitive recv_into is on THIS loop's non-blocking
+            # socket: it returns EWOULDBLOCK instead of parking
+            self._do_read()  # udalint: disable=UDA102
 
     def _do_read(self) -> None:
         try:
@@ -1091,6 +1093,12 @@ class EvLoopShuffleServer:
             time.sleep(0.005)
         loop.stop()
         self._loop = None
+        # Deliberately NOT a ResourceLedger drain point: the engine
+        # outlives the server (a warm bounce reuses it, and its pool
+        # may still be running a delayed pread for a force-closed conn
+        # — that pread's fd pin is live, not leaked). fd-pin quiescence
+        # is asserted where it is a contract: DataEngine.stop (pool
+        # drained, cache closed) and the bridge-EXIT full drain.
 
     def __enter__(self) -> "EvLoopShuffleServer":
         return self

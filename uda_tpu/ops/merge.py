@@ -41,6 +41,13 @@ from uda_tpu.ops.pallas_merge import merge_sorted_pair
 from uda_tpu.utils.comparators import KeyType
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.metrics import metrics
+from uda_tpu.utils.resledger import resledger
+
+
+def _buf_key(flat: np.ndarray) -> int:
+    """Ledger identity of a pool buffer: its base data pointer (stable
+    across the lease's view reshapes; cheap on both sides)."""
+    return int(flat.__array_interface__["data"][0])
 
 __all__ = ["merge_batches", "merge_batches_host", "merge_iter_host",
            "merge_record_streams", "sorted_batch_order",
@@ -199,13 +206,20 @@ class RowBufferPool:
 
     def lease(self, rows: int, cols: int) -> np.ndarray:
         need = rows * cols
+        got = None
         with self._lock:
             for i, buf in enumerate(self._free):
                 if buf.size >= need:
                     got = self._free.pop(i)
                     metrics.add("stage.buffer.reuses")
-                    return got[:need].reshape(rows, cols)
-        return np.empty((rows, cols), np.uint32)
+                    break
+        if got is None:
+            got = np.empty(need, np.uint32)
+        # ledger key = the base buffer's data pointer: release() walks
+        # any view back to the same base, so both sides reproduce it
+        resledger.acquire("pool.lease", key=_buf_key(got),
+                          owner=id(self), amount=need * 4)
+        return got[:need].reshape(rows, cols)
 
     def release(self, view: Optional[np.ndarray]) -> None:
         if view is None:
@@ -214,6 +228,7 @@ class RowBufferPool:
         while base.base is not None:
             base = base.base
         flat = np.asarray(base, np.uint32).reshape(-1)
+        resledger.settle("pool.lease", key=_buf_key(flat), owner=id(self))
         with self._lock:
             self._free.append(flat)
             self._free.sort(key=lambda b: b.size)

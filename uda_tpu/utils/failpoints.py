@@ -265,14 +265,21 @@ class FailpointRegistry:
     def scoped(self, spec: str) -> Iterator["FailpointRegistry"]:
         """Arm ``spec`` for the duration of a with-block, restoring the
         previous arming (including trigger state) on exit."""
+        from uda_tpu.utils.resledger import resledger
+
         with self._lock:
             saved = dict(self._sites)
         try:
             self.arm_spec(spec)
+            # an armed scope is an open obligation (ctx.failpoints.
+            # scoped): a scope that never unwinds leaves the whole
+            # process armed — the leak the drain points must see
+            resledger.acquire("ctx.failpoints.scoped", key=spec)
             yield self
         finally:
             with self._lock:
                 self._sites = saved
+            resledger.settle("ctx.failpoints.scoped", key=spec)
 
     def evaluate(self, site: str, data: Optional[bytes],
                  key: str) -> Optional[bytes]:
@@ -333,7 +340,13 @@ def chaos_spec(seed: int) -> str:
     per schedule (``segment.fetch`` only ever delays): two independent
     periodic error sites can phase-lock against a multi-call segment and
     livelock the retry loop by construction, which would be a bug in the
-    schedule, not in the engine."""
+    schedule, not in the engine. The ``error:every:N`` shape relies on
+    resume for the same reason: a retry that refetched its partition
+    from offset 0 would re-hit a periodic error at the same phase
+    EVERY attempt once the partition spans >= N chunks (observed: the
+    warm-restart completion rung) — the offset-ledger resume across
+    remote errors (merger/segment.py) is what lets each attempt bank
+    its progress and converge under this schedule."""
     rng = random.Random(seed)
     pread = rng.choice([
         f"error:every:{rng.randint(4, 8)}",

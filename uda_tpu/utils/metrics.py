@@ -49,6 +49,7 @@ from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
 from uda_tpu.utils.locks import TrackedLock
+from uda_tpu.utils.resledger import resledger as _resledger
 
 __all__ = ["Metrics", "Span", "metrics", "device_trace",
            "METRICS_REGISTRY", "REGISTRY_PREFIXES", "NAME_RE",
@@ -155,6 +156,11 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                   "deadlocks) detected by the runtime "
                                   "validator (utils/locks.py, "
                                   "UDA_TPU_LOCKDEP=1)"),
+    "resledger.leaks": ("counter", "obligations (leases, fd pins, "
+                                   "admission charges, paired-gauge "
+                                   "increments) still open at a drain "
+                                   "point (utils/resledger.py, "
+                                   "UDA_TPU_RESLEDGER=1)"),
     # -- counters: supplier / emit / merge / exchange --------------------
     "supplier.bytes": ("counter", "bytes served by the DataEngine"),
     "emit.bytes": ("counter", "framed bytes handed to the consumer"),
@@ -431,11 +437,16 @@ class Metrics:
     (two dict writes under one lock); histograms and spans cost nothing
     until enabled."""
 
-    def __init__(self, stats: Optional[bool] = None) -> None:
+    def __init__(self, stats: Optional[bool] = None,
+                 ledger=None) -> None:
         # lockdep-tracked (utils/locks.py): the metrics hub is a LEAF
         # lock — every layer counts under its own locks, so an edge
         # OUT of "metrics" would itself be a design smell
         self._lock = TrackedLock("metrics")
+        # the ResourceLedger mirroring paired gauges (utils/resledger):
+        # only the global hub carries one — private Metrics() fixtures
+        # must never feed the process-wide obligation books
+        self._ledger = ledger
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, _Hist] = {}
@@ -506,10 +517,17 @@ class Metrics:
 
     def gauge_add(self, name: str, delta: float, **labels) -> None:
         """Adjust a gauge by ``delta`` (the on-air increment/decrement
-        idiom of the reference's AIO counters)."""
+        idiom of the reference's AIO counters). Paired gauges (the
+        increment-must-meet-decrement set, resledger.PAIRED_GAUGES)
+        additionally flow through the armed ResourceLedger, so a +1
+        whose -1 never lands is reported with the +1's stack at the
+        next drain point."""
         key = _series_key(name, labels) if labels else name
         with self._lock:
             self.gauges[key] = self.gauges.get(key, 0.0) + delta
+        led = self._ledger
+        if led is not None and led.enabled and not labels:
+            led.note_gauge(name, delta)
 
     # -- histograms ---------------------------------------------------------
 
@@ -745,4 +763,4 @@ def device_trace(log_dir: str | None = None) -> Iterator[None]:
             get_logger().warn(f"device trace stop failed: {e}")
 
 
-metrics = Metrics()
+metrics = Metrics(ledger=_resledger)
