@@ -46,7 +46,7 @@ fi
 # build failure, like the reference's scripts/build check_* gates.
 # The machine-readable findings land in the artifacts (udalint.json)
 # so downstream gates consume them structurally, never by grep.
-echo "-- udalint static analysis (incl. udaflow UDA101-UDA103)" \
+echo "-- udalint static analysis (incl. UDA009 span names + udaflow UDA101-UDA103)" \
   | tee -a "$ART/ci.log"
 # human-readable gate FIRST (findings must land in ci.log/console);
 # the machine-readable artifact only runs on a clean tree, where the
@@ -58,10 +58,21 @@ echo "-- unit + engine tests" | tee -a "$ART/ci.log"
 python -m pytest tests/ -q 2>&1 | tee "$ART/pytest.log" | tail -2
 
 # Network data plane: a real server + 2 concurrent reduce clients over
-# 127.0.0.1, byte-compared against the in-process path (uda_tpu/net/).
+# 127.0.0.1, byte-compared against the in-process path (uda_tpu/net/),
+# with span tracing on — the smoke's span JSONL feeds the trace-merge
+# gate below, and the smoke itself now round-trips one MSG_STATS poll.
 echo "-- net loopback smoke" | tee -a "$ART/ci.log"
-env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-  python scripts/net_smoke.py 2>&1 | tee -a "$ART/ci.log" | tail -1
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
+  python scripts/net_smoke.py --spans "$ART/net_smoke_spans.jsonl" \
+  2>&1 | tee -a "$ART/ci.log" | tail -1
+
+# Trace-merge gate: the smoke's span file must stitch into one valid
+# Perfetto-loadable Chrome trace (empty or unparsable span files fail;
+# the cross-process link assertion rides tier-1's two-process-shaped
+# e2e in tests/test_observability.py — the smoke is one process).
+echo "-- trace merge (net smoke spans)" | tee -a "$ART/ci.log"
+python scripts/trace_merge.py "$ART/net_smoke_spans.jsonl" \
+  --out "$ART/net_smoke_trace.json" 2>&1 | tee -a "$ART/ci.log" | tail -1
 
 # Net data-plane bench, quick mode: single-stream + p99 latency + the
 # 256-connection fan-in on the event-loop core. Gates on correctness

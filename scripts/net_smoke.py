@@ -43,6 +43,16 @@ def run_reduce(port: int, reduce_id: int, out: dict) -> None:
 
 
 def main() -> int:
+    # optional span export (--spans <path>): record the whole smoke as
+    # a span tree and write the per-process JSONL file that
+    # scripts/trace_merge.py stitches — the ci.sh trace gate. The
+    # wire's trace context makes the in-process server's net.serve /
+    # engine.pread spans children of each reducer's fetch spans.
+    spans_out = None
+    argv = sys.argv[1:]
+    if "--spans" in argv:
+        spans_out = argv[argv.index("--spans") + 1]
+        metrics.enable_spans()
     tmp = tempfile.mkdtemp(prefix="uda_net_smoke_")
     make_mof_tree(tmp, JOB, NUM_MAPS, NUM_REDUCERS, records_per_map=200,
                   seed=42)
@@ -74,9 +84,22 @@ def main() -> int:
                 print(f"NET SMOKE FAIL: reducer {r} output differs from "
                       f"the LocalFetchClient path")
                 return 1
+        # the introspection plane: one MSG_STATS poll against the live
+        # server must return counters + the resledger block (the
+        # udatop scrape surface)
+        from uda_tpu.net.client import fetch_remote_stats
+        snap = fetch_remote_stats("127.0.0.1", server.port)
+        if "counters" not in snap or "resledger" not in snap \
+                or "net.server" not in snap.get("providers", {}):
+            print(f"NET SMOKE FAIL: MSG_STATS snapshot incomplete: "
+                  f"{sorted(snap)}")
+            return 1
     finally:
         server.stop()
         engine.stop()
+    if spans_out is not None:
+        n = metrics.export_spans_jsonl(spans_out)
+        print(f"NET SMOKE: {n} spans -> {spans_out}")
     print(f"NET SMOKE OK: {NUM_REDUCERS} concurrent reduce clients, "
           f"{int(metrics.get('net.requests'))} requests, "
           f"{int(metrics.get('net.bytes.out', role='server'))} B served, "

@@ -21,12 +21,24 @@ SEED="${CHAOS_SEED:-$RANDOM}"
 SPEC="$(python -c "from uda_tpu.utils.failpoints import chaos_spec; print(chaos_spec(${SEED}))")"
 OUT="${CHAOS_TELEMETRY_JSON:-CHAOS_TELEMETRY.json}"
 COUNTERS="$(mktemp)"
-trap 'rm -f "${COUNTERS}"' EXIT
+# flight-recorder dump dirs, one per rung (utils/flightrec.py): every
+# FallbackSignal/stall/resledger-leak inside a rung black-boxes its
+# event stream here; the telemetry merge below archives the dumps per
+# rung into CHAOS_TELEMETRY.json — and a rung that FAILS without
+# leaving a dump is itself a failure (a fault path that dies without
+# its post-mortem defeats the recorder's purpose).
+FRROOT="$(mktemp -d)"
+export FRROOT  # the telemetry merge below reads the dumps from it
+for r in main pressure network exchange completion pipeline lockdep; do
+  mkdir -p "${FRROOT}/${r}"
+done
+trap 'rm -f "${COUNTERS}"; rm -rf "${FRROOT}"' EXIT
 echo "chaos seed:          ${SEED}"
 echo "failpoint schedule:  ${SPEC}"
 
 rc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/main" \
     UDA_TPU_CHAOS_TELEMETRY="${COUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
     --continue-on-collection-errors "$@" || rc=$?
@@ -39,10 +51,11 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
 # themselves pin tiny uda.tpu.*.budget knobs (tests/test_budget.py).
 PSPEC="data_engine.pread=delay:$((SEED % 20 + 5)):prob:0.3:seed:${SEED},segment.fetch=delay:$((SEED % 8 + 1)):prob:0.15:seed:${SEED}"
 PCOUNTERS="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}"; rm -rf "${FRROOT}"' EXIT
 echo "pressure schedule:   ${PSPEC}"
 prc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/pressure" \
     UDA_TPU_CHAOS_TELEMETRY="${PCOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
     -k "pressure or watchdog or budget" \
@@ -62,10 +75,11 @@ NSPEC="$(python -c "from uda_tpu.utils.failpoints import net_chaos_spec; print(n
 NCOUNTERS="$(mktemp)"
 NCYCLES="$(mktemp)"
 NLEAKS="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}"; rm -rf "${FRROOT}"' EXIT
 echo "network schedule:    ${NSPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
 nrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/network" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${NCYCLES}" \
     UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${NLEAKS}" \
     UDA_TPU_CHAOS_TELEMETRY="${NCOUNTERS}" \
@@ -83,10 +97,11 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
 # the device exchange shares with everything else.
 ECOUNTERS="$(mktemp)"
 ECYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}"; rm -rf "${FRROOT}"' EXIT
 echo "exchange rung:       scoped exchange.round schedules (UDA_TPU_LOCKDEP=1)"
 erc=0
 env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/exchange" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${ECYCLES}" \
     UDA_TPU_CHAOS_TELEMETRY="${ECOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
@@ -105,10 +120,11 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
 CCOUNTERS="$(mktemp)"
 CCYCLES="$(mktemp)"
 CLEAKS="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}"; rm -rf "${FRROOT}"' EXIT
 echo "completion rung:     seeded supplier kill + warm restart (seed ${SEED}, UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
 crc=0
 env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 UDA_TPU_CHAOS_SEED="${SEED}" \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/completion" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${CCYCLES}" \
     UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${CLEAKS}" \
     UDA_TPU_CHAOS_TELEMETRY="${CCOUNTERS}" \
@@ -127,10 +143,11 @@ PIPESPEC="data_engine.pread=delay:$((SEED % 15 + 5)):prob:0.25:seed:${SEED},deco
 PICOUNTERS="$(mktemp)"
 PICYCLES="$(mktemp)"
 PILEAKS="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}"; rm -rf "${FRROOT}"' EXIT
 echo "pipeline schedule:   ${PIPESPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
 pirc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PIPESPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/pipeline" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${PICYCLES}" \
     UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${PILEAKS}" \
     UDA_TPU_CHAOS_TELEMETRY="${PICOUNTERS}" \
@@ -148,10 +165,11 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PIPESPEC}" UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${LCOUNTERS}" "${LCYCLES}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/lockdep" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${LCYCLES}" \
     UDA_TPU_CHAOS_TELEMETRY="${LCOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
@@ -166,7 +184,7 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${PIPESPEC}" "${PICOUNTERS}" "${pirc}" "${PICYCLES}" \
     "${LCOUNTERS}" "${lrc}" "${LCYCLES}" \
     "${NLEAKS}" "${CLEAKS}" "${PILEAKS}" <<'EOF' || mrc=$?
-import json, sys
+import glob, json, os, sys
 (seed, spec, counters_path, out, rc, pspec, pcounters, prc,
  nspec, ncounters, nrc, ncycles,
  ecounters, erc, ecycles,
@@ -174,6 +192,28 @@ import json, sys
  pipespec, picounters, pirc, picycles,
  lcounters, lrc, lcycles,
  nleaks_path, cleaks_path, pileaks_path) = sys.argv[1:29]
+frroot = os.environ.get("FRROOT", "")
+def flightrec_block(rung, exit_code):
+    """Archive the rung's black-box dumps (cause + structured extra +
+    event count; the full event streams stay in the dump files) and
+    flag the anti-pattern the recorder exists to prevent: a rung that
+    FAILED without leaving a single post-mortem dump."""
+    reports = []
+    for path in sorted(glob.glob(
+            os.path.join(frroot, rung, "flightrec_*.json"))):
+        try:
+            with open(path) as f:
+                rep = json.load(f)
+        except Exception:
+            reports.append({"file": os.path.basename(path),
+                            "cause": "unreadable"})
+            continue
+        reports.append({"file": os.path.basename(path),
+                        "cause": rep.get("cause"),
+                        "extra": rep.get("extra"),
+                        "events": len(rep.get("events", []))})
+    return {"dumps": len(reports), "reports": reports,
+            "failed_without_dump": bool(int(exit_code)) and not reports}
 def load(path):
     try:
         with open(path) as f:
@@ -240,11 +280,29 @@ pipeline["drained"] = {
 }
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 nleak = len(n_leaks) + len(c_leaks) + len(pi_leaks)
+# flight-recorder archive, one block per rung; a rung that failed
+# without a single black-box dump flags failed_without_dump
+fr = {"main": flightrec_block("main", rc),
+      "pressure": flightrec_block("pressure", prc),
+      "network": flightrec_block("network", nrc),
+      "exchange": flightrec_block("exchange", erc),
+      "completion": flightrec_block("completion", crc_),
+      "pipeline": flightrec_block("pipeline", pirc),
+      "lockdep": flightrec_block("lockdep", lrc)}
+network["flightrec"] = fr["network"]
+exchange["flightrec"] = fr["exchange"]
+completion["flightrec"] = fr["completion"]
+pipeline["flightrec"] = fr["pipeline"]
+lockdep["flightrec"] = fr["lockdep"]
+no_postmortem = sorted(r for r, b in fr.items()
+                       if b["failed_without_dump"])
 with open(out, "w") as f:
     json.dump({"chaos_seed": int(seed), "schedule": spec,
                "pytest_exit": int(rc), "telemetry": load(counters_path),
+               "flightrec": fr["main"],
                "pressure": {"schedule": pspec, "pytest_exit": int(prc),
-                            "telemetry": load(pcounters)},
+                            "telemetry": load(pcounters),
+                            "flightrec": fr["pressure"]},
                "network": network,
                "exchange": exchange,
                "completion": completion,
@@ -252,18 +310,25 @@ with open(out, "w") as f:
                "lockdep": lockdep,
                "resledger": {"armed_rungs": ["network", "completion",
                                              "pipeline"],
-                             "leaks": nleak}},
+                             "leaks": nleak},
+               "flightrec_missing_postmortem": no_postmortem},
               f, indent=1, sort_keys=True)
     f.write("\n")
 ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
         + len(pi_reports) + len(l_reports))
+ndumps = sum(b["dumps"] for b in fr.values())
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc}, "
-      f"resledger leaks: {nleak})")
-# the zero-cycles / zero-leaks guarantees are ENFORCED, not just
-# printed: a detected inversion (or a leaked obligation that never got
-# the unlucky scheduling to become a visible wedge) still fails the
-# tier — that is the entire point of lockdep and the ledger
-sys.exit(3 if (ncyc or nleak) else 0)
+      f"resledger leaks: {nleak}, flightrec dumps: {ndumps})")
+if no_postmortem:
+    print(f"FLIGHTREC: rung(s) failed with NO black-box dump: "
+          f"{', '.join(no_postmortem)} — the post-mortem record is "
+          f"part of the failure contract", file=sys.stderr)
+# the zero-cycles / zero-leaks / dump-on-failure guarantees are
+# ENFORCED, not just printed: a detected inversion, a leaked
+# obligation, or a failing rung with no post-mortem record all fail
+# the tier — that is the entire point of lockdep, the ledger and the
+# flight recorder
+sys.exit(3 if (ncyc or nleak or no_postmortem) else 0)
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
@@ -272,8 +337,9 @@ if [ "${crc}" -ne 0 ]; then rc="${crc}"; fi
 if [ "${pirc}" -ne 0 ]; then rc="${pirc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
-  echo "LOCKDEP/RESLEDGER: cycle or leaked-obligation reports on real" \
-       "code (see CHAOS_TELEMETRY.json)" >&2
+  echo "LOCKDEP/RESLEDGER/FLIGHTREC: cycle reports, leaked obligations" \
+       "or a failing rung without its black-box dump (see" \
+       "CHAOS_TELEMETRY.json)" >&2
   rc="${mrc}"
 fi
 exit "${rc}"

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Stitch per-process span JSONL files into ONE Chrome/Perfetto trace.
+
+Each uda_tpu process exports its recorded spans with
+``metrics.export_spans_jsonl(path)`` — one JSON object per line
+carrying the span record plus ``pid`` and ``ts_unix`` (the span start
+converted through the process's wall-clock anchor, so two processes'
+spans land on one comparable timeline). This tool merges any number of
+such files into a single Perfetto-loadable trace:
+
+- events are keyed by **trace id**: a reduce-side ``net.fetch`` span
+  and the supplier-side ``net.serve`` span it caused (wire-carried
+  trace context, uda_tpu/net/wire.py) share one trace id and link by
+  parent span id even though they were recorded in different
+  processes;
+- each source process becomes a Perfetto *process* lane (its recorded
+  pid), with ``process_name`` metadata naming the source file;
+- ``args`` carry trace/span/parent ids and the span attributes, so
+  selecting any event shows its cross-process lineage.
+
+Usage::
+
+    python scripts/trace_merge.py spans_a.jsonl spans_b.jsonl \
+        --out trace.json [--trace <id>] [--require-cross-process]
+
+Exit codes: 0 ok; 2 usage/IO; 3 no spans (or --require-cross-process
+found no wire-linked span) — the ci.sh gate runs it over the net
+loopback smoke's span file and fails on an empty or unstitchable
+trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load_spans(paths):
+    """-> (spans, per-file counts). Malformed lines fail loudly — a
+    torn span file would silently drop the exact spans a post-mortem
+    needs."""
+    spans = []
+    counts = {}
+    for path in paths:
+        n = 0
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    raise SystemExit(
+                        f"trace_merge: {path}:{lineno}: bad span "
+                        f"record: {e}")
+                rec.setdefault("pid", 0)
+                rec["_src"] = os.path.basename(path)
+                spans.append(rec)
+                n += 1
+        counts[path] = n
+    return spans, counts
+
+
+def merge(spans, trace_filter=None):
+    """-> (chrome trace dict, stats). Timestamps use ``ts_unix`` when
+    present (cross-process comparable); a file exported by an older
+    process without the anchor degrades to its raw perf_counter
+    timeline (still valid within that process's lane)."""
+    if trace_filter is not None:
+        spans = [s for s in spans if s.get("trace") == trace_filter]
+    events = []
+    procs = {}
+    ids = {(s["pid"], s["id"]) for s in spans}
+    all_ids = {s["id"] for s in spans}
+    cross = 0
+    for s in spans:
+        procs.setdefault(s["pid"], s.get("_src", ""))
+        args = dict(s.get("attrs") or {})
+        for key, arg in (("trace", "trace_id"), ("id", "span_id"),
+                         ("parent", "parent_id")):
+            if s.get(key) is not None:
+                args[arg] = s[key]
+        parent = s.get("parent")
+        if parent is not None and (s["pid"], parent) not in ids \
+                and parent in all_ids:
+            # the parent span exists but in ANOTHER process: this is a
+            # wire-stitched link (a net.serve under a remote net.fetch)
+            cross += 1
+            args["cross_process_parent"] = True
+        ts = s.get("ts_unix", s.get("ts", 0.0))
+        events.append({"name": s["name"], "ph": "X", "pid": s["pid"],
+                       "tid": s.get("tid", 0), "ts": ts * 1e6,
+                       "dur": s.get("dur", 0.0) * 1e6, "args": args})
+    for pid, src in sorted(procs.items()):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": f"uda_tpu pid {pid} "
+                                                  f"({src})"}})
+    stats = {"spans": len(spans), "processes": len(procs),
+             "traces": len({s.get("trace") for s in spans}),
+             "cross_process_links": cross}
+    return {"traceEvents": events}, stats
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+",
+                    help="per-process span JSONL files "
+                         "(metrics.export_spans_jsonl)")
+    ap.add_argument("--out", required=True,
+                    help="merged Chrome trace JSON destination")
+    ap.add_argument("--trace", type=int, default=None,
+                    help="keep only this trace id")
+    ap.add_argument("--require-cross-process", action="store_true",
+                    help="fail (exit 3) unless at least one span links "
+                         "to a parent recorded in another process — "
+                         "the wire trace-context acceptance gate")
+    args = ap.parse_args()
+    try:
+        spans, counts = load_spans(args.files)
+    except OSError as e:
+        print(f"trace_merge: {e}", file=sys.stderr)
+        return 2
+    if not spans:
+        print(f"trace_merge: no spans in {len(args.files)} file(s) "
+              f"(was the exporting process run with UDA_TPU_STATS=1?)",
+              file=sys.stderr)
+        return 3
+    trace, stats = merge(spans, args.trace)
+    if args.require_cross_process and not stats["cross_process_links"]:
+        print("trace_merge: no cross-process parent link found — wire "
+              "trace context did not stitch", file=sys.stderr)
+        return 3
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    per_file = ", ".join(f"{os.path.basename(p)}:{n}"
+                         for p, n in counts.items())
+    print(f"trace_merge: {stats['spans']} spans from "
+          f"{stats['processes']} process(es) ({per_file}) -> "
+          f"{args.out}; {stats['traces']} trace id(s), "
+          f"{stats['cross_process_links']} cross-process link(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

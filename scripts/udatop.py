@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""udatop: a live per-supplier console over the MSG_STATS plane.
+
+Polls a list of shuffle endpoints (``host[:port]``) with the wire's
+uncredited MSG_STATS snapshot request (uda_tpu/net/wire.py) and
+renders one line per supplier: connections, in-flight serves, serve
+throughput (delta of ``net.bytes.out{role=server}`` between polls),
+read-latency p95, penalties, ResourceLedger obligations/leaks. This is
+the scrape surface ROADMAP item 1's per-tenant fairness gates will
+consume — today it is the operator's top(1).
+
+Usage::
+
+    python scripts/udatop.py host1 host2:9012 --interval 2
+    python scripts/udatop.py 127.0.0.1:9012 --once --json
+
+``--once`` prints a single sample and exits (scriptable; ``--json``
+dumps the raw snapshots instead of the table). A peer that refuses
+MSG_STATS (old version: typed ERR or disconnect) renders as
+``unsupported``; an unreachable one as ``down`` — the console never
+crashes over one sick supplier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from uda_tpu.net.client import fetch_remote_stats  # noqa: E402
+from uda_tpu.utils.config import Config  # noqa: E402
+from uda_tpu.utils.errors import UdaError  # noqa: E402
+
+_HEADER = (f"{'supplier':<22} {'gen':>5} {'conns':>5} {'onair':>5} "
+           f"{'MB/s':>8} {'read p95':>9} {'penal':>5} {'oblig':>5} "
+           f"{'leaks':>5}")
+
+
+def parse_host(spec: str, default_port: int):
+    host, _, port = spec.partition(":")
+    return host or "127.0.0.1", int(port) if port else default_port
+
+
+def row(spec: str, snap, prev, dt: float) -> str:
+    if isinstance(snap, str):  # "down" / "unsupported"
+        return f"{spec:<22} {snap}"
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+    p = snap.get("percentiles", {})
+    led = snap.get("resledger", {})
+    prov = snap.get("providers", {})
+    srv = prov.get("net.server", {}) if isinstance(prov, dict) else {}
+    out_now = c.get("net.bytes.out{role=server}", 0.0)
+    out_prev = (prev.get("counters", {})
+                .get("net.bytes.out{role=server}", 0.0)
+                if isinstance(prev, dict) else None)
+    mb_s = ((out_now - out_prev) / dt / 1e6
+            if out_prev is not None and dt > 0 else 0.0)
+    p95 = p.get("supplier.read.latency_ms", {}).get("p95", 0.0)
+    return (f"{spec:<22} {srv.get('generation', '?'):>5} "
+            f"{int(g.get('net.server.connections', 0)):>5} "
+            f"{int(g.get('net.server.inflight', 0)):>5} "
+            f"{mb_s:>8.2f} {p95:>8.1f}ms "
+            f"{int(c.get('fetch.penalties', 0)):>5} "
+            f"{led.get('outstanding', 0):>5} "
+            f"{led.get('leak_reports', 0):>5}")
+
+
+def poll(targets, timeout: float):
+    snaps = {}
+    for spec, (host, port) in targets.items():
+        try:
+            snaps[spec] = fetch_remote_stats(host, port, timeout=timeout)
+        except UdaError as e:
+            # a typed refusal (ProtocolError from an old peer) vs a
+            # dead endpoint — branch on the exception TYPE, never its
+            # message (UDA005)
+            from uda_tpu.utils.errors import TransportError
+            snaps[spec] = ("down" if isinstance(e, TransportError)
+                           else "unsupported")
+    return snaps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("hosts", nargs="+", help="supplier endpoints, "
+                                             "host[:port]")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="one sample, no screen clearing")
+    ap.add_argument("--json", action="store_true",
+                    help="dump raw snapshots as JSON (implies no table)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args()
+    default_port = int(Config().get("uda.tpu.net.port"))
+    targets = {spec: parse_host(spec, default_port)
+               for spec in args.hosts}
+    prev: dict = {}
+    prev_t = time.monotonic()
+    while True:
+        snaps = poll(targets, args.timeout)
+        now = time.monotonic()
+        dt = max(now - prev_t, 1e-9)
+        if args.json:
+            print(json.dumps({spec: s if isinstance(s, dict) else
+                              {"status": s} for spec, s in snaps.items()},
+                             default=repr))
+        else:
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(time.strftime("%H:%M:%S"), "udatop —",
+                  len(targets), "supplier(s), every",
+                  f"{args.interval:g}s")
+            print(_HEADER)
+            for spec in args.hosts:
+                print(row(spec, snaps[spec], prev.get(spec), dt))
+            sys.stdout.flush()
+        if args.once:
+            return 0
+        prev, prev_t = snaps, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:
+        sys.exit(0)

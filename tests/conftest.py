@@ -143,6 +143,13 @@ def _metrics_hygiene():
     for name, value in metrics.snapshot().items():
         _SESSION_COUNTERS[name] += value
     metrics.reset()
+    # flight-recorder hygiene: events/dump bookkeeping are per-test
+    # (the ring is process-global and always on), and a test that
+    # configured a dump directory must not leak it into later tests'
+    # dumps
+    from uda_tpu.utils.flightrec import flightrec
+    flightrec.reset()
+    flightrec._dump_dir = ""
     if unbalanced or leaked:
         parts = []
         if unbalanced:

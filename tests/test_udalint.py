@@ -33,6 +33,7 @@ from uda_tpu.analysis.rules import (ALL_RULES, BlockingInLockRule,
                                     FailpointSiteRule, MetricsNameRule,
                                     RawSocketCloseRule,
                                     ReasonStringBranchRule,
+                                    SpanNameRule,
                                     SwallowedExceptionRule)
 from uda_tpu.utils.locks import LockDep, TrackedCondition, TrackedLock
 
@@ -494,6 +495,51 @@ class TestEventLoopBlockingRule:
             self.sock.sendall(frame)
         """
         assert rule_ids(lint(src, self.RULES, rel=self.NET)) == ["UDA008"]
+
+
+# -- UDA009: span names ------------------------------------------------------
+
+
+class TestSpanNameRule:
+    def rules(self):
+        return [SpanNameRule(registry={"net.serve", "reduce_task"})]
+
+    def test_registered_literal_passes(self):
+        src = ('metrics.start_span("net.serve", map=m)\n'
+               'with metrics.span("reduce_task", job=j):\n'
+               '    pass\n')
+        assert lint(src, self.rules()) == []
+
+    def test_unregistered_name_fires(self):
+        out = lint('metrics.start_span("net.sreve")\n', self.rules())
+        assert rule_ids(out) == ["UDA009"]
+        assert "net.sreve" in out[0].message
+
+    def test_span_context_manager_checked_too(self):
+        out = lint('with metrics.span("nope.span"):\n    pass\n',
+                   self.rules())
+        assert rule_ids(out) == ["UDA009"]
+
+    def test_non_literal_name_fires(self):
+        out = lint('metrics.start_span(some_name)\n', self.rules())
+        assert rule_ids(out) == ["UDA009"]
+        assert "string literal" in out[0].message
+
+    def test_aliased_receiver_tracked(self):
+        src = ('from uda_tpu.utils.metrics import metrics as m\n'
+               'm.span("nope.span")\n')
+        assert rule_ids(lint(src, self.rules())) == ["UDA009"]
+
+    def test_unrelated_receivers_and_methods_pass(self):
+        src = ('tracer.start_span("whatever")\n'  # not the hub
+               'metrics.timer("merge")\n'         # timer names exempt
+               'metrics.use_span(span)\n')        # takes a Span object
+        assert lint(src, self.rules()) == []
+
+    def test_suppression_silences(self):
+        src = ('metrics.start_span("nope.span")  '
+               '# udalint: disable=UDA009\n')
+        assert lint(src, self.rules()) == []
 
 
 # -- engine plumbing ---------------------------------------------------------

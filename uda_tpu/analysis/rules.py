@@ -24,6 +24,12 @@ UDA008 no blocking call (``recv``/``sendall``/unbounded ``.result()``/
        in uda_tpu/net/ — registered callbacks are the functions marked
        ``@loop_callback`` (uda_tpu/net/evloop.py); the loop thread's
        own run loop is exempt (parking in select() is its job)
+UDA009 span names passed to ``start_span``/``span`` must belong to the
+       declared ``SPAN_REGISTRY`` (uda_tpu/utils/metrics.py) — the
+       UDA002 contract for the trace plane: span names are
+       cross-process identifiers (REQ frames carry them as trace
+       context, trace_merge.py stitches on them), so a typo'd name is
+       a broken trace, not just an ugly one
 UDA101 resource balance over the per-function CFG: every registered
        acquire (uda_tpu/analysis/flow.py DEFAULT_PAIRS) must reach a
        release/transfer/with-guard on EVERY path, exception edges
@@ -54,7 +60,8 @@ __all__ = ["ALL_RULES", "default_engine",
            "ConfigKeyRule", "MetricsNameRule", "FailpointSiteRule",
            "RawSocketCloseRule", "ReasonStringBranchRule",
            "SwallowedExceptionRule", "BlockingInLockRule",
-           "EventLoopBlockingRule", "ResourceBalanceRule",
+           "EventLoopBlockingRule", "SpanNameRule",
+           "ResourceBalanceRule",
            "TransitiveBlockingRule", "StaticLockOrderRule"]
 
 
@@ -557,10 +564,83 @@ class EventLoopBlockingRule(Rule):
         return None
 
 
+# -- UDA009 ------------------------------------------------------------------
+
+_SPAN_METHODS = ("start_span", "span")
+
+
+class SpanNameRule(Rule):
+    """Span names at ``metrics.start_span``/``metrics.span`` call sites
+    must be string literals registered in ``SPAN_REGISTRY`` — the
+    UDA002 contract extended to the trace plane. Span names are
+    cross-process identifiers (the wire carries their ids as trace
+    context; scripts/trace_merge.py and every trace dashboard key on
+    the inventory), so they are a static, auditable table like metrics
+    names and failpoint sites. Receivers resolve through the same
+    per-file alias tracking as UDA002 (``from ... import metrics as
+    m``, ``m = metrics``); ``metrics.timer(name)`` spans are named by
+    their timer counter and deliberately out of scope."""
+
+    rule_id = "UDA009"
+    description = "span names must be registered in SPAN_REGISTRY"
+    hint = ("register the name in uda_tpu/utils/metrics.py "
+            "SPAN_REGISTRY (description included) or fix the typo")
+    node_types = (ast.Call, ast.ImportFrom, ast.Assign)
+
+    def __init__(self, registry: Optional[Set[str]] = None):
+        if registry is None:
+            from uda_tpu.utils.metrics import SPAN_REGISTRY
+            registry = set(SPAN_REGISTRY)
+        self.registry = registry
+        self._aliases: Set[str] = set()
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._aliases = {"metrics"}
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> Iterable[Finding]:
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.endswith("metrics"):
+                for alias in node.names:
+                    if alias.name == "metrics":
+                        self._aliases.add(alias.asname or alias.name)
+            return ()
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in self._aliases:
+                for tgt in node.targets:
+                    seg = _last_segment(tgt)
+                    if seg:
+                        self._aliases.add(seg)
+            return ()
+        # ast.Call
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _SPAN_METHODS
+                and _last_segment(func.value) in self._aliases):
+            return ()
+        name_arg = node.args[0] if node.args else None
+        if name_arg is None:
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name_arg = kw.value
+        if not (isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)):
+            return (self.finding(
+                ctx, node,
+                "span name must be a string literal (span names are a "
+                "static, cross-process-auditable inventory)"),)
+        if name_arg.value in self.registry:
+            return ()
+        return (self.finding(
+            ctx, name_arg,
+            f"span name {name_arg.value!r} is not declared in "
+            f"SPAN_REGISTRY"),)
+
+
 ALL_RULES = (ConfigKeyRule, MetricsNameRule, FailpointSiteRule,
              RawSocketCloseRule, ReasonStringBranchRule,
              SwallowedExceptionRule, BlockingInLockRule,
-             EventLoopBlockingRule,
+             EventLoopBlockingRule, SpanNameRule,
              # the udaflow dataflow tier (uda_tpu/analysis/flow.py)
              ResourceBalanceRule, TransitiveBlockingRule,
              StaticLockOrderRule)

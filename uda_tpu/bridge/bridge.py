@@ -153,6 +153,15 @@ class UdaBridge:
         if callable_obj is not None and hasattr(callable_obj, "log_to"):
             get_logger().set_sink(callable_obj.log_to)
         get_logger().set_level(self.cfg.get("uda.log.level"))
+        # the flight recorder rides both roles from process start
+        # (uda.tpu.flightrec.*; the env kill switch still wins)
+        from uda_tpu.utils.flightrec import (flightrec,
+                                             flightrec_enabled_from_env)
+        flightrec.configure(
+            enabled=(bool(self.cfg.get("uda.tpu.flightrec.enable"))
+                     and flightrec_enabled_from_env()),
+            capacity=int(self.cfg.get("uda.tpu.flightrec.events")),
+            dump_dir=str(self.cfg.get("uda.tpu.flightrec.dir")))
         if not is_net_merger:
             # MOFSupplier_main: the data engine serves fetches; paths
             # resolve through the up-call (the IndexCache round trip).

@@ -75,6 +75,13 @@ class _Reconstruction:
         self.on_complete = on_complete
         self.k = ctx.scheme.k
         self.n = ctx.scheme.n
+        # span attribution: the reconstruction is started under the
+        # owning Segment's fetch span (use_span in Segment._try_recover)
+        # — capture it HERE, because every later shard issue happens on
+        # a transport completion thread whose contextvar is empty, and
+        # the shard streams' net.fetch spans would otherwise start as
+        # parentless roots invisible in the trace tree
+        self.parent_span = metrics.current_span()
         self._lock = threading.Lock()
         # chunks grouped by their reported stripe identity (the
         # full-partition length): a STALE shard from a prior map
@@ -242,7 +249,12 @@ class _ShardStream:
                 self._issuing = True
                 self._inline = self._PENDING
             try:
-                self.rec.client.start_fetch(req, self._on_complete)
+                # adopt the owning fetch span for this issue: shard
+                # streams chain from completion threads, so the
+                # explicit parent is the only way their transport
+                # spans join the segment's trace tree
+                with metrics.use_span(self.rec.parent_span):
+                    self.rec.client.start_fetch(req, self._on_complete)
             except Exception as e:  # noqa: BLE001 - sync transport
                 # raise == failed stream, same as an error completion
                 with self._mu:

@@ -42,6 +42,7 @@ from uda_tpu.utils.comparators import KeyType, get_key_type
 from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import FallbackSignal, MergeError, UdaError
 from uda_tpu.utils.failpoints import failpoints
+from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.logging import get_logger
@@ -152,6 +153,17 @@ class PenaltyBox:
             now = time.monotonic()
             return [k for k, t in self._until.items() if t > now]
 
+    def snapshot(self) -> dict:
+        """Introspection view (the MSG_STATS scrape surface and the
+        final stats record's recovery block): fault counts, success
+        streaks and who is boxed right now."""
+        with self._lock:
+            now = time.monotonic()
+            return {"faults": dict(self._faults),
+                    "streaks": dict(self._streak),
+                    "boxed": [k for k, t in self._until.items()
+                              if t > now]}
+
 
 class MergeManager:
     """Orchestrates fetch -> pack -> device merge -> framed emission for
@@ -187,6 +199,14 @@ class MergeManager:
             failpoints.arm_spec(spec)
         if self.cfg.get("uda.tpu.stats.enable"):
             metrics.enable_stats()
+        # the black box rides every task (utils/flightrec.py): config
+        # knobs AND the env kill switch must both say on
+        from uda_tpu.utils.flightrec import flightrec_enabled_from_env
+        flightrec.configure(
+            enabled=(bool(self.cfg.get("uda.tpu.flightrec.enable"))
+                     and flightrec_enabled_from_env()),
+            capacity=int(self.cfg.get("uda.tpu.flightrec.events")),
+            dump_dir=str(self.cfg.get("uda.tpu.flightrec.dir")))
         self._stop = threading.Event()
         # admission control + liveness (uda_tpu.utils.budget/.watchdog):
         # the budget is built lazily (platform detection must not run
@@ -454,6 +474,23 @@ class MergeManager:
             self._emit_progress += len(block)
 
         wd = self._start_watchdog(reduce_id)
+        # the MSG_STATS / final-stats-record scrape surface for THIS
+        # task: penalty box, recovery ledger and the last admission
+        # decision, live for the run's duration
+        from uda_tpu.utils.stats import (register_stats_provider,
+                                         unregister_stats_provider)
+
+        def _recovery_provider() -> dict:
+            adm = self.last_admission
+            return {"penalty_box": self.penalty_box.snapshot(),
+                    "ledger": self.ledger.snapshot(),
+                    "admission": ({"decision": adm.decision,
+                                   "cause": adm.cause,
+                                   "reason": adm.reason}
+                                  if adm is not None else None)}
+
+        provider_name = f"recovery.r{reduce_id}"
+        register_stats_provider(provider_name, _recovery_provider)
         try:
             # the trace root: every phase timer and per-segment fetch
             # span below hangs off this reduce-task span
@@ -461,7 +498,13 @@ class MergeManager:
                               maps=len(map_ids)):
                 return self._run(job_id, map_ids, reduce_id,
                                  tracked_consumer)
-        except FallbackSignal:
+        except FallbackSignal as e:
+            # a lower layer already chose fallback: the black box still
+            # owes the post-mortem (run() is the one dump point, so a
+            # task failure produces exactly ONE dump)
+            flightrec.dump("fallback", extra={
+                "job": job_id, "reduce": reduce_id,
+                "error": type(e.cause).__name__})
             raise
         except UdaError as e:
             # a watchdog rescue surfaces through whichever waiter woke
@@ -472,8 +515,17 @@ class MergeManager:
                 e = stall
             metrics.add("fallback.signals")
             log.error(f"merge failed terminally, requesting fallback: {e}")
+            # the flight-recorder post-mortem: the event stream behind
+            # this fallback (injected faults, segment transitions,
+            # recovery events) plus the terminal cause, dumped before
+            # the signal leaves the engine
+            flightrec.dump("fallback", extra={
+                "job": job_id, "reduce": reduce_id,
+                "error": type(e).__name__,
+                "supplier": getattr(e, "supplier", None)})
             raise FallbackSignal(e) from e
         finally:
+            unregister_stats_provider(provider_name, _recovery_provider)
             if wd is not None:
                 wd.stop()
                 self._watchdog = None
@@ -574,6 +626,12 @@ class MergeManager:
                          * (1 << 20))
             adm = self.budget().route(est, threshold)
             self.last_admission = adm
+            # admission decisions carry their STRUCTURED cause into the
+            # black box — a post-mortem reads why the task took the
+            # path it did, not just that it failed on it
+            flightrec.record("admission", decision=adm.decision,
+                             cause=adm.cause, rejected=adm.rejected,
+                             estimate=est)
             if adm.rejected:
                 raise UdaError(
                     f"partition refused by admission control: "

@@ -976,6 +976,12 @@ class OverlappedMerger:
         once every stager has stopped — never under a concurrent
         write_run."""
         self._aborted = True
+        # black-box state transition: an abort is the merge half of
+        # almost every failure post-mortem (utils/flightrec.py)
+        from uda_tpu.utils.flightrec import flightrec
+        flightrec.record("overlap.abort",
+                         staged_runs=self.stats.get("staged_runs", 0),
+                         pending=self.stats.get("pending", 0))
         try:
             self._q.put_nowait(None)  # best effort: wake one instantly
         except queue.Full:

@@ -21,6 +21,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Optional, Sequence
 
+from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.locks import TrackedLock
 
 __all__ = ["RecoveryLedger"]
@@ -48,6 +49,12 @@ class RecoveryLedger:
         with self._lock:
             self._events.append(event)
             self.version += 1
+        # recovery events are exactly what a post-mortem wants in
+        # sequence with the faults that caused them — mirror into the
+        # process black box (utils/flightrec.py) under a
+        # recovery.<kind> event kind
+        flightrec.record(f"recovery.{kind}", supplier=supplier,
+                         map_id=map_id, error=event["error"])
 
     def rank(self, hosts: Sequence[str]) -> list:
         """``hosts`` ordered healthiest-first by PenaltyBox state

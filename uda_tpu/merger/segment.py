@@ -28,6 +28,7 @@ from uda_tpu.mofserver.data_engine import DataEngine, FetchResult, ShuffleReques
 from uda_tpu.utils.errors import (MergeError, StorageError, TransportError,
                                   attribute_supplier)
 from uda_tpu.utils.failpoints import failpoint
+from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.ifile import RecordBatch, crack_partial
 from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
@@ -418,6 +419,10 @@ class Segment:
                 return False
             self._error = error
             self._done.set()
+        # black-box state transition (per segment, off the chunk path)
+        flightrec.record("segment.done", map=self.map_id,
+                         supplier=self.supplier,
+                         error=type(error).__name__ if error else None)
         self._notify_done()
         return True
 
@@ -437,6 +442,8 @@ class Segment:
         self.trace_span = metrics.start_span(
             "fetch.segment", map=self.map_id, supplier=self.supplier,
             reduce=self.reduce_id)
+        flightrec.record("segment.start", map=self.map_id,
+                         supplier=self.supplier)
         self._drive(self._try_issue(0))
 
     def _try_issue(self, offset: int):
@@ -593,6 +600,8 @@ class Segment:
             self._attempt_hosts[spec_epoch] = alt
             self._open_attempts += 1
         metrics.add("fetch.speculated", supplier=alt or self.map_id)
+        flightrec.record("segment.speculate", map=self.map_id,
+                         primary=self.host, alternate=alt)
         # hands off to the speculative epoch: _on_complete settles the
         # winner, _drop_attempt the loser (and the sync-raise path)
         metrics.gauge_add("fetch.on_air", 1)  # udalint: disable=UDA101
@@ -807,6 +816,10 @@ class Segment:
                     log.warn(f"fetch of {self.map_id} failed ({result}); "
                              f"retrying ({self._retries_left} left)")
                 metrics.add("fetch.retries", supplier=self.supplier)
+                flightrec.record("segment.retry", map=self.map_id,
+                                 supplier=self.supplier,
+                                 error=type(result).__name__,
+                                 resume=resume, left=self._retries_left)
                 delay = self.policy.backoff(attempt, self._rng)
                 if self._deadline is not None:
                     delay = min(delay,
@@ -1001,6 +1014,9 @@ class Segment:
         if not self._finish(exc):
             return False  # a real terminal path won the race
         metrics.add("fetch.failed_admin")
+        flightrec.record("segment.admin_fail", map=self.map_id,
+                         supplier=self.supplier,
+                         error=type(exc).__name__)
         return True
 
     # -- consumption --------------------------------------------------------

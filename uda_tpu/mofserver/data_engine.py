@@ -392,7 +392,13 @@ class DataEngine:
         # where the pool never ran it
         metrics.gauge_add("supplier.reads.on_air", 1)  # udalint: disable=UDA101
         try:
-            return self._pool.submit(self._serve, req, want)
+            # span adoption across the pool handoff: the submitting
+            # thread's current span (a net.serve span on the wire path,
+            # a fetch.segment span in-process) becomes the worker-side
+            # engine.pread span's parent — the contextvar does not
+            # cross threads, so the parent rides the work item
+            return self._pool.submit(self._serve, req, want,
+                                     metrics.current_span())
         except BaseException:  # pool shutdown race: undo the accounting
             self._unadmit(want)
             metrics.gauge_add("supplier.reads.on_air", -1)
@@ -445,7 +451,8 @@ class DataEngine:
         # same handoff as submit(): _serve_plan's finally owns the -1
         metrics.gauge_add("supplier.reads.on_air", 1)  # udalint: disable=UDA101
         try:
-            return self._pool.submit(self._serve_plan, req, want)
+            return self._pool.submit(self._serve_plan, req, want,
+                                     metrics.current_span())
         except BaseException:  # pool shutdown race: undo the accounting
             self._unadmit(want)
             metrics.gauge_add("supplier.reads.on_air", -1)
@@ -487,20 +494,26 @@ class DataEngine:
             self._unadmit(want_admit)
             raise
 
-    def _serve_plan(self, req: ShuffleRequest, admitted: int = 0):
+    def _serve_plan(self, req: ShuffleRequest, admitted: int = 0,
+                    parent_span=None):
         """Worker-side body of submit_serve: resolve on the pool thread
         (the resolver may be an embedder upcall — never run it on the
         event loop), then either pin an FdSlice or fall through to the
         byte serve. An FdSlice KEEPS its admission charge until
-        release(); every other outcome settles here."""
+        release(); every other outcome settles here. ``parent_span``
+        is the submitting thread's span (see submit): the worker's
+        engine.pread span adopts it."""
         t0 = time.perf_counter()
         sliced = False
         try:
-            if self._slice_eligible():
-                plan = self._plan_inner(req, admitted)
-                sliced = True
-                return plan
-            return self._serve_inner(req)
+            with metrics.use_span(parent_span), \
+                    metrics.span("engine.pread", map=req.map_id,
+                                 reduce=req.reduce_id, offset=req.offset):
+                if self._slice_eligible():
+                    plan = self._plan_inner(req, admitted)
+                    sliced = True
+                    return plan
+                return self._serve_inner(req)
         finally:
             if admitted and not sliced:
                 self._unadmit(admitted)
@@ -565,10 +578,14 @@ class DataEngine:
                 f"{self.sync_fetch_timeout_s:g} s (bounded by the "
                 f"mapred.rdma.fetch.* knobs)") from e
 
-    def _serve(self, req: ShuffleRequest, admitted: int = 0) -> FetchResult:
+    def _serve(self, req: ShuffleRequest, admitted: int = 0,
+               parent_span=None) -> FetchResult:
         t0 = time.perf_counter()
         try:
-            return self._serve_inner(req)
+            with metrics.use_span(parent_span), \
+                    metrics.span("engine.pread", map=req.map_id,
+                                 reduce=req.reduce_id, offset=req.offset):
+                return self._serve_inner(req)
         finally:
             if admitted:
                 self._unadmit(admitted)

@@ -7,9 +7,9 @@ DataEngine (``uda.tpu.net.listen``) and reduce hosts dial it through
 ``HostRoutingClient``'s default socket factory (``uda.tpu.net.fetch``).
 """
 
-from uda_tpu.net.client import RemoteFetchClient
+from uda_tpu.net.client import RemoteFetchClient, fetch_remote_stats
 from uda_tpu.net.server import ShuffleServer
 from uda_tpu.net.wire import MAX_FRAME, WIRE_VERSION
 
 __all__ = ["RemoteFetchClient", "ShuffleServer", "WIRE_VERSION",
-           "MAX_FRAME"]
+           "MAX_FRAME", "fetch_remote_stats"]

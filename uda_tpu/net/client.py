@@ -54,7 +54,7 @@ from uda_tpu.mofserver.data_engine import ShuffleRequest
 from uda_tpu.net import wire
 from uda_tpu.net.evloop import EventLoop, loop_callback, shared_client_loop
 from uda_tpu.utils.config import Config
-from uda_tpu.utils.errors import TransportError
+from uda_tpu.utils.errors import ProtocolError, TransportError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
@@ -266,11 +266,15 @@ class _ClientConn:
             result = wire.decode_error(memoryview(payload))
         elif msg_type == wire.MSG_SIZE:
             result = wire.decode_size(memoryview(payload))
+        elif msg_type == wire.MSG_STATS_REPLY:
+            result = wire.decode_stats_reply(memoryview(payload))
         elif msg_type == wire.MSG_HELLO:
             # the accept banner correlates with no request: record the
-            # server generation (warm-restart continuity) and move on
-            generation, warm = wire.decode_hello(bytes(payload))
-            self.client._on_hello(generation, warm)
+            # server generation (warm-restart continuity) and its
+            # capability bits (trace-context frames, MSG_STATS), then
+            # move on
+            generation, warm, caps = wire.decode_hello_ex(bytes(payload))
+            self.client._on_hello(generation, warm, caps)
             return
         else:
             raise TransportError(
@@ -334,8 +338,15 @@ class EvLoopFetchClient(InputClient):
         # ledger is still continuous with this supplier's bytes
         self._generation: Optional[int] = None
         self._resumable = True
+        # peer capability bits from the HELLO banner (wire.CAP_TRACE:
+        # the peer decodes trace-context REQ tails + serves MSG_STATS).
+        # 0 until the banner lands — frames sent before it stay
+        # un-extended, which is always legal.
+        self._peer_caps = 0
+        self._hello_seen = threading.Event()
 
-    def _on_hello(self, generation: int, warm: bool) -> None:
+    def _on_hello(self, generation: int, warm: bool,
+                  caps: int = 0) -> None:
         """Loop thread (first frame of every connection). A CHANGED
         generation is a supplier restart: warm (handoff-continued)
         keeps resume legal, cold revokes it — a cold supplier may hold
@@ -345,6 +356,7 @@ class EvLoopFetchClient(InputClient):
         with self._lock:
             prev = self._generation
             self._generation = generation
+            self._peer_caps = caps
             if prev is not None and generation != prev and not warm:
                 # STICKY: a later warm bounce must not re-legalize
                 # resume — a segment's offset ledger may predate the
@@ -353,6 +365,7 @@ class EvLoopFetchClient(InputClient):
                 # created after this client object are conservative by
                 # one refetch; correctness wins.
                 self._resumable = False
+        self._hello_seen.set()
         if prev is not None and generation != prev:
             metrics.add("net.generation.changes", host=self.host,
                         warm=str(bool(warm)).lower())
@@ -408,7 +421,28 @@ class EvLoopFetchClient(InputClient):
         metrics.add("net.connects", host=self.host)
         metrics.gauge_add("net.client.connections", 1)
         loop.call_soon(conn.register)
+        # bounded first-banner wait: the HELLO (first frame on every
+        # accept) carries the peer's capability bits — without this, a
+        # fetch racing the banner would always go un-extended and the
+        # FIRST chunk of a trace would predictably lose its supplier
+        # spans. Best-effort: timing out just means un-extended frames
+        # (always legal), never an error; reconnects skip it (the
+        # event stays set — caps survive a same-server redial).
+        self._hello_seen.wait(timeout=min(2.0, self.connect_timeout_s))
         return conn
+
+    def _trace_of(self, span) -> Optional[tuple]:
+        """The wire trace-context tail for one outbound frame: this
+        request's OWN span ids (the supplier's serve span becomes its
+        child), sent only when the peer's HELLO advertised
+        wire.CAP_TRACE — an old decoder would tear on trailing
+        bytes."""
+        if span is None or span.span_id is None:
+            return None  # spans disabled (noop span)
+        with self._lock:
+            if not self._peer_caps & wire.CAP_TRACE:
+                return None
+        return span.trace_id, span.span_id
 
     def _on_conn_dead(self, conn: _ClientConn, cause: Exception) -> None:
         """Loop thread (via _die): fail every request in flight on this
@@ -494,7 +528,8 @@ class EvLoopFetchClient(InputClient):
                 f"connection to {self.host}:{self.port} lost before "
                 f"the fetch was issued"))
             return
-        self._post(conn, wire.encode_request(req_id, req))
+        self._post(conn, wire.encode_request(req_id, req,
+                                             trace=self._trace_of(span)))
 
     def _post(self, conn: _ClientConn, frame: bytes) -> None:
         """Write one frame — inline on this thread when the socket has
@@ -538,8 +573,9 @@ class EvLoopFetchClient(InputClient):
             req_id = self._next_id
             self._pending[req_id] = _Waiter(on_size, span,
                                             time.perf_counter())
-        self._post(conn, wire.encode_size_request(req_id, job_id,
-                                                  list(map_ids), reduce_id))
+        self._post(conn, wire.encode_size_request(
+            req_id, job_id, list(map_ids), reduce_id,
+            trace=self._trace_of(span)))
         if not got.wait(timeout=_SIZE_PROBE_TIMEOUT_S):
             with self._lock:
                 self._pending.pop(req_id, None)  # late reply -> orphaned
@@ -547,6 +583,42 @@ class EvLoopFetchClient(InputClient):
             return None
         result = box[0]
         return None if isinstance(result, Exception) else result
+
+    def fetch_stats(self, timeout: float = _SIZE_PROBE_TIMEOUT_S
+                    ) -> Optional[dict]:
+        """Snapshot the supplier's live introspection record over the
+        multiplexed connection (MSG_STATS — uncredited on the server,
+        so it answers even when data holds every credit). Best effort:
+        transport trouble, a typed ERR (old peer), or a timeout
+        returns None."""
+        try:
+            conn = self._ensure_connected()
+        except TransportError:
+            return None
+        box: list = [None]
+        got = threading.Event()
+
+        def on_stats(result) -> None:
+            box[0] = result
+            got.set()
+
+        span = metrics.start_span("net.stats", host=self.host)
+        with self._lock:
+            if self._conn is not conn:
+                span.end(error="disconnect")
+                return None
+            self._next_id += 1
+            req_id = self._next_id
+            self._pending[req_id] = _Waiter(on_stats, span,
+                                            time.perf_counter())
+        self._post(conn, wire.encode_stats_request(req_id))
+        if not got.wait(timeout=timeout):
+            with self._lock:
+                self._pending.pop(req_id, None)
+            span.end(error="timeout")
+            return None
+        result = box[0]
+        return result if isinstance(result, dict) else None
 
     def stop(self) -> None:
         with self._lock:
@@ -573,3 +645,56 @@ class EvLoopFetchClient(InputClient):
 # reader (PR 4) was deleted once BENCH_NET_r07.json recorded the second
 # evloop-only point (last A/B: BENCH_NET_r06.json).
 RemoteFetchClient = EvLoopFetchClient
+
+
+def fetch_remote_stats(host: str, port: Optional[int] = None,
+                       timeout: float = 5.0,
+                       config: Optional[Config] = None) -> dict:
+    """One-shot MSG_STATS poll over a plain blocking socket — the
+    scripts/udatop.py scrape path: no shared loop, no client object,
+    one dial per poll (an introspection console must work against a
+    process whose client plane it is not part of). Consumes the HELLO
+    banner, sends MSG_STATS, returns the decoded snapshot dict.
+    Raises TransportError on dial failure/timeout and re-raises the
+    typed remote error when the peer answers ERR (an old peer's
+    ProtocolError refusal included)."""
+    cfg = config or Config()
+    if port is None:
+        port = int(cfg.get("uda.tpu.net.port"))
+    try:
+        sock = socket.create_connection((host, port), timeout=timeout)
+    except OSError as e:
+        raise TransportError(
+            f"stats poll: connect to {host}:{port} failed: {e}") from e
+    try:
+        sock.settimeout(timeout)
+        wire.tune_socket(sock)
+        sock.sendall(wire.encode_stats_request(1))
+        while True:
+            try:
+                frame = wire.recv_frame(sock)
+            except socket.timeout as e:  # noqa: PERF203 - bounded poll
+                raise TransportError(
+                    f"stats poll: {host}:{port} did not answer within "
+                    f"{timeout:g} s") from e
+            if frame is None:
+                # the peer spoke the wire fine and hung up on the
+                # MSG_STATS frame itself: that is an old decoder
+                # refusing an unknown type, not a dead endpoint —
+                # ProtocolError so consoles render "unsupported", not
+                # "down" (udatop branches on the TYPE, UDA005)
+                raise ProtocolError(
+                    f"stats poll: {host}:{port} closed the connection "
+                    f"on MSG_STATS (pre-observability peer)")
+            msg_type, _req_id, payload = frame
+            if msg_type == wire.MSG_HELLO:
+                continue  # the banner precedes every reply
+            if msg_type == wire.MSG_STATS_REPLY:
+                return wire.decode_stats_reply(payload)
+            if msg_type == wire.MSG_ERR:
+                raise wire.decode_error(payload)
+            raise TransportError(
+                f"stats poll: unexpected frame type {msg_type} from "
+                f"{host}:{port}")
+    finally:
+        wire.close_hard(sock)

@@ -230,6 +230,23 @@ class EventLoop:
         except KeyError:
             return sock in self._parked
 
+    def stats(self) -> dict:
+        """Introspection glance (the MSG_STATS conn-table provider):
+        liveness plus queue depths. Racy reads by design — this is a
+        console view, not a synchronization point; the selector map
+        read is guarded because selectors are not safe against
+        concurrent mutation (a torn read degrades to -1, never an
+        exception on the poll path)."""
+        try:
+            registered = len(self._sel.get_map())
+        except (OSError, RuntimeError):
+            registered = -1
+        return {"alive": self.alive(),
+                "registered": registered,
+                "parked": len(self._parked),
+                "pending_callbacks": len(self._pending),
+                "dispatch_depth": self._dispatchq.qsize()}
+
     # -- the loop -----------------------------------------------------------
 
     def _run(self) -> None:

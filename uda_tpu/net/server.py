@@ -65,7 +65,7 @@ from uda_tpu.mofserver.data_engine import DataEngine, FdSlice
 from uda_tpu.net import wire
 from uda_tpu.net.evloop import EventLoop, loop_callback
 from uda_tpu.utils.config import Config
-from uda_tpu.utils.errors import TransportError, UdaError
+from uda_tpu.utils.errors import ProtocolError, TransportError, UdaError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
@@ -379,13 +379,32 @@ class _EvConn:
         self._payload = None
         self._hdr_got = 0
         if msg_type == wire.MSG_REQ:
-            self._admit(("req", req_id, wire.decode_request(payload)))
+            req, trace = wire.decode_request_ex(payload)
+            self._admit(("req", req_id, (req, trace)))
         elif msg_type == wire.MSG_SIZE_REQ:
             self._admit(("size", req_id,
-                         wire.decode_size_request(payload)))
+                         wire.decode_size_request_ex(payload)))
+        elif msg_type == wire.MSG_STATS:
+            # uncredited, the HELLO precedent: an introspection poll
+            # must answer even when the data pipeline holds every
+            # credit (that contended state is exactly what the poller
+            # wants to see)
+            self._start_stats(req_id)
         else:
-            raise TransportError(
-                f"unexpected frame type {msg_type} on the server side")
+            # in-range but unknown/unexpected type: a NEWER peer
+            # probing an optional message. Refuse it with a typed ERR
+            # on the same req id and keep serving — tearing the
+            # connection down would fail every in-flight fetch over a
+            # harmless capability probe.
+            log.warn(f"net: unsupported frame type {msg_type} from "
+                     f"{self.peer}; answering typed ERR")
+            metrics.add("net.errors")
+            err = ProtocolError(
+                f"unsupported frame type {msg_type} (this peer speaks "
+                f"wire v{wire.WIRE_VERSION})")
+            frame = wire.encode_error(req_id, err)
+            self._enqueue(_BufItem([frame], credited=False,
+                                   t0=time.perf_counter()), frame)
 
     def _eof(self) -> None:
         if self._hdr_got or self._payload is not None:
@@ -472,25 +491,39 @@ class _EvConn:
 
     # -- serving -------------------------------------------------------------
 
-    def _start_req(self, req_id: int, req) -> None:
+    def _start_req(self, req_id: int, body) -> None:
+        req, trace = body
         metrics.add("net.requests")
         t0 = time.perf_counter()
-        span = metrics.start_span("net.serve", map=req.map_id,
+        # wire-level trace adoption: a REQ that carried (trace_id,
+        # parent_span_id) makes this serve span a CHILD of the remote
+        # reduce task's fetch span — the supplier-side work it caused
+        # lands in the same trace tree, stitched across processes by
+        # scripts/trace_merge.py
+        parent = (metrics.remote_parent(*trace) if trace is not None
+                  else None)
+        span = metrics.start_span("net.serve", parent=parent,
+                                  map=req.map_id,
                                   reduce=req.reduce_id, offset=req.offset,
                                   peer=self.peer)
         try:
-            if self.server.zero_copy:
-                # the inline fast path: an index-cache hit plans the
-                # (fd, offset, len) slice right here on the loop thread
-                # and the response leaves without a single pool handoff
-                # — every chunk after a partition's first
-                plan = self.server.engine.try_plan(req)
-                if plan is not None:
-                    self._complete(req_id, plan, None, t0, span, req)
-                    return
-                fut = self.server.engine.submit_serve(req)
-            else:
-                fut = self.server.engine.submit(req)
+            # the engine adopts the serve span across its pool handoff
+            # (DataEngine.submit captures the current span), so
+            # engine.pread / zero-copy plan work is a child of net.serve
+            with metrics.use_span(span):
+                if self.server.zero_copy:
+                    # the inline fast path: an index-cache hit plans the
+                    # (fd, offset, len) slice right here on the loop
+                    # thread and the response leaves without a single
+                    # pool handoff — every chunk after a partition's
+                    # first
+                    plan = self.server.engine.try_plan(req)
+                    if plan is not None:
+                        self._complete(req_id, plan, None, t0, span, req)
+                        return
+                    fut = self.server.engine.submit_serve(req)
+                else:
+                    fut = self.server.engine.submit(req)
         except Exception as e:  # noqa: BLE001 - sync rejection (stopped
             # engine, admission push-back, bad offset) -> typed ERR
             self._complete(req_id, None, e, t0, span, req)
@@ -599,24 +632,57 @@ class _EvConn:
         """SIZE probes are credited like DATA (no frame escapes the
         wqe.per.conn bound) but the resolver sums may ride an embedder
         upcall — run them on the dispatcher thread, never the loop."""
-        job_id, mids, reduce_id = body
+        (job_id, mids, reduce_id), trace = body
         t0 = time.perf_counter()
         self.loop.dispatch(self._do_size, req_id, job_id, mids,
-                           reduce_id, t0)
+                           reduce_id, t0, trace)
 
     def _do_size(self, req_id: int, job_id: str, mids, reduce_id: int,
-                 t0: float) -> None:
+                 t0: float, trace=None) -> None:
         """Dispatcher thread: delegate to LocalFetchClient so wire and
-        in-process estimates cannot diverge (exact-or-unknown)."""
+        in-process estimates cannot diverge (exact-or-unknown). A
+        wire-carried trace context parents the serve span under the
+        remote net.size_probe, same adoption as _start_req."""
         from uda_tpu.merger.segment import LocalFetchClient
 
-        total = LocalFetchClient(self.server.engine) \
-            .estimate_partition_bytes(job_id, mids, reduce_id)
+        parent = (metrics.remote_parent(*trace) if trace is not None
+                  else None)
+        span = metrics.start_span("net.serve", parent=parent, kind="size",
+                                  reduce=reduce_id, peer=self.peer)
+        with metrics.use_span(span):
+            total = LocalFetchClient(self.server.engine) \
+                .estimate_partition_bytes(job_id, mids, reduce_id)
+        span.end(known=total is not None)
         frame = wire.encode_size(req_id, total)
         if self.closed or not self.loop.alive():
             metrics.gauge_add("net.server.inflight", -1)
             return
         self._enqueue(_BufItem([frame], credited=True, t0=t0), frame)
+
+    def _start_stats(self, req_id: int) -> None:
+        """MSG_STATS (loop thread): snapshot building walks metrics and
+        provider locks — cheap, but off the loop on principle (a
+        provider is component code). Uncredited: the reply rides the
+        outbound queue like the HELLO banner."""
+        self.loop.dispatch(self._do_stats, req_id)
+
+    def _do_stats(self, req_id: int) -> None:
+        """Dispatcher thread: build + encode the introspection
+        snapshot."""
+        from uda_tpu.utils.stats import introspection_snapshot
+
+        metrics.add("net.stats.requests")
+        try:
+            frame = wire.encode_stats_reply(req_id,
+                                            introspection_snapshot())
+        except Exception as e:  # noqa: BLE001 - an unencodable snapshot
+            # must degrade to a typed ERR, never strand the poller
+            log.warn(f"net: stats snapshot failed: {e}")
+            frame = wire.encode_error(req_id, e)
+        if self.closed or not self.loop.alive():
+            return  # uncredited: nothing to settle
+        self._enqueue(_BufItem([frame], credited=False,
+                               t0=time.perf_counter()), frame)
 
     # -- outbound (any thread; _wlock serializes writers) --------------------
 
@@ -972,6 +1038,10 @@ class EvLoopShuffleServer:
         self._loop = EventLoop("uda-net-loop").start()
         self._loop.call_soon(self._loop.register, ls, _READ,
                              self._on_accept)
+        # the MSG_STATS scrape surface: this server's conn table +
+        # generation, folded into every introspection snapshot
+        from uda_tpu.utils.stats import register_stats_provider
+        register_stats_provider("net.server", self._stats_snapshot)
         log.info(f"shuffle server listening on {self.address[0]}:"
                  f"{self.address[1]} (credit/conn={self.credit}, "
                  f"core=evloop, zerocopy={self.zero_copy}, "
@@ -1039,6 +1109,33 @@ class EvLoopShuffleServer:
         with self._lock:
             self._conns.discard(conn)
 
+    def _stats_snapshot(self) -> dict:
+        """The introspection provider: generation, bound port, loop
+        health and the per-connection table (peer, in-flight depth,
+        parked backlog, drain state). Lock-light reads of monotone
+        fields — a racy glance is the contract of a live console."""
+        with self._lock:
+            conns = list(self._conns)
+        loop = self._loop
+        with self._marks_lock:
+            nmarks = len(self._marks)
+        return {
+            "generation": self.generation,
+            "warm_restart": self.warm_restart,
+            "port": (self._listener.getsockname()[1]
+                     if self._listener is not None else None),
+            "credit_per_conn": self.credit,
+            "zerocopy_mode": self.zc_mode,
+            "loop": (loop.stats() if loop is not None
+                     else {"alive": False}),
+            "watermarks": nmarks,
+            "connections": [
+                {"peer": c.peer, "inflight": c.inflight,
+                 "parked": len(c._parked), "credits": c._credits,
+                 "draining": c.draining, "closed": c.closed}
+                for c in conns],
+        }
+
     def _sendfile_refused_once(self) -> None:
         """First sendfile refusal (EINVAL-class: the fs/socket pairing
         will never splice): memoize it so the serve path stops planning
@@ -1064,6 +1161,8 @@ class EvLoopShuffleServer:
         if self._loop is None:
             return
         self._stopping.set()
+        from uda_tpu.utils.stats import unregister_stats_provider
+        unregister_stats_provider("net.server", self._stats_snapshot)
         loop = self._loop
         ls, self._listener = self._listener, None
         if ls is not None:

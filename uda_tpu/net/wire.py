@@ -26,23 +26,51 @@ Frame types::
     ERR        typed failure for one req  (error kind + message)
     SIZE_REQ   partition size probe       (job, reduce, map ids)
     SIZE       size reply                 (total bytes, -1 = unknown)
-    HELLO      accept banner              (server generation + warm flag;
-                                           the FIRST frame on every
-                                           accepted connection — a
-                                           warm-restarted supplier
-                                           advertises generation+1 so
-                                           clients know resumed offsets
-                                           are continuous)
+    HELLO      accept banner              (server generation + warm flag +
+                                           capability bits; the FIRST
+                                           frame on every accepted
+                                           connection — a warm-restarted
+                                           supplier advertises
+                                           generation+1 so clients know
+                                           resumed offsets are
+                                           continuous)
+    STATS      introspection snapshot req (empty payload; uncredited,
+                                           riding the HELLO-banner
+                                           precedent — it answers no
+                                           fetch and must not compete
+                                           with data for credits)
+    STATS_REPLY                           (UTF-8 JSON: the remote
+                                           process's live counters,
+                                           gauges, percentiles,
+                                           ResourceLedger obligations
+                                           and conn table —
+                                           utils/stats.py
+                                           introspection_snapshot)
 
-Decoding is STRICT: a bad magic, an unknown version or type, a length
-over :data:`MAX_FRAME`, a short buffer or trailing garbage all raise
-:class:`TransportError` — the receiving side treats any of them as a
-broken connection (the stream has lost frame sync; there is no
-resynchronization, like a torn RDMA connection there is only
-reconnect). ``ERR`` payloads carry the error's class name so the reduce
-side re-raises the TYPED error (a supplier-side ``StorageError``
-admission rejection must look like a StorageError to the Segment retry
-machinery, not like a generic transport fault).
+**Wire trace context** (versioned by LENGTH, the v2-UDIX back-compat
+discipline): REQ and SIZE_REQ payloads may carry an optional trailing
+``(trace_id, parent_span_id)`` pair (two u64s). An old decoder never
+sees it — new clients append the block only to peers whose HELLO
+banner advertises :data:`CAP_TRACE` — and a new decoder accepts both
+shapes (exactly-zero or exactly-16 trailing bytes). The supplier
+adopts the pair as the parent of its ``net.serve`` span, so
+supplier-side serve/pread work lands in the reduce-side fetch span's
+tree and ``scripts/trace_merge.py`` can stitch the processes' span
+files into one trace.
+
+Decoding is STRICT: a bad magic, an unknown version, an out-of-range
+type, a length over :data:`MAX_FRAME`, a short buffer or trailing
+garbage all raise :class:`TransportError` — the receiving side treats
+any of them as a broken connection (the stream has lost frame sync;
+there is no resynchronization, like a torn RDMA connection there is
+only reconnect). One deliberate soft spot: an in-range but UNKNOWN
+frame type decodes fine at the header layer and is answered by the
+server with a typed ``ERR`` frame instead of a teardown — a newer peer
+probing an optional message (MSG_STATS-style) must get a clean refusal,
+not a disconnect. ``ERR`` payloads carry the error's class name so the
+reduce side re-raises the TYPED error (a supplier-side
+``StorageError`` admission rejection must look like a StorageError to
+the Segment retry machinery, not like a generic transport fault).
 """
 
 from __future__ import annotations
@@ -58,12 +86,16 @@ from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
 
 __all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
            "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
-           "MSG_HELLO",
-           "encode_request", "decode_request", "encode_result",
+           "MSG_HELLO", "MSG_STATS", "MSG_STATS_REPLY", "CAP_TRACE",
+           "encode_request", "decode_request", "decode_request_ex",
+           "encode_result",
            "encode_result_head", "decode_result", "decode_result_take",
            "encode_error", "decode_error", "encode_size_request",
-           "decode_size_request", "encode_size", "decode_size",
-           "encode_hello", "decode_hello",
+           "decode_size_request", "decode_size_request_ex",
+           "encode_size", "decode_size",
+           "encode_hello", "decode_hello", "decode_hello_ex",
+           "encode_stats_request", "encode_stats_reply",
+           "decode_stats_reply",
            "encode_frame", "decode_header", "recv_frame", "close_hard",
            "tune_socket"]
 
@@ -81,8 +113,16 @@ MSG_ERR = 3
 MSG_SIZE_REQ = 4
 MSG_SIZE = 5
 MSG_HELLO = 6
+MSG_STATS = 7        # introspection snapshot request (empty payload)
+MSG_STATS_REPLY = 8  # introspection snapshot (UTF-8 JSON payload)
 
-_TYPES = (MSG_REQ, MSG_DATA, MSG_ERR, MSG_SIZE_REQ, MSG_SIZE, MSG_HELLO)
+_TYPES = (MSG_REQ, MSG_DATA, MSG_ERR, MSG_SIZE_REQ, MSG_SIZE, MSG_HELLO,
+          MSG_STATS, MSG_STATS_REPLY)
+# the header accepts any type in this reserved range; semantically
+# unknown ones get a typed ERR from the server, never a teardown (the
+# forward-compat contract — see the module docstring). Anything past
+# the range is a desynced stream, same as a bad magic.
+_MAX_TYPE = 32
 
 _REQ = struct.Struct("!IQI")      # reduce_id, offset, chunk_size
 _DATA = struct.Struct("!QQQB")    # raw_length, part_length, offset, flags
@@ -90,8 +130,15 @@ _CRC = struct.Struct("!I")
 _SIZE_REQ = struct.Struct("!II")  # reduce_id, num maps
 _SIZE = struct.Struct("!q")       # total bytes, -1 = unknown
 _HELLO = struct.Struct("!IB")     # server generation, flags
+_TRACE = struct.Struct("!QQ")     # trace_id, parent_span_id (optional
+                                  # REQ/SIZE_REQ tail — see docstring)
 
 _HELLO_WARM = 0x01  # the generation continues a persisted handoff
+# HELLO capability bits (old decoders mask only the bits they know —
+# decode_hello tests _HELLO_WARM and ignores the rest, so advertising
+# new bits is free):
+CAP_TRACE = 0x02    # peer decodes the trace-context REQ/SIZE_REQ tail
+                    # and serves MSG_STATS (the observability plane)
 
 _FLAG_LAST = 0x01
 _FLAG_CRC = 0x02
@@ -139,9 +186,15 @@ def encode_frame(msg_type: int, req_id: int, payload: bytes) -> bytes:
                        len(payload)) + payload
 
 
-def encode_request(req_id: int, req: ShuffleRequest) -> bytes:
+def encode_request(req_id: int, req: ShuffleRequest,
+                   trace: Optional[tuple] = None) -> bytes:
+    """``trace`` is the optional ``(trace_id, parent_span_id)`` pair —
+    append it ONLY to peers whose HELLO advertised :data:`CAP_TRACE`
+    (an old decoder treats trailing bytes as a torn frame)."""
     payload = (_REQ.pack(req.reduce_id, req.offset, req.chunk_size)
                + _pack_str(req.job_id) + _pack_str(req.map_id))
+    if trace is not None:
+        payload += _TRACE.pack(trace[0], trace[1])
     return encode_frame(MSG_REQ, req_id, payload)
 
 
@@ -185,10 +238,13 @@ def encode_error(req_id: int, exc: BaseException) -> bytes:
 
 
 def encode_size_request(req_id: int, job_id: str, map_ids: Sequence[str],
-                        reduce_id: int) -> bytes:
+                        reduce_id: int,
+                        trace: Optional[tuple] = None) -> bytes:
     payload = b"".join([_SIZE_REQ.pack(reduce_id, len(map_ids)),
                         _pack_str(job_id),
                         *(_pack_str(mid) for mid in map_ids)])
+    if trace is not None:
+        payload += _TRACE.pack(trace[0], trace[1])
     return encode_frame(MSG_SIZE_REQ, req_id, payload)
 
 
@@ -197,19 +253,56 @@ def encode_size(req_id: int, total: Optional[int]) -> bytes:
                         _SIZE.pack(-1 if total is None else total))
 
 
-def encode_hello(generation: int, warm: bool) -> bytes:
-    """The accept banner (req id 0 — it correlates with nothing)."""
+def encode_hello(generation: int, warm: bool,
+                 caps: int = CAP_TRACE) -> bytes:
+    """The accept banner (req id 0 — it correlates with nothing).
+    ``caps`` bits advertise optional capabilities (trace-context
+    frames, MSG_STATS); decoders from before a bit existed ignore
+    it."""
+    flags = (_HELLO_WARM if warm else 0) | (caps & 0xFE)
     return encode_frame(MSG_HELLO, 0,
-                        _HELLO.pack(generation & 0xFFFFFFFF,
-                                    _HELLO_WARM if warm else 0))
+                        _HELLO.pack(generation & 0xFFFFFFFF, flags))
 
 
 def decode_hello(payload) -> tuple[int, bool]:
-    """-> (server generation, warm)."""
+    """-> (server generation, warm). Ignores capability bits it does
+    not know — the forward-compat contract that lets new servers
+    advertise CAP_TRACE to old clients."""
+    generation, warm, _ = decode_hello_ex(payload)
+    return generation, warm
+
+
+def decode_hello_ex(payload) -> tuple[int, bool, int]:
+    """-> (server generation, warm, capability bits)."""
     if len(payload) != _HELLO.size:
         raise TransportError(f"malformed HELLO frame ({len(payload)} B)")
     generation, flags = _HELLO.unpack(payload)
-    return generation, bool(flags & _HELLO_WARM)
+    return generation, bool(flags & _HELLO_WARM), flags & 0xFE
+
+
+def encode_stats_request(req_id: int) -> bytes:
+    """MSG_STATS: snapshot a remote process's live telemetry. Empty
+    payload; uncredited on the server (the HELLO precedent) so an
+    introspection poll can never be starved by a full data pipeline."""
+    return encode_frame(MSG_STATS, req_id, b"")
+
+
+def encode_stats_reply(req_id: int, snapshot: dict) -> bytes:
+    """The introspection snapshot as UTF-8 JSON (the shape is
+    ``uda_tpu.utils.stats.introspection_snapshot``)."""
+    import json
+
+    return encode_frame(MSG_STATS_REPLY, req_id,
+                        json.dumps(snapshot, default=repr).encode("utf-8"))
+
+
+def decode_stats_reply(payload) -> dict:
+    import json
+
+    try:
+        return json.loads(bytes(payload).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise TransportError(f"malformed STATS_REPLY frame: {e}") from e
 
 
 # -- decode ------------------------------------------------------------------
@@ -227,7 +320,10 @@ def decode_header(header: bytes) -> tuple[int, int, int]:
     if version != WIRE_VERSION:
         raise TransportError(f"wire version mismatch: peer speaks "
                              f"v{version}, this side v{WIRE_VERSION}")
-    if msg_type not in _TYPES:
+    if not 1 <= msg_type <= _MAX_TYPE:
+        # far outside the reserved range: this is a desynced stream,
+        # not a newer peer — in-range unknown types pass here and get
+        # a typed ERR from the semantic layer instead of a teardown
         raise TransportError(f"unknown frame type {msg_type}")
     if length > MAX_FRAME:
         raise TransportError(f"frame length {length} exceeds the "
@@ -235,14 +331,33 @@ def decode_header(header: bytes) -> tuple[int, int, int]:
     return msg_type, req_id, length
 
 
+def _take_trace(payload, off: int, what: str) -> Optional[tuple]:
+    """The optional trailing trace-context block: exactly zero or
+    exactly ``_TRACE.size`` bytes may remain (the length IS the
+    version, the v2-UDIX discipline); anything else is a torn frame."""
+    rest = len(payload) - off
+    if rest == 0:
+        return None
+    if rest == _TRACE.size:
+        return _TRACE.unpack_from(payload, off)
+    raise TransportError(f"malformed {what} frame: {rest} trailing bytes")
+
+
 def decode_request(payload: bytes) -> ShuffleRequest:
+    return decode_request_ex(payload)[0]
+
+
+def decode_request_ex(payload) -> tuple[ShuffleRequest, Optional[tuple]]:
+    """-> (request, optional (trace_id, parent_span_id) wire trace
+    context). Old peers send no trace tail; both shapes decode."""
     if len(payload) < _REQ.size:
         raise TransportError(f"truncated REQ frame ({len(payload)} B)")
     reduce_id, offset, chunk_size = _REQ.unpack_from(payload, 0)
     job_id, off = _unpack_str(payload, _REQ.size, "job id")
     map_id, off = _unpack_str(payload, off, "map id")
-    _done(payload, off, "REQ")
-    return ShuffleRequest(job_id, map_id, reduce_id, offset, chunk_size)
+    trace = _take_trace(payload, off, "REQ")
+    return (ShuffleRequest(job_id, map_id, reduce_id, offset, chunk_size),
+            trace)
 
 
 def _decode_result_meta(payload):
@@ -299,6 +414,11 @@ def decode_error(payload: bytes) -> UdaError:
 
 
 def decode_size_request(payload: bytes) -> tuple[str, list[str], int]:
+    return decode_size_request_ex(payload)[0]
+
+
+def decode_size_request_ex(payload) -> tuple[tuple, Optional[tuple]]:
+    """-> ((job_id, map_ids, reduce_id), optional trace context)."""
     if len(payload) < _SIZE_REQ.size:
         raise TransportError(f"truncated SIZE_REQ frame ({len(payload)} B)")
     reduce_id, n = _SIZE_REQ.unpack_from(payload, 0)
@@ -307,8 +427,8 @@ def decode_size_request(payload: bytes) -> tuple[str, list[str], int]:
     for i in range(n):
         mid, off = _unpack_str(payload, off, f"map id {i}")
         mids.append(mid)
-    _done(payload, off, "SIZE_REQ")
-    return job_id, mids, reduce_id
+    trace = _take_trace(payload, off, "SIZE_REQ")
+    return (job_id, mids, reduce_id), trace
 
 
 def decode_size(payload: bytes) -> Optional[int]:

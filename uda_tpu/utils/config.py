@@ -301,6 +301,21 @@ _FLAG_LIST = [
     Flag("uda.tpu.stats.jsonl", "", str,
          "path for the JSON-lines stats stream (appended); empty = "
          "UDA_TPU_STATS_JSONL env, else stderr"),
+    Flag("uda.tpu.flightrec.enable", True, bool,
+         "the flight recorder (utils/flightrec.py): an always-on "
+         "bounded ring of structured events (segment transitions, "
+         "admission causes, recovery events, failpoint fires, watchdog "
+         "samples) dumped automatically on FallbackSignal, stall or "
+         "resledger leak. UDA_TPU_FLIGHTREC=0 is the env kill switch "
+         "(both must say on)"),
+    Flag("uda.tpu.flightrec.events", 4096, int,
+         "flight-recorder ring capacity in events (the black box's "
+         "whole memory bound; oldest events roll off)"),
+    Flag("uda.tpu.flightrec.dir", "", str,
+         "directory for flight-recorder dump files "
+         "(flightrec_<pid>_<seq>_<cause>.json); empty = "
+         "UDA_TPU_FLIGHTREC_DIR env, else dumps stay in-memory only "
+         "(FlightRecorder.reports)"),
     Flag("uda.tpu.auto.approach.threshold.mb", 2048, int,
          "auto merge-approach crossover: partitions at most this many "
          "MB take the hybrid LPQ/RPQ path (fastest at small/mid scale), "

@@ -65,6 +65,7 @@ from typing import Dict, Iterator, Optional
 from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
                                   ProtocolError, StorageError,
                                   TransportError, UdaError)
+from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.metrics import metrics
 
 __all__ = ["Failpoint", "FailpointRegistry", "failpoints", "failpoint",
@@ -301,6 +302,11 @@ class FailpointRegistry:
             else:
                 positions = []
         metrics.add(f"failpoint.{site}")
+        # the black box records every FIRE (armed sites only — the
+        # disarmed hot path never reaches here): a post-mortem dump
+        # must show which injected fault preceded the fallback
+        flightrec.record("failpoint", site=site, action=fp.action,
+                         key=key)
         if fp.action == "delay":
             time.sleep(fp.delay_ms / 1000.0)
             return data

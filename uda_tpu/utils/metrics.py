@@ -53,7 +53,7 @@ from uda_tpu.utils.resledger import resledger as _resledger
 
 __all__ = ["Metrics", "Span", "metrics", "device_trace",
            "METRICS_REGISTRY", "REGISTRY_PREFIXES", "NAME_RE",
-           "PARITY_ALIASES", "stats_enabled_from_env"]
+           "SPAN_REGISTRY", "PARITY_ALIASES", "stats_enabled_from_env"]
 
 # Dotted namespace every metrics.add/gauge/observe name must match
 # (scripts/check_metrics_names.py enforces this over uda_tpu/).
@@ -253,6 +253,14 @@ METRICS_REGISTRY: Dict[str, tuple] = {
     "net.handoff.loaded": ("counter", "warm restarts that resumed a "
                                       "persisted handoff record "
                                       "(generation continuity)"),
+    "net.stats.requests": ("counter", "MSG_STATS introspection "
+                                      "snapshots served to remote "
+                                      "peers (uncredited, like the "
+                                      "HELLO banner)"),
+    "flightrec.dumps": ("counter", "flight-recorder black-box dumps "
+                                   "written (FallbackSignal, stall, "
+                                   "resledger leak — "
+                                   "utils/flightrec.py)"),
     # -- gauges ----------------------------------------------------------
     "fetch.on_air": ("gauge", "fetch attempts currently in flight "
                               "(reference AIO on-air counter)"),
@@ -310,6 +318,35 @@ METRICS_REGISTRY: Dict[str, tuple] = {
 # Dynamically-named families (f-string call sites): the static prefix
 # must be listed here.
 REGISTRY_PREFIXES = ("failpoint.",)
+
+# The span-name registry: every literal name passed to
+# ``metrics.start_span``/``metrics.span`` must be listed here (udalint
+# UDA009 — the span contract of UDA002's metrics-name rule). Spans are
+# cross-PROCESS identifiers since the wire carries (trace_id,
+# parent_span_id) on REQ/SIZE_REQ frames, so a typo'd name is not just
+# an ugly trace: it breaks scripts/trace_merge.py's stitching and any
+# dashboard keying on the inventory below. Timer spans
+# (``metrics.timer``) are named by their timer counter and documented
+# at the call site; they are not part of this literal-name inventory.
+SPAN_REGISTRY: Dict[str, str] = {
+    "reduce_task": "root of one reduce task's trace tree "
+                   "(merger/merge_manager.py)",
+    "fetch.segment": "one partition's whole fetch, child of "
+                     "reduce_task (merger/segment.py)",
+    "net.fetch": "one chunk request on the wire, reduce side "
+                 "(net/client.py); its (trace, span) ids ride the REQ "
+                 "frame",
+    "net.size_probe": "partition size probe over the wire "
+                      "(net/client.py)",
+    "net.serve": "one REQ served, supplier side (net/server.py); "
+                 "adopts the wire-carried trace context so it is a "
+                 "child of the remote net.fetch",
+    "net.stats": "one MSG_STATS introspection poll, client side "
+                 "(net/client.py)",
+    "engine.pread": "one DataEngine chunk read/plan, child of the "
+                    "serve (or local fetch) span "
+                    "(mofserver/data_engine.py)",
+}
 
 # snapshot() aliases for the reference's per-reduce-task aggregate trio
 # (reducer.h:80-90): alias name -> source timer counter.
@@ -432,6 +469,24 @@ class _NoopSpan:
 _NOOP_SPAN = _NoopSpan()
 
 
+class _RemoteParent:
+    """A parent that lives in ANOTHER process: the (trace_id,
+    parent_span_id) pair a REQ/SIZE_REQ frame carried over the wire
+    (uda_tpu/net/wire.py). Quacks enough like a Span for
+    ``start_span(parent=...)`` — the supplier-side serve span then
+    joins the reduce-side fetch span's tree, and
+    ``scripts/trace_merge.py`` stitches the two processes' span files
+    on exactly these ids."""
+
+    __slots__ = ("trace_id", "span_id")
+    parent_id = None
+    attrs: dict = {}
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
 class Metrics:
     """Process-wide metrics hub. Counters and gauges are always live
     (two dict writes under one lock); histograms and spans cost nothing
@@ -459,6 +514,17 @@ class Metrics:
         self._hist_enabled = self._default_stats
         self._spans_enabled = self._default_stats
         self._next_id = 0
+        # span/trace ids must be unique ACROSS processes (they cross
+        # the wire and are merged by scripts/trace_merge.py): ids are
+        # base + counter with a random per-process 32-bit base in the
+        # high half of a u64 — collisions between two processes of one
+        # job are 2^-32-grade, and ids still fit the wire's u64 fields
+        self._id_base = int.from_bytes(os.urandom(4), "big") << 32
+        # wall-clock anchor: spans record perf_counter() timestamps
+        # (monotonic, process-local); exports convert through this
+        # (wall, perf) pair so two processes' spans land on one
+        # comparable timeline
+        self._anchor = (time.time(), time.perf_counter())
 
     # -- enablement ---------------------------------------------------------
 
@@ -568,10 +634,21 @@ class Metrics:
     def _new_ids(self, parent: Optional[Span]) -> tuple[int, int, Optional[int]]:
         with self._lock:
             self._next_id += 1
-            sid = self._next_id
+            sid = self._id_base + self._next_id
         if parent is not None and parent.span_id is not None:
             return parent.trace_id, sid, parent.span_id
         return sid, sid, None  # root: trace id = own span id
+
+    @staticmethod
+    def remote_parent(trace_id: int, span_id: int):
+        """Wrap a wire-carried (trace_id, parent_span_id) pair as a
+        ``start_span(parent=...)`` argument — the supplier side of
+        cross-process trace propagation. (The CLIENT side stamps its
+        own request span's ids onto the frame, gated by the peer's
+        CAP_TRACE — EvLoopFetchClient._trace_of — so there is
+        deliberately no context-var convenience here that could bypass
+        the capability gate.)"""
+        return _RemoteParent(trace_id, span_id)
 
     def start_span(self, name: str, parent: Optional[Span] = None,
                    **attrs) -> Span:
@@ -720,6 +797,26 @@ class Metrics:
                            "dur": s["dur"] * 1e6, "args": args})
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
+
+    def export_spans_jsonl(self, path: str, append: bool = False) -> int:
+        """Write the recorded spans as JSON lines — the PER-PROCESS
+        half of cross-process tracing. Each line carries the span
+        record plus ``pid`` and ``ts_unix`` (the perf_counter start
+        converted through this process's wall-clock anchor), so
+        ``scripts/trace_merge.py`` can stitch several processes' files
+        into one Perfetto-loadable timeline keyed by trace id. Returns
+        the number of spans written."""
+        anchor_wall, anchor_perf = self._anchor
+        with self._lock:
+            spans = list(self.spans)
+        pid = os.getpid()
+        with open(path, "a" if append else "w") as f:
+            for s in spans:
+                rec = dict(s)
+                rec["pid"] = pid
+                rec["ts_unix"] = anchor_wall + (s["ts"] - anchor_perf)
+                f.write(json.dumps(rec) + "\n")
+        return len(spans)
 
 
 def stats_enabled_from_env() -> bool:

@@ -283,6 +283,18 @@ class ResourceLedger:
                 metrics.add("resledger.leaks", len(reports))
             except Exception as e:  # noqa: BLE001
                 print(f"resledger: metrics unavailable: {e}")
+            # a leak on the PROCESS-GLOBAL books is a black-box trigger
+            # (private fixture ledgers seeding leaks on purpose stay
+            # out — the emit_metrics flag is the global-instance mark):
+            # dump the event stream that surrounded the unmatched
+            # acquire, with the leak summary as the cause
+            try:
+                from uda_tpu.utils.flightrec import flightrec
+                flightrec.dump("resledger_leak", extra={
+                    "point": point, "leaks": len(reports),
+                    "pairs": sorted({r["pair"] for r in reports})})
+            except Exception as e:  # noqa: BLE001 - interpreter teardown
+                print(f"resledger: flightrec unavailable: {e}")
         out = (os.environ.get("UDA_TPU_RESLEDGER_JSON")
                if self.emit_json else None)
         if out:

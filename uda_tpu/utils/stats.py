@@ -27,7 +27,18 @@ JSON-lines schema (one object per line)::
      "rates": {"fetch_mb_s": ..., "merge_records_s": ...,
                "retry_per_s": ..., "emit_mb_s": ...},
      "histograms": {<name>: {"count","sum","min","max","p50","p95","p99"}},
-     "final": true}            # last record only
+     "percentiles": {<name>: {"p50","p95","p99"}},
+     "final": true,            # last record only, which also carries:
+     "recovery": {"recovery.r<id>": {penalty_box, ledger, admission}},
+     "resledger": {"armed","outstanding","by_pair","leak_reports"}}
+
+This module is also the **introspection registry**: components with
+process-local state register snapshot providers
+(:func:`register_stats_provider`) and
+:func:`introspection_snapshot` folds them — with counters, gauges,
+percentiles and the ResourceLedger summary — into the record the
+shuffle server answers ``MSG_STATS`` wire requests with
+(``scripts/udatop.py`` is the console over it).
 
 Configuration: ``uda.tpu.stats.enable`` / ``UDA_TPU_STATS=1`` switch the
 whole observability layer on; ``uda.tpu.stats.interval.ms`` paces the
@@ -47,8 +58,11 @@ from typing import Callable, Dict, Optional
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import PARITY_ALIASES, Metrics
 from uda_tpu.utils.metrics import metrics as global_metrics
+from uda_tpu.utils.resledger import resledger
 
-__all__ = ["StatsReporter", "telemetry_block"]
+__all__ = ["StatsReporter", "telemetry_block", "introspection_snapshot",
+           "register_stats_provider", "unregister_stats_provider",
+           "percentiles_block", "resledger_block"]
 
 # (rate key, source counter, scale) — rate = delta(counter)/dt/scale
 _RATES = (
@@ -70,6 +84,99 @@ def telemetry_block(m: Optional[Metrics] = None) -> Dict:
         counters.setdefault(alias, 0.0)
     return {"counters": counters, "gauges": m.gauges_snapshot(),
             "histograms": m.histogram_summaries()}
+
+
+def percentiles_block(m: Optional[Metrics] = None,
+                      summaries: Optional[Dict] = None) -> Dict:
+    """The Metrics.percentile() projection, one compact block per
+    histogram series: ``{name: {"p50","p95","p99"}}`` — the same
+    estimator the speculation threshold consumes internally, exposed
+    in every interval/final record and over MSG_STATS so remote
+    pollers (scripts/udatop.py) read latency tails without shipping
+    whole bucket arrays. Pass already-built ``summaries`` (a
+    ``histogram_summaries()`` result) to avoid a second walk of every
+    series per record/poll."""
+    if summaries is None:
+        summaries = (m or global_metrics).histogram_summaries()
+    return {name: {"p50": s.get("p50", 0.0), "p95": s.get("p95", 0.0),
+                   "p99": s.get("p99", 0.0)}
+            for name, s in summaries.items()
+            if s.get("count")}
+
+
+def resledger_block() -> Dict:
+    """The ResourceLedger obligation summary: open obligations grouped
+    by pair (count + amount), plus the lifetime leak-report count.
+    Stacks deliberately stay OFF the wire — they are the dump/log
+    diagnostic; the summary is the scrape surface."""
+    by_pair: Dict[str, Dict[str, float]] = {}
+    outstanding = resledger.outstanding() if resledger.enabled else []
+    for rec in outstanding:
+        agg = by_pair.setdefault(rec["pair"], {"count": 0, "amount": 0.0})
+        agg["count"] += 1
+        agg["amount"] += rec["amount"]
+    return {"armed": resledger.enabled,
+            "outstanding": len(outstanding),
+            "by_pair": by_pair,
+            "leak_reports": len(resledger.leak_reports)}
+
+
+# -- introspection providers (the MSG_STATS scrape surface) -------------------
+
+# name -> zero-arg callable returning a JSON-able dict. Components with
+# process-local state the metrics hub cannot see (a MergeManager's
+# PenaltyBox/RecoveryLedger, a ShuffleServer's conn table) register
+# here for the life of the component; introspection_snapshot() folds
+# every provider into the remote-readable record. Providers must be
+# cheap and non-blocking — they run on a server dispatcher thread per
+# MSG_STATS poll.
+_PROVIDERS: Dict[str, Callable[[], Dict]] = {}
+_PROVIDERS_LOCK = threading.Lock()
+
+
+def register_stats_provider(name: str, fn: Callable[[], Dict]) -> None:
+    with _PROVIDERS_LOCK:
+        _PROVIDERS[name] = fn
+
+
+def unregister_stats_provider(name: str, fn: Optional[Callable] = None
+                              ) -> None:
+    """Remove ``name``; with ``fn`` given, only when it is still the
+    registered callable (a replaced provider must not be yanked by its
+    predecessor's teardown)."""
+    with _PROVIDERS_LOCK:
+        # == not `is`: bound methods are re-materialized per access,
+        # but compare equal for the same (function, instance) pair
+        if fn is None or _PROVIDERS.get(name) == fn:
+            _PROVIDERS.pop(name, None)
+
+
+def introspection_snapshot(m: Optional[Metrics] = None) -> Dict:
+    """The live introspection record served over MSG_STATS (and usable
+    locally): counters/gauges/histogram percentiles, the ResourceLedger
+    obligation summary, and every registered provider's block
+    (PenaltyBox/RecoveryLedger state, evloop conn tables). One
+    provider failing must not take the whole snapshot down — its block
+    degrades to an error marker."""
+    m = m or global_metrics
+    snap = telemetry_block(m)
+    snap["ts"] = round(time.time(), 3)
+    snap["pid"] = os.getpid()
+    snap["percentiles"] = percentiles_block(
+        summaries=snap["histograms"])
+    snap["resledger"] = resledger_block()
+    with _PROVIDERS_LOCK:
+        providers = dict(_PROVIDERS)
+    blocks = {}
+    for name, fn in providers.items():
+        try:
+            blocks[name] = fn()
+        except Exception as e:  # noqa: BLE001 - a dying component's
+            # provider racing its own teardown is expected; the poll
+            # must still answer
+            blocks[name] = {"error": type(e).__name__}
+    snap["providers"] = blocks
+    return snap
 
 
 class StatsReporter:
@@ -159,10 +266,32 @@ class StatsReporter:
                 "rates": rates,
                 "histograms": self.metrics.histogram_summaries(),
             }
+            # the Metrics.percentile() projection (p50/p95/p99 per
+            # series) in EVERY record — the tail-latency view the
+            # speculation threshold already consumes internally —
+            # derived from the summaries just built, not a second walk
+            record["percentiles"] = percentiles_block(
+                summaries=record["histograms"])
             if final:
                 record["final"] = True
                 for alias in PARITY_ALIASES:
                     record["counters"].setdefault(alias, 0.0)
+                # the task post-mortem blocks: what the survivable-
+                # shuffle layer did (registered recovery.* providers —
+                # PenaltyBox state, RecoveryLedger counts) and whether
+                # the obligation books closed clean
+                with _PROVIDERS_LOCK:
+                    providers = dict(_PROVIDERS)
+                recovery = {}
+                for name, fn in providers.items():
+                    if not name.startswith("recovery"):
+                        continue
+                    try:
+                        recovery[name] = fn()
+                    except Exception as e:  # noqa: BLE001 - teardown race
+                        recovery[name] = {"error": type(e).__name__}
+                record["recovery"] = recovery
+                record["resledger"] = resledger_block()
             self._latest = record
             self._write_jsonl(record)
         self._progress_line(record)

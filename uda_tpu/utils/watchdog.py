@@ -32,6 +32,7 @@ import traceback
 from typing import Callable, Optional
 
 from uda_tpu.utils.errors import UdaError
+from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.locks import lockdep
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
@@ -79,8 +80,16 @@ def dump_diagnostics(reason: str = "") -> str:
     if spans:
         lines.append(f"--- span tree ({len(spans)} recorded spans) ---")
         children: dict = {}
+        known = {s["id"] for s in spans}
         for s in spans:
-            children.setdefault(s.get("parent"), []).append(s)
+            parent = s.get("parent")
+            # a parent id this process never recorded is a REMOTE
+            # parent (wire-carried trace context) or an un-ended span:
+            # render the child as a local root rather than dropping the
+            # whole subtree from the dump
+            if parent is not None and parent not in known:
+                parent = None
+            children.setdefault(parent, []).append(s)
 
         def walk(parent_id, depth):
             for s in children.get(parent_id, []):
@@ -144,7 +153,14 @@ class StallWatchdog:
                 log.warn(f"watchdog progress probe failed: {e}")  # not
                 continue                                          # kill us
             now = time.monotonic()
-            if now_token != token:
+            changed = now_token != token
+            # every sample lands in the black box: a post-mortem dump
+            # shows exactly when progress flatlined, not just that it
+            # eventually did (bounded rate — poll_s >= 0.05 s)
+            flightrec.record("watchdog", changed=changed,
+                             idle_s=round(0.0 if changed
+                                          else now - last_change, 3))
+            if changed:
                 token, last_change = now_token, now
                 continue
             if now - last_change < self.stall_s:
@@ -159,6 +175,10 @@ class StallWatchdog:
             f"(stall deadline {self.stall_s:g} s)")
         self.last_dump = dump_diagnostics(str(err))
         log.error(self.last_dump)
+        # the stall IS a black-box trigger: the ring holds the
+        # flatlining watchdog samples and whatever faults preceded them
+        flightrec.dump("stall", extra={"stalled_s": round(stalled_for, 3),
+                                       "deadline_s": self.stall_s})
         hook = self.on_stall
         if hook is not None:
             try:
