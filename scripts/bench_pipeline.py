@@ -137,6 +137,25 @@ def _compressed_run_bytes(tmp: str, pipeline: bool) -> bytes:
     return b"".join(blocks)
 
 
+def _time_accounting_point(tmp: str) -> dict:
+    """One pipelined MergeManager run with spans on -> the critpath
+    ``time_accounting`` block (uda_tpu.utils.critpath). This is the
+    time-accounting point perfwatch ingests next to the throughput
+    numbers: bucket shares trend across rounds, and the buckets-sum-
+    to-wall invariant is checked right here (exit gate in _run)."""
+    from uda_tpu.utils.critpath import time_accounting_block
+    from uda_tpu.utils.metrics import metrics
+
+    metrics.reset()
+    metrics.enable_stats()
+    try:
+        _compressed_run_bytes(os.path.join(tmp, "timeacct"), True)
+        block = time_accounting_block()
+    finally:
+        metrics.reset()
+    return block or {}
+
+
 def identity_gate(tmp: str) -> dict:
     """Byte-identity of pipelined vs serial staging across input order,
     spool mode and compression — the CI correctness gate."""
@@ -184,6 +203,23 @@ def _run(args, tmp: str) -> int:
     if not result["identity"]["all_identical"]:
         print(json.dumps(result))
         print("FAIL: pipelined staging is not byte-identical to serial",
+              file=sys.stderr)
+        return 3
+
+    # the where-time-goes point: buckets must partition the task wall
+    # (critical + idle == wall by construction; gate at 5% for the
+    # acceptance record). A missing block (span layer broken) fails —
+    # this bench is the time-accounting plane's own canary.
+    ta = _time_accounting_point(tmp)
+    result["time_accounting"] = ta
+    ta_sum = (sum(b["critical_s"] for b in ta.get("buckets", {}).values())
+              + ta.get("idle_s", 0.0))
+    result["time_accounting_sums_to_wall"] = bool(
+        ta.get("wall_s") and abs(ta_sum - ta["wall_s"])
+        <= 0.05 * ta["wall_s"])
+    if not result["time_accounting_sums_to_wall"]:
+        print(json.dumps(result))
+        print("FAIL: time_accounting buckets do not sum to task wall",
               file=sys.stderr)
         return 3
 

@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""perfwatch: normalize bench history into one trajectory + gate on it.
+
+The repo's bench record is nine heterogeneous ``BENCH_*.json`` shapes
+plus ``REGRESSION_*`` ladders — a perf regression is caught only if a
+human rereads old JSON. This tool makes the trajectory a machine
+artifact:
+
+- **ingest**: parse every historical ``BENCH_*.json`` /
+  ``REGRESSION_*.json`` (each shape has a dedicated extractor below)
+  into one normalized ``PERF_TRAJECTORY.json``::
+
+      {"schema": 1, "entries": [
+        {"run": "BENCH_PIPELINE_r09", "rev": "d00dbd9",
+         "workload": "pipeline", "metric": "sorted_pipelined_MBps",
+         "value": 277.8, "direction": "up"}, ...]}
+
+  ``direction``: ``up`` = higher is better, ``down`` = lower is
+  better, ``info`` = recorded for trends, never gated
+  (time-accounting shares). Correctness metrics (identity/status
+  booleans, error counts) carry a per-entry ``tol`` of 0 — any
+  worsening fails regardless of the band.
+
+- **--check POINT.json**: normalize a fresh bench output (same
+  extractors) and compare each metric against the LATEST trajectory
+  entry for the same (workload, metric) under a relative tolerance
+  band — ``up`` fails when ``value < base*(1-tol)``, ``down`` when
+  ``value > base*(1+tol)``; a per-entry ``tol`` (the 0 on correctness
+  metrics) overrides the band. Metrics with no
+  baseline report ``new`` and pass. Exit 1 on any regression — the
+  ci.sh gate (which passes a generous ``--tolerance`` because shared
+  CI hosts gate direction-of-change, not absolute MB/s).
+  ``--append`` adds the checked point to the trajectory on green.
+
+Usage::
+
+    python scripts/perfwatch.py ingest                      # rebuild
+    python scripts/perfwatch.py --check ci/bench.json --tolerance 0.6
+    python scripts/perfwatch.py --check new.json --append
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+TRAJECTORY = os.path.join(REPO, "PERF_TRAJECTORY.json")
+DEFAULT_TOLERANCE = 0.25
+
+
+# -- normalization ------------------------------------------------------------
+
+def _add(entries: List[Dict], run: str, workload: str, metric: str,
+         value, direction: str, tol: Optional[float] = None) -> None:
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return
+    rec = {"run": run, "workload": workload, "metric": metric,
+           "value": round(value, 6), "direction": direction}
+    if tol is not None:
+        rec["tol"] = tol
+    entries.append(rec)
+
+
+def _extract_headline(run: str, data: Dict, out: List[Dict]) -> None:
+    """bench.py output (flat or BENCH_HW headline block)."""
+    head = data.get("headline") if isinstance(data.get("headline"), dict) \
+        else data
+    if head.get("metric") and "value" in head:
+        _add(out, run, "terasort_singlechip", head["metric"],
+             head["value"], "up")
+    for rows, block in (data.get("small_batch") or {}).items():
+        if isinstance(block, dict) and "gbps" in block:
+            _add(out, run, "terasort_small_batch",
+                 f"gbps_rows_{rows}", block["gbps"], "up")
+    for path, v in (data.get("flyoff") or {}).items():
+        if isinstance(v, (int, float)):
+            _add(out, run, "terasort_flyoff", f"{path}_gbps", v, "up")
+
+
+def _extract_net(run: str, data: Dict, out: List[Dict]) -> None:
+    w = "net_quick" if data.get("quick") else "net"
+    ss = (data.get("single_stream") or {}).get("evloop") or {}
+    if "mb_per_s" in ss:
+        _add(out, run, w, "single_stream_mb_per_s", ss["mb_per_s"], "up")
+    fan = data.get("fanin") or {}
+    if "agg_mb_per_s" in fan:
+        _add(out, run, w, "fanin_agg_mb_per_s", fan["agg_mb_per_s"], "up")
+    if "errors" in fan:
+        _add(out, run, w, "fanin_errors", fan["errors"], "down", tol=0.0)
+    if "stalled" in fan:
+        _add(out, run, w, "fanin_ok", 0.0 if fan["stalled"] else 1.0,
+             "up", tol=0.0)
+    lat = (data.get("frame_latency") or {}).get("evloop") or {}
+    if "p99_ms" in lat:
+        _add(out, run, w, "frame_p99_ms", lat["p99_ms"], "down")
+
+
+def _extract_pipeline(run: str, data: Dict, out: List[Dict]) -> None:
+    quick = bool(data.get("quick"))
+    w = "pipeline_quick" if quick else "pipeline"
+    ident = data.get("identity") or {}
+    if "all_identical" in ident:
+        _add(out, run, w, "identity_all",
+             1.0 if ident["all_identical"] else 0.0, "up", tol=0.0)
+    if "time_accounting_sums_to_wall" in data:
+        _add(out, run, w, "timeacct_sums_to_wall",
+             1.0 if data["time_accounting_sums_to_wall"] else 0.0,
+             "up", tol=0.0)
+    # quick-mode throughput on a shared host is noise (observed 0.7-1.8x
+    # spread run to run): record it as trend data, gate only full runs —
+    # direction-of-change gating, never absolute MB/s on CI iron
+    for key, value in data.items():
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        if key.endswith("_MBps") or key.startswith("speedup_"):
+            _add(out, run, w, key, value, "info" if quick else "up")
+        elif key.endswith("_wait_p95_ms"):
+            _add(out, run, w, key, value, "info" if quick else "down")
+    for key in ("speedup_ok", "spool_ok", "wait_p95_drops"):
+        if key in data and not quick:
+            # full-mode gates only: quick throughput is host noise
+            _add(out, run, w, key, 1.0 if data[key] else 0.0, "up",
+                 tol=0.0)
+    _extract_time_accounting(run, w, data, out)
+
+
+def _extract_regression(run: str, data: Dict, out: List[Dict]) -> None:
+    w = f"regression_{data.get('size', 'unknown')}"
+    for rec in data.get("results", []):
+        if not isinstance(rec, dict) or "workload" not in rec:
+            continue
+        name = rec["workload"]
+        if "status" in rec:
+            _add(out, run, w, f"{name}_pass",
+                 1.0 if rec["status"] == "PASS" else 0.0, "up", tol=0.0)
+        if rec.get("wall_s"):
+            _add(out, run, w, f"{name}_wall_s", rec["wall_s"], "down")
+        if rec.get("max_rss_mb"):
+            _add(out, run, w, f"{name}_max_rss_mb", rec["max_rss_mb"],
+                 "down")
+
+
+def _extract_time_accounting(run: str, workload: str, data: Dict,
+                             out: List[Dict]) -> None:
+    """The critpath block (utils/critpath.py): bucket shares ride the
+    trajectory as trend data (``info`` — a share shift is a finding to
+    read, not automatically a regression)."""
+    ta = data.get("time_accounting")
+    if not isinstance(ta, dict):
+        return
+    if "wall_s" in ta:
+        _add(out, run, workload, "timeacct_wall_s", ta["wall_s"], "info")
+    for bucket, rec in (ta.get("buckets") or {}).items():
+        if isinstance(rec, dict) and "share" in rec:
+            _add(out, run, workload, f"timeacct_{bucket}_share",
+                 rec["share"], "info")
+
+
+def _extract_telemetry_hists(run: str, workload: str, data: Dict,
+                             out: List[Dict]) -> None:
+    """The offline-percentile consumer of the exported histogram
+    bucket boundaries+counts: recompute p90 — a percentile the inline
+    p50/p95/p99 trio does NOT carry — from a committed telemetry block
+    alone (metrics.percentile_from_summary, the exact live
+    estimator), recorded as latency trend data."""
+    hists = (data.get("telemetry") or {}).get("histograms") or {}
+    entries = [(name, s) for name, s in hists.items()
+               if isinstance(s, dict) and s.get("buckets")
+               and "{" not in name]  # totals only, not labeled series
+    if not entries:
+        return
+    from uda_tpu.utils.metrics import percentile_from_summary
+    for name, s in entries:
+        if name.endswith("_ms"):
+            _add(out, run, workload, f"hist_{name}_p90",
+                 percentile_from_summary(s, 90), "info")
+
+
+def extract(run: str, data) -> List[Dict]:
+    """Shape-sniffing dispatch over every historical artifact layout.
+    Unknown or payload-less shapes (the early driver-wrapped bench
+    failures with ``"parsed": null``) normalize to zero entries."""
+    out: List[Dict] = []
+    if not isinstance(data, dict):
+        return out
+    if "parsed" in data and "cmd" in data:  # driver wrapper
+        data = data.get("parsed")
+        if not isinstance(data, dict):
+            return out
+    if data.get("bench") == "net_loopback":
+        _extract_net(run, data, out)
+    elif "identity" in data and "speedup_sorted" in data:
+        _extract_pipeline(run, data, out)
+    elif isinstance(data.get("results"), list):
+        _extract_regression(run, data, out)
+    elif isinstance(data.get("headline"), dict) \
+            or ("metric" in data and "value" in data):
+        _extract_headline(run, data, out)
+        _extract_time_accounting(run, "terasort_singlechip", data, out)
+        _extract_telemetry_hists(run, "terasort_singlechip", data, out)
+    return out
+
+
+def _git_rev(args: List[str]) -> str:
+    try:
+        res = subprocess.run(["git"] + args, cwd=REPO, timeout=10,
+                             capture_output=True, text=True, check=False)
+        return res.stdout.strip() if res.returncode == 0 else ""
+    except OSError:
+        return ""
+
+
+def normalize_file(path: str, rev: Optional[str] = None) -> List[Dict]:
+    run = os.path.splitext(os.path.basename(path))[0]
+    with open(path) as f:
+        data = json.load(f)
+    entries = extract(run, data)
+    if rev is None:
+        rev = _git_rev(["log", "-n1", "--format=%h", "--", path])
+    for e in entries:
+        e["rev"] = rev
+    return entries
+
+
+# -- trajectory ---------------------------------------------------------------
+
+def load_trajectory(path: str) -> List[Dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    return list(doc.get("entries", []))
+
+
+def save_trajectory(path: str, entries: List[Dict]) -> None:
+    with open(path, "w") as f:
+        json.dump({"schema": 1, "entries": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def ingest(files: List[str], out: str) -> int:
+    if not files:
+        files = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))
+                       + glob.glob(os.path.join(REPO,
+                                                "REGRESSION_*.json")))
+    entries: List[Dict] = []
+    skipped = []
+    for path in files:
+        try:
+            got = normalize_file(path)
+        except (OSError, ValueError) as e:
+            print(f"perfwatch: {path}: unreadable ({e})", file=sys.stderr)
+            skipped.append(os.path.basename(path))
+            continue
+        if not got:
+            skipped.append(os.path.basename(path))
+        entries.extend(got)
+    # stable order: ingest file order (run ids are round-stamped, so
+    # later files ARE later rounds); dedupe keeps the last occurrence
+    seen: Dict[tuple, Dict] = {}
+    for e in entries:
+        seen[(e["run"], e["workload"], e["metric"])] = e
+    entries = list(seen.values())
+    save_trajectory(out, entries)
+    print(f"perfwatch: {len(entries)} entries from "
+          f"{len(files) - len(skipped)}/{len(files)} file(s) -> {out}")
+    if skipped:
+        # no silent caps: files that normalized to nothing are named
+        print(f"perfwatch: no metrics in: {', '.join(skipped)}")
+    return 0
+
+
+# -- the gate -----------------------------------------------------------------
+
+def check(point_path: str, trajectory_path: str, tolerance: float,
+          append: bool) -> int:
+    entries = load_trajectory(trajectory_path)
+    if not entries:
+        print(f"perfwatch: no trajectory at {trajectory_path} "
+              f"(run `perfwatch.py ingest` first)", file=sys.stderr)
+        return 2
+    try:
+        point = normalize_file(point_path,
+                               rev=_git_rev(["rev-parse", "--short",
+                                             "HEAD"]))
+    except (OSError, ValueError) as e:
+        print(f"perfwatch: {point_path}: {e}", file=sys.stderr)
+        return 2
+    if not point:
+        print(f"perfwatch: {point_path} normalized to zero metrics "
+              f"(unknown shape?)", file=sys.stderr)
+        return 2
+    latest: Dict[tuple, Dict] = {}
+    for e in entries:  # file order; last occurrence = latest round
+        latest[(e["workload"], e["metric"])] = e
+    regressions = []
+    compared = fresh = 0
+    rows = []
+    for e in point:
+        direction = e["direction"]
+        base = latest.get((e["workload"], e["metric"]))
+        if base is None:
+            fresh += 1
+            rows.append((e, None, "new"))
+            continue
+        if direction == "info":
+            rows.append((e, base, "info"))
+            continue
+        compared += 1
+        tol = e.get("tol", tolerance)
+        bv, nv = base["value"], e["value"]
+        bad = ((direction == "up" and nv < bv * (1 - tol) and nv < bv)
+               or (direction == "down" and nv > bv * (1 + tol)
+                   and nv > bv))
+        verdict = "REGRESSION" if bad else "ok"
+        if bad:
+            regressions.append((e, base))
+        rows.append((e, base, verdict))
+    width = max((len(f"{e['workload']}.{e['metric']}") for e, _, _ in
+                 rows), default=10)
+    print(f"perfwatch: {point_path} vs {trajectory_path} "
+          f"(tolerance {tolerance:g})")
+    for e, base, verdict in rows:
+        name = f"{e['workload']}.{e['metric']}"
+        if base is None:
+            print(f"  {name:<{width}}  {e['value']:>12g}  "
+                  f"(no baseline) {verdict}")
+        else:
+            delta = ((e["value"] - base["value"]) / base["value"] * 100
+                     if base["value"] else 0.0)
+            print(f"  {name:<{width}}  {e['value']:>12g}  vs "
+                  f"{base['value']:>12g} ({base['run']})  "
+                  f"{delta:+.1f}%  {verdict}")
+    print(f"perfwatch: {compared} compared, {fresh} new, "
+          f"{len(regressions)} regression(s)")
+    if regressions:
+        for e, base in regressions:
+            print(f"perfwatch: REGRESSION {e['workload']}."
+                  f"{e['metric']}: {e['value']:g} vs {base['value']:g} "
+                  f"({base['run']}, direction {e['direction']})",
+                  file=sys.stderr)
+        return 1
+    if append:
+        merged = {(x["run"], x["workload"], x["metric"]): x
+                  for x in entries}
+        for e in point:
+            merged[(e["run"], e["workload"], e["metric"])] = e
+        save_trajectory(trajectory_path, list(merged.values()))
+        print(f"perfwatch: appended {len(point)} entries "
+              f"-> {trajectory_path}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("mode", nargs="?", choices=["ingest"],
+                    help="'ingest': rebuild the trajectory from "
+                         "historical artifacts")
+    ap.add_argument("files", nargs="*",
+                    help="artifact files for ingest (default: the "
+                         "repo's BENCH_*.json + REGRESSION_*.json)")
+    ap.add_argument("--check", metavar="POINT",
+                    help="normalize POINT.json and gate it against "
+                         "the trajectory (exit 1 on regression)")
+    ap.add_argument("--trajectory", default=TRAJECTORY)
+    ap.add_argument("--out", default=TRAJECTORY,
+                    help="ingest destination")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="relative band for up/down metrics (entries "
+                         "with their own tol, e.g. correctness "
+                         "booleans at 0, keep it); default %(default)s")
+    ap.add_argument("--append", action="store_true",
+                    help="with --check: append the point to the "
+                         "trajectory when green")
+    args = ap.parse_args()
+    if args.check:
+        return check(args.check, args.trajectory, args.tolerance,
+                     args.append)
+    if args.mode == "ingest":
+        return ingest(args.files, args.out)
+    ap.print_usage()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

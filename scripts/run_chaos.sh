@@ -185,6 +185,8 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${LCOUNTERS}" "${lrc}" "${LCYCLES}" \
     "${NLEAKS}" "${CLEAKS}" "${PILEAKS}" <<'EOF' || mrc=$?
 import glob, json, os, sys
+sys.path.insert(0, os.getcwd())
+from uda_tpu.utils.critpath import buckets_from_counters
 (seed, spec, counters_path, out, rc, pspec, pcounters, prc,
  nspec, ncounters, nrc, ncycles,
  ecounters, erc, ecycles,
@@ -234,7 +236,15 @@ def lockdep_block(schedule, exit_code, telem_path, cycles_path):
     return {"schedule": schedule, "pytest_exit": int(exit_code),
             "cycles": int(telem.get("counters", {})
                           .get("lockdep.cycles", 0)),
-            "cycle_reports": reports, "telemetry": telem}, reports
+            "cycle_reports": reports, "telemetry": telem,
+            "time_accounting": timeacct_block(telem)}, reports
+def timeacct_block(telem):
+    """Where the rung's CPU seconds went, bucketed from the session's
+    accumulated <timer>_time counters (busy seconds — a chaos rung has
+    no single task wall; the per-task span partition rides the
+    StatsReporter final records and flightrec dumps instead). Diffable
+    across rounds like every other telemetry block."""
+    return buckets_from_counters(telem.get("counters", {}))
 def resledger_block(block, leaks_path):
     """Fold the rung's leaked-obligation reports (UDA_TPU_RESLEDGER_
     JSON lines) into its telemetry block; returns the reports so the
@@ -297,11 +307,16 @@ lockdep["flightrec"] = fr["lockdep"]
 no_postmortem = sorted(r for r, b in fr.items()
                        if b["failed_without_dump"])
 with open(out, "w") as f:
+    main_telem = load(counters_path)
+    pressure_telem = load(pcounters)
     json.dump({"chaos_seed": int(seed), "schedule": spec,
-               "pytest_exit": int(rc), "telemetry": load(counters_path),
+               "pytest_exit": int(rc), "telemetry": main_telem,
+               "time_accounting": timeacct_block(main_telem),
                "flightrec": fr["main"],
                "pressure": {"schedule": pspec, "pytest_exit": int(prc),
-                            "telemetry": load(pcounters),
+                            "telemetry": pressure_telem,
+                            "time_accounting":
+                                timeacct_block(pressure_telem),
                             "flightrec": fr["pressure"]},
                "network": network,
                "exchange": exchange,

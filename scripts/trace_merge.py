@@ -41,10 +41,13 @@ sys.path.insert(0, REPO)
 
 
 def load_spans(paths):
-    """-> (spans, per-file counts). Malformed lines fail loudly — a
-    torn span file would silently drop the exact spans a post-mortem
-    needs."""
+    """-> (spans, profiles, per-file counts). Malformed lines fail
+    loudly — a torn span file would silently drop the exact spans a
+    post-mortem needs. ``kind: "profile"`` records (the sampling
+    profiler's per-span summaries, appended by export_spans_jsonl) are
+    split out for their own lane."""
     spans = []
+    profiles = []
     counts = {}
     for path in paths:
         n = 0
@@ -61,13 +64,21 @@ def load_spans(paths):
                         f"record: {e}")
                 rec.setdefault("pid", 0)
                 rec["_src"] = os.path.basename(path)
-                spans.append(rec)
-                n += 1
+                if rec.get("kind") == "profile":
+                    profiles.append(rec)
+                else:
+                    spans.append(rec)
+                    n += 1
         counts[path] = n
-    return spans, counts
+    return spans, profiles, counts
 
 
-def merge(spans, trace_filter=None):
+# the synthetic tid profile-lane events render on (one lane per
+# process, clear of real thread ids)
+PROFILE_TID = 1 << 20
+
+
+def merge(spans, trace_filter=None, profiles=()):
     """-> (chrome trace dict, stats). Timestamps use ``ts_unix`` when
     present (cross-process comparable); a file exported by an older
     process without the anchor degrades to its raw perf_counter
@@ -97,13 +108,35 @@ def merge(spans, trace_filter=None):
         events.append({"name": s["name"], "ph": "X", "pid": s["pid"],
                        "tid": s.get("tid", 0), "ts": ts * 1e6,
                        "dur": s.get("dur", 0.0) * 1e6, "args": args})
+    # profile lane: one X event per sampled span summary, spanning its
+    # observed sample window, on a synthetic per-process profiler tid —
+    # the where-the-cpu-went view lines up NEXT TO the span lanes
+    prof_pids = set()
+    for p in profiles:
+        t0, t1 = p.get("t0_unix", 0.0), p.get("t1_unix", 0.0)
+        if t1 <= t0:
+            continue
+        prof_pids.add(p["pid"])
+        procs.setdefault(p["pid"], p.get("_src", ""))
+        events.append({"name": f"profile:{p.get('span', '?')}",
+                       "ph": "X", "pid": p["pid"], "tid": PROFILE_TID,
+                       "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                       "args": {"self_samples": p.get("self"),
+                                "total_samples": p.get("total"),
+                                "hz": p.get("hz"),
+                                "stacks": p.get("stacks", [])}})
+    for pid in sorted(prof_pids):
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": PROFILE_TID,
+                       "args": {"name": "sampling profiler"}})
     for pid, src in sorted(procs.items()):
         events.append({"name": "process_name", "ph": "M", "pid": pid,
                        "tid": 0, "args": {"name": f"uda_tpu pid {pid} "
                                                   f"({src})"}})
     stats = {"spans": len(spans), "processes": len(procs),
              "traces": len({s.get("trace") for s in spans}),
-             "cross_process_links": cross}
+             "cross_process_links": cross,
+             "profile_lanes": len(prof_pids)}
     return {"traceEvents": events}, stats
 
 
@@ -122,7 +155,7 @@ def main() -> int:
                          "the wire trace-context acceptance gate")
     args = ap.parse_args()
     try:
-        spans, counts = load_spans(args.files)
+        spans, profiles, counts = load_spans(args.files)
     except OSError as e:
         print(f"trace_merge: {e}", file=sys.stderr)
         return 2
@@ -131,7 +164,7 @@ def main() -> int:
               f"(was the exporting process run with UDA_TPU_STATS=1?)",
               file=sys.stderr)
         return 3
-    trace, stats = merge(spans, args.trace)
+    trace, stats = merge(spans, args.trace, profiles=profiles)
     if args.require_cross_process and not stats["cross_process_links"]:
         print("trace_merge: no cross-process parent link found — wire "
               "trace context did not stitch", file=sys.stderr)
@@ -143,7 +176,8 @@ def main() -> int:
     print(f"trace_merge: {stats['spans']} spans from "
           f"{stats['processes']} process(es) ({per_file}) -> "
           f"{args.out}; {stats['traces']} trace id(s), "
-          f"{stats['cross_process_links']} cross-process link(s)")
+          f"{stats['cross_process_links']} cross-process link(s), "
+          f"{stats['profile_lanes']} profile lane(s)")
     return 0
 
 

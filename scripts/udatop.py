@@ -38,7 +38,25 @@ from uda_tpu.utils.errors import UdaError  # noqa: E402
 
 _HEADER = (f"{'supplier':<22} {'gen':>5} {'conns':>5} {'onair':>5} "
            f"{'MB/s':>8} {'read p95':>9} {'penal':>5} {'oblig':>5} "
-           f"{'leaks':>5}")
+           f"{'leaks':>5} {'where':<16}")
+
+
+def where_time_goes(prov: dict) -> str:
+    """The dominant time-accounting bucket from the peer's
+    ``time_accounting`` stats provider (uda_tpu.utils.critpath rides
+    MSG_STATS), e.g. ``merge 62%`` — '-' when the peer records no
+    spans or predates the provider."""
+    ta = prov.get("time_accounting") if isinstance(prov, dict) else None
+    if not isinstance(ta, dict):
+        return "-"
+    buckets = ta.get("buckets")
+    if not isinstance(buckets, dict) or not buckets:
+        return "-"
+    best = max(buckets.items(),
+               key=lambda kv: kv[1].get("critical_s", 0.0))
+    if best[1].get("critical_s", 0.0) <= 0:
+        return "-"
+    return f"{best[0]} {best[1].get('share', 0.0) * 100:.0f}%"
 
 
 def parse_host(spec: str, default_port: int):
@@ -68,7 +86,8 @@ def row(spec: str, snap, prev, dt: float) -> str:
             f"{mb_s:>8.2f} {p95:>8.1f}ms "
             f"{int(c.get('fetch.penalties', 0)):>5} "
             f"{led.get('outstanding', 0):>5} "
-            f"{led.get('leak_reports', 0):>5}")
+            f"{led.get('leak_reports', 0):>5} "
+            f"{where_time_goes(prov):<16}")
 
 
 def poll(targets, timeout: float):

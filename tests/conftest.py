@@ -150,6 +150,12 @@ def _metrics_hygiene():
     from uda_tpu.utils.flightrec import flightrec
     flightrec.reset()
     flightrec._dump_dir = ""
+    # profiler hygiene: a test that armed the global sampling profiler
+    # must not keep its daemon thread (and the thread-span registry
+    # writes it enables) running into later tests' timing assertions
+    from uda_tpu.utils.profiler import profiler
+    profiler.stop()
+    profiler.reset()
     if unbalanced or leaked:
         parts = []
         if unbalanced:

@@ -1,0 +1,506 @@
+"""Time-accounting plane: sampling profiler + critpath + perfwatch.
+
+Covers ISSUE 12's acceptance gates: profiler off = no thread and no
+samples; on = samples attribute to the busy span; measured overhead at
+the default rate; critpath buckets sum to the task wall on a synthetic
+tree AND a real quick merge; the block rides the StatsReporter final
+record, MSG_STATS providers and flightrec/watchdog dumps; perfwatch
+ingests every historical BENCH artifact, passes on an identical point
+and fails on an injected 30% slowdown; histogram summaries export
+bucket boundaries+counts that recompute percentiles offline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from scripts import perfwatch  # noqa: E402
+from uda_tpu.merger import LocalFetchClient, MergeManager  # noqa: E402
+from uda_tpu.mofserver import DataEngine, DirIndexResolver  # noqa: E402
+from uda_tpu.utils import critpath  # noqa: E402
+from uda_tpu.utils.config import Config  # noqa: E402
+from uda_tpu.utils.metrics import (metrics,  # noqa: E402
+                                   percentile_from_summary)
+from uda_tpu.utils.profiler import (DEFAULT_HZ, SamplingProfiler,  # noqa: E402
+                                    profile_hz_from_env, profiler)
+from uda_tpu.utils.stats import StatsReporter, introspection_snapshot  # noqa: E402
+from uda_tpu.utils.watchdog import StallWatchdog  # noqa: E402
+
+from helpers import make_mof_tree, map_ids  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _burn(seconds: float, span: str | None = None) -> None:
+    """A deterministically busy loop, optionally inside a span."""
+    def work():
+        t0 = time.perf_counter()
+        x = np.arange(4096)
+        while time.perf_counter() - t0 < seconds:
+            (x * x).sum()
+    if span is None:
+        work()
+    else:
+        with metrics.span(span):
+            work()
+
+
+# -- profiler ----------------------------------------------------------------
+
+def test_profiler_off_no_thread_no_samples():
+    before = {t.name for t in threading.enumerate()}
+    assert not profiler.armed
+    assert "uda-profiler" not in before
+    assert profiler.span_summary() == {}
+    assert profiler.folded() == ""
+    # the off-path per-call cost: spans do NOT touch the thread
+    # registry while no profiler asked for it
+    metrics.enable_spans()
+    from uda_tpu.utils.metrics import _THREAD_SPANS
+    with metrics.span("net.serve"):
+        assert _THREAD_SPANS == {}
+    assert metrics.get("profile.samples") == 0
+    assert metrics.get("profile.ticks") == 0
+
+
+def test_profile_hz_env_parsing(monkeypatch):
+    monkeypatch.delenv("UDA_TPU_PROFILE", raising=False)
+    assert profile_hz_from_env() == 0.0
+    monkeypatch.setenv("UDA_TPU_PROFILE", "0")
+    assert profile_hz_from_env() == 0.0
+    monkeypatch.setenv("UDA_TPU_PROFILE", "1")
+    assert profile_hz_from_env() == DEFAULT_HZ
+    monkeypatch.setenv("UDA_TPU_PROFILE", "250")
+    assert profile_hz_from_env() == 250.0
+    monkeypatch.setenv("UDA_TPU_PROFILE", "wat")
+    assert profile_hz_from_env() == DEFAULT_HZ  # asked -> armed, loudly
+
+
+def test_profiler_attributes_busy_span():
+    """A deliberately busy net.serve span must dominate its thread's
+    samples — the span-attribution acceptance gate."""
+    metrics.enable_spans()
+    profiler.start(200)
+    try:
+        t = threading.Thread(target=_burn, args=(0.5, "net.serve"))
+        t.start()
+        t.join()
+    finally:
+        profiler.stop()
+    summary = profiler.span_summary()
+    assert "net.serve" in summary, summary
+    serve = summary["net.serve"]
+    assert serve["self"] > 0 and serve["total"] >= serve["self"]
+    # the busy span owns more samples than any other ATTRIBUTED span
+    others = [v["self"] for k, v in summary.items()
+              if k not in ("net.serve", "(unattributed)")]
+    assert serve["self"] >= max(others, default=0)
+    # flamegraph text carries span-prefixed folded stacks
+    assert any(line.startswith("net.serve;")
+               for line in profiler.folded().splitlines())
+    # the counters flowed into the metrics hub (the snapshot surface)
+    assert metrics.get("profile.samples") > 0
+    assert metrics.get("profile.samples", span="net.serve") > 0
+    assert metrics.get("profile.ticks") > 0
+    # last-N-seconds slice sees the same attribution
+    recent = profiler.recent_summary(30.0)
+    assert recent["spans"].get("net.serve", 0) > 0
+    profiler.reset()
+
+
+def test_profiler_start_stop_idempotent_and_registry_cleanup():
+    profiler.start(100)
+    profiler.start(300)  # second arm keeps the first sampler
+    assert profiler.armed and profiler.hz == 100
+    profiler.stop()
+    profiler.stop()
+    assert not profiler.armed
+    from uda_tpu.utils.metrics import _THREAD_SPANS
+    assert _THREAD_SPANS == {}  # registry disabled + cleared
+    assert metrics.get_gauge("profile.hz") == 0.0
+
+
+def test_profiler_overhead_at_default_hz():
+    """The <=3% overhead gate, MEASURED: interleaved min-of-reps of a
+    fixed CPU workload with the profiler off vs armed at the default
+    rate. Skips (not fails) when the host is too noisy to resolve 3%
+    — the gate is about the profiler's cost, not the host's mood."""
+    reps = 5
+    dur = 0.25
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        _burn(dur)
+        return time.perf_counter() - t0
+
+    off, on = [], []
+    _burn(0.05)  # warm the allocator/caches
+    for _ in range(reps):
+        off.append(timed())
+        profiler.start(DEFAULT_HZ)
+        try:
+            on.append(timed())
+        finally:
+            profiler.stop()
+    base = min(off)
+    spread = (max(off) - base) / base
+    if spread > 0.08:
+        pytest.skip(f"host too noisy to resolve a 3% gate "
+                    f"(baseline spread {spread:.1%})")
+    overhead = min(on) / base - 1.0
+    assert overhead <= 0.03, f"profiler overhead {overhead:.2%} > 3%"
+    profiler.reset()
+
+
+# -- critpath ----------------------------------------------------------------
+
+def _span(name, ts, dur, sid, parent=None, trace=1):
+    return {"name": name, "ts": ts, "dur": dur, "tid": 0,
+            "trace": trace, "id": sid, "parent": parent}
+
+
+def test_critpath_synthetic_tree_buckets_sum_to_wall():
+    spans = [
+        _span("reduce_task", 0.0, 10.0, 1),
+        _span("fetch", 0.0, 6.0, 2, parent=1),
+        _span("overlap_pack", 2.0, 2.0, 3, parent=2),
+        _span("merge", 5.0, 5.0, 4, parent=1),
+        _span("merge.wait", 0.0, 5.0, 5, parent=4),
+    ]
+    block = critpath.analyze(spans)
+    assert block["root"] == "reduce_task"
+    assert block["wall_s"] == pytest.approx(10.0)
+    b = block["buckets"]
+    # priority partition: merge owns [5,10]; decompress_pack beats
+    # fetch on [2,4]; fetch keeps [0,2]+[4,5]; wait is fully shadowed
+    assert b["merge"]["critical_s"] == pytest.approx(5.0)
+    assert b["decompress_pack"]["critical_s"] == pytest.approx(2.0)
+    assert b["fetch"]["critical_s"] == pytest.approx(3.0)
+    assert b["wait"]["critical_s"] == pytest.approx(0.0)
+    assert b["wait"]["busy_s"] == pytest.approx(5.0)
+    total = sum(rec["critical_s"] for rec in b.values()) + block["idle_s"]
+    assert total == pytest.approx(block["wall_s"], rel=0.05)
+    # busy can exceed the wall (that IS the overlap)
+    assert sum(rec["busy_s"] for rec in b.values()) > block["wall_s"]
+    # longest dependency chain: root -> fetch (6s) -> overlap_pack (2s)
+    names = [s["name"] for s in block["critical_path"]]
+    assert names == ["reduce_task", "fetch", "overlap_pack"]
+    # trio reconciliation (critical seconds)
+    assert block["trio"]["total_fetch_time"] == pytest.approx(3.0)
+    assert block["trio"]["total_merge_time"] == pytest.approx(7.0)
+
+
+def test_critpath_idle_and_rootless():
+    # gap between spans = idle
+    spans = [_span("reduce_task", 0.0, 4.0, 1),
+             _span("fetch", 0.0, 1.0, 2, parent=1),
+             _span("merge", 3.0, 1.0, 3, parent=1)]
+    block = critpath.analyze(spans)
+    assert block["idle_s"] == pytest.approx(2.0)
+    # no reduce_task root (a supplier-side process): whole-window scope
+    spans = [_span("net.serve", 1.0, 2.0, 7)]
+    block = critpath.analyze(spans)
+    assert block["root"] is None
+    assert block["wall_s"] == pytest.approx(2.0)
+    assert block["buckets"]["serve"]["critical_s"] == pytest.approx(2.0)
+    assert critpath.analyze([]) is None
+
+
+def test_critpath_span_buckets_cover_known_names():
+    """Registry lockstep: every SPAN_REGISTRY name and every timer
+    name critpath buckets must stay known to the table (a renamed
+    timer silently falling into 'other' would corrupt the
+    accounting)."""
+    from uda_tpu.utils.metrics import SPAN_REGISTRY
+    for name in SPAN_REGISTRY:
+        if name in ("reduce_task", "net.stats"):
+            continue  # the root frames; stats polls are other
+        assert name in critpath.SPAN_BUCKETS, name
+    for bucket in critpath.SPAN_BUCKETS.values():
+        assert bucket in critpath.BUCKET_PRIORITY
+
+
+def _run_quick_merge(tmp_path, cfg_extra=None):
+    root = str(tmp_path / "mof")
+    job = "timeacct"
+    expected = make_mof_tree(root, job, num_maps=4, num_reducers=1,
+                             records_per_map=400, seed=3)
+    cfg = Config(dict({"mapred.rdma.buf.size": 8}, **(cfg_extra or {})))
+    engine = DataEngine(DirIndexResolver(root), cfg)
+    blocks = []
+    try:
+        mm = MergeManager(LocalFetchClient(engine), "uda.tpu.RawBytes",
+                          cfg)
+        mm.run(job, map_ids(job, 4), 0,
+               lambda b: blocks.append(bytes(b)))
+    finally:
+        engine.stop()
+    assert len(expected[0]) == 1600
+    return b"".join(blocks)
+
+
+def test_critpath_real_quick_merge_and_final_record(tmp_path):
+    """On a real (quick) merge with spans on: buckets sum to the task
+    wall within 5%, and the block lands in the StatsReporter final
+    record plus the MSG_STATS introspection snapshot."""
+    metrics.enable_stats()
+    out = _run_quick_merge(tmp_path)
+    assert out
+    block = critpath.time_accounting_block()
+    assert block is not None and block["root"] == "reduce_task"
+    total = (sum(rec["critical_s"] for rec in block["buckets"].values())
+             + block["idle_s"])
+    assert total == pytest.approx(block["wall_s"], rel=0.05)
+    assert metrics.get("critpath.analyses") > 0
+    # the StatsReporter final record carries it
+    rep = StatsReporter(metrics, interval_s=60, out=open(os.devnull, "w"))
+    rec = rep.report_once(final=True)
+    assert rec["counters"]["total_fetch_time"] >= 0
+    assert rec["time_accounting"]["root"] == "reduce_task"
+    # MSG_STATS scrape surface: MergeManager installed the provider
+    snap = introspection_snapshot()
+    ta = snap["providers"]["time_accounting"]
+    assert ta.get("root") == "reduce_task" or ta.get("available") is False
+    rep.stop(final=False)
+
+
+def test_buckets_from_counters_fallback():
+    block = critpath.buckets_from_counters(
+        {"fetch_time": 2.0, "merge_time": 3.0, "wait_mem_time": 0.5,
+         "overlap_pack_time": 1.0, "emit_time": 0.25})
+    assert block["kind"] == "busy_seconds_from_counters"
+    assert block["buckets"]["fetch"] == pytest.approx(2.0)
+    assert block["buckets"]["serve"] == pytest.approx(0.25)
+    assert block["trio"]["total_merge_time"] == pytest.approx(4.0)
+
+
+# -- exports: span file lanes + standalone critpath --------------------------
+
+def test_span_export_profile_records_and_tools(tmp_path):
+    metrics.enable_stats()
+    profiler.start(200)
+    try:
+        _burn(0.3, "net.serve")
+    finally:
+        profiler.stop()
+    path = str(tmp_path / "spans.jsonl")
+    n = metrics.export_spans_jsonl(path)
+    assert n >= 1
+    recs = [json.loads(ln) for ln in open(path)]
+    profs = [r for r in recs if r.get("kind") == "profile"]
+    assert any(r["span"] == "net.serve" and r["self"] > 0
+               for r in profs)
+    # trace_merge renders a profile lane next to the span lanes
+    out = str(tmp_path / "trace.json")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/trace_merge.py"),
+         path, "--out", out], capture_output=True, text=True,
+        timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "1 profile lane(s)" in res.stdout
+    trace = json.load(open(out))
+    assert any(e["name"].startswith("profile:net.serve")
+               for e in trace["traceEvents"])
+    # standalone critpath over the same file
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/critpath.py"),
+         path, "--json"], capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    block = json.loads(res.stdout)
+    assert block["buckets"]["serve"]["busy_s"] > 0
+    profiler.reset()
+
+
+# -- histogram bucket export (satellite) -------------------------------------
+
+def test_histogram_summary_buckets_recompute_percentiles():
+    metrics.enable_stats()
+    rng = np.random.default_rng(5)
+    for v in rng.gamma(2.0, 40.0, size=500):
+        metrics.observe("fetch.latency_ms", float(v))
+    s = metrics.histogram_summaries()["fetch.latency_ms"]
+    assert s["buckets"] and all(len(b) == 2 for b in s["buckets"])
+    assert sum(c for _, c in s["buckets"]) == s["count"] == 500
+    # offline recompute == the live estimator, at ARBITRARY p
+    for p in (10, 25, 50, 75, 90, 95, 99, 99.9):
+        live = metrics.percentile("fetch.latency_ms", p)
+        off = percentile_from_summary(s, p)
+        assert off == pytest.approx(live, rel=1e-9), p
+    # json-safe (no inf edges) and pre-bucket summaries degrade to 0
+    json.dumps(s)
+    assert percentile_from_summary({"count": 3}, 50) == 0.0
+
+
+# -- perfwatch ---------------------------------------------------------------
+
+def test_perfwatch_ingests_all_historical_artifacts(tmp_path):
+    out = str(tmp_path / "traj.json")
+    assert perfwatch.ingest([], out) == 0
+    doc = json.load(open(out))
+    entries = doc["entries"]
+    assert len(entries) > 100
+    workloads = {e["workload"] for e in entries}
+    assert {"pipeline", "net", "terasort_singlechip",
+            "regression_small"} <= workloads
+    # every entry normalized: required keys + sane directions
+    for e in entries:
+        assert e["direction"] in ("up", "down", "info")
+        assert isinstance(e["value"], (int, float))
+    # the committed trajectory is in lockstep with the extractors
+    committed = json.load(open(os.path.join(REPO,
+                                            "PERF_TRAJECTORY.json")))
+    committed_keys = {(e["run"], e["workload"], e["metric"])
+                      for e in committed["entries"]}
+    fresh_keys = {(e["run"], e["workload"], e["metric"])
+                  for e in entries}
+    assert fresh_keys <= committed_keys, (
+        "historical entries missing from the committed "
+        "PERF_TRAJECTORY.json — re-run scripts/perfwatch.py ingest")
+
+
+def test_perfwatch_check_green_on_identical_red_on_slowdown(tmp_path):
+    traj = str(tmp_path / "traj.json")
+    perfwatch.ingest([os.path.join(REPO, "BENCH_PIPELINE_r09.json")],
+                     traj)
+    point = os.path.join(REPO, "BENCH_PIPELINE_r09.json")
+    assert perfwatch.check(point, traj, 0.25, append=False) == 0
+    # inject a 30% slowdown -> demonstrably red at the default band
+    data = json.load(open(point))
+    for key in list(data):
+        if key.endswith("_MBps"):
+            data[key] = round(data[key] * 0.7, 1)
+    slow = str(tmp_path / "slow.json")
+    json.dump(data, open(slow, "w"))
+    assert perfwatch.check(slow, traj, 0.25, append=False) == 1
+    # correctness booleans gate at tol 0 regardless of the band
+    data = json.load(open(point))
+    data["identity"]["all_identical"] = False
+    broken = str(tmp_path / "broken.json")
+    json.dump(data, open(broken, "w"))
+    assert perfwatch.check(broken, traj, 5.0, append=False) == 1
+    # improvements and unknown metrics never fail
+    data = json.load(open(point))
+    data["sorted_pipelined_MBps"] *= 2
+    fast = str(tmp_path / "fast.json")
+    json.dump(data, open(fast, "w"))
+    assert perfwatch.check(fast, traj, 0.25, append=False) == 0
+
+
+def test_perfwatch_check_append_and_new_baseline(tmp_path):
+    traj = str(tmp_path / "traj.json")
+    perfwatch.ingest([os.path.join(REPO, "BENCH_NET_r07.json")], traj)
+    # a point with no matching workload: everything 'new', still green,
+    # --append makes it the next baseline
+    point = str(tmp_path / "point.json")
+    json.dump({"bench": "net_loopback", "quick": True,
+               "single_stream": {"evloop": {"mb_per_s": 100.0}}},
+              open(point, "w"))
+    assert perfwatch.check(point, traj, 0.25, append=True) == 0
+    doc = json.load(open(traj))
+    assert any(e["workload"] == "net_quick" for e in doc["entries"])
+    # now a regressed second quick point fails against it
+    slow = str(tmp_path / "slow.json")
+    json.dump({"bench": "net_loopback", "quick": True,
+               "single_stream": {"evloop": {"mb_per_s": 60.0}}},
+              open(slow, "w"))
+    assert perfwatch.check(slow, traj, 0.25, append=False) == 1
+
+
+def test_perfwatch_offline_hist_percentiles_from_telemetry():
+    """perfwatch consumes the exported bucket boundaries+counts: p90
+    (not in the inline trio) recomputed from a telemetry block alone
+    matches the live estimator."""
+    from uda_tpu.utils.stats import telemetry_block
+    metrics.enable_stats()
+    for v in (1.0, 2.0, 4.0, 8.0, 100.0, 250.0):
+        metrics.observe("fetch.latency_ms", v)
+    data = {"metric": "terasort_singlechip_shuffle_merge_gbps",
+            "value": 1.0, "telemetry": telemetry_block()}
+    entries = perfwatch.extract("BENCH_X", data)
+    p90 = [e for e in entries
+           if e["metric"] == "hist_fetch.latency_ms_p90"]
+    assert p90 and p90[0]["direction"] == "info"
+    assert p90[0]["value"] == pytest.approx(
+        metrics.percentile("fetch.latency_ms", 90), rel=1e-9)
+
+
+def test_perfwatch_cli_roundtrip(tmp_path):
+    """The ci.sh surface: ingest + --check over the CLI."""
+    traj = str(tmp_path / "traj.json")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/perfwatch.py"),
+         "ingest", os.path.join(REPO, "BENCH_PIPELINE_r09.json"),
+         "--out", traj], capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/perfwatch.py"),
+         "--check", os.path.join(REPO, "BENCH_PIPELINE_r09.json"),
+         "--trajectory", traj], capture_output=True, text=True,
+        timeout=120)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "0 regression(s)" in res.stdout
+
+
+# -- forensics wiring (satellite) --------------------------------------------
+
+@pytest.mark.faults
+def test_stall_dump_carries_profile_and_time_accounting(tmp_path):
+    """The forensics rung: a watchdog stall dump AND the flightrec
+    post-mortem carry the span-attributed profile slice when the
+    profiler is armed — and neither ever arms it themselves."""
+    from uda_tpu.utils.flightrec import flightrec
+    metrics.enable_stats()
+    profiler.start(200)
+    stop = threading.Event()
+
+    def busy():
+        with metrics.span("net.serve"):
+            x = np.arange(2048)
+            while not stop.is_set():
+                (x * x).sum()
+
+    t = threading.Thread(target=busy)
+    t.start()
+    wd = StallWatchdog(0.3, lambda: 42, name="wd-timeacct").start()
+    try:
+        deadline = time.monotonic() + 10
+        while not wd.fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert wd.fired
+        assert "sampling profile" in wd.last_dump
+        assert "net.serve" in wd.last_dump
+        # the stall also dumped the black box, with the profile block
+        rep = flightrec.reports[-1]
+        assert rep["cause"] == "stall"
+        assert rep["profile"]["samples"] > 0
+        assert "net.serve" in rep["profile"]["spans"]
+    finally:
+        stop.set()
+        t.join()
+        wd.stop()
+        profiler.stop()
+        profiler.reset()
+
+
+def test_dump_without_profiler_omits_block_not_raises():
+    """Disarmed profiler -> the dump simply has no profile section
+    (omission, never an error inside an unwind)."""
+    from uda_tpu.utils.flightrec import flightrec
+    from uda_tpu.utils.watchdog import dump_diagnostics
+    assert not profiler.armed
+    text = dump_diagnostics("unit")
+    assert "sampling profile" not in text
+    flightrec.record("unit", x=1)
+    flightrec.dump("unit-test")
+    assert "profile" not in flightrec.reports[-1]

@@ -207,6 +207,18 @@ class MergeManager:
                      and flightrec_enabled_from_env()),
             capacity=int(self.cfg.get("uda.tpu.flightrec.events")),
             dump_dir=str(self.cfg.get("uda.tpu.flightrec.dir")))
+        # the time-accounting plane (utils/profiler + utils/critpath):
+        # arm the sampling profiler when asked (config wins, env
+        # otherwise; arming is sticky — a later manager with the 0
+        # default never disarms a profiler the operator turned on) and
+        # expose the where-time-goes block over MSG_STATS
+        from uda_tpu.utils.critpath import install_stats_provider
+        from uda_tpu.utils.profiler import profile_hz_from_env, profiler
+        install_stats_provider()
+        prof_hz = (float(self.cfg.get("uda.tpu.profile.hz"))
+                   or profile_hz_from_env())
+        if prof_hz > 0:
+            profiler.start(prof_hz)
         self._stop = threading.Event()
         # admission control + liveness (uda_tpu.utils.budget/.watchdog):
         # the budget is built lazily (platform detection must not run

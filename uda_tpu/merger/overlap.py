@@ -458,17 +458,26 @@ class OverlappedMerger:
         here, which also makes _forest_lock uncontended in pipeline
         mode."""
         with metrics.use_span(self._parent_span):
+            # merge.wait spans: the consumer's blocked-on-staging time
+            # as a first-class trace lane (the span twin of the
+            # merge.wait_ms histogram, critpath's "wait" bucket). One
+            # span covers each contiguous wait; a no-op while spans
+            # are disabled
+            wait = metrics.start_span("merge.wait")
             while True:
                 try:
                     staged = self._staged_q.get(timeout=0.25)
                 except queue.Empty:
                     if self._aborted:
+                        wait.end(aborted=True)
                         return
                     continue
+                wait.end()
                 if staged is None:
                     return
                 if self._error is not None or self._aborted:
                     self._discard(staged)
+                    wait = metrics.start_span("merge.wait")
                     continue
                 try:
                     self._observe_wait(staged.fed_t)
@@ -480,6 +489,7 @@ class OverlappedMerger:
                 finally:
                     self._release_charge(staged.charge)
                     staged.charge = 0
+                wait = metrics.start_span("merge.wait")
 
     def _discard(self, staged: _StagedRun) -> None:
         """Drop a staged run without merging (abort/error drain):
@@ -624,16 +634,18 @@ class OverlappedMerger:
         rows = staged.rows
         with metrics.timer("overlap_stage"):
             if self.engine == "pallas":
-                dev = jax.device_put(rows)
-                if staged.lease is not None:
-                    # accounting point: the host buffer may only be
-                    # reused once the transfer is done. Merges of the
-                    # PREVIOUS run keep executing under this wait.
-                    t0 = time.perf_counter()
-                    jax.block_until_ready(dev)
-                    metrics.observe("merge.pipeline.put_ms",
-                                    (time.perf_counter() - t0) * 1e3)
-                    self._recycle(staged)
+                with metrics.span("merge.device_put", rows=staged.valid):
+                    dev = jax.device_put(rows)
+                    if staged.lease is not None:
+                        # accounting point: the host buffer may only be
+                        # reused once the transfer is done. Merges of
+                        # the PREVIOUS run keep executing under this
+                        # wait.
+                        t0 = time.perf_counter()
+                        jax.block_until_ready(dev)
+                        metrics.observe("merge.pipeline.put_ms",
+                                        (time.perf_counter() - t0) * 1e3)
+                        self._recycle(staged)
                 rows = dev
             # host engine: the run KEEPS its pool lease (recycled when
             # it merges away); ownership moves to the _Run so an

@@ -426,8 +426,10 @@ class EvLoopFetchClient(InputClient):
         # fetch racing the banner would always go un-extended and the
         # FIRST chunk of a trace would predictably lose its supplier
         # spans. Best-effort: timing out just means un-extended frames
-        # (always legal), never an error; reconnects skip it (the
-        # event stays set — caps survive a same-server redial).
+        # (always legal), never an error. Reconnects wait too —
+        # _on_conn_dead cleared the event and the caps, because the
+        # peer behind host:port may have been REPLACED since the last
+        # banner (stale CAP_TRACE against an old decoder tears frames).
         self._hello_seen.wait(timeout=min(2.0, self.connect_timeout_s))
         return conn
 
@@ -455,6 +457,16 @@ class EvLoopFetchClient(InputClient):
             self._conn = None
             orphans = list(self._pending.items())
             self._pending.clear()
+            # capability state dies with the connection: the NEXT dial
+            # may reach a replaced peer (e.g. a pre-CAP_TRACE binary),
+            # and a stale trace bit would make every post-reconnect REQ
+            # carry the 16-byte tail its strict decoder tears on.
+            # Clearing _hello_seen restores the bounded first-banner
+            # wait, same as a fresh dial. Generation/resume state is
+            # deliberately KEPT — resume legality is judged against the
+            # new banner's generation when it lands (_on_hello).
+            self._peer_caps = 0
+            self._hello_seen.clear()
         metrics.gauge_add("net.client.connections", -1)
         metrics.add("net.disconnects", role="client")
         err = TransportError(
@@ -669,7 +681,11 @@ def fetch_remote_stats(host: str, port: Optional[int] = None,
     try:
         sock.settimeout(timeout)
         wire.tune_socket(sock)
-        sock.sendall(wire.encode_stats_request(1))
+        try:
+            sock.sendall(wire.encode_stats_request(1))
+        except OSError as e:  # peer died between accept and our send
+            raise TransportError(
+                f"stats poll: send to {host}:{port} failed: {e}") from e
         while True:
             try:
                 frame = wire.recv_frame(sock)
@@ -677,6 +693,13 @@ def fetch_remote_stats(host: str, port: Optional[int] = None,
                 raise TransportError(
                     f"stats poll: {host}:{port} did not answer within "
                     f"{timeout:g} s") from e
+            except OSError as e:
+                # a mid-poll RST/EPIPE must keep the typed contract
+                # (udatop's loop catches UdaError only): a raw OSError
+                # escaping here crashes the console over one sick peer
+                raise TransportError(
+                    f"stats poll: {host}:{port} connection lost: "
+                    f"{e}") from e
             if frame is None:
                 # the peer spoke the wire fine and hung up on the
                 # MSG_STATS frame itself: that is an old decoder

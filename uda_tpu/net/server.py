@@ -1039,9 +1039,14 @@ class EvLoopShuffleServer:
         self._loop.call_soon(self._loop.register, ls, _READ,
                              self._on_accept)
         # the MSG_STATS scrape surface: this server's conn table +
-        # generation, folded into every introspection snapshot
+        # generation, folded into every introspection snapshot — plus
+        # the time-accounting block (serve-bucket-dominant on a pure
+        # supplier), so udatop's where-time-goes column answers for
+        # both roles
+        from uda_tpu.utils.critpath import install_stats_provider
         from uda_tpu.utils.stats import register_stats_provider
         register_stats_provider("net.server", self._stats_snapshot)
+        install_stats_provider()
         log.info(f"shuffle server listening on {self.address[0]}:"
                  f"{self.address[1]} (credit/conn={self.credit}, "
                  f"core=evloop, zerocopy={self.zero_copy}, "

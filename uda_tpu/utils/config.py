@@ -311,6 +311,16 @@ _FLAG_LIST = [
     Flag("uda.tpu.flightrec.events", 4096, int,
          "flight-recorder ring capacity in events (the black box's "
          "whole memory bound; oldest events roll off)"),
+    Flag("uda.tpu.profile.hz", 0, int,
+         "span-attributed sampling profiler rate in Hz "
+         "(utils/profiler.py): a daemon thread walks every thread's "
+         "stack at this rate and attributes samples to the thread's "
+         "active span; summaries land in Metrics.snapshot counters "
+         "(profile.samples), stats records, MSG_STATS, span exports "
+         "and stall/flightrec dumps. 0 = off (no sampling thread, one "
+         "enabled-check elsewhere); UDA_TPU_PROFILE=<hz> is the env "
+         "equivalent (bare '1' = the 97 Hz default). Span attribution "
+         "needs the span layer on (UDA_TPU_STATS=1)"),
     Flag("uda.tpu.flightrec.dir", "", str,
          "directory for flight-recorder dump files "
          "(flightrec_<pid>_<seq>_<cause>.json); empty = "

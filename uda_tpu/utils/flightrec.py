@@ -169,6 +169,22 @@ class FlightRecorder:
             pass  # metrics during interpreter teardown: deliberately
             # silent (logging may be half-dead too); the events
             # themselves still dump, which is the whole point
+        try:  # the last-30s span-attributed profile slice — ARMED
+            # profiler only (a post-mortem never arms sampling), and
+            # any profiler error degrades to omission: this runs
+            # inside failure unwinds where the dump must stay total
+            from uda_tpu.utils.profiler import profiler
+            if profiler.armed:
+                report["profile"] = profiler.recent_summary(30.0)
+        except Exception:  # udalint: disable=UDA006 - omission, never
+            pass  # a second failure inside the unwind
+        try:  # where the wall went (span-derived; spans on only)
+            from uda_tpu.utils.critpath import time_accounting_block
+            ta = time_accounting_block()
+            if ta is not None:
+                report["time_accounting"] = ta
+        except Exception:  # udalint: disable=UDA006 - omission, never
+            pass  # a second failure inside the unwind
         with self._mu:
             self._seq += 1
             seq = self._seq

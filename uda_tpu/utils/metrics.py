@@ -53,7 +53,9 @@ from uda_tpu.utils.resledger import resledger as _resledger
 
 __all__ = ["Metrics", "Span", "metrics", "device_trace",
            "METRICS_REGISTRY", "REGISTRY_PREFIXES", "NAME_RE",
-           "SPAN_REGISTRY", "PARITY_ALIASES", "stats_enabled_from_env"]
+           "SPAN_REGISTRY", "PARITY_ALIASES", "stats_enabled_from_env",
+           "percentile_from_summary", "active_span_of_thread",
+           "enable_thread_span_registry"]
 
 # Dotted namespace every metrics.add/gauge/observe name must match
 # (scripts/check_metrics_names.py enforces this over uda_tpu/).
@@ -261,6 +263,16 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                    "written (FallbackSignal, stall, "
                                    "resledger leak — "
                                    "utils/flightrec.py)"),
+    # -- counters: time-accounting plane (profiler + critpath) -----------
+    "profile.samples": ("counter", "sampling-profiler stack samples, "
+                                   "attributed to the sampled thread's "
+                                   "active span (utils/profiler.py) "
+                                   "[labels: span]"),
+    "profile.ticks": ("counter", "sampling-profiler wakeups (one walk "
+                                 "of sys._current_frames per tick)"),
+    "critpath.analyses": ("counter", "critical-path/time-accounting "
+                                     "analyses computed over the span "
+                                     "tree (utils/critpath.py)"),
     # -- gauges ----------------------------------------------------------
     "fetch.on_air": ("gauge", "fetch attempts currently in flight "
                               "(reference AIO on-air counter)"),
@@ -288,6 +300,11 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                       "staging-pipeline admission "
                                       "level; bounded by "
                                       "uda.tpu.stage.inflight.mb)"),
+    "profile.hz": ("gauge", "sampling-profiler rate currently armed "
+                            "(0 = off; set absolutely at start/stop, "
+                            "deliberately NOT a paired gauge — the "
+                            "profiler is process-scoped, not an "
+                            "obligation)"),
     # -- histograms (recorded only while stats are enabled) --------------
     "fetch.latency_ms": ("histogram", "per-chunk fetch latency "
                                       "[labels: supplier]"),
@@ -346,6 +363,14 @@ SPAN_REGISTRY: Dict[str, str] = {
     "engine.pread": "one DataEngine chunk read/plan, child of the "
                     "serve (or local fetch) span "
                     "(mofserver/data_engine.py)",
+    "merge.wait": "the overlap merge consumer blocked waiting for the "
+                  "next staged run (merger/overlap.py); the span twin "
+                  "of the merge.wait_ms histogram — critpath's 'wait' "
+                  "bucket",
+    "merge.device_put": "host->device transfer of one staged run plus "
+                        "the buffer-recycle completion wait "
+                        "(merger/overlap.py); critpath's 'device_put' "
+                        "bucket",
 }
 
 # snapshot() aliases for the reference's per-reduce-task aggregate trio
@@ -404,16 +429,81 @@ class _Hist:
     def summary(self) -> Dict[str, float]:
         if self.count == 0:
             return {"count": 0, "sum": 0.0}
+        # "buckets": the non-empty bucket boundaries+counts as
+        # [upper_edge, count] pairs (upper_edge None = the overflow
+        # bucket past 2^30), so exported summaries carry enough to
+        # recompute ARBITRARY percentiles offline
+        # (percentile_from_summary — perfwatch/critpath consume it);
+        # p50/p95/p99 stay inline for existing consumers
+        buckets = [[(_BUCKET_EDGES[i] if i < len(_BUCKET_EDGES)
+                     else None), c]
+                   for i, c in enumerate(self.counts) if c]
         return {"count": self.count, "sum": self.total,
                 "min": self.vmin, "max": self.vmax,
                 "p50": self.percentile(50), "p95": self.percentile(95),
-                "p99": self.percentile(99)}
+                "p99": self.percentile(99), "buckets": buckets}
+
+
+def percentile_from_summary(summary: Dict, p: float) -> float:
+    """Recompute an arbitrary percentile OFFLINE from an exported
+    histogram summary's ``buckets`` boundaries+counts — the exact
+    estimator :meth:`_Hist.percentile` runs live, so perfwatch and
+    critpath read the same numbers from a BENCH_*.json telemetry block
+    that a live poll would have returned. Returns 0.0 for an empty or
+    bucket-less summary (a pre-bucket export degrades to its inline
+    p50/p95/p99 only)."""
+    count = summary.get("count", 0)
+    buckets = summary.get("buckets")
+    if not count or not buckets:
+        return 0.0
+    vmin = summary.get("min", 0.0)
+    vmax = summary.get("max", 0.0)
+    target = count * p / 100.0
+    seen = 0
+    for le, c in buckets:
+        if seen + c >= target:
+            if le is None:  # the overflow bucket past the last edge
+                lo, hi = _BUCKET_EDGES[-1], vmax
+            else:
+                i = bisect.bisect_left(_BUCKET_EDGES, le)
+                lo = _BUCKET_EDGES[i - 1] if i > 0 else 0.0
+                hi = le
+            frac = (target - seen) / c
+            return min(max(lo + (hi - lo) * frac, vmin), vmax)
+        seen += c
+    return vmax
 
 
 def _series_key(name: str, labels: dict) -> str:
     """Stable series key: ``name{k=v,...}`` with sorted label keys."""
     inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
     return f"{name}{{{inner}}}"
+
+
+# -- thread -> active span registry (the sampling profiler's view) -----------
+# The contextvar above is readable only from its own thread; the
+# sampling profiler (utils/profiler.py) attributes another thread's
+# stack samples, so span()/use_span() ALSO mirror the current span into
+# this plain dict — but only while a profiler has asked for it
+# (enable_thread_span_registry), keeping the unprofiled span path at
+# one module-global check. Dict get/set/del are GIL-atomic; the sampler
+# reads racily by design (a sample landing one span early/late is
+# sampling noise, not corruption).
+_THREAD_SPANS: Dict[int, "Span"] = {}
+_THREAD_REG_ON = False
+
+
+def enable_thread_span_registry(on: bool) -> None:
+    global _THREAD_REG_ON
+    _THREAD_REG_ON = bool(on)
+    if not on:
+        _THREAD_SPANS.clear()
+
+
+def active_span_of_thread(tid: int) -> Optional["Span"]:
+    """The span currently adopted by thread ``tid`` (None when the
+    thread runs outside any span, or the registry is off)."""
+    return _THREAD_SPANS.get(tid)
 
 
 _current_span: contextvars.ContextVar[Optional["Span"]] = \
@@ -428,11 +518,11 @@ class Span:
     work queued on)."""
 
     __slots__ = ("_metrics", "name", "trace_id", "span_id", "parent_id",
-                 "t0", "attrs", "tid", "_ended")
+                 "t0", "attrs", "tid", "_ended", "chain")
 
     def __init__(self, metrics_obj: "Metrics", name: str,
                  trace_id: int, span_id: int, parent_id: Optional[int],
-                 attrs: dict):
+                 attrs: dict, chain: tuple = ()):
         self._metrics = metrics_obj
         self.name = name
         self.trace_id = trace_id
@@ -442,6 +532,10 @@ class Span:
         self.attrs = attrs
         self.tid = threading.get_ident()
         self._ended = False
+        # root->self name chain: lets the profiler charge a sample to
+        # every enclosing span ("total" attribution) without needing
+        # live parent object references
+        self.chain = chain or (name,)
 
     def end(self, **attrs) -> None:
         if self._ended:
@@ -461,6 +555,7 @@ class _NoopSpan:
     name = ""
     trace_id = span_id = parent_id = None
     attrs: dict = {}
+    chain: tuple = ()
 
     def end(self, **attrs) -> None:
         pass
@@ -662,7 +757,10 @@ class Metrics:
         if parent is None:
             parent = _current_span.get()
         trace_id, span_id, parent_id = self._new_ids(parent)
-        return Span(self, name, trace_id, span_id, parent_id, attrs)
+        chain = (parent.chain + (name,)
+                 if isinstance(parent, Span) else (name,))
+        return Span(self, name, trace_id, span_id, parent_id, attrs,
+                    chain=chain)
 
     @contextlib.contextmanager
     def span(self, name: str, parent: Optional[Span] = None,
@@ -674,9 +772,19 @@ class Metrics:
             yield s
             return
         token = _current_span.set(s)
+        tid = prev = None
+        if _THREAD_REG_ON:
+            tid = threading.get_ident()
+            prev = _THREAD_SPANS.get(tid)
+            _THREAD_SPANS[tid] = s
         try:
             yield s
         finally:
+            if tid is not None:
+                if prev is not None:
+                    _THREAD_SPANS[tid] = prev
+                else:
+                    _THREAD_SPANS.pop(tid, None)
             _current_span.reset(token)
             s.end()
 
@@ -690,9 +798,19 @@ class Metrics:
             yield
             return
         token = _current_span.set(span)
+        tid = prev = None
+        if _THREAD_REG_ON:
+            tid = threading.get_ident()
+            prev = _THREAD_SPANS.get(tid)
+            _THREAD_SPANS[tid] = span
         try:
             yield
         finally:
+            if tid is not None:
+                if prev is not None:
+                    _THREAD_SPANS[tid] = prev
+                else:
+                    _THREAD_SPANS.pop(tid, None)
             _current_span.reset(token)
 
     def current_span(self) -> Optional[Span]:
@@ -816,6 +934,17 @@ class Metrics:
                 rec["pid"] = pid
                 rec["ts_unix"] = anchor_wall + (s["ts"] - anchor_perf)
                 f.write(json.dumps(rec) + "\n")
+            # the profiler's per-span sample summaries ride the same
+            # file as `kind: "profile"` records (scripts/trace_merge.py
+            # renders them as a profile lane next to the span lanes);
+            # lazy import + total: an unprofiled or half-torn-down
+            # process still exports its spans
+            try:
+                from uda_tpu.utils.profiler import profiler
+                for rec in profiler.export_records(pid=pid):
+                    f.write(json.dumps(rec) + "\n")
+            except Exception:  # udalint: disable=UDA006 - profile
+                pass  # lanes are additive; span export must not fail
         return len(spans)
 
 

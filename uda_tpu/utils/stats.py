@@ -28,9 +28,13 @@ JSON-lines schema (one object per line)::
                "retry_per_s": ..., "emit_mb_s": ...},
      "histograms": {<name>: {"count","sum","min","max","p50","p95","p99"}},
      "percentiles": {<name>: {"p50","p95","p99"}},
+     "profile": {...},         # armed sampling profiler only
+                               # (utils/profiler.py summary)
      "final": true,            # last record only, which also carries:
      "recovery": {"recovery.r<id>": {penalty_box, ledger, admission}},
-     "resledger": {"armed","outstanding","by_pair","leak_reports"}}
+     "resledger": {"armed","outstanding","by_pair","leak_reports"},
+     "time_accounting": {...}} # span-derived wall partition
+                               # (utils/critpath.py; spans on only)
 
 This module is also the **introspection registry**: components with
 process-local state register snapshot providers
@@ -179,6 +183,31 @@ def introspection_snapshot(m: Optional[Metrics] = None) -> Dict:
     return snap
 
 
+def _profile_block() -> Optional[Dict]:
+    """The armed sampling profiler's summary, or None (off / import
+    failure) — lazy + total so reporting never depends on the
+    profiler's health."""
+    try:
+        from uda_tpu.utils.profiler import profiler
+
+        if not profiler.armed:
+            return None
+        return profiler.summary()
+    except Exception:  # udalint: disable=UDA006 - profiling is
+        return None  # additive; a reporter record must still emit
+
+
+def _time_accounting_block(m: Optional[Metrics]) -> Optional[Dict]:
+    """The critpath block over the recorded span tree, or None —
+    same additive contract as the profile block."""
+    try:
+        from uda_tpu.utils.critpath import time_accounting_block
+
+        return time_accounting_block(m)
+    except Exception:  # udalint: disable=UDA006 - additive block
+        return None
+
+
 class StatsReporter:
     """Periodic snapshot/delta/rate reporter over a :class:`Metrics`.
 
@@ -272,6 +301,9 @@ class StatsReporter:
             # derived from the summaries just built, not a second walk
             record["percentiles"] = percentiles_block(
                 summaries=record["histograms"])
+            prof = _profile_block()
+            if prof is not None:
+                record["profile"] = prof
             if final:
                 record["final"] = True
                 for alias in PARITY_ALIASES:
@@ -292,6 +324,13 @@ class StatsReporter:
                         recovery[name] = {"error": type(e).__name__}
                 record["recovery"] = recovery
                 record["resledger"] = resledger_block()
+                # the time-accounting post-mortem: where the task's
+                # wall-clock went, bucketed over the recorded span
+                # tree (None when spans were off — the block is
+                # additive, never a failure)
+                ta = _time_accounting_block(self.metrics)
+                if ta is not None:
+                    record["time_accounting"] = ta
             self._latest = record
             self._write_jsonl(record)
         self._progress_line(record)

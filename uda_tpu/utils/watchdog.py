@@ -101,6 +101,39 @@ def dump_diagnostics(reason: str = "") -> str:
                 walk(s["id"], depth + 1)
 
         walk(None, 1)
+    # the last-30-seconds span-attributed profile: WHAT the threads
+    # were executing as progress flatlined, next to WHERE they sit now
+    # (the stacks above). Armed profiler only — a dump never arms it —
+    # and total: any profiler error degrades to omission, because this
+    # renders inside a failure path
+    try:
+        from uda_tpu.utils.profiler import profiler
+
+        if profiler.armed:
+            recent = profiler.recent_summary(30.0)
+            lines.append(f"--- sampling profile (last "
+                         f"{recent['window_s']:g}s, "
+                         f"{recent['samples']} samples) ---")
+            lines.extend(f"  {name}: {n}"
+                         for name, n in recent["spans"].items())
+    except Exception:  # udalint: disable=UDA006 - dump must stay total
+        pass
+    # where the wall went so far (span-derived; spans on only)
+    try:
+        from uda_tpu.utils.critpath import time_accounting_block
+
+        ta = time_accounting_block()
+        if ta is not None:
+            lines.append(f"--- time accounting (wall "
+                         f"{ta['wall_s']:.3f}s, root "
+                         f"{ta['root'] or 'none'}) ---")
+            lines.extend(
+                f"  {b}: critical {rec['critical_s']:.3f}s "
+                f"({rec['share'] * 100:.1f}%), busy {rec['busy_s']:.3f}s"
+                for b, rec in ta["buckets"].items() if rec["busy_s"])
+            lines.append(f"  idle: {ta['idle_s']:.3f}s")
+    except Exception:  # udalint: disable=UDA006 - dump must stay total
+        pass
     counters = {k: v for k, v in metrics.snapshot().items() if v}
     if counters:
         lines.append("--- non-zero counters ---")
