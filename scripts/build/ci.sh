@@ -83,6 +83,32 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python scripts/net_bench.py --quick --out "$ART/bench_net.json" \
   2>&1 | tee -a "$ART/ci.log" | tail -4
 
+# Batched host-I/O serve A/B, quick mode: the batched+coalesced read
+# plane (uda.tpu.read.batch=on) must be BYTE-IDENTICAL to the
+# single-pread oracle (=off) on the hot-burst shape — identity is the
+# gate (exit 3 on divergence); throughput/speedup are recorded as
+# perfwatch trend data (full runs ride BENCH_IO_r*.json and gate the
+# >= 1.3x acceptance there).
+echo "-- batched host-I/O serve A/B (quick)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/io_bench.py --quick --out "$ART/bench_io.json" \
+  2>&1 | tee -a "$ART/ci.log" | tail -3
+
+# Tuning-cache round trip: a quick io.read fly-off probe must persist
+# a winner, and a SECOND probe run must serve from the cache without
+# re-measuring (tune_probe prints "0 probe(s)" — the self-service
+# routing contract; the full lifecycle matrix rides
+# tests/test_tuncache.py in tier-1).
+echo "-- tuning-cache probe round trip (quick)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/tune_probe.py --cache "$ART/tune_cache.json" --quick \
+  --domain io.read 2>&1 | tee -a "$ART/ci.log" | tail -2
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/tune_probe.py --cache "$ART/tune_cache.json" --quick \
+  --domain io.read 2>&1 | tee -a "$ART/ci.log" | grep -q "0 probe(s) run" \
+  || { echo "FAIL: second tune_probe run re-probed a fresh cache" \
+       | tee -a "$ART/ci.log"; exit 1; }
+
 # Hierarchical exchange gate, quick mode (2x4 virtual mesh): the
 # two-stage pod exchange must be byte-identical to the flat exchange
 # and the host oracles, and the accounting invariant must hold —
@@ -119,6 +145,8 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # perf regression, which is a build failure.
 echo "-- perfwatch perf-regression gate" | tee -a "$ART/ci.log"
 python scripts/perfwatch.py --check "$ART/bench_pipeline.json" \
+  --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
+python scripts/perfwatch.py --check "$ART/bench_io.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 
 # CPU-only gates run with the accelerator-pool env stripped: the pool's

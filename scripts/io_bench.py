@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Serve-path read A/B: batched+coalesced vs single-pread.
+
+The host-I/O half of ROADMAP item 5: a ShuffleServer on 127.0.0.1
+serving a synthetic MOF with the zero-copy plane OFF (the byte serve
+path — where every chunk costs a pool handoff (~100 us on this host,
+PR 6's measurement) plus a pread (~20 us)), measured two ways:
+
+- ``uda.tpu.read.batch=off`` — the single-pread oracle: one pool
+  handoff + one pread per chunk, exactly the pre-batching path;
+- ``uda.tpu.read.batch=on`` — the batched plane: the event-loop server
+  accumulates each recv's decoded burst and unpark sweep into ONE
+  ``DataEngine.submit_batch`` (per-fd grouping, gap-threshold range
+  coalescing, ``os.preadv`` vectored reads — O(files) syscalls for a
+  burst against one hot MOF, not O(chunks)).
+
+The workload is the hot-index burst shape from PR 6's parked-request
+test: N pipelined small-chunk fetches of one hot MOF fired at once
+against the credit window, so decoded requests arrive (and unpark) in
+bursts. **Byte identity is gated on every compared configuration**
+(every chunk of both configs is compared against the file bytes; any
+mismatch exits 3) — throughput is recorded and banded by perfwatch,
+not hard-gated, since it is host-dependent.
+
+Emits BENCH_IO_r13.json; ``--quick`` (the ci.sh gate) shrinks sizes
+and gates identity only.
+
+Usage: scripts/io_bench.py [--quick] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from uda_tpu.mofserver import DataEngine, ShuffleRequest  # noqa: E402
+from uda_tpu.mofserver.index import IndexRecord  # noqa: E402
+from uda_tpu.net import ShuffleServer  # noqa: E402
+from uda_tpu.net.client import RemoteFetchClient  # noqa: E402
+from uda_tpu.utils.config import Config  # noqa: E402
+from uda_tpu.utils.metrics import metrics  # noqa: E402
+
+JOB = "jobIoBench"
+MAP = "attempt_jobIoBench_m_000000_0"
+
+
+class _SyntheticResolver:
+    """Every (job, map, reduce) resolves to one hot pre-written MOF —
+    the bench measures the read plane, not index parsing (the
+    hot-index shape: resolve is always a cache-class hit)."""
+
+    def __init__(self, path: str, nbytes: int):
+        self._rec = IndexRecord(start_offset=0, raw_length=nbytes,
+                                part_length=nbytes, path=path)
+
+    def resolve(self, job_id: str, map_id: str, reduce_id: int):
+        return self._rec
+
+
+def _make_data_file(tmp: str, nbytes: int) -> str:
+    path = os.path.join(tmp, "iobench.mof")
+    block = os.urandom(1 << 20)
+    with open(path, "wb") as f:
+        left = nbytes
+        while left > 0:
+            f.write(block[:min(left, len(block))])
+            left -= len(block)
+    return path
+
+
+def _offsets(total: int, chunk: int, n: int) -> list:
+    """The hot-burst shape: a mostly-sequential chunk walk of the hot
+    MOF with light seeded jitter (windows of 4 shuffled) — the real
+    serve arrival order: a Segment walks its partition sequentially,
+    but pipelining and credit unparking interleave neighbours. This is
+    what per-fd grouping + gap coalescing exist for. Deterministic —
+    every configuration fetches the SAME ranges, so identity and
+    throughput compare like for like."""
+    import random
+
+    offs = [(i * chunk) % max(total - chunk, 1) for i in range(n)]
+    rng = random.Random(1913)
+    for base in range(0, n, 4):
+        window = offs[base:base + 4]
+        rng.shuffle(window)
+        offs[base:base + 4] = window
+    return offs
+
+
+def run_burst(path: str, total: int, chunk: int, n: int,
+              batch: str, timeout_s: float = 600.0) -> dict:
+    """Fire n pipelined fetches at once (the parked-request burst);
+    returns throughput + the per-offset digests for the identity
+    gate."""
+    metrics.reset()
+    engine = DataEngine(
+        _SyntheticResolver(path, total),
+        Config({"uda.tpu.read.batch": batch}))
+    server = ShuffleServer(engine,
+                           Config({"uda.tpu.net.zerocopy": False}),
+                           host="127.0.0.1", port=0).start()
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    offs = _offsets(total, chunk, n)
+    results: list = [None] * n
+    done = threading.Event()
+    lock = threading.Lock()
+    count = [0]
+
+    def make_cb(i):
+        def cb(res):
+            results[i] = res
+            with lock:
+                count[0] += 1
+                if count[0] == n:
+                    done.set()
+        return cb
+
+    t0 = time.perf_counter()
+    for i, off in enumerate(offs):
+        client.start_fetch(ShuffleRequest(JOB, MAP, 0, off, chunk),
+                           make_cb(i))
+    ok = done.wait(timeout=timeout_s)
+    secs = time.perf_counter() - t0
+    snap = metrics.snapshot()
+    client.stop()
+    server.stop()
+    engine.stop()
+    if not ok:
+        raise RuntimeError(
+            f"burst stalled: {count[0]}/{n} completed (batch={batch})")
+    errors = [r for r in results if isinstance(r, Exception)]
+    if errors:
+        raise RuntimeError(f"burst saw {len(errors)} errors, first: "
+                           f"{errors[0]} (batch={batch})")
+    digests = {}
+    nbytes = 0
+    for off, res in zip(offs, results):
+        nbytes += len(res.data)
+        # last-writer-wins per offset: every config fetches identical
+        # ranges, so the digest map compares exactly
+        digests[off] = hashlib.sha256(bytes(res.data)).hexdigest()
+    return {
+        "config": f"batch_{batch}",
+        "chunks": n, "chunk_kb": chunk // 1024,
+        "bytes": nbytes, "seconds": round(secs, 4),
+        "mb_per_s": round(nbytes / (1 << 20) / max(secs, 1e-9), 1),
+        "io_batch_submits": int(snap.get("io.batch.submits", 0)),
+        "io_batch_requests": int(snap.get("io.batch.requests", 0)),
+        "io_batch_reads": int(snap.get("io.batch.reads", 0)),
+        "io_coalesce_runs": int(snap.get("io.coalesce.runs", 0)),
+        "io_coalesce_gap_bytes": int(snap.get("io.coalesce.gap.bytes",
+                                              0)),
+        "_digests": digests,
+    }
+
+
+def oracle_digests(path: str, total: int, chunk: int, n: int) -> dict:
+    out = {}
+    with open(path, "rb") as f:
+        for off in _offsets(total, chunk, n):
+            f.seek(off)
+            out[off] = hashlib.sha256(
+                f.read(min(chunk, total - off))).hexdigest()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes; identity-gate only (ci.sh)")
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "BENCH_IO_r13.json"))
+    ap.add_argument("--reps", type=int, default=3,
+                    help="burst repetitions per config; best is "
+                         "reported (noisy-host discipline)")
+    args = ap.parse_args()
+
+    if args.quick:
+        total, chunk, n = 16 << 20, 64 << 10, 192
+        args.reps = min(args.reps, 2)
+    else:
+        total, chunk, n = 64 << 20, 64 << 10, 768
+
+    tmp = tempfile.mkdtemp(prefix="uda_io_bench_")
+    path = _make_data_file(tmp, total)
+    oracle = oracle_digests(path, total, chunk, n)
+
+    out: dict = {"bench": "io_serve", "round": "r13",
+                 "quick": args.quick, "chunk_kb": chunk // 1024,
+                 "chunks": n, "burst": {}}
+    rc = 0
+    identity_all = True
+    best: dict = {}
+    for batch in ("off", "on"):
+        runs = [run_burst(path, total, chunk, n, batch)
+                for _ in range(max(1, args.reps))]
+        r = max(runs, key=lambda x: x["mb_per_s"])
+        r["reps_mb_per_s"] = [x["mb_per_s"] for x in runs]
+        # identity gated on EVERY run of EVERY configuration, not just
+        # the best-of rep — a fast-but-wrong run must never hide
+        for x in runs:
+            identical = x.pop("_digests") == oracle
+            r.setdefault("identity_runs", []).append(identical)
+            identity_all = identity_all and identical
+        r.pop("_digests", None)
+        r["identical"] = all(r["identity_runs"])
+        best[batch] = r
+        out["burst"][f"batch_{batch}"] = r
+        print(f"batch={batch}: {r['mb_per_s']} MB/s best of "
+              f"{r['reps_mb_per_s']} ({n} x {chunk >> 10} KB chunks; "
+              f"batch submits {r['io_batch_submits']}, coalesced runs "
+              f"{r['io_coalesce_runs']}, reads "
+              f"{r['io_batch_reads']}, identical={r['identical']})")
+
+    speedup = round(best["on"]["mb_per_s"]
+                    / max(best["off"]["mb_per_s"], 1e-9), 3)
+    out["identity_all"] = identity_all
+    out["speedup_batched"] = speedup
+    print(f"batched/single-pread speedup: {speedup}x "
+          f"(identity_all={identity_all})")
+    if not identity_all:
+        print("FAIL: byte identity broke between configurations",
+              file=sys.stderr)
+        rc = 3
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    try:
+        os.remove(path)
+        os.rmdir(tmp)
+    except OSError:
+        pass
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,34 @@ def _extract_pipeline(run: str, data: Dict, out: List[Dict]) -> None:
     _extract_time_accounting(run, w, data, out)
 
 
+def _extract_io(run: str, data: Dict, out: List[Dict]) -> None:
+    """scripts/io_bench.py output: batched-vs-single-pread serve A/B.
+    Identity is a hard gate (tol 0); quick-mode throughput/speedup are
+    trend data (the pipeline-quick precedent: shared-host noise must
+    not flake CI), full-mode gates direction-of-change."""
+    quick = bool(data.get("quick"))
+    w = "io_serve_quick" if quick else "io_serve"
+    if "identity_all" in data:
+        _add(out, run, w, "identity_all",
+             1.0 if data["identity_all"] else 0.0, "up", tol=0.0)
+    if "speedup_batched" in data:
+        _add(out, run, w, "speedup_batched", data["speedup_batched"],
+             "info" if quick else "up")
+    for cfg, rec in (data.get("burst") or {}).items():
+        if isinstance(rec, dict) and "mb_per_s" in rec:
+            _add(out, run, w, f"{cfg}_mb_per_s", rec["mb_per_s"],
+                 "info" if quick else "up")
+        if isinstance(rec, dict) and "io_batch_reads" in rec \
+                and cfg == "batch_on":
+            # the O(files)-not-O(chunks) structural figure: kernel
+            # reads per burst must not creep back toward chunk count.
+            # Quick mode records it as trend data only — recv batching
+            # (and so run count) swings with host load, and a loaded
+            # CI box must not flake the gate
+            _add(out, run, w, "batched_reads_per_burst",
+                 rec["io_batch_reads"], "info" if quick else "down")
+
+
 def _extract_regression(run: str, data: Dict, out: List[Dict]) -> None:
     w = f"regression_{data.get('size', 'unknown')}"
     for rec in data.get("results", []):
@@ -200,6 +228,8 @@ def extract(run: str, data) -> List[Dict]:
             return out
     if data.get("bench") == "net_loopback":
         _extract_net(run, data, out)
+    elif data.get("bench") == "io_serve":
+        _extract_io(run, data, out)
     elif "identity" in data and "speedup_sorted" in data:
         _extract_pipeline(run, data, out)
     elif isinstance(data.get("results"), list):

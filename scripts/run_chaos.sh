@@ -29,7 +29,7 @@ COUNTERS="$(mktemp)"
 # its post-mortem defeats the recorder's purpose).
 FRROOT="$(mktemp -d)"
 export FRROOT  # the telemetry merge below reads the dumps from it
-for r in main pressure network exchange completion pipeline lockdep; do
+for r in main pressure network exchange completion pipeline iobatch lockdep; do
   mkdir -p "${FRROOT}/${r}"
 done
 trap 'rm -f "${COUNTERS}"; rm -rf "${FRROOT}"' EXIT
@@ -155,6 +155,29 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PIPESPEC}" UDA_TPU_STATS=1 \
     -k "pipeline" \
     --continue-on-collection-errors "$@" || pirc=$?
 
+# Batched host-I/O rung: batch-partial-failure (ISSUE 13) — a seeded
+# data_engine.preadv schedule (error + delay, keyed per request range)
+# against the batched serve plane. The faults-marked iobatch tests
+# assert the isolation contract: an injected fault fails ONLY the
+# targeted request, its coalesced batch-mates complete byte-correct,
+# and at exit the ledger holds zero obligations (admission bytes, fd
+# pins, the io.batch.inflight paired gauge) and lockdep zero cycles.
+IOSPEC="data_engine.preadv=error:every:$((SEED % 5 + 3)),data_engine.pread=delay:$((SEED % 10 + 2)):prob:0.2:seed:${SEED}"
+IOCOUNTERS="$(mktemp)"
+IOCYCLES="$(mktemp)"
+IOLEAKS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}"; rm -rf "${FRROOT}"' EXIT
+echo "iobatch schedule:    ${IOSPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
+iorc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${IOSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/iobatch" \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${IOCYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${IOLEAKS}" \
+    UDA_TPU_CHAOS_TELEMETRY="${IOCOUNTERS}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    -k "iobatch" \
+    --continue-on-collection-errors "$@" || iorc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -165,7 +188,7 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PIPESPEC}" UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -183,7 +206,9 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${CCOUNTERS}" "${crc}" "${CCYCLES}" \
     "${PIPESPEC}" "${PICOUNTERS}" "${pirc}" "${PICYCLES}" \
     "${LCOUNTERS}" "${lrc}" "${LCYCLES}" \
-    "${NLEAKS}" "${CLEAKS}" "${PILEAKS}" <<'EOF' || mrc=$?
+    "${NLEAKS}" "${CLEAKS}" "${PILEAKS}" \
+    "${IOSPEC}" "${IOCOUNTERS}" "${iorc}" "${IOCYCLES}" \
+    "${IOLEAKS}" <<'EOF' || mrc=$?
 import glob, json, os, sys
 sys.path.insert(0, os.getcwd())
 from uda_tpu.utils.critpath import buckets_from_counters
@@ -193,7 +218,8 @@ from uda_tpu.utils.critpath import buckets_from_counters
  ccounters, crc_, ccycles,
  pipespec, picounters, pirc, picycles,
  lcounters, lrc, lcycles,
- nleaks_path, cleaks_path, pileaks_path) = sys.argv[1:29]
+ nleaks_path, cleaks_path, pileaks_path,
+ iospec, iocounters, iorc, iocycles, ioleaks_path) = sys.argv[1:34]
 frroot = os.environ.get("FRROOT", "")
 def flightrec_block(rung, exit_code):
     """Archive the rung's black-box dumps (cause + structured extra +
@@ -276,6 +302,22 @@ completion["survived"] = {
 pipeline, pi_reports = lockdep_block(pipespec, pirc, picounters,
                                      picycles)
 pi_leaks = resledger_block(pipeline, pileaks_path)
+iobatch, io_reports = lockdep_block(iospec, iorc, iocounters, iocycles)
+io_leaks = resledger_block(iobatch, ioleaks_path)
+# the batch-partial-failure contract, surfaced: requests batched,
+# coalesced runs/syscalls issued, injected per-request faults, and
+# zero bytes/pins left in flight (the per-test asserts enforce it;
+# this is the cross-round diffable record)
+ioc = iobatch["telemetry"].get("counters", {})
+iobatch["isolated"] = {
+    "batch_requests": ioc.get("io.batch.requests", 0),
+    "batch_reads": ioc.get("io.batch.reads", 0),
+    "coalesce_runs": ioc.get("io.coalesce.runs", 0),
+    "preadv_failpoint_fires": ioc.get("failpoint.data_engine.preadv",
+                                      0),
+    "inflight_left": iobatch["telemetry"].get(
+        "gauges", {}).get("io.batch.inflight", 0),
+}
 # the drain contract, surfaced: staged runs consumed, backpressure
 # blocks observed, and zero bytes left in flight after every
 # faulted-and-aborted pipeline (the per-test asserts enforce the
@@ -289,7 +331,7 @@ pipeline["drained"] = {
         "gauges", {}).get("stage.inflight.bytes", 0),
 }
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
-nleak = len(n_leaks) + len(c_leaks) + len(pi_leaks)
+nleak = len(n_leaks) + len(c_leaks) + len(pi_leaks) + len(io_leaks)
 # flight-recorder archive, one block per rung; a rung that failed
 # without a single black-box dump flags failed_without_dump
 fr = {"main": flightrec_block("main", rc),
@@ -298,11 +340,13 @@ fr = {"main": flightrec_block("main", rc),
       "exchange": flightrec_block("exchange", erc),
       "completion": flightrec_block("completion", crc_),
       "pipeline": flightrec_block("pipeline", pirc),
+      "iobatch": flightrec_block("iobatch", iorc),
       "lockdep": flightrec_block("lockdep", lrc)}
 network["flightrec"] = fr["network"]
 exchange["flightrec"] = fr["exchange"]
 completion["flightrec"] = fr["completion"]
 pipeline["flightrec"] = fr["pipeline"]
+iobatch["flightrec"] = fr["iobatch"]
 lockdep["flightrec"] = fr["lockdep"]
 no_postmortem = sorted(r for r, b in fr.items()
                        if b["failed_without_dump"])
@@ -322,15 +366,16 @@ with open(out, "w") as f:
                "exchange": exchange,
                "completion": completion,
                "pipeline": pipeline,
+               "iobatch": iobatch,
                "lockdep": lockdep,
                "resledger": {"armed_rungs": ["network", "completion",
-                                             "pipeline"],
+                                             "pipeline", "iobatch"],
                              "leaks": nleak},
                "flightrec_missing_postmortem": no_postmortem},
               f, indent=1, sort_keys=True)
     f.write("\n")
 ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
-        + len(pi_reports) + len(l_reports))
+        + len(pi_reports) + len(io_reports) + len(l_reports))
 ndumps = sum(b["dumps"] for b in fr.values())
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc}, "
       f"resledger leaks: {nleak}, flightrec dumps: {ndumps})")
@@ -350,6 +395,7 @@ if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
 if [ "${erc}" -ne 0 ]; then rc="${erc}"; fi
 if [ "${crc}" -ne 0 ]; then rc="${crc}"; fi
 if [ "${pirc}" -ne 0 ]; then rc="${pirc}"; fi
+if [ "${iorc}" -ne 0 ]; then rc="${iorc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP/RESLEDGER/FLIGHTREC: cycle reports, leaked obligations" \

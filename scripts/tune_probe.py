@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""tune_probe: seeded fly-off probes that populate the online tuning
+cache (uda_tpu/utils/tuncache.py).
+
+The generalization of the repo's hand-deployed sweep winners
+(``UDA_TPU_SORT_PATH``/``UDA_TPU_CHUNK_COLS``; ROADMAP item 5): instead
+of a human reading BENCH_*.json and exporting env vars, this probe
+measures on THIS host and persists per-(key-shape, platform, backend)
+winners that ``ops.sort.route_engine`` and the batched host-I/O plane
+consult at routing time. Env-var winners still override the cache —
+precedence is env > cache > built-in, tested in
+tests/test_tuncache.py.
+
+Domains probed (``--domain`` selects one, default both):
+
+- ``sort.engine``: a bench_step fly-off over the pure-XLA engine set
+  (plus the Pallas lanes engines on a TPU backend) at two row-bucket
+  shapes, one winner per (backend, rows-bucket, lanes-capability) key.
+- ``io.read``: a submit_batch burst A/B over coalesce-gap settings on
+  a synthetic MOF (the io_bench hot-burst shape, in-process), one
+  winner per platform: {batch, gap_kb, batch_max, backend}.
+
+Re-probe rung: ``--reprobe-age S`` skips entries younger than S
+seconds (the background-freshness contract: a cron/idle-time
+invocation re-measures only what drifted stale; ``uda.tpu.tune.
+reprobe.s`` is the in-process analogue via tuncache.ensure_fresh).
+``--force`` re-measures everything. Probes count ``tune.probes`` —
+the lifecycle test's "probe counter zero on the second run" gate rides
+exactly this skip.
+
+Usage::
+
+    UDA_TPU_TUNE_CACHE=/path/tune.json python scripts/tune_probe.py --quick
+    python scripts/tune_probe.py --cache /path/tune.json --domain io.read
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+JOB = "jobTuneProbe"
+MAP = "attempt_jobTuneProbe_m_000000_0"
+
+
+def _fresh(cache, domain: str, key: str, reprobe_age: float,
+           force: bool) -> bool:
+    """True when the entry is fresh enough to SKIP re-probing."""
+    if force:
+        return False
+    age = cache.age_s(domain, key)
+    if age is None:
+        return False
+    if reprobe_age <= 0:
+        return True  # a winner exists and no staleness horizon: keep it
+    return age <= reprobe_age
+
+
+def probe_sort_engine(cache, quick: bool, reprobe_age: float,
+                      force: bool, seed: int) -> list:
+    """Fly-off per (backend, rows-bucket, lanes-capability): time each
+    candidate engine with bench_step (sortedness + checksum asserted —
+    a broken engine can never be crowned) and persist the winner."""
+    import jax
+    import numpy as np
+
+    from uda_tpu.models import terasort
+    from uda_tpu.ops import sort as sort_ops
+    from uda_tpu.utils.metrics import metrics
+    from uda_tpu.utils.tuncache import rows_bucket
+
+    backend = jax.default_backend()
+    sizes = (1 << 14,) if quick else (1 << 16, 1 << 20)
+    out = []
+    for n in sizes:
+        for lanes_ok in (False, True):
+            key = f"{backend}|rows{rows_bucket(n)}|lanes{int(lanes_ok)}"
+            if _fresh(cache, "sort.engine", key, reprobe_age, force):
+                out.append((key, "fresh", None))
+                continue
+            metrics.add("tune.probes", domain="sort.engine")
+            candidates = ["carry", "gather", "gather2", "carrychunk"]
+            if lanes_ok and backend == "tpu":
+                # interpret-mode lanes on CPU are pathologically slow
+                # and would never win honestly — probe them only where
+                # they compile for real
+                candidates += list(sort_ops.LANES_ENGINES)
+            best = None
+            times = {}
+            for path in candidates:
+                try:
+                    def one(s):
+                        t0 = time.perf_counter()
+                        viol, ck_in, ck_out = terasort.bench_step(
+                            jax.random.key(s), n, 1, path=path,
+                            tile=min(1024, n))
+                        assert int(viol) == 0
+                        assert np.uint32(ck_in) == np.uint32(ck_out)
+                        return time.perf_counter() - t0
+
+                    one(seed)  # warmup/compile
+                    dt = min(one(seed + 1), one(seed + 2))
+                    times[path] = round(dt, 5)
+                    if best is None or dt < best[1]:
+                        best = (path, dt)
+                except Exception as e:  # noqa: BLE001 - one engine's
+                    # failure (unsupported shape/backend) must not
+                    # kill the fly-off; it just cannot win
+                    times[path] = f"error: {type(e).__name__}"
+            if best is None:
+                out.append((key, "no-winner", None))
+                continue
+            gbps = n * terasort.RECORD_BYTES / 1e9 / best[1]
+            cache.record("sort.engine", key,
+                         {"engine": best[0], "times_s": times},
+                         metric=round(gbps, 4), probe="tune_probe")
+            out.append((key, "probed", best[0]))
+    return out
+
+
+def probe_io_read(cache, quick: bool, reprobe_age: float, force: bool,
+                  seed: int) -> list:
+    """Burst A/B over the batched read plane's parameters on a
+    synthetic MOF: batch off vs on at each coalesce-gap rung, winner =
+    the fastest configuration whose bytes matched the oracle."""
+    from uda_tpu.mofserver.data_engine import DataEngine, ShuffleRequest
+    from uda_tpu.mofserver.index import IndexRecord
+    from uda_tpu.utils.config import Config
+    from uda_tpu.utils.metrics import metrics
+
+    key = sys.platform
+    if _fresh(cache, "io.read", key, reprobe_age, force):
+        return [(key, "fresh", None)]
+    metrics.add("tune.probes", domain="io.read")
+
+    class _Resolver:
+        def __init__(self, path, n):
+            self._rec = IndexRecord(start_offset=0, raw_length=n,
+                                    part_length=n, path=path)
+
+        def resolve(self, job_id, map_id, reduce_id):
+            return self._rec
+
+    import random
+
+    total = (8 << 20) if quick else (64 << 20)
+    chunk = 64 << 10
+    burst = 64 if quick else 256
+    tmp = tempfile.mkdtemp(prefix="uda_tune_probe_")
+    path = os.path.join(tmp, "probe.mof")
+    block = os.urandom(1 << 20)
+    with open(path, "wb") as f:
+        left = total
+        while left > 0:
+            f.write(block[:min(left, len(block))])
+            left -= len(block)
+
+    def burst_offsets():
+        # the hot-burst shape: mostly-sequential chunks with jitter.
+        # The rng is REBUILT per call so every configuration and every
+        # repetition fetches the same ranges in the same order — a
+        # shared advancing rng would hand each A/B arm a different
+        # arrival order and bias which winner gets crowned
+        offs = [(i * chunk) % (total - chunk) for i in range(burst)]
+        random.Random(seed).shuffle(offs)
+        return offs
+
+    def run(cfg_over: dict, batched: bool) -> float:
+        engine = DataEngine(_Resolver(path, total),
+                            Config(dict(cfg_over)))
+        offs = burst_offsets()
+        reqs = [ShuffleRequest(JOB, MAP, 0, off, chunk) for off in offs]
+        t0 = time.perf_counter()
+        if batched:
+            futs = engine.submit_batch(reqs)
+        else:
+            futs = [engine.submit(r) for r in reqs]
+        with open(path, "rb") as oracle_f:
+            for req, fut in zip(reqs, futs):
+                res = fut.result(timeout=60.0)
+                oracle_f.seek(req.offset)
+                want = oracle_f.read(min(chunk, total - req.offset))
+                assert bytes(res.data) == want, "probe identity broke"
+        dt = time.perf_counter() - t0
+        engine.stop()
+        return dt
+
+    reps = 2 if quick else 3
+    results = {}
+    results["off"] = min(run({}, batched=False) for _ in range(reps))
+    gaps = (0, 64, 256)
+    best = ("off", results["off"], {})
+    for gap in gaps:
+        name = f"gap{gap}"
+        results[name] = min(
+            run({"uda.tpu.read.coalesce.gap.kb": gap}, batched=True)
+            for _ in range(reps))
+        if results[name] < best[1]:
+            best = (name, results[name],
+                    {"batch": "on", "gap_kb": gap, "batch_max": 256})
+    probe_engine = DataEngine(_Resolver(path, total), Config())
+    winner = dict(best[2] or {"batch": "off"})
+    winner["backend"] = probe_engine.io_backend
+    probe_engine.stop()
+    mbps = burst * chunk / (1 << 20) / best[1]
+    cache.record("io.read", key, winner, metric=round(mbps, 2),
+                 probe="tune_probe")
+    try:
+        os.remove(path)
+        os.rmdir(tmp)
+    except OSError:
+        pass
+    return [(key, "probed",
+             f"{winner} ({ {k: round(v, 4) for k, v in results.items()} })")]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cache", default="",
+                    help="tuning-cache path (default: UDA_TPU_TUNE_CACHE"
+                         " env, required one way or the other)")
+    ap.add_argument("--domain", choices=["sort.engine", "io.read"],
+                    help="probe one domain only (default: both)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes (CI / test sizes)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-measure even fresh entries")
+    ap.add_argument("--reprobe-age", type=float, default=0.0,
+                    help="re-measure entries older than this many "
+                         "seconds (0 = existing winners are kept; "
+                         "this is the background re-probe rung)")
+    ap.add_argument("--seed", type=int, default=13)
+    ap.add_argument("--list", action="store_true",
+                    help="print the cache entries and exit")
+    args = ap.parse_args()
+
+    from uda_tpu.utils.metrics import metrics
+    from uda_tpu.utils.tuncache import TuneCache, cache_path_from_env
+
+    path = args.cache or cache_path_from_env()
+    if not path:
+        print("tune_probe: no cache path (--cache or UDA_TPU_TUNE_CACHE)",
+              file=sys.stderr)
+        return 2
+    cache = TuneCache(path)
+    if args.list:
+        for k, v in sorted(cache.entries().items()):
+            print(f"{k}: {v.get('winner')} (metric {v.get('metric')})")
+        return 0
+    reports = []
+    if args.domain in (None, "io.read"):
+        reports += probe_io_read(cache, args.quick, args.reprobe_age,
+                                 args.force, args.seed)
+    if args.domain in (None, "sort.engine"):
+        reports += probe_sort_engine(cache, args.quick,
+                                     args.reprobe_age, args.force,
+                                     args.seed)
+    probes = int(metrics.get("tune.probes"))
+    for key, status, winner in reports:
+        line = f"tune_probe: {key}: {status}"
+        if winner is not None:
+            line += f" -> {winner}"
+        print(line)
+    print(f"tune_probe: {probes} probe(s) run, cache at {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,487 @@
+"""Batched host-I/O plane (ISSUE 13): coalescing planner units,
+submit_batch byte identity + per-request error isolation, the wire
+serve path's batch feeding, and the batch-partial-failure chaos
+contract (faults-marked — scripts/run_chaos.sh's iobatch rung runs
+these under a seeded data_engine.preadv schedule with the
+ResourceLedger and lockdep armed)."""
+
+import hashlib
+import os
+import tempfile
+import threading
+
+import pytest
+
+from uda_tpu.mofserver.data_engine import (DataEngine, ShuffleRequest,
+                                           plan_coalesced)
+from uda_tpu.mofserver.index import IndexRecord
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import ConfigError, StorageError
+from uda_tpu.utils.failpoints import failpoints
+from uda_tpu.utils.metrics import metrics
+
+JOB = "jobIoBatch"
+MAP = "attempt_jobIoBatch_m_000000_0"
+
+
+class SyntheticResolver:
+    """Every (job, map, reduce) resolves to one pre-written file."""
+
+    def __init__(self, path: str, nbytes: int, start: int = 0):
+        self._rec = IndexRecord(start_offset=start, raw_length=nbytes,
+                                part_length=nbytes, path=path)
+
+    def resolve(self, job_id, map_id, reduce_id):
+        return self._rec
+
+
+def _write(tmp, name, nbytes, seed=7):
+    import random
+
+    rng = random.Random(seed)
+    path = os.path.join(tmp, name)
+    with open(path, "wb") as f:
+        f.write(bytes(rng.getrandbits(8) for _ in range(nbytes)))
+    return path
+
+
+@pytest.fixture()
+def quiet_sites():
+    """Identity assertions below are about the REAL read plane; the
+    ambient chaos-rung schedules (data_engine.pread/preadv) would
+    inject the very faults these tests assert absent — pinned out,
+    trigger state restored on exit (the PR 10 idiom)."""
+    with failpoints.scoped(""):
+        failpoints.disarm("data_engine.pread")
+        failpoints.disarm("data_engine.preadv")
+        yield
+
+
+# -- coalescing planner (pure units) -----------------------------------------
+
+
+def test_plan_coalesced_adjacent_and_gap_merge():
+    items = [("a", 0, 100), ("b", 100, 50), ("c", 180, 20)]
+    runs = plan_coalesced(items, gap_bytes=30, max_run_bytes=1 << 20)
+    assert [[i[0] for i in run] for run in runs] == [["a", "b", "c"]]
+    runs = plan_coalesced(items, gap_bytes=29, max_run_bytes=1 << 20)
+    assert [[i[0] for i in run] for run in runs] == [["a", "b"], ["c"]]
+
+
+def test_plan_coalesced_zero_gap_only_adjacent():
+    items = [("a", 0, 10), ("b", 10, 10), ("c", 21, 10)]
+    runs = plan_coalesced(items, gap_bytes=0, max_run_bytes=1 << 20)
+    assert [[i[0] for i in run] for run in runs] == [["a", "b"], ["c"]]
+
+
+def test_plan_coalesced_overlap_starts_fresh_run():
+    # duplicate/overlapping ranges cannot share one scatter read
+    items = [("a", 0, 100), ("dup", 0, 100), ("b", 50, 100)]
+    runs = plan_coalesced(items, gap_bytes=1 << 20,
+                          max_run_bytes=1 << 20)
+    assert len(runs) == 3
+    for run in runs:
+        end = -1
+        for _, off, length in run:
+            assert off >= end
+            end = off + length
+
+
+def test_plan_coalesced_max_run_bound():
+    items = [("x%d" % i, i * 100, 100) for i in range(10)]
+    runs = plan_coalesced(items, gap_bytes=0, max_run_bytes=300)
+    assert all(sum(r[2] for r in run) <= 300 for run in runs)
+    assert [len(run) for run in runs] == [3, 3, 3, 1]
+
+
+def test_plan_coalesced_iov_max_bound():
+    """A run never exceeds the IOV_MAX-derived item cap: preadv
+    rejects >1024 buffers per call, and an oversized batch_max must
+    split runs rather than fail the whole burst's reads."""
+    items = [("x%d" % i, i * 10, 10) for i in range(1200)]
+    runs = plan_coalesced(items, gap_bytes=0, max_run_bytes=1 << 30)
+    assert all(len(run) <= 511 for run in runs)
+    assert sum(len(run) for run in runs) == 1200
+
+
+def test_plan_coalesced_unsorted_input_sorted_runs():
+    items = [("b", 500, 10), ("a", 0, 10), ("c", 505, 10)]
+    runs = plan_coalesced(items, gap_bytes=0, max_run_bytes=1 << 20)
+    flat = [i[0] for run in runs for i in run]
+    assert flat == ["a", "b", "c"]  # "c" overlaps "b": separate runs
+    assert len(runs) == 3
+
+
+# -- submit_batch semantics ---------------------------------------------------
+
+
+def test_submit_batch_byte_identity_vs_file(tmp_path, quiet_sites):
+    data_len = 1 << 20
+    path = _write(str(tmp_path), "f.mof", data_len)
+    with open(path, "rb") as f:
+        blob = f.read()
+    engine = DataEngine(SyntheticResolver(path, data_len), Config())
+    try:
+        # adjacent, gapped, duplicate and tail-clamped ranges in one
+        # batch — every shape the coalescer must scatter correctly
+        offs = [0, 65536, 131072, 131072, 400000, 400100,
+                data_len - 100]
+        reqs = [ShuffleRequest(JOB, MAP, 0, off, 65536) for off in offs]
+        futs = engine.submit_batch(reqs)
+        for req, fut in zip(reqs, futs):
+            res = fut.result(timeout=10)
+            want = blob[req.offset:req.offset + 65536]
+            assert bytes(res.data) == want
+            assert res.last == (req.offset + len(res.data) >= data_len)
+            assert res.raw_length == data_len
+        assert metrics.get("io.batch.requests") == len(reqs)
+        assert metrics.get("io.batch.submits") == 1
+        # adjacent trio coalesced: strictly fewer reads than requests
+        assert metrics.get("io.batch.reads") < len(reqs)
+    finally:
+        engine.stop()
+
+
+def test_submit_batch_matches_single_submit(tmp_path, quiet_sites):
+    """The A/B twin contract: batch results byte-identical to the
+    single-pread path over the same requests."""
+    data_len = 512 * 1024
+    path = _write(str(tmp_path), "f.mof", data_len, seed=11)
+    engine = DataEngine(SyntheticResolver(path, data_len), Config())
+    try:
+        offs = [0, 1000, 64 * 1024, 300000, 500000]
+        reqs = [ShuffleRequest(JOB, MAP, 0, off, 32768) for off in offs]
+        single = [engine.submit(r).result(timeout=10) for r in reqs]
+        batched = [f.result(timeout=10)
+                   for f in engine.submit_batch(reqs)]
+        for s, b in zip(single, batched):
+            assert bytes(s.data) == bytes(b.data)
+            assert (s.raw_length, s.part_length, s.offset, s.last) == \
+                (b.raw_length, b.part_length, b.offset, b.last)
+    finally:
+        engine.stop()
+
+
+def test_submit_batch_bad_offset_fails_only_that_request(tmp_path,
+                                                         quiet_sites):
+    data_len = 256 * 1024
+    path = _write(str(tmp_path), "f.mof", data_len)
+    engine = DataEngine(SyntheticResolver(path, data_len), Config())
+    try:
+        reqs = [ShuffleRequest(JOB, MAP, 0, 0, 4096),
+                ShuffleRequest(JOB, MAP, 0, data_len + 5, 4096),
+                ShuffleRequest(JOB, MAP, 0, 8192, 4096)]
+        futs = engine.submit_batch(reqs)
+        assert futs[0].result(timeout=10).data
+        with pytest.raises(StorageError):
+            futs[1].result(timeout=10)
+        assert futs[2].result(timeout=10).data
+    finally:
+        engine.stop()
+
+
+def test_submit_batch_admission_rejection_is_per_request(tmp_path,
+                                                         quiet_sites):
+    data_len = 4 << 20
+    path = _write(str(tmp_path), "f.mof", data_len)
+    engine = DataEngine(
+        SyntheticResolver(path, data_len),
+        Config({"uda.tpu.supplier.read.budget.mb": 1}))
+    try:
+        # 1 MB budget: the first (idle-engine escape) admits, the
+        # second cannot fit on top of it, the third neither — each
+        # rejection is ITS future's StorageError, the admitted one
+        # serves
+        reqs = [ShuffleRequest(JOB, MAP, 0, i << 20, 1 << 20)
+                for i in range(3)]
+        futs = engine.submit_batch(reqs)
+        assert len(futs[0].result(timeout=10).data) == 1 << 20
+        for f in futs[1:]:
+            with pytest.raises(StorageError):
+                f.result(timeout=10)
+        assert metrics.get("supplier.admission.rejections") == 2
+    finally:
+        engine.stop()
+    assert metrics.get_gauge("supplier.read.bytes.on_air") == 0
+
+
+def test_submit_batch_never_raises_when_stopped(tmp_path):
+    path = _write(str(tmp_path), "f.mof", 1024)
+    engine = DataEngine(SyntheticResolver(path, 1024), Config())
+    engine.stop()
+    futs = engine.submit_batch([ShuffleRequest(JOB, MAP, 0, 0, 512)])
+    with pytest.raises(StorageError):
+        futs[0].result(timeout=5)
+
+
+def test_submit_batch_crc_stamped_from_disk_bytes(tmp_path,
+                                                  quiet_sites):
+    import zlib
+
+    data_len = 128 * 1024
+    path = _write(str(tmp_path), "f.mof", data_len)
+    with open(path, "rb") as f:
+        blob = f.read()
+    engine = DataEngine(SyntheticResolver(path, data_len),
+                        Config({"uda.tpu.fetch.crc": True}))
+    try:
+        futs = engine.submit_batch(
+            [ShuffleRequest(JOB, MAP, 0, 4096, 8192)])
+        res = futs[0].result(timeout=10)
+        assert res.crc == (zlib.crc32(blob[4096:4096 + 8192])
+                           & 0xFFFFFFFF)
+    finally:
+        engine.stop()
+
+
+def test_backend_ladder_and_io_backend_recorded(tmp_path):
+    """This 4.4-class host exercises the preadv rung; the selection is
+    recorded as the io.backend label AND the engine attribute (the
+    stats-record contract of the once-per-process-warn satellite)."""
+    path = _write(str(tmp_path), "f.mof", 1024)
+    engine = DataEngine(SyntheticResolver(path, 1024), Config())
+    try:
+        assert engine.io_backend in ("io_uring", "preadv", "pread")
+        if hasattr(os, "preadv"):
+            assert engine.io_backend in ("io_uring", "preadv")
+        assert metrics.get("io.backend",
+                           backend=engine.io_backend) >= 1
+    finally:
+        engine.stop()
+    # explicit rung requests walk DOWN the ladder, typos fail loudly
+    e2 = DataEngine(SyntheticResolver(path, 1024),
+                    Config({"uda.tpu.read.backend": "pread"}))
+    assert e2.io_backend == "pread"
+    e2.stop()
+    with pytest.raises(ConfigError):
+        DataEngine(SyntheticResolver(path, 1024),
+                   Config({"uda.tpu.read.backend": "io_urng"}))
+
+
+def test_native_unavailable_warns_once_counts_every_time(tmp_path,
+                                                         monkeypatch):
+    """data_engine.py's native-fallback log.warn fired per
+    construction; a fleet of engines must not spam — once per process,
+    counted every time (io.native.unavailable)."""
+    import uda_tpu.mofserver.data_engine as de
+
+    path = _write(str(tmp_path), "f.mof", 1024)
+    warns = []
+    monkeypatch.setattr(de, "_native_warned", False)
+    monkeypatch.setattr(
+        de.log, "warn",
+        lambda msg, *a, **k: warns.append(str(msg)))
+
+    class _Boom:
+        def __getattr__(self, name):
+            raise RuntimeError("no native today")
+
+    real_native_reads = de._NativeReads
+    monkeypatch.setattr(de, "_NativeReads",
+                        lambda pool: (_ for _ in ()).throw(
+                            RuntimeError("no native today")))
+    try:
+        for _ in range(3):
+            DataEngine(SyntheticResolver(path, 1024),
+                       Config({"uda.tpu.use.native": True})).stop()
+    finally:
+        de._NativeReads = real_native_reads
+    native_warns = [w for w in warns if "native reader unavailable"
+                    in w]
+    assert len(native_warns) == 1
+    assert metrics.get("io.native.unavailable") == 3
+
+
+# -- the wire serve path ------------------------------------------------------
+
+
+def _wire_burst(path, data_len, batch, n=64, chunk=16 * 1024,
+                server_cfg=None):
+    from uda_tpu.net import ShuffleServer
+    from uda_tpu.net.client import RemoteFetchClient
+
+    engine = DataEngine(SyntheticResolver(path, data_len),
+                        Config({"uda.tpu.read.batch": batch}))
+    scfg = dict(server_cfg or {"uda.tpu.net.zerocopy": False})
+    server = ShuffleServer(engine, Config(scfg), host="127.0.0.1",
+                           port=0).start()
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    results = [None] * n
+    done = threading.Event()
+    lock = threading.Lock()
+    count = [0]
+
+    def mk(i):
+        def cb(res):
+            results[i] = res
+            with lock:
+                count[0] += 1
+                if count[0] == n:
+                    done.set()
+        return cb
+
+    try:
+        for i in range(n):
+            client.start_fetch(
+                ShuffleRequest(JOB, MAP, 0, (i * chunk) % data_len,
+                               chunk), mk(i))
+        assert done.wait(30.0), f"burst stalled {count[0]}/{n}"
+    finally:
+        client.stop()
+        server.stop()
+        engine.stop()
+    return results
+
+
+def test_wire_burst_batched_is_byte_identical(tmp_path, quiet_sites):
+    data_len = 2 << 20
+    path = _write(str(tmp_path), "f.mof", data_len, seed=3)
+    with open(path, "rb") as f:
+        blob = f.read()
+
+    def digest(results):
+        h = hashlib.sha256()
+        for r in results:
+            assert not isinstance(r, Exception), r
+            h.update(bytes(r.data))
+        return h.hexdigest()
+
+    got_on = _wire_burst(path, data_len, "on")
+    on_batched = metrics.get("io.batch.requests")
+    assert on_batched > 0, "batch plane never engaged with batch=on"
+    d_on = digest(got_on)
+    metrics.reset()
+    got_off = _wire_burst(path, data_len, "off")
+    assert metrics.get("io.batch.requests") == 0, \
+        "batch=off must reproduce today's single-pread path exactly"
+    assert digest(got_off) == d_on
+    for r, want_off in zip(got_on,
+                           [(i * 16384) % data_len for i in range(64)]):
+        assert bytes(r.data) == blob[want_off:want_off + 16384]
+
+
+def test_wire_zero_copy_requests_stay_unbatched(tmp_path, quiet_sites):
+    """Slice-eligible requests keep the zero-copy plane: batching must
+    never steal the sendfile/mmap path (it would trade a splice for a
+    heap copy)."""
+    data_len = 1 << 20
+    path = _write(str(tmp_path), "f.mof", data_len)
+    results = _wire_burst(path, data_len, "on", n=16,
+                          server_cfg={"uda.tpu.net.zerocopy": True})
+    assert all(not isinstance(r, Exception) for r in results)
+    assert metrics.get("io.batch.requests") == 0
+    assert metrics.get("net.serve.fd") > 0
+
+
+# -- failure injection (the chaos rung's tests) -------------------------------
+
+
+@pytest.mark.faults
+def test_iobatch_partial_failure_only_targets_request(tmp_path):
+    """THE batch-partial-failure contract: an injected
+    data_engine.preadv fault (keyed <fd>@<file offset>) fails exactly
+    the targeted request of a coalesced batch; its batch-mates
+    complete byte-correct and every obligation settles (the conftest
+    teardown + the chaos rung's armed ledger enforce zero leaks)."""
+    data_len = 1 << 20
+    path = _write(str(tmp_path), "f.mof", data_len, seed=5)
+    with open(path, "rb") as f:
+        blob = f.read()
+    engine = DataEngine(SyntheticResolver(path, data_len), Config())
+    try:
+        # four ADJACENT chunks -> one coalesced vectored read; the
+        # match trigger keys on the victim's file offset
+        offs = [0, 16384, 32768, 49152]
+        with failpoints.scoped(
+                "data_engine.preadv=error:match:@32768"):
+            failpoints.disarm("data_engine.pread")
+            futs = engine.submit_batch(
+                [ShuffleRequest(JOB, MAP, 0, off, 16384)
+                 for off in offs])
+            for off, fut in zip(offs, futs):
+                if off == 32768:
+                    with pytest.raises(StorageError):
+                        fut.result(timeout=10)
+                else:
+                    res = fut.result(timeout=10)
+                    assert bytes(res.data) == blob[off:off + 16384]
+        assert metrics.get("failpoint.data_engine.preadv") >= 1
+    finally:
+        engine.stop()
+    assert metrics.get_gauge("io.batch.inflight") == 0
+    assert metrics.get_gauge("supplier.read.bytes.on_air") == 0
+
+
+@pytest.mark.faults
+def test_iobatch_truncate_damages_one_request(tmp_path):
+    """Data-bearing injection on the batch plane: a truncated chunk
+    looks like wire damage on ONE request (CRC validates per chunk),
+    batch-mates untouched."""
+    import zlib
+
+    data_len = 256 * 1024
+    path = _write(str(tmp_path), "f.mof", data_len)
+    with open(path, "rb") as f:
+        blob = f.read()
+    engine = DataEngine(SyntheticResolver(path, data_len),
+                        Config({"uda.tpu.fetch.crc": True}))
+    try:
+        with failpoints.scoped(
+                "data_engine.preadv=truncate:100:match:@8192"):
+            failpoints.disarm("data_engine.pread")
+            futs = engine.submit_batch(
+                [ShuffleRequest(JOB, MAP, 0, 0, 8192),
+                 ShuffleRequest(JOB, MAP, 0, 8192, 8192)])
+            ok = futs[0].result(timeout=10)
+            assert bytes(ok.data) == blob[:8192]
+            assert ok.crc == zlib.crc32(blob[:8192]) & 0xFFFFFFFF
+            hurt = futs[1].result(timeout=10)
+            # truncated AFTER the CRC stamp (truncate:<n> drops n tail
+            # bytes): the mismatch is detectable exactly like wire
+            # damage (the Segment's re-fetch contract)
+            assert len(hurt.data) == 8192 - 100
+            assert hurt.crc == zlib.crc32(blob[8192:16384]) & 0xFFFFFFFF
+            assert zlib.crc32(bytes(hurt.data)) & 0xFFFFFFFF != hurt.crc
+    finally:
+        engine.stop()
+
+
+@pytest.mark.faults
+def test_iobatch_wire_pread_injection_still_fires(tmp_path):
+    """Chaos coverage survives batching: the historical
+    data_engine.pread site fires per request on the batch plane too
+    (same <map>/<reduce> key), so every existing schedule keeps
+    testing the wire serve path."""
+    data_len = 512 * 1024
+    path = _write(str(tmp_path), "f.mof", data_len)
+    with failpoints.scoped("data_engine.pread=error:every:3"):
+        failpoints.disarm("data_engine.preadv")
+        results = _wire_burst(path, data_len, "on", n=12)
+    errors = [r for r in results if isinstance(r, Exception)]
+    ok = [r for r in results if not isinstance(r, Exception)]
+    assert errors, "every:3 schedule never fired through the batch path"
+    assert ok, "injection must not take down the whole batch"
+    assert metrics.get("io.batch.requests") > 0
+    assert metrics.get_gauge("io.batch.inflight") == 0
+
+
+@pytest.mark.faults
+def test_iobatch_preadv_delay_keeps_books_balanced(tmp_path):
+    """A delay storm on the batch plane (the chaos rung's other
+    action) must finish with zero in-flight obligations."""
+    data_len = 256 * 1024
+    path = _write(str(tmp_path), "f.mof", data_len)
+    engine = DataEngine(SyntheticResolver(path, data_len), Config())
+    try:
+        with failpoints.scoped("data_engine.preadv=delay:5:prob:0.5:"
+                               "seed:7"):
+            failpoints.disarm("data_engine.pread")
+            futs = engine.submit_batch(
+                [ShuffleRequest(JOB, MAP, 0, i * 8192, 8192)
+                 for i in range(16)])
+            for fut in futs:
+                fut.result(timeout=30)
+    finally:
+        engine.stop()
+    assert metrics.get_gauge("io.batch.inflight") == 0
+    assert metrics.get_gauge("supplier.reads.on_air") == 0

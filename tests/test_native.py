@@ -101,6 +101,46 @@ def test_read_pool(tmp_path):
         os.close(fd)
 
 
+def test_read_pool_submit_batch(tmp_path):
+    """The C15 batch-submission half: N jobs in ONE native call, tags
+    in job order, completions via the same get_events surface — with
+    per-tag isolation (an EOF-shortened read hurts only its own tag)."""
+    data = np.random.default_rng(1).bytes(1 << 19)
+    path = str(tmp_path / "blob")
+    with open(path, "wb") as f:
+        f.write(data)
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        with native.ReadPool(threads=2) as pool:
+            assert pool.backend() in ("io_uring", "pool")
+            jobs = [(fd, 0, 4096), (fd, 4096, 4096),
+                    (fd, (1 << 19) - 100, 4096),  # EOF-clamped
+                    (fd, 1 << 18, 8192)]
+            tags = pool.submit_batch(jobs)
+            assert len(tags) == len(jobs)
+            got = {}
+            while len(got) < len(jobs):
+                for tag, buf in pool.poll(min_events=1, timeout=5.0):
+                    got[tag] = buf
+            assert bytes(got[tags[0]]) == data[:4096]
+            assert bytes(got[tags[1]]) == data[4096:8192]
+            assert bytes(got[tags[2]]) == data[-100:]
+            assert bytes(got[tags[3]]) == data[1 << 18:(1 << 18) + 8192]
+            assert pool.submit_batch([]) == []
+    finally:
+        os.close(fd)
+
+
+def test_read_pool_backend_on_this_host():
+    """The ladder's runtime half: a 4.4-class kernel must land on the
+    worker pool even though the io_uring backend may be compiled in;
+    a newer kernel may legitimately report io_uring — both are valid
+    rungs of the same ABI."""
+    with native.ReadPool(threads=1) as pool:
+        b = pool.backend()
+        assert b in ("io_uring", "pool")
+
+
 def test_use_native_flag_gates_codec(tmp_path):
     # regression: uda.tpu.use.native=false must disable the native codec
     # dispatch in ifile, not only the DataEngine reader

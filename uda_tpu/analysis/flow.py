@@ -153,6 +153,10 @@ DEFAULT_PAIRS: Tuple[ObligationPair, ...] = (
         "gauge.read.bytes", kind="gauge", gauge="supplier.read.bytes.on_air",
         description="admitted supplier read bytes"),
     ObligationPair(
+        "gauge.io.batch", kind="gauge", gauge="io.batch.inflight",
+        description="requests inside the batched read plane "
+                    "(mofserver/data_engine.py submit_batch)"),
+    ObligationPair(
         "ctx.failpoints.scoped", kind="context", acquire=("scoped",),
         recv=r".*failpoints.*", transfer=("enter_context",),
         description="scoped failpoint arming must be entered "

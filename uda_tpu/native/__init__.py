@@ -80,6 +80,13 @@ def _bind(lib):
     lib.uda_pool_get_events.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), i64p,
         ctypes.c_int, ctypes.c_int, ctypes.c_double]
+    lib.uda_pool_backend.restype = ctypes.c_int
+    lib.uda_pool_backend.argtypes = [ctypes.c_void_p]
+    lib.uda_pool_submit_batch.restype = ctypes.c_int
+    lib.uda_pool_submit_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        i64p, i64p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64)]
     lib.uda_write_records.restype = ctypes.c_int64
     lib.uda_write_records.argtypes = [u8p, i64p, i64p, i64p, i64p,
                                       ctypes.c_int64, u8p,
@@ -444,6 +451,16 @@ class ReadPool:
         self._next_tag = 0
         self._pending: dict[int, tuple[np.ndarray, object]] = {}
 
+    def backend(self) -> str:
+        """Which PARITY C15 rung this pool runs: "io_uring" when the
+        ring backend was compiled in AND the running kernel accepted
+        io_uring_setup, else "pool" (pread worker threads)."""
+        if not self._pool:
+            return "pool"
+        return ("io_uring"
+                if self._lib.uda_pool_backend(self._pool) == 1
+                else "pool")
+
     def submit(self, fd: int, offset: int, length: int):
         """Returns a tag; the destination buffer is allocated here and
         returned by poll() with the completion."""
@@ -460,6 +477,36 @@ class ReadPool:
             raise StorageError("submit on stopped native pool")
         return tag
 
+    def submit_batch(self, jobs) -> list:
+        """Batched submission (the C15 submit_batch half): every
+        ``(fd, offset, length)`` job enters the native pool in ONE
+        call — one lock round / ring doorbell for the whole burst.
+        Returns the tags in job order; completions ride poll() like
+        single submits (per-tag isolation)."""
+        n = len(jobs)
+        if n == 0:
+            return []
+        bufs = [np.empty(length, np.uint8) for _, _, length in jobs]
+        fds = (ctypes.c_int32 * n)(*[fd for fd, _, _ in jobs])
+        offs = (ctypes.c_int64 * n)(*[off for _, off, _ in jobs])
+        lens = (ctypes.c_int64 * n)(*[length for _, _, length in jobs])
+        dsts = (ctypes.POINTER(ctypes.c_uint8) * n)(
+            *[_u8ptr(b) for b in bufs])
+        with self._lock:
+            tags = list(range(self._next_tag, self._next_tag + n))
+            self._next_tag += n
+            for tag, buf in zip(tags, bufs):
+                self._pending[tag] = (buf, None)
+        ctags = (ctypes.c_uint64 * n)(*tags)
+        rc = self._lib.uda_pool_submit_batch(self._pool, n, fds, offs,
+                                             lens, dsts, ctags)
+        if rc != 0:
+            with self._lock:
+                for tag in tags:
+                    self._pending.pop(tag, None)
+            raise StorageError("submit_batch on stopped native pool")
+        return tags
+
     def poll(self, min_events: int = 1, timeout: float = 5.0
              ) -> list[tuple[int, object]]:
         """Drain completions: [(tag, result)] where result is the data
@@ -475,7 +522,13 @@ class ReadPool:
             tag = int(tags[i])
             res = int(results[i])
             with self._lock:
-                buf, _ = self._pending.pop(tag)
+                ent = self._pending.pop(tag, None)
+            if ent is None:
+                # duplicate/stale completion (a tag already settled by
+                # an error path): dropping it beats killing the router
+                # thread that every native read in the process shares
+                continue
+            buf, _ = ent
             if res < 0:
                 out.append((tag, StorageError(
                     f"native read failed: errno {-res}")))

@@ -43,6 +43,17 @@ queue head); credit settlement is marshalled back to the loop OFF the
 data path. On this box that removes two thread handoffs per chunk from
 the serve critical path.
 
+**Batched byte-path serves** (``uda.tpu.read.batch``, the other half
+of the RDMAbox lesson): requests that will take the engine's byte path
+(zerocopy off, CRC stamping on, pread failpoint armed) accumulate per
+connection during one recv's frame burst / one credit-unpark sweep and
+flush as ONE ``DataEngine.submit_batch`` — per-fd grouping, range
+coalescing and vectored reads turn a burst against a hot MOF into
+O(files) syscalls with one pool handoff, while slice-eligible requests
+keep the zero-copy plane untouched. ``off`` reproduces the
+one-handoff-one-pread-per-chunk path exactly (the io_bench identity
+oracle).
+
 Failpoints (same sites, same frequencies as the threaded core):
 ``net.accept`` per accepted connection, ``net.frame`` per outbound
 response frame — applied to the frame head; a truncated head is a torn
@@ -272,6 +283,12 @@ class _EvConn:
         self._parked: "deque" = deque()  # decoded reqs waiting for credit
         self._credits = server.credit
         self._unparking = False
+        # batched byte-path serves (loop thread): requests that would
+        # take the engine's byte path accumulate here during one recv's
+        # frame burst / one unpark sweep and flush as ONE
+        # engine.submit_batch — one pool handoff for the burst
+        self._batch: list = []
+        self._batch_flushing = False
         self.inflight = 0
         self._read_paused = False
         self._mask = 0
@@ -342,6 +359,9 @@ class _EvConn:
             self._feed(self._rbuf[:n])
         except TransportError as e:
             self._drop(e)
+        # one recv's decoded burst -> one batch submission (requests
+        # parked for credit flush later, from the unpark sweep)
+        self._flush_batch()
 
     def _feed(self, mv) -> None:
         """Incremental frame reassembly over one recv's bytes; may park
@@ -478,6 +498,10 @@ class _EvConn:
                 self._update_interest()
         finally:
             self._unparking = False
+        # the unpark sweep's byte-path starts batch exactly like a
+        # recv burst's (nested settles returned at the guard above and
+        # never reach here — the OUTER settle flushes once)
+        self._flush_batch()
 
     def _settle_offloop(self, res, span) -> None:
         """Settle a completion that arrived for a dead connection (or
@@ -521,6 +545,17 @@ class _EvConn:
                     if plan is not None:
                         self._complete(req_id, plan, None, t0, span, req)
                         return
+                if self.server.batch_reads and not (
+                        self.server.zero_copy
+                        and self.server.engine.slice_eligible()):
+                    # the byte path will be taken (zerocopy off, CRC
+                    # stamping on, or the pread failpoint armed):
+                    # accumulate the burst and flush ONE submit_batch
+                    # (uda.tpu.read.batch; the RDMAbox lesson) instead
+                    # of one pool handoff per chunk
+                    self._batch.append((req_id, req, t0, span))
+                    return
+                if self.server.zero_copy:
                     fut = self.server.engine.submit_serve(req)
                 else:
                     fut = self.server.engine.submit(req)
@@ -530,6 +565,32 @@ class _EvConn:
             return
         fut.add_done_callback(
             lambda f: self._engine_done(req_id, f, t0, span, req))
+
+    def _flush_batch(self) -> None:
+        """Submit the accumulated byte-path burst (loop thread). The
+        loop is ITERATIVE like the unpark sweep: a synchronously-
+        failed batch (stopped engine) completes inline -> settle ->
+        unpark -> more entries may land in self._batch — the outer
+        while picks them up instead of recursing."""
+        if self._batch_flushing or self.closed or not self._batch:
+            return
+        self._batch_flushing = True
+        try:
+            while self._batch:
+                entries, self._batch = self._batch, []
+                bmax = self.server.batch_max
+                for i in range(0, len(entries), bmax):
+                    part = entries[i:i + bmax]
+                    futs = self.server.engine.submit_batch(
+                        [ent[1] for ent in part],
+                        parent_spans=[ent[3] for ent in part])
+                    for (req_id, req, t0, span), fut in zip(part, futs):
+                        fut.add_done_callback(
+                            lambda f, req_id=req_id, t0=t0, span=span,
+                            req=req:
+                            self._engine_done(req_id, f, t0, span, req))
+        finally:
+            self._batch_flushing = False
 
     def _engine_done(self, req_id: int, f, t0: float, span, req) -> None:
         """Engine worker thread (or the loop, when the future was
@@ -897,6 +958,13 @@ class _EvConn:
         for item in items:
             _release_item(item)
             self._settle(item.credited)
+        # batched-but-unflushed requests die with the connection: they
+        # were credited at _start, so settle them like torn responses
+        # (closed flag is set — _settle only rebalances the gauge)
+        batch, self._batch = self._batch, []
+        for (_req_id, _req, _t0, span) in batch:
+            span.end(error="closed")
+            self._settle(True)
         self._parked.clear()
         self.server._forget(self)
         metrics.gauge_add("net.server.connections", -1)
@@ -928,6 +996,11 @@ class EvLoopShuffleServer:
         else:  # auto: probe once per process
             self.zc_mode = _pick_zerocopy_mode()
         self._sendfile_refused = False
+        # batched byte-path serves (uda.tpu.read.batch; the engine owns
+        # the knob/tuning-cache resolution — getattr keeps stub engines
+        # in tests working)
+        self.batch_reads = bool(getattr(engine, "batch_enabled", False))
+        self.batch_max = int(getattr(engine, "batch_max", 256))
         self._listener: Optional[socket.socket] = None
         self._loop: Optional[EventLoop] = None
         self._conns: set = set()

@@ -142,25 +142,56 @@ def resolve_sort_path(path: str, lanes_ok: bool = False) -> str:
     return path
 
 
+def _cached_engine(n_rows: int, lanes_ok: bool) -> "str | None":
+    """The tuning-cache consult for "auto" routing (utils/tuncache.py):
+    a fly-off winner persisted per (backend, row-bucket, lanes
+    capability) by scripts/tune_probe.py. Returns None — today's
+    built-in default — on a cold cache, an unreadable file, or a
+    winner this caller cannot run (validation here, so a stale or
+    hand-edited cache can never force an invalid engine name onto a
+    production sort surface). Precedence is env > cache > built-in:
+    callers consult this only when UDA_TPU_SORT_PATH is unset."""
+    from uda_tpu.utils.tuncache import rows_bucket, tune_cache
+
+    backend = jax.default_backend()
+    key = f"{backend}|rows{rows_bucket(n_rows)}|lanes{int(lanes_ok)}"
+    rec = tune_cache.lookup("sort.engine", key)
+    if rec is None:
+        return None
+    engine = (rec.get("winner") or {}).get("engine")
+    valid = (ALL_SORT_PATHS if lanes_ok
+             else tuple(p for p in ALL_SORT_PATHS
+                        if p not in LANES_ENGINES))
+    if engine not in valid:
+        return None
+    return engine
+
+
 def route_engine(n_rows: int, path: str = "auto",
                  lanes_ok: bool = False) -> str:
     """Batch-size-aware engine routing: resolve ``path`` like
-    :func:`resolve_sort_path`, then — for "auto" only — steer batches
-    below :data:`SMALL_BATCH_ROWS` away from :data:`GATHER_BOUND_ENGINES`
-    onto "carrychunk" on TPU (its permutation apply rides small sort
-    networks, no global gather — the only engine shape that holds up in
-    the latency-bound take-ramp regime). The steering matters once a
-    gather-bound fly-off winner (keys8f/gather2/...) deploys as the
-    auto default via ``UDA_TPU_SORT_PATH`` — the built-in defaults are
-    never gather-bound, so without a deploy the route equals
-    :func:`resolve_sort_path`. An EXPLICIT path is always honored:
-    routing refines the default, it never overrides the operator.
-    This is the resolution entry for the production sort surfaces
-    (models.terasort.single_chip_sort, parallel.distributed).
-    Resolution is eager, never inside a jitted trace."""
+    :func:`resolve_sort_path` — consulting the persisted tuning cache
+    for "auto" when no env winner is deployed (env > cache > built-in;
+    a cold cache is byte-for-byte today's defaults) — then, for "auto"
+    only, steer batches below :data:`SMALL_BATCH_ROWS` away from
+    :data:`GATHER_BOUND_ENGINES` onto "carrychunk" on TPU (its
+    permutation apply rides small sort networks, no global gather —
+    the only engine shape that holds up in the latency-bound take-ramp
+    regime). The steering applies to deployed AND cached winners
+    alike: a gather-bound fly-off champion (keys8f/gather2/...) must
+    not be routed into the regime the take-ramp datum says it loses.
+    An EXPLICIT path is always honored: routing refines the default,
+    it never overrides the operator. This is the resolution entry for
+    the production sort surfaces (models.terasort.single_chip_sort,
+    parallel.distributed). Resolution is eager, never inside a jitted
+    trace."""
     if path != "auto":
         return resolve_sort_path(path, lanes_ok)
     resolved = resolve_sort_path("auto", lanes_ok)
+    if not DEPLOYED_SORT_PATH:
+        cached = _cached_engine(n_rows, lanes_ok)
+        if cached is not None:
+            resolved = cached
     if (n_rows < SMALL_BATCH_ROWS and jax.default_backend() == "tpu"
             and resolved in GATHER_BOUND_ENGINES):
         return "carrychunk"

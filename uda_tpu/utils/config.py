@@ -255,6 +255,55 @@ _FLAG_LIST = [
          "mapping — faster on kernels that emulate sendfile, e.g. "
          "sandboxed runtimes), or 'auto' (one-time per-process probe "
          "picks the faster; sendfile wins ties)"),
+    # --- batched host-I/O plane (mofserver/data_engine.py) --------------
+    Flag("uda.tpu.read.batch", "auto", str,
+         "batched supplier reads: 'on'/'auto' = the event-loop serve "
+         "path feeds byte-path request bursts to DataEngine."
+         "submit_batch (per-fd grouping, range coalescing, one vectored "
+         "read + one completion dispatch per batch — the RDMAbox "
+         "batched-submission lesson); 'off' = today's one-pool-handoff-"
+         "one-pread-per-chunk path, kept as the byte-identity "
+         "correctness oracle (scripts/io_bench.py A/Bs the two). "
+         "'auto' additionally lets the tuning cache "
+         "(uda.tpu.tune.cache.path) refine the batch parameters"),
+    Flag("uda.tpu.read.coalesce.gap.kb", 64, int,
+         "coalescing gap threshold in KB: two queued reads of the same "
+         "MOF whose ranges are closer than this merge into ONE "
+         "vectored read (the gap bytes are read into scratch and "
+         "discarded — a small waste that buys a syscall; "
+         "io.coalesce.gap.bytes counts the waste). 0 = only strictly "
+         "adjacent ranges coalesce"),
+    Flag("uda.tpu.read.batch.max", 256, int,
+         "max requests per submitted batch (the server flushes a "
+         "burst at this bound); also caps one coalesced run at "
+         "max*64 KB so scratch buffers stay bounded"),
+    Flag("uda.tpu.read.backend", "auto", str,
+         "batch read mechanism: 'io_uring' (native reader pool with "
+         "the kernel ring, when compiled in AND the running kernel "
+         "supports it), 'preadv' (one os.preadv per coalesced run), "
+         "'pread' (per-request os.pread on the batch worker — still "
+         "one pool handoff per batch). 'auto' walks that ladder "
+         "downward; the selected rung is recorded as the io.backend "
+         "metric label"),
+    # --- online tuning cache (utils/tuncache.py) ------------------------
+    Flag("uda.tpu.tune.cache.path", "", str,
+         "persisted per-(key-shape, platform, backend) fly-off winner "
+         "table (JSON) consulted by ops.sort.route_engine and the "
+         "batched-I/O plane's parameters; populated by "
+         "scripts/tune_probe.py. Corrupt/truncated/version-bumped "
+         "files are ignored (tune.cache.invalid), never fatal; "
+         "env-var winners (UDA_TPU_SORT_PATH) still override the "
+         "cache. Setting this explicitly also installs the path as "
+         "the PROCESS-default cache (tuncache.set_default_cache) so "
+         "config-less consumers like route_engine consult the same "
+         "table — unless UDA_TPU_TUNE_CACHE is set, which always "
+         "wins. empty = UDA_TPU_TUNE_CACHE env, else no cache "
+         "(today's built-in defaults)"),
+    Flag("uda.tpu.tune.reprobe.s", 0.0, float,
+         "tuning-cache staleness horizon in seconds: an entry older "
+         "than this is re-measured by the background re-probe rung "
+         "(tune_probe.py --reprobe-age, or a registered in-process "
+         "probe via tuncache.ensure_fresh). 0 = winners never expire"),
     # --- memory admission / pressure-response knobs (utils/budget.py) ---
     Flag("uda.tpu.hbm.budget.mb", 0, int,
          "per-chip HBM budget for the device row matrix + merge working "

@@ -74,6 +74,7 @@ SPAN_BUCKETS: Dict[str, str] = {
     "rpq_phase": "merge",
     # serve: supplier-side reads + emission to the consumer
     "net.serve": "serve", "engine.pread": "serve",
+    "engine.read_batch": "serve",
     "supplier_read": "serve", "emit": "serve",
 }
 

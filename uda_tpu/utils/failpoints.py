@@ -48,7 +48,10 @@ connection: delay = slow accept, error = dropped at birth) and
 ``coding.decode`` (the Reed-Solomon reconstruction of one partition,
 keyed ``<map>/<reduce>``) and ``net.handoff`` (the warm-restart
 handoff record, keyed ``load``/``save`` — an injected save fault
-degrades the next start to cold, never breaks the stop).
+degrades the next start to cold, never breaks the stop). The batched
+host-I/O plane adds ``data_engine.preadv`` (per-request bytes after a
+coalesced vectored read, keyed ``<fd>@<file offset>`` — damages one
+request of a batch, never its batch-mates).
 """
 
 from __future__ import annotations
@@ -102,6 +105,12 @@ _SITE_ERRORS = {
     # "<map>@<offset>"): a corrupt/injected block must abort the fetch
     # cleanly — the stage pool drains, no in-flight budget bytes leak
     "decompress.block": CompressionError,
+    # the batched host-I/O plane's per-request site (keyed "<fd>@<file
+    # offset>"): fires on each request's bytes AFTER the coalesced
+    # vectored read, so an injected error/truncate/corrupt damages
+    # exactly ONE request of a batch — its batch-mates must complete
+    # byte-correct (the batch-partial-failure chaos rung)
+    "data_engine.preadv": StorageError,
 }
 
 # The registered-site inventory. udalint's UDA003 rule checks every

@@ -263,6 +263,54 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                    "written (FallbackSignal, stall, "
                                    "resledger leak — "
                                    "utils/flightrec.py)"),
+    # -- counters: batched host-I/O plane (mofserver/data_engine.py) -----
+    "io.batch.submits": ("counter", "request batches handed to the "
+                                    "DataEngine batch worker (one pool "
+                                    "handoff each, however many chunks "
+                                    "ride it)"),
+    "io.batch.requests": ("counter", "ShuffleRequests served through "
+                                     "the batched read plane"),
+    "io.batch.reads": ("counter", "kernel read operations the batch "
+                                  "plane issued (coalesced vectored "
+                                  "reads / native batch submits) — "
+                                  "the O(files)-not-O(chunks) figure "
+                                  "[labels: backend]"),
+    "io.coalesce.runs": ("counter", "coalesced runs built from "
+                                    "adjacent/near-adjacent request "
+                                    "ranges (each is one vectored "
+                                    "read)"),
+    "io.coalesce.gap.bytes": ("counter", "gap bytes read into scratch "
+                                         "and discarded to merge "
+                                         "near-adjacent ranges "
+                                         "(uda.tpu.read.coalesce."
+                                         "gap.kb)"),
+    "io.backend": ("counter", "batch-read backend rung selected at "
+                              "engine construction (the io_uring -> "
+                              "preadv -> pread fallback ladder) "
+                              "[labels: backend]"),
+    "io.native.unavailable": ("counter", "DataEngine constructions "
+                                         "that wanted the native "
+                                         "reader but fell back to "
+                                         "os.pread (warned once per "
+                                         "process, counted every "
+                                         "time)"),
+    # -- counters: online tuning cache (utils/tuncache.py) ---------------
+    "tune.cache.hits": ("counter", "routing decisions served from a "
+                                   "persisted fly-off winner "
+                                   "[labels: domain]"),
+    "tune.cache.misses": ("counter", "routing decisions that found no "
+                                     "cached winner (built-in "
+                                     "defaults used) [labels: domain]"),
+    "tune.cache.invalid": ("counter", "tuning-cache files ignored as "
+                                      "corrupt/truncated/version-"
+                                      "bumped (never fatal)"),
+    "tune.cache.writes": ("counter", "winner records persisted to the "
+                                     "tuning cache"),
+    "tune.probes": ("counter", "fly-off probes executed "
+                               "(scripts/tune_probe.py) "
+                               "[labels: domain]"),
+    "tune.reprobes": ("counter", "stale winners re-measured by the "
+                                 "background re-probe rung"),
     # -- counters: time-accounting plane (profiler + critpath) -----------
     "profile.samples": ("counter", "sampling-profiler stack samples, "
                                    "attributed to the sampled thread's "
@@ -300,6 +348,11 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                       "staging-pipeline admission "
                                       "level; bounded by "
                                       "uda.tpu.stage.inflight.mb)"),
+    "io.batch.inflight": ("gauge", "requests inside the batched read "
+                                   "plane (submitted to a batch "
+                                   "worker, future not yet resolved); "
+                                   "paired — every +1 must meet its "
+                                   "-1 at settlement"),
     "profile.hz": ("gauge", "sampling-profiler rate currently armed "
                             "(0 = off; set absolutely at start/stop, "
                             "deliberately NOT a paired gauge — the "
@@ -363,6 +416,12 @@ SPAN_REGISTRY: Dict[str, str] = {
     "engine.pread": "one DataEngine chunk read/plan, child of the "
                     "serve (or local fetch) span "
                     "(mofserver/data_engine.py)",
+    "engine.read_batch": "one batched read submission: per-fd "
+                         "grouping + coalescing + vectored reads for "
+                         "a whole request burst on one pool worker "
+                         "(mofserver/data_engine.py submit_batch); "
+                         "per-request engine.pread children adopt "
+                         "each request's own serve span",
     "merge.wait": "the overlap merge consumer blocked waiting for the "
                   "next staged run (merger/overlap.py); the span twin "
                   "of the merge.wait_ms histogram — critpath's 'wait' "

@@ -80,6 +80,7 @@ PAIRED_GAUGES: Dict[str, str] = {
     "arena.slots_in_use": "gauge.arena.slots",
     "supplier.reads.on_air": "gauge.reads.on_air",
     "supplier.read.bytes.on_air": "gauge.read.bytes",
+    "io.batch.inflight": "gauge.io.batch",
 }
 
 
