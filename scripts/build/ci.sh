@@ -94,6 +94,17 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python scripts/io_bench.py --quick --out "$ART/bench_io.json" \
   2>&1 | tee -a "$ART/ci.log" | tail -3
 
+# Multi-tenant fairness bench, quick mode: T concurrent jobs through
+# one daemon — the byte-identity gate (every job's concurrent fetch ==
+# its solo run; exit 3 on divergence) plus the WDRR plumbing end to
+# end; fairness/weighted ratios are recorded as perfwatch trend data
+# (full runs ride BENCH_TENANT_r*.json and gate the >= 0.7 fairness +
+# ~2:1 weighting bands there).
+echo "-- multi-tenant fairness bench (quick)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/tenant_bench.py --quick \
+  --out "$ART/bench_tenant.json" 2>&1 | tee -a "$ART/ci.log" | tail -4
+
 # Tuning-cache round trip: a quick io.read fly-off probe must persist
 # a winner, and a SECOND probe run must serve from the cache without
 # re-measuring (tune_probe prints "0 probe(s)" — the self-service
@@ -147,6 +158,8 @@ echo "-- perfwatch perf-regression gate" | tee -a "$ART/ci.log"
 python scripts/perfwatch.py --check "$ART/bench_pipeline.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 python scripts/perfwatch.py --check "$ART/bench_io.json" \
+  --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
+python scripts/perfwatch.py --check "$ART/bench_tenant.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 
 # CPU-only gates run with the accelerator-pool env stripped: the pool's

@@ -163,6 +163,33 @@ def _extract_io(run: str, data: Dict, out: List[Dict]) -> None:
                  rec["io_batch_reads"], "info" if quick else "down")
 
 
+def _extract_tenant(run: str, data: Dict, out: List[Dict]) -> None:
+    """scripts/tenant_bench.py output: many-job fairness. Identity is
+    the hard gate (tol 0); the fairness/weighted ratios gate full runs
+    (direction-of-change) and trend quick runs — scheduler fairness is
+    remarkably stable, but CI hosts still only gate direction."""
+    quick = bool(data.get("quick"))
+    w = "tenant_fairness_quick" if quick else "tenant_fairness"
+    ident = data.get("identity") or {}
+    if "concurrent_equals_solo" in ident:
+        _add(out, run, w, "identity_concurrent_equals_solo",
+             1.0 if ident["concurrent_equals_solo"] else 0.0, "up",
+             tol=0.0)
+    eq = data.get("equal_weight") or {}
+    if "fairness_ratio" in eq:
+        _add(out, run, w, "fairness_ratio", eq["fairness_ratio"],
+             "info" if quick else "up")
+        vals = list((eq.get("goodput_mb_s") or {}).values())
+        if vals:
+            _add(out, run, w, "aggregate_goodput_mb_s",
+                 round(sum(vals), 3), "info" if quick else "up")
+    wt = data.get("weighted") or {}
+    if "weighted_ratio" in wt:
+        _add(out, run, w, "weighted_ratio", wt["weighted_ratio"],
+             "info")  # a band, not a direction: perfwatch trends it,
+        # the bench itself gates the [1.4, 3.0] band on full runs
+
+
 def _extract_regression(run: str, data: Dict, out: List[Dict]) -> None:
     w = f"regression_{data.get('size', 'unknown')}"
     for rec in data.get("results", []):
@@ -230,6 +257,8 @@ def extract(run: str, data) -> List[Dict]:
         _extract_net(run, data, out)
     elif data.get("bench") == "io_serve":
         _extract_io(run, data, out)
+    elif data.get("bench") == "tenant_fairness":
+        _extract_tenant(run, data, out)
     elif "identity" in data and "speedup_sorted" in data:
         _extract_pipeline(run, data, out)
     elif isinstance(data.get("results"), list):

@@ -29,7 +29,7 @@ COUNTERS="$(mktemp)"
 # its post-mortem defeats the recorder's purpose).
 FRROOT="$(mktemp -d)"
 export FRROOT  # the telemetry merge below reads the dumps from it
-for r in main pressure network exchange completion pipeline iobatch lockdep; do
+for r in main pressure network exchange completion pipeline iobatch tenant lockdep; do
   mkdir -p "${FRROOT}/${r}"
 done
 trap 'rm -f "${COUNTERS}"; rm -rf "${FRROOT}"' EXIT
@@ -178,6 +178,34 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${IOSPEC}" UDA_TPU_STATS=1 \
     -k "iobatch" \
     --continue-on-collection-errors "$@" || iorc=$?
 
+# Multi-tenant rung: the abusive-tenant isolation contract (ISSUE 14)
+# under ambient chaos. The faults-marked tenant test arms its OWN
+# scoped schedule (tenant.validate errors matched to ONE tenant's key
+# — the abusive job), so every abuser request draws a typed
+# TenantError while the victim tenant's job must complete BYTE-CORRECT
+# with zero fallbacks; this rung layers a seeded supplier-delay storm
+# on top (reads hold admission bytes longer — per-tenant shares stay
+# honest under pressure) and runs it all with lockdep + the resource
+# ledger armed: the new lock classes (tenant.registry) and the
+# per-tenant admission books (tenant.admit / the paired
+# tenant.read.bytes.on_air gauge) must end with zero cycles and zero
+# leaked obligations.
+TSPEC="data_engine.pread=delay:$((SEED % 10 + 2)):prob:0.2:seed:${SEED}"
+TENCOUNTERS="$(mktemp)"
+TENCYCLES="$(mktemp)"
+TENLEAKS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}"; rm -rf "${FRROOT}"' EXIT
+echo "tenant schedule:     ${TSPEC} + scoped tenant.validate abuse (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
+tenrc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${TSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/tenant" \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${TENCYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${TENLEAKS}" \
+    UDA_TPU_CHAOS_TELEMETRY="${TENCOUNTERS}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    -k "tenant" \
+    --continue-on-collection-errors "$@" || tenrc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -188,7 +216,7 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${IOSPEC}" UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -208,7 +236,9 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${LCOUNTERS}" "${lrc}" "${LCYCLES}" \
     "${NLEAKS}" "${CLEAKS}" "${PILEAKS}" \
     "${IOSPEC}" "${IOCOUNTERS}" "${iorc}" "${IOCYCLES}" \
-    "${IOLEAKS}" <<'EOF' || mrc=$?
+    "${IOLEAKS}" \
+    "${TSPEC}" "${TENCOUNTERS}" "${tenrc}" "${TENCYCLES}" \
+    "${TENLEAKS}" <<'EOF' || mrc=$?
 import glob, json, os, sys
 sys.path.insert(0, os.getcwd())
 from uda_tpu.utils.critpath import buckets_from_counters
@@ -219,7 +249,9 @@ from uda_tpu.utils.critpath import buckets_from_counters
  pipespec, picounters, pirc, picycles,
  lcounters, lrc, lcycles,
  nleaks_path, cleaks_path, pileaks_path,
- iospec, iocounters, iorc, iocycles, ioleaks_path) = sys.argv[1:34]
+ iospec, iocounters, iorc, iocycles, ioleaks_path,
+ tenspec, tencounters, tenrc, tencycles, tenleaks_path) = \
+    sys.argv[1:39]
 frroot = os.environ.get("FRROOT", "")
 def flightrec_block(rung, exit_code):
     """Archive the rung's black-box dumps (cause + structured extra +
@@ -304,6 +336,23 @@ pipeline, pi_reports = lockdep_block(pipespec, pirc, picounters,
 pi_leaks = resledger_block(pipeline, pileaks_path)
 iobatch, io_reports = lockdep_block(iospec, iorc, iocounters, iocycles)
 io_leaks = resledger_block(iobatch, ioleaks_path)
+tenant, ten_reports = lockdep_block(
+    f"{tenspec} + scoped tenant.validate abuse", tenrc, tencounters,
+    tencycles)
+ten_leaks = resledger_block(tenant, tenleaks_path)
+# the abusive-tenant isolation contract, surfaced: the abuser's
+# injected refusals, the penalty boxings, and the VICTIM guarantee —
+# zero fallbacks (its jobs completed, byte-correct per the test's own
+# asserts) and zero admission bytes left on any tenant's books
+tc = tenant["telemetry"].get("counters", {})
+tenant["isolated"] = {
+    "validate_failpoint_fires": tc.get("failpoint.tenant.validate", 0),
+    "tenant_penalties": tc.get("tenant.penalties", 0),
+    "sched_grants": tc.get("tenant.sched.grants", 0),
+    "victim_fallback_signals": tc.get("fallback.signals", 0),
+    "tenant_bytes_left": tenant["telemetry"].get(
+        "gauges", {}).get("tenant.read.bytes.on_air", 0),
+}
 # the batch-partial-failure contract, surfaced: requests batched,
 # coalesced runs/syscalls issued, injected per-request faults, and
 # zero bytes/pins left in flight (the per-test asserts enforce it;
@@ -331,7 +380,8 @@ pipeline["drained"] = {
         "gauges", {}).get("stage.inflight.bytes", 0),
 }
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
-nleak = len(n_leaks) + len(c_leaks) + len(pi_leaks) + len(io_leaks)
+nleak = (len(n_leaks) + len(c_leaks) + len(pi_leaks) + len(io_leaks)
+         + len(ten_leaks))
 # flight-recorder archive, one block per rung; a rung that failed
 # without a single black-box dump flags failed_without_dump
 fr = {"main": flightrec_block("main", rc),
@@ -341,12 +391,14 @@ fr = {"main": flightrec_block("main", rc),
       "completion": flightrec_block("completion", crc_),
       "pipeline": flightrec_block("pipeline", pirc),
       "iobatch": flightrec_block("iobatch", iorc),
+      "tenant": flightrec_block("tenant", tenrc),
       "lockdep": flightrec_block("lockdep", lrc)}
 network["flightrec"] = fr["network"]
 exchange["flightrec"] = fr["exchange"]
 completion["flightrec"] = fr["completion"]
 pipeline["flightrec"] = fr["pipeline"]
 iobatch["flightrec"] = fr["iobatch"]
+tenant["flightrec"] = fr["tenant"]
 lockdep["flightrec"] = fr["lockdep"]
 no_postmortem = sorted(r for r, b in fr.items()
                        if b["failed_without_dump"])
@@ -367,15 +419,18 @@ with open(out, "w") as f:
                "completion": completion,
                "pipeline": pipeline,
                "iobatch": iobatch,
+               "tenant": tenant,
                "lockdep": lockdep,
                "resledger": {"armed_rungs": ["network", "completion",
-                                             "pipeline", "iobatch"],
+                                             "pipeline", "iobatch",
+                                             "tenant"],
                              "leaks": nleak},
                "flightrec_missing_postmortem": no_postmortem},
               f, indent=1, sort_keys=True)
     f.write("\n")
 ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
-        + len(pi_reports) + len(io_reports) + len(l_reports))
+        + len(pi_reports) + len(io_reports) + len(ten_reports)
+        + len(l_reports))
 ndumps = sum(b["dumps"] for b in fr.values())
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc}, "
       f"resledger leaks: {nleak}, flightrec dumps: {ndumps})")
@@ -396,6 +451,7 @@ if [ "${erc}" -ne 0 ]; then rc="${erc}"; fi
 if [ "${crc}" -ne 0 ]; then rc="${crc}"; fi
 if [ "${pirc}" -ne 0 ]; then rc="${pirc}"; fi
 if [ "${iorc}" -ne 0 ]; then rc="${iorc}"; fi
+if [ "${tenrc}" -ne 0 ]; then rc="${tenrc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP/RESLEDGER/FLIGHTREC: cycle reports, leaked obligations" \

@@ -1,0 +1,628 @@
+"""The multi-tenant service plane (uda_tpu/tenant/ + the net/engine
+integration): registry lifecycle + epoch fencing, the weighted-fair
+CreditScheduler's DRR invariants, per-tenant admission isolation, the
+tenant-keyed warm-restart watermarks, and the two-tenant loopback e2e
+(byte parity against sequential single-tenant runs; the faults-marked
+abusive-tenant rung proves one tenant's injected faults never touch a
+victim's bytes)."""
+
+import threading
+import time
+
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.mofserver import (DataEngine, DirIndexResolver, FetchResult,
+                               ShuffleRequest)
+from uda_tpu.net import RemoteFetchClient, ShuffleServer, wire
+from uda_tpu.tenant import (DEFAULT_TENANT, CreditScheduler,
+                            TenantRegistry, sign_job)
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import StorageError, TenantError
+from uda_tpu.utils.failpoints import failpoints
+from uda_tpu.utils.ifile import crack
+from uda_tpu.utils.metrics import metrics
+
+
+# -- registry lifecycle ------------------------------------------------------
+
+def test_registry_register_heartbeat_retire_lifecycle():
+    reg = TenantRegistry()
+    rec = reg.register("acme", "job_1", epoch=1, weight=3)
+    assert rec.active and rec.epoch == 1 and rec.weight == 3
+    assert reg.weight_of("acme") == 3
+    # same-epoch re-register is a heartbeat (idempotent)
+    again = reg.register("acme", "job_1", epoch=1, weight=3)
+    assert again is rec
+    reg.validate("acme", "job_1", epoch=1)  # a validated REQ heartbeats
+    reg.retire("acme", "job_1", epoch=1)
+    with pytest.raises(TenantError, match="retired"):
+        reg.validate("acme", "job_1", epoch=1)
+    # a retired epoch cannot resume; a HIGHER epoch (restart) can
+    with pytest.raises(TenantError, match="retired"):
+        reg.register("acme", "job_1", epoch=1)
+    rec2 = reg.register("acme", "job_1", epoch=2)
+    assert rec2.active and rec2.epoch == 2
+
+
+def test_registry_epoch_fencing():
+    reg = TenantRegistry()
+    reg.register("t", "j", epoch=3)
+    # a stale-epoch registration is refused outright
+    with pytest.raises(TenantError, match="stale epoch"):
+        reg.register("t", "j", epoch=2)
+    # a higher epoch fences the old one: old validates fail typed, the
+    # new epoch serves
+    reg.register("t", "j", epoch=4)
+    with pytest.raises(TenantError, match="stale epoch"):
+        reg.validate("t", "j", epoch=3)
+    assert reg.validate("t", "j", epoch=4).epoch == 4
+    assert metrics.get("tenant.epoch.fenced") == 1
+
+
+def test_registry_unknown_job_and_auth():
+    reg = TenantRegistry(secret="s3cret")
+    with pytest.raises(TenantError, match="unknown job"):
+        reg.validate("t", "nope")
+    # wrong/missing token -> typed auth refusal
+    with pytest.raises(TenantError, match="authentication"):
+        reg.register("t", "j", epoch=1, token="bogus")
+    tok = sign_job("s3cret", "t", "j", 1)
+    assert reg.register("t", "j", epoch=1, token=tok).active
+    # the token binds the exact (tenant, job, epoch) triple
+    with pytest.raises(TenantError, match="authentication"):
+        reg.register("t", "j", epoch=2, token=tok)
+
+
+def test_registry_ttl_expires_idle_jobs(monkeypatch):
+    import uda_tpu.tenant.registry as regmod
+
+    now = [100.0]
+    monkeypatch.setattr(regmod.time, "monotonic", lambda: now[0])
+    reg = TenantRegistry(ttl_s=5.0)
+    reg.register("t", "j", epoch=1)
+    now[0] += 3.0
+    reg.validate("t", "j")          # activity refreshes the clock
+    now[0] += 4.0
+    reg.validate("t", "j")          # 4s idle < ttl: still there
+    now[0] += 6.0
+    with pytest.raises(TenantError, match="unknown job"):
+        reg.validate("t", "j")      # expired past the ttl
+
+
+def test_registry_share_bytes_partitions_by_weight():
+    reg = TenantRegistry()
+    reg.register("a", "ja", epoch=1, weight=2)
+    # a lone tenant owns the whole budget (partitions bind only under
+    # contention — the single-job deployment keeps PR 3's admission)
+    assert reg.share_bytes("a", 900) == 900
+    reg.register("b", "jb", epoch=1, weight=1)
+    assert reg.share_bytes("a", 900) == 600
+    assert reg.share_bytes("b", 900) == 300
+    # an unknown tenant is unconstrained by the partition layer (the
+    # global budget still bounds it)
+    assert reg.share_bytes("zz", 900) == 900
+
+
+# -- the weighted-fair scheduler ---------------------------------------------
+
+class _Conn:
+    """Stand-in for the parked item's connection slot."""
+
+
+def test_wdrr_weight_proportionality_and_deficit_bounds():
+    weights = {"a": 2, "b": 1, "c": 1}
+    sched = CreditScheduler(4, weight_of=lambda t: weights.get(t, 1))
+    conn = _Conn()
+    # saturate: 4 credits granted inline, the rest parks
+    order = [t for _ in range(40) for t in ("a", "b", "c")]
+    live, parked = [], 0
+    for i, t in enumerate(order):
+        if sched.admit(t, (conn, (t, i))):
+            live.append((t, i))
+        else:
+            parked += 1
+    assert parked == len(order) - 4
+    served = []  # parked entries in GRANT order (the fairness record)
+    while live:
+        t, _i = live.pop(0)
+        sched.release(t)
+        for _conn, entry in sched.grant_parked():
+            served.append(entry)
+            live.append(entry)
+    counts = {t: sum(1 for e in served if e[0] == t) for t in weights}
+    # every parked request was eventually served (no starvation)
+    assert sum(counts.values()) == parked
+    assert sched.backlog() == 0 and sched.free == sched.total
+    # weight proportionality over the contended window: a(2) is served
+    # ~2x b(1)/c(1) while every queue has backlog (a's queue drains
+    # first; the tail is b/c leftovers, so compare the first half)
+    window = served[: len(served) // 2]
+    wc = {t: sum(1 for e in window if e[0] == t) for t in weights}
+    assert wc["a"] > 1.5 * wc["b"]
+    assert 0.5 <= wc["b"] / max(1, wc["c"]) <= 2.0
+    # deficit bound: quantum x weight, never more
+    for t, tq in sched._tenants.items():
+        assert tq.deficit <= sched.quantum * weights[t] + 1e-9
+
+
+def test_wdrr_fifo_within_tenant_and_inline_grant():
+    sched = CreditScheduler(1)
+    conn = _Conn()
+    assert sched.admit("t", (conn, ("t", 0))) is True   # inline grant
+    assert sched.admit("t", (conn, ("t", 1))) is False  # parks
+    assert sched.admit("t", (conn, ("t", 2))) is False
+    sched.release("t")
+    granted = sched.grant_parked()
+    assert [e for _, e in granted] == [("t", 1)]        # FIFO
+    sched.release("t")
+    assert [e for _, e in sched.grant_parked()] == [("t", 2)]
+
+
+def test_penalty_box_deprioritizes_but_never_starves():
+    sched = CreditScheduler(1, penalty_threshold=2, penalty_ms=60_000)
+    conn = _Conn()
+    sched.admit("bad", (conn, ("bad", 0)))  # takes the only credit
+    sched.admit("bad", (conn, ("bad", 1)))
+    sched.admit("good", (conn, ("good", 0)))
+    sched.note_fault("bad")
+    sched.note_fault("bad")
+    assert sched.boxed("bad") and not sched.boxed("good")
+    sched.release("bad")
+    # the boxed tenant's parked entry yields to the unboxed neighbor
+    g1 = sched.grant_parked()
+    assert [e for _, e in g1] == [("good", 0)]
+    sched.release("good")
+    # no unboxed backlog left: the boxed tenant is served, not starved
+    g2 = sched.grant_parked()
+    assert [e for _, e in g2] == [("bad", 1)]
+    assert metrics.get("tenant.penalties", tenant="bad") == 1
+
+
+def test_drop_conn_removes_only_that_conns_parked_items():
+    sched = CreditScheduler(1)
+    c1, c2 = _Conn(), _Conn()
+    sched.admit("t", (c1, ("t", 0)))
+    sched.admit("t", (c1, ("t", 1)))
+    sched.admit("t", (c2, ("t", 2)))
+    assert sched.drop_conn(c1) == 1
+    sched.release("t")
+    assert [e for _, e in sched.grant_parked()] == [("t", 2)]
+
+
+# -- wire framing ------------------------------------------------------------
+
+def test_wire_job_roundtrip_and_strictness():
+    frame = wire.encode_job(7, "acme", "job_9", 3, weight=2,
+                            token="tok", retire=False)
+    msg_type, req_id, length = wire.decode_header(frame[:wire.HEADER.size])
+    assert (msg_type, req_id) == (wire.MSG_JOB, 7)
+    payload = frame[wire.HEADER.size:]
+    assert wire.decode_job(payload) == ("acme", "job_9", 3, 2, "tok",
+                                        False)
+    retire = wire.encode_job(8, "acme", "job_9", 3, retire=True)
+    assert wire.decode_job(retire[wire.HEADER.size:])[5] is True
+    from uda_tpu.utils.errors import TransportError
+    with pytest.raises(TransportError, match="trailing"):
+        wire.decode_job(payload + b"z")
+    ok = wire.encode_job_ok(7, 3)
+    assert wire.decode_job_ok(ok[wire.HEADER.size:]) == 3
+    with pytest.raises(TransportError, match="malformed"):
+        wire.decode_job_ok(b"\x00" * 3)
+
+
+# -- server integration ------------------------------------------------------
+
+JOB_A = "jobTenA"
+JOB_B = "jobTenB"
+
+TEN_CFG = {"uda.tpu.tenant.enable": True}
+
+
+def _tenant_cfg(tenant, **extra):
+    cfg = {"uda.tpu.tenant.id": tenant}
+    cfg.update(extra)
+    return Config(cfg)
+
+
+def _await(predicate, timeout=3.0):
+    """Wait for loop-marshalled settles to land (a client completion
+    can beat the server loop's credit-settle callback by a tick)."""
+    deadline = time.monotonic() + timeout
+    while not predicate() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert predicate()
+
+
+def _fetch_sync(client, req, timeout=10.0):
+    box, done = [], threading.Event()
+
+    def on_complete(res):
+        box.append(res)
+        done.set()
+
+    client.start_fetch(req, on_complete)
+    assert done.wait(timeout), "fetch never completed"
+    return box[0]
+
+
+def _fetch_job(client, job, num_maps, reduce_id=0):
+    """All of one reducer's records for a job over ``client``."""
+    got = []
+    for mid in map_ids(job, num_maps):
+        res = _fetch_sync(client, ShuffleRequest(job, mid, reduce_id, 0,
+                                                 1 << 20))
+        assert isinstance(res, FetchResult), f"fetch failed: {res!r}"
+        got += list(crack(res.data).iter_records())
+    return got
+
+
+@pytest.fixture
+def two_job_supplier(tmp_path):
+    """One daemon serving TWO jobs' MOF trees (the multi-tenant
+    shape) -> (expected_a, expected_b, server, engine)."""
+    expected_a = make_mof_tree(str(tmp_path), JOB_A, num_maps=3,
+                               num_reducers=1, records_per_map=40, seed=3)
+    expected_b = make_mof_tree(str(tmp_path), JOB_B, num_maps=3,
+                               num_reducers=1, records_per_map=40, seed=4)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(engine, Config(TEN_CFG), host="127.0.0.1",
+                           port=0).start()
+    yield expected_a, expected_b, server, engine
+    server.stop()
+    engine.stop()
+
+
+def test_hello_advertises_cap_tenant(two_job_supplier):
+    _, _, server, _ = two_job_supplier
+    client = RemoteFetchClient("127.0.0.1", server.port,
+                               _tenant_cfg("a"))
+    try:
+        client._ensure_connected()
+        assert client._hello_seen.wait(2.0)
+        with client._lock:
+            assert client._peer_caps & wire.CAP_TENANT
+    finally:
+        client.stop()
+
+
+def test_bind_then_fetch_and_epoch_fence_e2e(two_job_supplier):
+    expected_a, _, server, _ = two_job_supplier
+    old = RemoteFetchClient("127.0.0.1", server.port,
+                            _tenant_cfg("a", **{"uda.tpu.tenant.epoch": 1}))
+    new = RemoteFetchClient("127.0.0.1", server.port,
+                            _tenant_cfg("a", **{"uda.tpu.tenant.epoch": 2}))
+    try:
+        assert old.bind_job(JOB_A) == 1
+        got = _fetch_job(old, JOB_A, 3)
+        assert sorted(got) == sorted(expected_a[0])
+        # the restarted attempt registers epoch 2: the predecessor's
+        # NEXT fetch draws a typed TenantError (stale epoch) — it can
+        # never read its successor's chunks
+        assert new.bind_job(JOB_A) == 2
+        err = _fetch_sync(old, ShuffleRequest(JOB_A,
+                                              map_ids(JOB_A, 1)[0],
+                                              0, 0, 1 << 20))
+        assert isinstance(err, TenantError) and "stale epoch" in str(err)
+        # the successor serves
+        assert sorted(_fetch_job(new, JOB_A, 3)) == sorted(expected_a[0])
+        # a stale-epoch REGISTRATION is refused typed too
+        with pytest.raises(TenantError, match="stale epoch"):
+            old.bind_job(JOB_A)
+    finally:
+        old.stop()
+        new.stop()
+
+
+def test_retired_job_draws_typed_errors(two_job_supplier):
+    expected_a, _, server, _ = two_job_supplier
+    client = RemoteFetchClient("127.0.0.1", server.port,
+                               _tenant_cfg("a"))
+    try:
+        client.bind_job(JOB_A)
+        assert sorted(_fetch_job(client, JOB_A, 3)) == \
+            sorted(expected_a[0])
+        client.retire_job(JOB_A)
+        err = _fetch_sync(client, ShuffleRequest(
+            JOB_A, map_ids(JOB_A, 1)[0], 0, 0, 1 << 20))
+        assert isinstance(err, TenantError) and "retired" in str(err)
+    finally:
+        client.stop()
+
+
+def test_unbound_old_client_rides_default_tenant(two_job_supplier):
+    """Back-compat: a client with NO tenant configured never sends
+    MSG_JOB and serves exactly as before (the default tenant)."""
+    expected_a, _, server, _ = two_job_supplier
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    try:
+        assert sorted(_fetch_job(client, JOB_A, 3)) == \
+            sorted(expected_a[0])
+    finally:
+        client.stop()
+    assert metrics.get("tenant.sched.grants",
+                       tenant=DEFAULT_TENANT) >= 3
+
+
+def test_strict_mode_rejects_unregistered_jobs(tmp_path):
+    make_mof_tree(str(tmp_path), JOB_A, num_maps=1, num_reducers=1,
+                  records_per_map=10, seed=1)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(
+        engine, Config(dict(TEN_CFG, **{"uda.tpu.tenant.strict": True})),
+        host="127.0.0.1", port=0).start()
+    unbound = RemoteFetchClient("127.0.0.1", server.port, Config())
+    bound = RemoteFetchClient("127.0.0.1", server.port,
+                              _tenant_cfg("a"))
+    try:
+        err = _fetch_sync(unbound, ShuffleRequest(
+            JOB_A, map_ids(JOB_A, 1)[0], 0, 0, 1 << 20))
+        assert isinstance(err, TenantError) and "registration" in str(err)
+        # a registered job serves in strict mode
+        assert _fetch_job(bound, JOB_A, 1)
+    finally:
+        unbound.stop()
+        bound.stop()
+        server.stop()
+        engine.stop()
+
+
+def test_msg_job_auth_end_to_end(tmp_path):
+    make_mof_tree(str(tmp_path), JOB_A, num_maps=1, num_reducers=1,
+                  records_per_map=10, seed=1)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    server = ShuffleServer(
+        engine,
+        Config(dict(TEN_CFG, **{"uda.tpu.tenant.secret": "hunter2"})),
+        host="127.0.0.1", port=0).start()
+    bad = RemoteFetchClient("127.0.0.1", server.port,
+                            _tenant_cfg("a"))  # no secret
+    good = RemoteFetchClient(
+        "127.0.0.1", server.port,
+        _tenant_cfg("a", **{"uda.tpu.tenant.secret": "hunter2"}))
+    try:
+        with pytest.raises(TenantError, match="authentication"):
+            bad.bind_job(JOB_A)
+        # the refused binding FENCES the job's REQs on that connection
+        err = _fetch_sync(bad, ShuffleRequest(
+            JOB_A, map_ids(JOB_A, 1)[0], 0, 0, 1 << 20))
+        assert isinstance(err, TenantError) and "refused" in str(err)
+        good.bind_job(JOB_A)
+        assert _fetch_job(good, JOB_A, 1)
+    finally:
+        bad.stop()
+        good.stop()
+        server.stop()
+        engine.stop()
+
+
+def test_two_tenant_concurrent_e2e_byte_parity(two_job_supplier):
+    """THE multi-tenant acceptance shape in miniature: two tenants'
+    jobs fetch CONCURRENTLY through one daemon under a small shared
+    credit pool, and each job's bytes equal its sequential solo run."""
+    expected_a, expected_b, server, engine = two_job_supplier
+    # solo oracles first (sequential single-tenant runs)
+    solo_a = RemoteFetchClient("127.0.0.1", server.port,
+                               _tenant_cfg("a"))
+    solo_b = RemoteFetchClient("127.0.0.1", server.port,
+                               _tenant_cfg("b"))
+    try:
+        solo_a.bind_job(JOB_A)
+        oracle_a = _fetch_job(solo_a, JOB_A, 3)
+        solo_b.bind_job(JOB_B)
+        oracle_b = _fetch_job(solo_b, JOB_B, 3)
+    finally:
+        solo_a.stop()
+        solo_b.stop()
+    ca = RemoteFetchClient("127.0.0.1", server.port, _tenant_cfg("a"))
+    cb = RemoteFetchClient("127.0.0.1", server.port, _tenant_cfg("b"))
+    out = {}
+    errs = []
+
+    def run(tag, client, job):
+        try:
+            client.bind_job(job)
+            out[tag] = _fetch_job(client, job, 3)
+        except Exception as e:  # noqa: BLE001 - surfaced by the assert
+            errs.append((tag, e))
+
+    try:
+        ta = threading.Thread(target=run, args=("a", ca, JOB_A))
+        tb = threading.Thread(target=run, args=("b", cb, JOB_B))
+        ta.start()
+        tb.start()
+        ta.join(20)
+        tb.join(20)
+        assert not errs, errs
+        assert sorted(out["a"]) == sorted(oracle_a) == \
+            sorted(expected_a[0])
+        assert sorted(out["b"]) == sorted(oracle_b) == \
+            sorted(expected_b[0])
+    finally:
+        ca.stop()
+        cb.stop()
+    # both tenants drew scheduler grants; the pool settled back to full
+    assert metrics.get("tenant.sched.grants", tenant="a") >= 3
+    assert metrics.get("tenant.sched.grants", tenant="b") >= 3
+    _await(lambda: server._sched.free == server._sched.total)
+    _await(lambda:
+           metrics.get_gauge("tenant.read.bytes.on_air") == 0)
+
+
+def test_per_tenant_admission_isolation(two_job_supplier):
+    """One tenant over ITS read-budget share -> StorageError for that
+    tenant only; the neighbor's requests ride its own share."""
+    _, _, _, engine = two_job_supplier
+    reg = TenantRegistry()
+    reg.register("hog", "jh", epoch=1, weight=1)
+    reg.register("calm", "jc", epoch=1, weight=1)
+    engine.set_tenant_registry(reg)
+    # each tenant's share = half the budget; hog fills its share
+    share = reg.share_bytes("hog", engine.read_budget_bytes)
+    engine._admit_bytes(share, "hog")
+    with pytest.raises(StorageError, match="read share"):
+        engine._admit_bytes(1 << 20, "hog")
+    assert metrics.get("tenant.admission.rejections",
+                       tenant="hog") == 1
+    # the calm tenant admits fine inside its own share
+    engine._admit_bytes(1 << 20, "calm")
+    engine._unadmit(1 << 20, "calm")
+    engine._unadmit(share, "hog")
+    assert metrics.get_gauge("tenant.read.bytes.on_air") == 0
+
+
+def test_watermarks_keyed_by_tenant(tmp_path):
+    """The satellite regression: the served-offset watermark table is
+    keyed by (tenant, job, partition) — two tenants carrying the SAME
+    job/map/reduce ids get separate marks, so a warm bounce can never
+    resume one job's offsets into another's fetch ledger."""
+    expected = make_mof_tree(str(tmp_path), JOB_A, num_maps=1,
+                             num_reducers=1, records_per_map=20, seed=5)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    handoff = str(tmp_path / "handoff.json")
+    server = ShuffleServer(
+        engine,
+        Config(dict(TEN_CFG, **{"uda.tpu.net.handoff.path": handoff})),
+        host="127.0.0.1", port=0).start()
+    ca = RemoteFetchClient("127.0.0.1", server.port, _tenant_cfg("a"))
+    cb = RemoteFetchClient("127.0.0.1", server.port, _tenant_cfg("b"))
+    try:
+        ca.bind_job(JOB_A)
+        cb.bind_job(JOB_A)  # same job id, DIFFERENT tenant
+        assert sorted(_fetch_job(ca, JOB_A, 1)) == sorted(expected[0])
+        assert sorted(_fetch_job(cb, JOB_A, 1)) == sorted(expected[0])
+        mid = map_ids(JOB_A, 1)[0]
+        marks = dict(server._marks)
+        assert f"a|{JOB_A}|{mid}|0" in marks
+        assert f"b|{JOB_A}|{mid}|0" in marks
+    finally:
+        ca.stop()
+        cb.stop()
+        server.stop()
+        engine.stop()
+
+
+def test_tenancy_off_stamps_nothing(tmp_path):
+    """The off switch is the PR 4-13 data plane bit for bit: no
+    registry, no scheduler state, empty tenant stamps, unkeyed-by-
+    tenant watermarks."""
+    make_mof_tree(str(tmp_path), JOB_A, num_maps=1, num_reducers=1,
+                  records_per_map=10, seed=1)
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), Config())
+    handoff = str(tmp_path / "handoff.json")
+    server = ShuffleServer(
+        engine, Config({"uda.tpu.net.handoff.path": handoff}),
+        host="127.0.0.1", port=0).start()
+    client = RemoteFetchClient("127.0.0.1", server.port, Config())
+    try:
+        assert _fetch_job(client, JOB_A, 1)
+        mid = map_ids(JOB_A, 1)[0]
+        assert f"|{JOB_A}|{mid}|0" in server._marks  # empty tenant key
+        assert server.registry is None and server._sched is None
+        assert metrics.get("tenant.sched.grants") == 0
+    finally:
+        client.stop()
+        server.stop()
+        engine.stop()
+
+
+def test_introspection_carries_tenancy_block(two_job_supplier):
+    _, _, server, _ = two_job_supplier
+    client = RemoteFetchClient("127.0.0.1", server.port,
+                               _tenant_cfg("a"))
+    try:
+        client.bind_job(JOB_A)
+        snap = server._stats_snapshot()
+        assert snap["tenancy"]["scheduler"]["total"] == \
+            server._sched.total
+        jobs = snap["tenancy"]["registry"]["jobs"]
+        assert any(j["tenant"] == "a" and j["job"] == JOB_A
+                   for j in jobs)
+    finally:
+        client.stop()
+
+
+def test_fenced_epoch_is_terminal_through_merge_manager(two_job_supplier):
+    """The reduce-side contract end to end: a MergeManager whose
+    client binds a FENCED epoch fails into FallbackSignal without
+    burning the retry/backoff budget — TenantError is terminal in the
+    Segment state machine (a registry refusal cannot be retried into
+    legality)."""
+    from uda_tpu.merger import HostRoutingClient, MergeManager
+    from uda_tpu.utils.errors import FallbackSignal
+
+    expected_a, _, server, _ = two_job_supplier
+    # the successor attempt fences epoch 2 in
+    fencer = RemoteFetchClient("127.0.0.1", server.port,
+                               _tenant_cfg("a", **{
+                                   "uda.tpu.tenant.epoch": 2}))
+    cfg = _tenant_cfg("a", **{"uda.tpu.tenant.epoch": 1,
+                              "uda.tpu.fetch.retries": 5,
+                              "mapred.rdma.fetch.retry.backoff.ms": 500})
+    router = HostRoutingClient(config=cfg)
+    mm = MergeManager(router, "uda.tpu.RawBytes", cfg)
+    maps = [(f"127.0.0.1:{server.port}", m) for m in map_ids(JOB_A, 3)]
+    try:
+        fencer.bind_job(JOB_A)
+        t0 = time.monotonic()
+        with pytest.raises(FallbackSignal) as ei:
+            mm.run(JOB_A, maps, 0, lambda b: None)
+        # terminal, not retried: no retry counters, no 500 ms backoffs
+        assert isinstance(ei.value.cause, TenantError)
+        assert metrics.get("fetch.retries") == 0
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        router.stop()
+        mm.stop()
+        fencer.stop()
+
+
+# -- the abusive-tenant rung (chaos) -----------------------------------------
+
+@pytest.mark.faults
+def test_abusive_tenant_degrades_only_itself(two_job_supplier):
+    """The isolation contract under injected faults: tenant 'abuser'
+    is armed with tenant.validate errors (every REQ of its jobs draws
+    a typed TenantError) while tenant 'victim' runs the same daemon
+    concurrently — the victim's job completes byte-correct with zero
+    faults, and the abuser lands in the scheduler's penalty box."""
+    expected_a, expected_b, server, _ = two_job_supplier
+    abuser = RemoteFetchClient("127.0.0.1", server.port,
+                               _tenant_cfg("abuser"))
+    victim = RemoteFetchClient("127.0.0.1", server.port,
+                               _tenant_cfg("victim"))
+    with failpoints.scoped("tenant.validate=error:match:abuser"):
+        try:
+            abuser.bind_job(JOB_A)
+            victim.bind_job(JOB_B)
+            out = {}
+            errs = {}
+
+            def run_victim():
+                out["b"] = _fetch_job(victim, JOB_B, 3)
+
+            def run_abuser():
+                for mid in map_ids(JOB_A, 3):
+                    res = _fetch_sync(abuser, ShuffleRequest(
+                        JOB_A, mid, 0, 0, 1 << 20))
+                    errs.setdefault("a", []).append(res)
+
+            tv = threading.Thread(target=run_victim)
+            ta = threading.Thread(target=run_abuser)
+            tv.start()
+            ta.start()
+            tv.join(20)
+            ta.join(20)
+            # the abuser's every request failed typed
+            assert all(isinstance(r, TenantError) for r in errs["a"])
+            # the victim is byte-correct and untouched by the faults
+            assert sorted(out["b"]) == sorted(expected_b[0])
+        finally:
+            abuser.stop()
+            victim.stop()
+    # the repeated faults boxed the abuser (threshold default 4; three
+    # maps x validate fire once per REQ -> note_fault per error)
+    assert metrics.get("tenant.rejected") == 0  # failpoint, not registry
+    assert metrics.get("failpoint.tenant.validate") >= 3
+    # victim served zero errors and the credit pool drained clean
+    _await(lambda: server._sched.free == server._sched.total)

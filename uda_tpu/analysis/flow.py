@@ -157,6 +157,13 @@ DEFAULT_PAIRS: Tuple[ObligationPair, ...] = (
         description="requests inside the batched read plane "
                     "(mofserver/data_engine.py submit_batch)"),
     ObligationPair(
+        "gauge.tenant.read.bytes", kind="gauge",
+        gauge="tenant.read.bytes.on_air",
+        description="tenant-stamped supplier admission bytes (the "
+                    "per-tenant partition level; the tenant.admit "
+                    "attribution pair rides the same charge with "
+                    "key=tenant — mofserver/data_engine.py)"),
+    ObligationPair(
         "ctx.failpoints.scoped", kind="context", acquire=("scoped",),
         recv=r".*failpoints.*", transfer=("enter_context",),
         description="scoped failpoint arming must be entered "

@@ -137,6 +137,9 @@ class UdaBridge:
         # network data plane (uda.tpu.net.listen): the ShuffleServer
         # serving this role's engine to remote reduce clients
         self._net_server = None
+        # multi-tenant registry (uda.tpu.tenant.enable): one per
+        # bridge lifetime, shared across re-INITs
+        self._tenant_registry = None
         # observability
         self._stats: Optional[StatsReporter] = None
 
@@ -258,7 +261,18 @@ class UdaBridge:
         if not self.cfg.get("uda.tpu.net.listen"):
             return
         from uda_tpu.net import ShuffleServer
-        self._net_server = ShuffleServer(engine, self.cfg).start()
+        registry = None
+        if self.cfg.get("uda.tpu.tenant.enable"):
+            # the multi-tenant daemon shape (uda_tpu/tenant/): one
+            # registry per bridge lifetime — re-INITs on the same
+            # bridge keep serving the same tenant books
+            from uda_tpu.tenant import TenantRegistry
+            if self._tenant_registry is None:
+                self._tenant_registry = TenantRegistry.from_config(
+                    self.cfg)
+            registry = self._tenant_registry
+        self._net_server = ShuffleServer(engine, self.cfg,
+                                         registry=registry).start()
 
     def _stop_net_server(self) -> None:
         srv, self._net_server = self._net_server, None
@@ -331,13 +345,21 @@ class UdaBridge:
             else:
                 raise ProtocolError(
                     f"INIT needs >= 4 params, got {len(params)}")
+            # the reduce task's tenant identity (uda.tpu.tenant.id):
+            # RemoteFetchClients read their binding from the same cfg;
+            # this process-global install feeds the hot-path metric
+            # labels (fetch.bytes{tenant=}) and diagnostics
+            from uda_tpu.tenant import set_current_tenant
+            set_current_tenant(str(self.cfg.get("uda.tpu.tenant.id")))
             # INIT-time admission: the fetch-window + staging working
             # set must fit the host budget (the reducer.cc:56-133
-            # buffer validation, generalized). Over budget either
-            # shrinks the window in cfg with a warning (enforce=
-            # reroute) or raises -> the fallback contract (enforce=
-            # reject); an unfittable chunk always raises. Runs BEFORE
-            # the MergeManager reads the window.
+            # buffer validation, generalized; with a tenant budget
+            # share configured, the budgets are this job's PARTITION
+            # of the machine, not the whole machine). Over budget
+            # either shrinks the window in cfg with a warning
+            # (enforce=reroute) or raises -> the fallback contract
+            # (enforce=reject); an unfittable chunk always raises.
+            # Runs BEFORE the MergeManager reads the window.
             MemoryBudget.from_config(self.cfg).validate_init(self.cfg)
             client = self._make_client(local_dirs)
             # data plane (uda.tpu.net.listen): serve THIS host's map

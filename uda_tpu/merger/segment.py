@@ -25,8 +25,9 @@ import zlib
 from typing import Optional
 
 from uda_tpu.mofserver.data_engine import DataEngine, FetchResult, ShuffleRequest
-from uda_tpu.utils.errors import (MergeError, StorageError, TransportError,
-                                  attribute_supplier)
+from uda_tpu.tenant import current_tenant
+from uda_tpu.utils.errors import (MergeError, StorageError, TenantError,
+                                  TransportError, attribute_supplier)
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.ifile import RecordBatch, crack_partial
@@ -736,6 +737,16 @@ class Segment:
         """Iterative fetch state machine (one outstanding fetch at a
         time; runs on whichever thread delivered the completion)."""
         while result is not None:
+            if isinstance(result, TenantError):
+                # the service plane's refusal is TERMINAL: a fenced
+                # epoch / retired job / failed registration cannot be
+                # retried into legality — burning the retry+backoff
+                # budget against the registry would only delay the
+                # fallback (and churn the penalty box against a
+                # healthy supplier)
+                self._notify_fault(result)
+                self._finish(result)
+                return
             if isinstance(result, Exception):
                 # transport-level retry (the reference retries its
                 # connect dance 5x and RNR-retries sends,
@@ -910,8 +921,19 @@ class Segment:
                 self._carry = data[consumed:] if not last else b""
                 self._next_offset = res.offset + len(res.data)
             issue_t0 = self._issue_t0
-        metrics.add("fetch.bytes", len(res.data), supplier=self.supplier)
-        metrics.add("fetch.chunks", supplier=self.supplier)
+        tenant = current_tenant()
+        if tenant:
+            # tenanted reduce tasks label the hot-path fetch counters
+            # (one module-global read per chunk; untenanted jobs keep
+            # the exact two-series shape of PRs 2-13)
+            metrics.add("fetch.bytes", len(res.data),
+                        supplier=self.supplier, tenant=tenant)
+            metrics.add("fetch.chunks", supplier=self.supplier,
+                        tenant=tenant)
+        else:
+            metrics.add("fetch.bytes", len(res.data),
+                        supplier=self.supplier)
+            metrics.add("fetch.chunks", supplier=self.supplier)
         metrics.observe("fetch.latency_ms",
                         (time.perf_counter() - issue_t0) * 1e3,
                         supplier=self.supplier)

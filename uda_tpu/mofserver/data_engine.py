@@ -93,7 +93,16 @@ class ShuffleRequest:
 
     ``host`` identifies the supplier serving this map output (the
     reference addresses fetches per supplier host, RDMAClient.cc:
-    498-527); single-host transports ignore it."""
+    498-527); single-host transports ignore it.
+
+    ``tenant`` is the multi-tenant service plane's in-process stamp:
+    the ShuffleServer copies its connection's MSG_JOB binding here
+    before submitting, so the engine's per-tenant admission partitions
+    and metric labels key on it. It never rides the wire (the REQ
+    frame carries job identity; the TENANT identity is the
+    connection's authenticated binding — a client cannot spoof a
+    neighbor's tenant per request). Empty = untenanted (the
+    single-job default, exact PR 1-13 behavior)."""
 
     job_id: str
     map_id: str
@@ -101,6 +110,7 @@ class ShuffleRequest:
     offset: int          # offset within the partition's record bytes
     chunk_size: int
     host: str = ""
+    tenant: str = ""
 
 
 @dataclasses.dataclass
@@ -159,6 +169,7 @@ class FdSlice:
     _engine: "DataEngine" = dataclasses.field(repr=False, default=None)
     _admitted: int = 0
     _released: bool = False
+    _tenant: str = ""    # the admission charge's tenant partition
 
     def release(self) -> None:
         if self._released:
@@ -166,7 +177,7 @@ class FdSlice:
         self._released = True
         self._engine._fds.release(self.path)
         if self._admitted:
-            self._engine._unadmit(self._admitted)
+            self._engine._unadmit(self._admitted, self._tenant)
 
     def view(self):
         """A memoryview of the chunk inside the MOF's cached whole-file
@@ -507,6 +518,13 @@ class DataEngine:
             if (attempt_ms or deadline_ms) else 60.0)
         self._admitted_bytes = 0
         self._admit_lock = threading.Lock()
+        # multi-tenant read-budget partitions (uda_tpu/tenant/): when a
+        # TenantRegistry is attached, tenant-stamped requests are
+        # additionally admitted against that tenant's weighted SHARE of
+        # the budget — one abusive job exhausts its slice and only its
+        # own clients see the push-back (the isolation contract).
+        self._tenant_registry = None
+        self._tenant_admitted: Dict[str, int] = {}
         spec = cfg.get("uda.tpu.failpoints")
         if spec:
             failpoints.arm_spec(spec)
@@ -633,7 +651,7 @@ class DataEngine:
         if self._stopped:
             raise StorageError("DataEngine is stopped")
         want = req.chunk_size or self.chunk_size_default
-        self._admit_bytes(want)
+        self._admit_bytes(want, req.tenant)
         # the +1 rides the returned Future: _serve's finally owns the
         # -1 on every outcome; the except below covers the one path
         # where the pool never ran it
@@ -647,21 +665,46 @@ class DataEngine:
             return self._pool.submit(self._serve, req, want,
                                      metrics.current_span())
         except BaseException:  # pool shutdown race: undo the accounting
-            self._unadmit(want)
+            self._unadmit(want, req.tenant)
             metrics.gauge_add("supplier.reads.on_air", -1)
             raise
 
-    def _admit_bytes(self, want: int) -> None:
+    def set_tenant_registry(self, registry) -> None:
+        """Attach the multi-tenant registry: tenant-stamped requests
+        are admitted against per-tenant budget shares
+        (``registry.share_bytes``), and a retiring job's obligation
+        books are drained — any admission bytes it never released are
+        reported with the tenant as the leak's attribution."""
+        self._tenant_registry = registry
+        if registry is not None:
+            registry.on_retire(lambda tenant, job:
+                               self.drain_tenant(tenant))
+
+    def drain_tenant(self, tenant: str) -> None:
+        """ResourceLedger drain of one tenant's admission books (retire
+        hook). Only when the tenant is quiescent — bytes still in
+        flight are live obligations, not leaks; the engine-stop drain
+        owns the final sweep."""
+        with self._admit_lock:
+            quiescent = self._tenant_admitted.get(tenant, 0) <= 0
+        if quiescent:
+            resledger.drain(f"tenant.retire[{tenant}]",
+                            pairs=("tenant.admit",), owner=id(self))
+
+    def _admit_bytes(self, want: int, tenant: str = "") -> None:
         """THE read-budget admission gate (the occupy_chunk pool bound,
         IndexInfo.cc:276-292, minus the blocking): every serve path —
-        submit, submit_serve, try_plan — charges through here, and
-        every non-serving outcome must pair the charge with
+        submit, submit_serve, try_plan, submit_batch — charges through
+        here, and every non-serving outcome must pair the charge with
         :meth:`_unadmit` (budget-critical logic lives exactly once).
         Raises StorageError on rejection. An oversized single request
         is admitted when the pool is otherwise idle: progress beats the
         bound (a request larger than the whole budget could never be
         served at all, which would turn push-back into a permanent
-        dead end)."""
+        dead end) — and the idle escape is PER TENANT on the tenant
+        gate, so one tenant's giant request rides its own idle slice,
+        never a neighbor's headroom."""
+        reg = self._tenant_registry
         with self._admit_lock:
             if self._admitted_bytes > 0 and \
                     self._admitted_bytes + want > self.read_budget_bytes:
@@ -671,13 +714,46 @@ class DataEngine:
                     f" B in flight + {want} B > budget "
                     f"{self.read_budget_bytes} B (retry with backoff, or "
                     f"raise uda.tpu.supplier.read.budget.mb)")
+            if tenant and reg is not None:
+                mine = self._tenant_admitted.get(tenant, 0)
+                share = reg.share_bytes(tenant, self.read_budget_bytes)
+                if mine > 0 and mine + want > share:
+                    metrics.add("supplier.admission.rejections")
+                    metrics.add("tenant.admission.rejections",
+                                tenant=tenant)
+                    raise StorageError(
+                        f"tenant {tenant!r} read share exhausted: "
+                        f"{mine} B in flight + {want} B > share "
+                        f"{share} B of the supplier budget (this "
+                        f"tenant's clients pace; others are unaffected)")
             self._admitted_bytes += want
+            if tenant:
+                self._tenant_admitted[tenant] = \
+                    self._tenant_admitted.get(tenant, 0) + want
         metrics.gauge_add("supplier.read.bytes.on_air", want)
+        if tenant:
+            metrics.gauge_add("tenant.read.bytes.on_air", want)
+            metrics.gauge_add("tenant.read.bytes.on_air", want,
+                              tenant=tenant)
+            resledger.acquire("tenant.admit", key=tenant, amount=want,
+                              owner=id(self), detail=f"tenant={tenant}")
 
-    def _unadmit(self, want: int) -> None:
+    def _unadmit(self, want: int, tenant: str = "") -> None:
         with self._admit_lock:
             self._admitted_bytes -= want
+            if tenant:
+                left = self._tenant_admitted.get(tenant, 0) - want
+                if left > 0:
+                    self._tenant_admitted[tenant] = left
+                else:
+                    self._tenant_admitted.pop(tenant, None)
         metrics.gauge_add("supplier.read.bytes.on_air", -want)
+        if tenant:
+            metrics.gauge_add("tenant.read.bytes.on_air", -want)
+            metrics.gauge_add("tenant.read.bytes.on_air", -want,
+                              tenant=tenant)
+            resledger.settle("tenant.admit", key=tenant, amount=want,
+                             owner=id(self))
 
     def submit_serve(self, req: ShuffleRequest) -> Future:
         """Like :meth:`submit`, but the Future may resolve to an
@@ -694,14 +770,14 @@ class DataEngine:
         if self._stopped:
             raise StorageError("DataEngine is stopped")
         want = req.chunk_size or self.chunk_size_default
-        self._admit_bytes(want)
+        self._admit_bytes(want, req.tenant)
         # same handoff as submit(): _serve_plan's finally owns the -1
         metrics.gauge_add("supplier.reads.on_air", 1)  # udalint: disable=UDA101
         try:
             return self._pool.submit(self._serve_plan, req, want,
                                      metrics.current_span())
         except BaseException:  # pool shutdown race: undo the accounting
-            self._unadmit(want)
+            self._unadmit(want, req.tenant)
             metrics.gauge_add("supplier.reads.on_air", -1)
             raise
 
@@ -755,7 +831,7 @@ class DataEngine:
                 # _serve_batch, whose finally settles every entry on
                 # every outcome (the except below covers the one path
                 # where the pool never ran it)
-                self._admit_bytes(want)  # udalint: disable=UDA101
+                self._admit_bytes(want, req.tenant)  # udalint: disable=UDA101
             except StorageError as e:
                 fut.set_exception(e)
                 continue
@@ -770,7 +846,18 @@ class DataEngine:
         if not entries:
             return futs
         metrics.add("io.batch.submits")
-        metrics.add("io.batch.requests", len(entries))
+        # per-tenant labels advance the total AND the tenant series;
+        # untenanted entries keep the plain total-only add
+        plain = sum(1 for e in entries if not e.req.tenant)
+        if plain:
+            metrics.add("io.batch.requests", plain)
+        by_tenant: Dict[str, int] = {}
+        for e in entries:
+            if e.req.tenant:
+                by_tenant[e.req.tenant] = by_tenant.get(e.req.tenant,
+                                                        0) + 1
+        for tenant, n in by_tenant.items():
+            metrics.add("io.batch.requests", n, tenant=tenant)
         try:
             self._pool.submit(self._serve_batch, entries)
         except BaseException as exc:  # pool shutdown race: undo + fail
@@ -788,7 +875,7 @@ class DataEngine:
         """The one settlement point for a batch entry's accounting
         (admission bytes + both paired gauges), run exactly once per
         entry on every outcome."""
-        self._unadmit(e.want_admit)
+        self._unadmit(e.want_admit, e.req.tenant)
         metrics.gauge_add("supplier.reads.on_air", -1)
         metrics.gauge_add("io.batch.inflight", -1)
         if observe:
@@ -999,7 +1086,11 @@ class DataEngine:
                     data = failpoint("data_engine.pread", data=data,
                                      key=f"{req.map_id}/{req.reduce_id}")
                     served = e.rec.part_length
-                    metrics.add("supplier.bytes", len(data))
+                    if req.tenant:
+                        metrics.add("supplier.bytes", len(data),
+                                    tenant=req.tenant)
+                    else:
+                        metrics.add("supplier.bytes", len(data))
                     e.fut.set_result(FetchResult(
                         data, e.rec.raw_length, e.rec.part_length,
                         req.offset, e.rec.path,
@@ -1033,14 +1124,14 @@ class DataEngine:
         if rec is None:
             return None
         want_admit = req.chunk_size or self.chunk_size_default
-        self._admit_bytes(want_admit)
+        self._admit_bytes(want_admit, req.tenant)
         try:
             return self._build_slice(rec, req, want_admit)
         except BaseException:
             # bad offset / fd-open failure (MOF deleted under a cached
             # index entry): the charge MUST unwind or the budget leaks
             # permanently and eventually wedges the supplier
-            self._unadmit(want_admit)
+            self._unadmit(want_admit, req.tenant)
             raise
 
     def _serve_plan(self, req: ShuffleRequest, admitted: int = 0,
@@ -1065,7 +1156,7 @@ class DataEngine:
                 return self._serve_inner(req)
         finally:
             if admitted and not sliced:
-                self._unadmit(admitted)
+                self._unadmit(admitted, req.tenant)
             metrics.gauge_add("supplier.reads.on_air", -1)
             metrics.observe("supplier.read.latency_ms",
                             (time.perf_counter() - t0) * 1e3)
@@ -1087,12 +1178,16 @@ class DataEngine:
                    served - req.offset)
         fd = self._fds.acquire(rec.path)
         try:
-            metrics.add("supplier.bytes", want)
+            if req.tenant:
+                metrics.add("supplier.bytes", want, tenant=req.tenant)
+            else:
+                metrics.add("supplier.bytes", want)
             return FdSlice(fd=fd, file_offset=rec.start_offset + req.offset,
                            length=want, raw_length=rec.raw_length,
                            part_length=rec.part_length, offset=req.offset,
                            path=rec.path, last=req.offset + want >= served,
-                           _engine=self, _admitted=admitted)
+                           _engine=self, _admitted=admitted,
+                           _tenant=req.tenant)
         except BaseException:
             # the slice never existed, so its release() never runs: the
             # fd pin must unwind here or the cache entry's refcount rots
@@ -1118,7 +1213,8 @@ class DataEngine:
                 # its finally-block accounting never fires — undo the
                 # admission charge here or timeouts would pin the read
                 # budget until submit() rejects an idle engine
-                self._unadmit(req.chunk_size or self.chunk_size_default)
+                self._unadmit(req.chunk_size or self.chunk_size_default,
+                              req.tenant)
                 metrics.gauge_add("supplier.reads.on_air", -1)
             # else: the read is running; _serve's finally settles it
             raise StorageError(
@@ -1137,7 +1233,7 @@ class DataEngine:
                 return self._serve_inner(req)
         finally:
             if admitted:
-                self._unadmit(admitted)
+                self._unadmit(admitted, req.tenant)
             metrics.gauge_add("supplier.reads.on_air", -1)
             metrics.observe("supplier.read.latency_ms",
                             (time.perf_counter() - t0) * 1e3)
@@ -1171,7 +1267,11 @@ class DataEngine:
             crc = zlib.crc32(data) & 0xFFFFFFFF if self._crc else None
             data = failpoint("data_engine.pread", data=data,
                              key=f"{req.map_id}/{req.reduce_id}")
-            metrics.add("supplier.bytes", len(data))
+            if req.tenant:
+                metrics.add("supplier.bytes", len(data),
+                            tenant=req.tenant)
+            else:
+                metrics.add("supplier.bytes", len(data))
             return FetchResult(data, rec.raw_length, rec.part_length,
                                req.offset, rec.path,
                                last=req.offset + len(data) >= served,
@@ -1191,6 +1291,11 @@ class DataEngine:
         # that never ran release() — the refcount-rot leak class
         resledger.drain("data_engine.stop", pairs=("engine.fd",),
                         owner=id(self._fds))
+        # the tenant partition books: with the pool drained, every
+        # tenant-stamped admission charge must have settled — an open
+        # one is attributed (key=tenant) to the job that leaked it
+        resledger.drain("data_engine.stop", pairs=("tenant.admit",),
+                        owner=id(self))
 
     def __enter__(self) -> "DataEngine":
         return self

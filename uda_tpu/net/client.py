@@ -266,6 +266,8 @@ class _ClientConn:
             result = wire.decode_error(memoryview(payload))
         elif msg_type == wire.MSG_SIZE:
             result = wire.decode_size(memoryview(payload))
+        elif msg_type == wire.MSG_JOB_OK:
+            result = wire.decode_job_ok(payload)
         elif msg_type == wire.MSG_STATS_REPLY:
             result = wire.decode_stats_reply(memoryview(payload))
         elif msg_type == wire.MSG_HELLO:
@@ -339,11 +341,31 @@ class EvLoopFetchClient(InputClient):
         self._generation: Optional[int] = None
         self._resumable = True
         # peer capability bits from the HELLO banner (wire.CAP_TRACE:
-        # the peer decodes trace-context REQ tails + serves MSG_STATS).
+        # the peer decodes trace-context REQ tails + serves MSG_STATS;
+        # wire.CAP_TENANT: the peer runs the tenant registry).
         # 0 until the banner lands — frames sent before it stay
         # un-extended, which is always legal.
         self._peer_caps = 0
         self._hello_seen = threading.Event()
+        # multi-tenant binding (uda_tpu/tenant/): when a tenant id is
+        # configured, the FIRST fetch of each job on each connection is
+        # preceded by an authenticated MSG_JOB frame binding
+        # (tenant, job, epoch) in the supplier's registry — TCP
+        # ordering makes register-before-fetch a wire guarantee. Empty
+        # tenant = the pre-tenancy client, frame for frame.
+        self._tenant = str(cfg.get("uda.tpu.tenant.id"))
+        self._tenant_epoch = max(1, int(cfg.get("uda.tpu.tenant.epoch")))
+        self._tenant_weight = max(1,
+                                  int(cfg.get("uda.tpu.tenant.weight")))
+        self._tenant_secret = str(cfg.get("uda.tpu.tenant.secret"))
+        # jobs MSG_JOB'd on THIS conn: job -> Event set once the bind
+        # frame is ON THE WIRE. Register-before-fetch must hold across
+        # concurrent first fetches of one job: the loser of the bind
+        # race waits for the winner's frame to be posted before its
+        # REQ may leave, or the REQ could overtake the MSG_JOB and
+        # land unregistered (typed refusal under strict, a silent
+        # default-tenant pass otherwise).
+        self._bound_jobs: dict = {}
 
     def _on_hello(self, generation: int, warm: bool,
                   caps: int = 0) -> None:
@@ -467,6 +489,10 @@ class EvLoopFetchClient(InputClient):
             # new banner's generation when it lands (_on_hello).
             self._peer_caps = 0
             self._hello_seen.clear()
+            # tenant bindings are per connection (the server's registry
+            # entry survives; the CONNECTION's binding does not) — the
+            # next fetch re-sends MSG_JOB before its REQ
+            self._bound_jobs.clear()
         metrics.gauge_add("net.client.connections", -1)
         metrics.add("net.disconnects", role="client")
         err = TransportError(
@@ -509,6 +535,134 @@ class EvLoopFetchClient(InputClient):
             log.warn(f"net: completion callback for req {req_id} "
                      f"raised: {e}")
 
+    # -- the tenant handshake -----------------------------------------------
+
+    def bind_tenant(self, tenant_id: str, epoch: int = 1,
+                    weight: int = 1, secret: str = "") -> None:
+        """Install (or change) this client's tenant identity — the
+        programmatic twin of the ``uda.tpu.tenant.*`` knobs. A changed
+        epoch re-binds each job on its next fetch."""
+        with self._lock:
+            self._tenant = str(tenant_id)
+            self._tenant_epoch = max(1, int(epoch))
+            self._tenant_weight = max(1, int(weight))
+            if secret:
+                self._tenant_secret = secret
+            self._bound_jobs.clear()
+
+    def _job_frame(self, req_id: int, job_id: str,
+                   retire: bool = False) -> bytes:
+        from uda_tpu.tenant import sign_job
+
+        return wire.encode_job(
+            req_id, self._tenant, job_id, self._tenant_epoch,
+            weight=self._tenant_weight,
+            token=sign_job(self._tenant_secret, self._tenant, job_id,
+                           self._tenant_epoch),
+            retire=retire)
+
+    def _maybe_bind(self, conn: _ClientConn, job_id: str) -> None:
+        """Send MSG_JOB for ``job_id`` ahead of its first REQ on this
+        connection (fire-and-forget: a refusal comes back as a typed
+        ERR on the MSG_JOB's req id — logged and counted; the
+        subsequent REQs draw their own typed TenantErrors from the
+        server's fence, which is what fails the fetch machinery).
+        No-op without a configured tenant or a CAP_TENANT peer.
+        Concurrent first fetches of one job serialize here: the bind
+        race's winner posts the MSG_JOB frame and sets the job's
+        event; losers WAIT on it (bounded) so no REQ can overtake the
+        registration onto the wire."""
+        with self._lock:
+            if not self._tenant or self._conn is not conn \
+                    or not self._peer_caps & wire.CAP_TENANT:
+                return
+            posted = self._bound_jobs.get(job_id)
+            if posted is None:
+                posted = threading.Event()
+                self._bound_jobs[job_id] = posted
+                self._next_id += 1
+                req_id = self._next_id
+
+                def on_bound(result) -> None:
+                    if isinstance(result, Exception):
+                        metrics.add("tenant.bind.errors")
+                        log.warn(f"tenant bind of {self._tenant}/"
+                                 f"{job_id} on {self.host} refused: "
+                                 f"{result}")
+
+                self._pending[req_id] = _Waiter(
+                    on_bound, metrics.start_span("net.job_bind",
+                                                 host=self.host),
+                    time.perf_counter())
+            else:
+                req_id = None
+        if req_id is None:
+            # best-effort bound wait: a timeout degrades to the
+            # server-side fence semantics, never an error here
+            posted.wait(timeout=min(5.0, self.connect_timeout_s))
+            return
+        try:
+            self._post(conn, self._job_frame(req_id, job_id))
+        finally:
+            posted.set()
+
+    def _job_roundtrip(self, job_id: str, retire: bool,
+                       timeout: float) -> int:
+        """Blocking MSG_JOB round trip: returns the granted epoch or
+        re-raises the typed registry refusal (tests, embedders that
+        want registration confirmed before issuing work)."""
+        conn = self._ensure_connected()
+        box: list = [None]
+        got = threading.Event()
+
+        def on_reply(result) -> None:
+            box[0] = result
+            got.set()
+
+        posted = threading.Event()
+        with self._lock:
+            if self._conn is not conn:
+                raise TransportError(
+                    f"connection to {self.host} lost before the "
+                    f"MSG_JOB round trip")
+            if not retire:
+                self._bound_jobs[job_id] = posted
+            self._next_id += 1
+            req_id = self._next_id
+            self._pending[req_id] = _Waiter(
+                on_reply, metrics.start_span("net.job_bind",
+                                             host=self.host,
+                                             retire=retire),
+                time.perf_counter())
+        try:
+            self._post(conn,
+                       self._job_frame(req_id, job_id, retire=retire))
+        finally:
+            posted.set()
+        if not got.wait(timeout=timeout):
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise TransportError(
+                f"MSG_JOB to {self.host} timed out after {timeout:g}s")
+        result = box[0]
+        if isinstance(result, Exception):
+            if not retire:
+                with self._lock:
+                    self._bound_jobs.pop(job_id, None)
+            raise result
+        return int(result)
+
+    def bind_job(self, job_id: str, timeout: float = 10.0) -> int:
+        """Register (tenant, job, epoch) with the supplier and wait for
+        the grant; raises the typed TenantError on refusal."""
+        return self._job_roundtrip(job_id, retire=False, timeout=timeout)
+
+    def retire_job(self, job_id: str, timeout: float = 10.0) -> int:
+        """Retire the job in the supplier's registry (the lifecycle's
+        final transition; the daemon drains the tenant's obligation
+        books and later REQs draw typed errors)."""
+        return self._job_roundtrip(job_id, retire=True, timeout=timeout)
+
     # -- InputClient --------------------------------------------------------
 
     def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
@@ -525,6 +679,9 @@ class EvLoopFetchClient(InputClient):
             span.end(error=type(e).__name__)
             on_complete(e)
             return
+        # tenant plane: the job's MSG_JOB precedes its first REQ on
+        # this connection (TCP order = registration order)
+        self._maybe_bind(conn, req.job_id)
         with self._lock:
             died = self._conn is not conn
             if not died:
@@ -568,6 +725,7 @@ class EvLoopFetchClient(InputClient):
             conn = self._ensure_connected()
         except TransportError:
             return None
+        self._maybe_bind(conn, job_id)
         box: list = [None]
         got = threading.Event()
 

@@ -46,6 +46,17 @@ Frame types::
                                            and conn table —
                                            utils/stats.py
                                            introspection_snapshot)
+    JOB        tenant handshake           (bind this connection to a
+                                           (tenant, job, epoch) in the
+                                           daemon's TenantRegistry —
+                                           register/heartbeat/retire,
+                                           HMAC-authenticated;
+                                           uncredited like HELLO; sent
+                                           to CAP_TENANT peers before
+                                           a job's first REQ)
+    JOB_OK     registration granted       (echoes the epoch; refusals
+                                           are typed TenantError ERR
+                                           frames on the same req id)
 
 **Wire trace context** (versioned by LENGTH, the v2-UDIX back-compat
 discipline): REQ and SIZE_REQ payloads may carry an optional trailing
@@ -81,12 +92,14 @@ from typing import Optional, Sequence
 
 from uda_tpu.mofserver.data_engine import FetchResult, ShuffleRequest
 from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
-                                  ProtocolError, StorageError, TransportError,
-                                  UdaError)
+                                  ProtocolError, StorageError, TenantError,
+                                  TransportError, UdaError)
 
 __all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
            "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
-           "MSG_HELLO", "MSG_STATS", "MSG_STATS_REPLY", "CAP_TRACE",
+           "MSG_HELLO", "MSG_STATS", "MSG_STATS_REPLY",
+           "MSG_JOB", "MSG_JOB_OK", "CAP_TRACE", "CAP_TENANT",
+           "encode_job", "decode_job", "encode_job_ok", "decode_job_ok",
            "encode_request", "decode_request", "decode_request_ex",
            "encode_result",
            "encode_result_head", "decode_result", "decode_result_take",
@@ -115,9 +128,18 @@ MSG_SIZE = 5
 MSG_HELLO = 6
 MSG_STATS = 7        # introspection snapshot request (empty payload)
 MSG_STATS_REPLY = 8  # introspection snapshot (UTF-8 JSON payload)
+MSG_JOB = 9          # tenant handshake: bind this connection to
+                     # (tenant, job, epoch) in the daemon's registry
+                     # (register / heartbeat / retire; authenticated by
+                     # an HMAC token when the server carries a secret).
+                     # Uncredited like HELLO — registration must never
+                     # compete with data for credits.
+MSG_JOB_OK = 10      # MSG_JOB accepted: echoes the granted epoch.
+                     # Refusals ride a typed ERR (TenantError) on the
+                     # MSG_JOB's req id instead.
 
 _TYPES = (MSG_REQ, MSG_DATA, MSG_ERR, MSG_SIZE_REQ, MSG_SIZE, MSG_HELLO,
-          MSG_STATS, MSG_STATS_REPLY)
+          MSG_STATS, MSG_STATS_REPLY, MSG_JOB, MSG_JOB_OK)
 # the header accepts any type in this reserved range; semantically
 # unknown ones get a typed ERR from the server, never a teardown (the
 # forward-compat contract — see the module docstring). Anything past
@@ -132,6 +154,10 @@ _SIZE = struct.Struct("!q")       # total bytes, -1 = unknown
 _HELLO = struct.Struct("!IB")     # server generation, flags
 _TRACE = struct.Struct("!QQ")     # trace_id, parent_span_id (optional
                                   # REQ/SIZE_REQ tail — see docstring)
+_JOB = struct.Struct("!IBH")      # epoch, flags (retire bit), weight
+_JOB_OK = struct.Struct("!I")     # granted epoch echo
+
+_JOB_RETIRE = 0x01  # MSG_JOB flags: this is a retire, not a register
 
 _HELLO_WARM = 0x01  # the generation continues a persisted handoff
 # HELLO capability bits (old decoders mask only the bits they know —
@@ -139,6 +165,12 @@ _HELLO_WARM = 0x01  # the generation continues a persisted handoff
 # new bits is free):
 CAP_TRACE = 0x02    # peer decodes the trace-context REQ/SIZE_REQ tail
                     # and serves MSG_STATS (the observability plane)
+CAP_TENANT = 0x04   # peer runs the multi-tenant service plane: it
+                    # accepts MSG_JOB registration and validates REQs
+                    # against its job/epoch registry (uda_tpu/tenant/).
+                    # Clients without a tenant binding ignore it; old
+                    # clients never see it (decode_hello masks only
+                    # the warm bit)
 
 _FLAG_LAST = 0x01
 _FLAG_CRC = 0x02
@@ -148,7 +180,8 @@ _FLAG_CRC = 0x02
 # supplier-admission backoff) see realistic types across the wire.
 _ERROR_CLASSES = {cls.__name__: cls for cls in
                   (UdaError, ConfigError, ProtocolError, TransportError,
-                   MergeError, StorageError, CompressionError)}
+                   MergeError, StorageError, CompressionError,
+                   TenantError)}
 
 
 def _pack_str(s: str) -> bytes:
@@ -278,6 +311,51 @@ def decode_hello_ex(payload) -> tuple[int, bool, int]:
         raise TransportError(f"malformed HELLO frame ({len(payload)} B)")
     generation, flags = _HELLO.unpack(payload)
     return generation, bool(flags & _HELLO_WARM), flags & 0xFE
+
+
+def encode_job(req_id: int, tenant_id: str, job_id: str, epoch: int,
+               weight: int = 1, token: str = "",
+               retire: bool = False) -> bytes:
+    """MSG_JOB: bind the connection to (tenant, job, epoch) in the
+    daemon's registry. ``token`` is the HMAC authentication string
+    (:func:`uda_tpu.tenant.registry.sign_job`; empty when the server
+    carries no secret); ``retire`` flips the frame from register/
+    heartbeat to the job's retirement. Send only to peers whose HELLO
+    advertised :data:`CAP_TENANT` — an older server answers a typed
+    ProtocolError ERR, which is a clean refusal but a wasted frame."""
+    flags = _JOB_RETIRE if retire else 0
+    payload = (_JOB.pack(int(epoch) & 0xFFFFFFFF, flags,
+                         max(1, int(weight)) & 0xFFFF)
+               + _pack_str(tenant_id) + _pack_str(job_id)
+               + _pack_str(token))
+    return encode_frame(MSG_JOB, req_id, payload)
+
+
+def decode_job(payload) -> tuple:
+    """-> (tenant_id, job_id, epoch, weight, token, retire)."""
+    if len(payload) < _JOB.size:
+        raise TransportError(f"truncated JOB frame ({len(payload)} B)")
+    epoch, flags, weight = _JOB.unpack_from(payload, 0)
+    tenant_id, off = _unpack_str(payload, _JOB.size, "tenant id")
+    job_id, off = _unpack_str(payload, off, "job id")
+    token, off = _unpack_str(payload, off, "token")
+    _done(payload, off, "JOB")
+    return (tenant_id, job_id, epoch, weight, token,
+            bool(flags & _JOB_RETIRE))
+
+
+def encode_job_ok(req_id: int, epoch: int) -> bytes:
+    """MSG_JOB accepted: the granted epoch, echoed (refusals are typed
+    ERR frames on the same req id — TenantError for auth/stale-epoch/
+    retired, so the client re-raises the exact registry error)."""
+    return encode_frame(MSG_JOB_OK, req_id,
+                        _JOB_OK.pack(int(epoch) & 0xFFFFFFFF))
+
+
+def decode_job_ok(payload) -> int:
+    if len(payload) != _JOB_OK.size:
+        raise TransportError(f"malformed JOB_OK frame ({len(payload)} B)")
+    return _JOB_OK.unpack(bytes(payload))[0]
 
 
 def encode_stats_request(req_id: int) -> bytes:

@@ -1,0 +1,45 @@
+"""Multi-tenant service plane: one long-lived shuffle daemon, many jobs.
+
+The Exoshuffle thesis (arXiv:2203.05072) applied to this engine:
+shuffle as a SHARED service rather than a per-job plugin. The pieces:
+
+- :class:`~uda_tpu.tenant.registry.TenantRegistry` — the job/epoch
+  registry with register/heartbeat/retire lifecycle, epoch fencing and
+  HMAC-authenticated wire registration (``MSG_JOB``);
+- :class:`~uda_tpu.tenant.sched.CreditScheduler` — weighted deficit
+  round-robin over parked requests, replacing the single global
+  ``mapred.rdma.wqe.per.conn`` cap with per-tenant weighted-fair
+  credit flow (plus the tenant penalty box: an abusive tenant is
+  deprioritized, never starved);
+- per-tenant read-budget partitions in ``DataEngine`` admission and
+  per-tenant ``MemoryBudget`` shares on the reduce side
+  (``uda.tpu.tenant.budget.share``).
+
+``current_tenant()`` is the process-local tenant identity the reduce
+side stamps onto its hot-path metric labels (set once at bridge INIT
+from ``uda.tpu.tenant.id``; a module-global read so the per-chunk cost
+is one attribute load).
+"""
+
+from __future__ import annotations
+
+from uda_tpu.tenant.registry import (DEFAULT_TENANT, TenantRecord,
+                                     TenantRegistry, sign_job)
+from uda_tpu.tenant.sched import CreditScheduler
+
+__all__ = ["TenantRegistry", "TenantRecord", "CreditScheduler",
+           "DEFAULT_TENANT", "sign_job", "current_tenant",
+           "set_current_tenant"]
+
+_CURRENT_TENANT = ""
+
+
+def set_current_tenant(tenant: str) -> None:
+    """Install this process's tenant identity (bridge INIT; empty =
+    untenanted, labels stay off the hot paths)."""
+    global _CURRENT_TENANT
+    _CURRENT_TENANT = str(tenant or "")
+
+
+def current_tenant() -> str:
+    return _CURRENT_TENANT

@@ -1,0 +1,260 @@
+"""CreditScheduler: weighted-fair credit flow across tenants.
+
+PR 4's single ``mapred.rdma.wqe.per.conn`` cap bounded the pipeline per
+CONNECTION — with many jobs on one daemon that is no bound at all: one
+tenant opening N connections (or bursting on one) takes N x credit of
+the shared engine while a neighbor drains at a trickle. This scheduler
+is the shared bound: a pool of ``uda.tpu.tenant.wqe.total`` credits
+over ALL connections, granted by weighted deficit round-robin (DRR,
+Shreedhar & Varghese) over the per-tenant parked queues:
+
+- a request that cannot take a credit parks in ITS tenant's FIFO (the
+  server pauses that connection's read interest — TCP backpressure is
+  still the credit return, now per tenant);
+- every settled response releases one credit and runs the grant sweep:
+  each non-empty tenant queue is visited in ring order, its deficit
+  grows by ``quantum x weight``, and it unparks one request per whole
+  deficit unit — so over any busy interval tenant grants converge to
+  the weight ratio regardless of arrival order or connection count;
+- deficits are capped at one round's earning and reset when a queue
+  empties (the classic DRR anti-burst rule), so the deficit of any
+  tenant is bounded by ``quantum x weight`` — the fairness invariant
+  ``tests/test_tenant.py`` pins.
+
+The **tenant penalty box** (the PenaltyBox idea, tenant-scoped): an
+abusive tenant — repeated admission rejections, injected faults on its
+requests — is *deprioritized*: while boxed, its queue is only visited
+when no unboxed tenant has backlog. Never starved: with no competing
+backlog a boxed tenant is served normally, so the box degrades exactly
+one tenant and only under contention (the isolation contract).
+
+Threading: loop-thread-confined BY DESIGN (the event-loop server owns
+every parked request); no locks. ``penalize`` may be called from
+completion threads via ``EventLoop.call_soon``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
+
+__all__ = ["CreditScheduler"]
+
+log = get_logger()
+
+
+class _TenantQ:
+    __slots__ = ("queue", "deficit", "faults", "boxed_until")
+
+    def __init__(self) -> None:
+        self.queue: deque = deque()   # (conn, entry) waiting for credit
+        self.deficit = 0.0
+        self.faults = 0
+        self.boxed_until = 0.0
+
+
+class CreditScheduler:
+    """``total`` credits shared across tenants; ``weight_of(tenant)``
+    supplies the live weights (the registry's view, consulted at each
+    sweep so a re-registration's new weight applies immediately)."""
+
+    def __init__(self, total: int,
+                 weight_of: Optional[Callable[[str], int]] = None,
+                 quantum: float = 1.0,
+                 penalty_threshold: int = 4, penalty_ms: int = 1000):
+        self.total = max(1, int(total))
+        self._free = self.total
+        self._weight_of = weight_of or (lambda t: 1)
+        self.quantum = float(quantum)
+        self.penalty_threshold = max(1, int(penalty_threshold))
+        self.penalty_s = max(0, int(penalty_ms)) / 1e3
+        self._tenants: Dict[str, _TenantQ] = {}
+        self._ring: List[str] = []    # visit order (insertion)
+        self._ring_pos = 0
+        # a turn interrupted by credit exhaustion RESUMES at the same
+        # tenant with its leftover deficit (and without re-earning):
+        # without this, single-credit settles would degrade weighted
+        # DRR to plain round-robin — every sweep would start a fresh
+        # turn at the next ring position
+        self._turn_earned = False
+        self._inflight: Dict[str, int] = {}
+        self.grants = 0               # lifetime grants (tests/invariants)
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def free(self) -> int:
+        return self._free
+
+    def backlog(self, tenant: Optional[str] = None) -> int:
+        if tenant is not None:
+            tq = self._tenants.get(tenant)
+            return len(tq.queue) if tq else 0
+        return sum(len(tq.queue) for tq in self._tenants.values())
+
+    def inflight(self, tenant: str) -> int:
+        return self._inflight.get(tenant, 0)
+
+    def _tq(self, tenant: str) -> _TenantQ:
+        tq = self._tenants.get(tenant)
+        if tq is None:
+            tq = self._tenants[tenant] = _TenantQ()
+            self._ring.append(tenant)
+        return tq
+
+    def _boxed(self, tq: _TenantQ, now: float) -> bool:
+        return tq.boxed_until > now
+
+    # -- credit flow ---------------------------------------------------------
+
+    def admit(self, tenant: str, item: Tuple) -> bool:
+        """Take a credit NOW (True) or park ``item`` in the tenant's
+        queue (False). A tenant with backlog — or in the penalty box
+        while others compete — always parks behind its queue, so a
+        burst cannot overtake its own earlier requests or jump a
+        neighbor's earned deficit."""
+        tq = self._tq(tenant)
+        now = time.monotonic()
+        if (self._free > 0 and not tq.queue
+                and not (self._boxed(tq, now) and self._other_backlog(
+                    tenant, now))):
+            self._grant(tenant)
+            return True
+        tq.queue.append(item)
+        metrics.add("tenant.sched.parked")
+        return False
+
+    def _other_backlog(self, tenant: str, now: float) -> bool:
+        for t, tq in self._tenants.items():
+            if t != tenant and tq.queue and not self._boxed(tq, now):
+                return True
+        return False
+
+    def _grant(self, tenant: str) -> None:
+        self._free -= 1
+        self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+        self.grants += 1
+        metrics.add("tenant.sched.grants", tenant=tenant)
+
+    def release(self, tenant: str) -> None:
+        """One response settled: its credit returns to the pool. The
+        caller follows with :meth:`grant_parked`."""
+        self._free = min(self.total, self._free + 1)
+        left = self._inflight.get(tenant, 0) - 1
+        if left > 0:
+            self._inflight[tenant] = left
+        else:
+            self._inflight.pop(tenant, None)
+
+    def grant_parked(self) -> List[Tuple]:
+        """The DRR sweep: unpark up to ``free`` items across tenants by
+        weighted deficit round-robin. Returns the granted (conn, entry)
+        items — each HOLDS one credit; the caller starts them (and
+        releases via :meth:`release` when they settle or drop)."""
+        granted: List[Tuple] = []
+        ring = self._ring
+        n = len(ring)
+        if n == 0 or self._free <= 0:
+            return granted
+        now = time.monotonic()
+        # visit budget: every full ring pass with eligible backlog
+        # serves at least one item (an unboxed non-empty queue earns
+        # >= one quantum), so the loop is bounded by grants + ring
+        # passes, never by backlog depth
+        visits = n * (self.total + 2)
+        while self._free > 0 and visits > 0:
+            unboxed_backlog = any(
+                tq.queue and not self._boxed(tq, now)
+                for tq in self._tenants.values())
+            if not unboxed_backlog and not any(
+                    tq.queue for tq in self._tenants.values()):
+                break
+            tenant = ring[self._ring_pos % n]
+            tq = self._tenants[tenant]
+            if not tq.queue or (self._boxed(tq, now)
+                                and unboxed_backlog):
+                if not tq.queue:
+                    tq.deficit = 0.0  # DRR: an empty queue forfeits
+                    # banked credit (anti-burst)
+                self._advance()
+                visits -= 1
+                continue
+            if not self._turn_earned:
+                weight = max(1, int(self._weight_of(tenant)))
+                earn = self.quantum * weight
+                tq.deficit = min(tq.deficit + earn, earn)
+                self._turn_earned = True
+            while tq.queue and tq.deficit >= self.quantum \
+                    and self._free > 0:
+                tq.deficit -= self.quantum
+                item = tq.queue.popleft()
+                self._grant(tenant)
+                granted.append(item)
+            if tq.queue and tq.deficit >= self.quantum:
+                break  # credits ran out mid-turn: the NEXT sweep
+                # resumes this tenant's turn with its leftover deficit
+            if not tq.queue:
+                tq.deficit = 0.0
+            self._advance()
+            visits -= 1
+        metrics.gauge("tenant.sched.backlog", self.backlog())
+        return granted
+
+    def _advance(self) -> None:
+        self._ring_pos = (self._ring_pos + 1) % max(1, len(self._ring))
+        self._turn_earned = False
+
+    def drop_conn(self, conn) -> int:
+        """A connection died: its parked (unstarted, creditless) items
+        leave the queues. Returns how many were dropped."""
+        dropped = 0
+        for tq in self._tenants.values():
+            keep = deque(it for it in tq.queue if it[0] is not conn)
+            dropped += len(tq.queue) - len(keep)
+            tq.queue = keep
+        return dropped
+
+    # -- the tenant penalty box ----------------------------------------------
+
+    def note_fault(self, tenant: str) -> None:
+        """One abusive event (admission rejection, injected fault on
+        this tenant's request): past the threshold the tenant enters
+        the box for ``penalty_ms`` (extended while faults continue;
+        a clean grant sweep is the implicit forgiveness — the box
+        simply expires)."""
+        tq = self._tq(tenant)
+        tq.faults += 1
+        if tq.faults >= self.penalty_threshold:
+            now = time.monotonic()
+            first = tq.boxed_until <= now
+            tq.boxed_until = now + self.penalty_s
+            tq.faults = 0
+            if first:
+                metrics.add("tenant.penalties", tenant=tenant)
+                log.warn(f"tenant {tenant!r} penalty-boxed for "
+                         f"{self.penalty_s:g}s (repeated faults); its "
+                         f"parked requests yield to other tenants")
+
+    def boxed(self, tenant: str) -> bool:
+        tq = self._tenants.get(tenant)
+        return bool(tq and self._boxed(tq, time.monotonic()))
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        now = time.monotonic()
+        return {
+            "total": self.total, "free": self._free,
+            "grants": self.grants,
+            "tenants": {
+                t: {"parked": len(tq.queue),
+                    "inflight": self._inflight.get(t, 0),
+                    "deficit": round(tq.deficit, 3),
+                    "weight": max(1, int(self._weight_of(t))),
+                    "boxed": self._boxed(tq, now)}
+                for t, tq in sorted(self._tenants.items())},
+        }

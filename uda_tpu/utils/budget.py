@@ -213,7 +213,8 @@ class MemoryBudget:
 
     def __init__(self, hbm_budget_mb: int = 0, host_budget_mb: int = 0,
                  hard_ceiling_mb: int = 0, key_width: int = 16,
-                 host_fraction: float = 0.7, enforce: str = "reroute"):
+                 host_fraction: float = 0.7, enforce: str = "reroute",
+                 tenant_share: float = 0.0):
         self._hbm_mb = int(hbm_budget_mb)
         self._host_mb = int(host_budget_mb)
         self.hard_ceiling_mb = int(hard_ceiling_mb)
@@ -223,6 +224,17 @@ class MemoryBudget:
             raise UdaError(f"uda.tpu.budget.enforce must be 'reroute' or "
                            f"'reject', got {enforce!r}")
         self.enforce = enforce
+        # the multi-tenant partition (uda.tpu.tenant.budget.share):
+        # several reducers of different tenants sharing one host must
+        # not each budget against the whole machine — every budget
+        # read below is scaled to this job's slice. 0/1 = whole
+        # machine (the single-job default). Applied to EXPLICIT knob
+        # values too: the knob states the machine's capacity, the
+        # share states this tenant's entitlement.
+        if tenant_share < 0.0 or tenant_share > 1.0:
+            raise UdaError(f"uda.tpu.tenant.budget.share must be in "
+                           f"[0, 1], got {tenant_share!r}")
+        self.tenant_share = float(tenant_share) or 1.0
 
     @classmethod
     def from_config(cls, cfg) -> "MemoryBudget":
@@ -233,21 +245,28 @@ class MemoryBudget:
             key_width=cfg.get("uda.tpu.key.width"),
             host_fraction=cfg.get(
                 "mapred.job.shuffle.input.buffer.percent"),
-            enforce=cfg.get("uda.tpu.budget.enforce"))
+            enforce=cfg.get("uda.tpu.budget.enforce"),
+            tenant_share=cfg.get("uda.tpu.tenant.budget.share"))
+
+    def _share(self, nbytes: int) -> int:
+        # never below 1 MB: a pathological share must degrade to the
+        # reroute/reject ladder, not to a zero budget that rejects the
+        # arena itself with a confusing arithmetic message
+        return max(MB, int(nbytes * self.tenant_share))
 
     @property
     def hbm_budget_bytes(self) -> int:
         if self._hbm_mb <= 0:
             self._hbm_mb = max(
                 1, int(_detect_hbm_mb() * HBM_RESERVE_FRACTION))
-        return self._hbm_mb * MB
+        return self._share(self._hbm_mb * MB)
 
     @property
     def host_budget_bytes(self) -> int:
         if self._host_mb <= 0:
             self._host_mb = max(
                 1, int(_host_available_mb() * self.host_fraction))
-        return self._host_mb * MB
+        return self._share(self._host_mb * MB)
 
     @property
     def hard_ceiling_bytes(self) -> int:

@@ -304,6 +304,60 @@ _FLAG_LIST = [
          "than this is re-measured by the background re-probe rung "
          "(tune_probe.py --reprobe-age, or a registered in-process "
          "probe via tuncache.ensure_fresh). 0 = winners never expire"),
+    # --- multi-tenant service plane (uda_tpu/tenant/) -------------------
+    Flag("uda.tpu.tenant.enable", False, bool,
+         "run the ShuffleServer as a multi-job daemon: HELLO "
+         "advertises CAP_TENANT, MSG_JOB registrations land in a "
+         "TenantRegistry, every bound REQ is epoch-validated, and the "
+         "per-conn credit cap is replaced by the weighted-fair "
+         "CreditScheduler (uda.tpu.tenant.wqe.total). Off = the "
+         "single-job data plane, bit for bit"),
+    Flag("uda.tpu.tenant.id", "", str,
+         "this process's tenant identity (reduce side): clients send "
+         "MSG_JOB binding (tenant, job, epoch) before each job's "
+         "first fetch, and hot-path metrics gain tenant labels. "
+         "Empty = untenanted"),
+    Flag("uda.tpu.tenant.epoch", 1, int,
+         "this job attempt's epoch: a restarted attempt registers "
+         "epoch+1, fencing the predecessor — its connections draw "
+         "typed TenantError instead of reading the successor's "
+         "chunks"),
+    Flag("uda.tpu.tenant.weight", 1, int,
+         "this tenant's weighted-fair share: scheduler grants and "
+         "supplier read-budget partitions are proportional to weight "
+         "over the sum of active tenants' weights"),
+    Flag("uda.tpu.tenant.secret", "", str,
+         "shared HMAC-SHA256 secret authenticating MSG_JOB frames "
+         "(tenant/registry.sign_job); empty = unauthenticated (the "
+         "trusted-fabric default, like the reference's rdma_cm "
+         "plane). Both sides must agree"),
+    Flag("uda.tpu.tenant.wqe.total", 0, int,
+         "the daemon-wide credit pool the CreditScheduler grants by "
+         "weighted deficit round-robin (requests in flight across ALL "
+         "connections and tenants); 0 = mapred.rdma.wqe.per.conn — "
+         "the bound the single-job knob provided, now weighted-fair"),
+    Flag("uda.tpu.tenant.strict", False, bool,
+         "refuse REQs for jobs never registered via MSG_JOB (typed "
+         "TenantError); off = unbound jobs ride the default tenant "
+         "(old clients stay compatible)"),
+    Flag("uda.tpu.tenant.ttl.s", 0.0, float,
+         "idle-job expiry horizon: a registered job with no "
+         "register/validate/heartbeat activity for this long is "
+         "dropped from the registry (retired tombstones are collected "
+         "on the same clock). 0 = jobs never expire"),
+    Flag("uda.tpu.tenant.penalty.threshold", 4, int,
+         "abusive-tenant events (admission rejections, faulted "
+         "requests) before the tenant enters the scheduler's penalty "
+         "box — its parked requests yield to unboxed tenants (never "
+         "starved: served when nothing competes)"),
+    Flag("uda.tpu.tenant.penalty.ms", 1000, int,
+         "how long a penalty-boxed tenant stays deprioritized"),
+    Flag("uda.tpu.tenant.budget.share", 0.0, float,
+         "reduce-side MemoryBudget partition: scale this job's host + "
+         "HBM budgets to the fraction of the machine its tenant owns "
+         "(several reducers of different tenants sharing one host "
+         "must not each claim the whole MemAvailable). 0 = whole-"
+         "machine budgets (the single-job default)"),
     # --- memory admission / pressure-response knobs (utils/budget.py) ---
     Flag("uda.tpu.hbm.budget.mb", 0, int,
          "per-chip HBM budget for the device row matrix + merge working "

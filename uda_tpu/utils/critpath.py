@@ -57,9 +57,10 @@ __all__ = ["analyze", "time_accounting_block", "buckets_from_counters",
 # (metrics.timer); names absent here land in "other". Kept in lockstep
 # with the timer call sites and SPAN_REGISTRY by tests/test_timeacct.
 SPAN_BUCKETS: Dict[str, str] = {
-    # fetch: getting bytes from suppliers (RPC + wire + scheduling)
+    # fetch: getting bytes from suppliers (RPC + wire + scheduling;
+    # the MSG_JOB tenant registration is fetch-plane control traffic)
     "fetch": "fetch", "fetch.segment": "fetch", "net.fetch": "fetch",
-    "net.size_probe": "fetch",
+    "net.size_probe": "fetch", "net.job_bind": "fetch",
     # wait: blocked-on-memory / blocked-on-staging idle
     "wait_mem": "wait", "merge.wait": "wait",
     # decompress+pack: host staging compute (materialize, vint-decode,

@@ -25,6 +25,7 @@ __all__ = [
     "MergeError",
     "StorageError",
     "CompressionError",
+    "TenantError",
     "FallbackSignal",
     "attribute_supplier",
 ]
@@ -90,6 +91,16 @@ class StorageError(UdaError):
 class CompressionError(UdaError):
     """Codec failure (reference DecompressorWrapper paths,
     src/Merger/DecompressorWrapper.cc)."""
+
+
+class TenantError(UdaError):
+    """Multi-tenant service-plane refusal (uda_tpu/tenant/): unknown or
+    retired job, stale epoch (a restarted job's fetches fenced off a
+    predecessor's chunks), or a failed MSG_JOB authentication. Rides
+    the wire as a typed ERR frame and is TERMINAL on the reduce side —
+    retrying cannot legalize a fenced epoch, so the Segment machinery
+    must fail the task into the fallback contract instead of pacing a
+    retry storm against the registry."""
 
 
 class FallbackSignal(Exception):

@@ -66,7 +66,7 @@ from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
 from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
-                                  ProtocolError, StorageError,
+                                  ProtocolError, StorageError, TenantError,
                                   TransportError, UdaError)
 from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.metrics import metrics
@@ -84,6 +84,7 @@ _ERROR_KINDS = {
     "config": ConfigError,
     "uda": UdaError,
     "compression": CompressionError,
+    "tenant": TenantError,
 }
 
 # default injected-error class per site: match what the real fault at
@@ -111,6 +112,14 @@ _SITE_ERRORS = {
     # exactly ONE request of a batch — its batch-mates must complete
     # byte-correct (the batch-partial-failure chaos rung)
     "data_engine.preadv": StorageError,
+    # the multi-tenant service plane (uda_tpu/tenant/), both keyed by
+    # TENANT id so chaos can target exactly one tenant's traffic:
+    # tenant.register fires per MSG_JOB registration, tenant.validate
+    # per bound REQ — an injected TenantError fails ONE tenant's
+    # requests with the typed refusal while its neighbors' jobs must
+    # complete byte-correct (the abusive-tenant isolation rung)
+    "tenant.register": TenantError,
+    "tenant.validate": TenantError,
 }
 
 # The registered-site inventory. udalint's UDA003 rule checks every

@@ -311,6 +311,39 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                "[labels: domain]"),
     "tune.reprobes": ("counter", "stale winners re-measured by the "
                                  "background re-probe rung"),
+    # -- counters: multi-tenant service plane (uda_tpu/tenant/) ----------
+    "tenant.registered": ("counter", "jobs registered in the tenant "
+                                     "registry (MSG_JOB) [labels: "
+                                     "tenant]"),
+    "tenant.retired": ("counter", "jobs retired [labels: tenant]"),
+    "tenant.heartbeats": ("counter", "registry heartbeats (repeat "
+                                     "MSG_JOB at the same epoch)"),
+    "tenant.epoch.fenced": ("counter", "registrations that superseded "
+                                       "a lower epoch (the restarted-"
+                                       "job fence)"),
+    "tenant.expired": ("counter", "idle jobs dropped by the TTL sweep "
+                                  "(uda.tpu.tenant.ttl.s)"),
+    "tenant.rejected": ("counter", "registry refusals -> typed "
+                                   "TenantError [labels: cause="
+                                   "unknown|retired|stale_epoch|auth|"
+                                   "capacity]"),
+    "tenant.bind.errors": ("counter", "client-side MSG_JOB refusals "
+                                      "(fire-and-forget binds whose "
+                                      "reply was a typed ERR)"),
+    "tenant.sched.grants": ("counter", "credits granted by the "
+                                       "weighted-fair scheduler "
+                                       "[labels: tenant]"),
+    "tenant.sched.parked": ("counter", "requests parked in a tenant's "
+                                       "WDRR queue (no credit at "
+                                       "arrival)"),
+    "tenant.penalties": ("counter", "tenants penalty-boxed by the "
+                                    "scheduler (repeated faults) "
+                                    "[labels: tenant]"),
+    "tenant.admission.rejections": ("counter", "ShuffleRequests "
+                                    "rejected by a TENANT's read-"
+                                    "budget share (the global "
+                                    "supplier.admission.rejections "
+                                    "also advances) [labels: tenant]"),
     # -- counters: time-accounting plane (profiler + critpath) -----------
     "profile.samples": ("counter", "sampling-profiler stack samples, "
                                    "attributed to the sampled thread's "
@@ -353,6 +386,21 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                    "worker, future not yet resolved); "
                                    "paired — every +1 must meet its "
                                    "-1 at settlement"),
+    "tenant.read.bytes.on_air": ("gauge", "tenant-stamped admission "
+                                          "bytes queued or being read "
+                                          "(the per-tenant partition "
+                                          "level; paired — the "
+                                          "unlabeled total rides the "
+                                          "ledger, the tenant series "
+                                          "is observability) [labels: "
+                                          "tenant]"),
+    "tenant.jobs.active": ("gauge", "active jobs in the tenant "
+                                    "registry (absolute, set at "
+                                    "register/retire — not paired)"),
+    "tenant.sched.backlog": ("gauge", "requests parked across every "
+                                      "tenant's WDRR queue (absolute, "
+                                      "set at each grant sweep — not "
+                                      "paired)"),
     "profile.hz": ("gauge", "sampling-profiler rate currently armed "
                             "(0 = off; set absolutely at start/stop, "
                             "deliberately NOT a paired gauge — the "
@@ -413,6 +461,8 @@ SPAN_REGISTRY: Dict[str, str] = {
                  "child of the remote net.fetch",
     "net.stats": "one MSG_STATS introspection poll, client side "
                  "(net/client.py)",
+    "net.job_bind": "one MSG_JOB tenant registration round trip, "
+                    "client side (net/client.py)",
     "engine.pread": "one DataEngine chunk read/plan, child of the "
                     "serve (or local fetch) span "
                     "(mofserver/data_engine.py)",

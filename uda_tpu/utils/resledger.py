@@ -81,6 +81,7 @@ PAIRED_GAUGES: Dict[str, str] = {
     "supplier.reads.on_air": "gauge.reads.on_air",
     "supplier.read.bytes.on_air": "gauge.read.bytes",
     "io.batch.inflight": "gauge.io.batch",
+    "tenant.read.bytes.on_air": "gauge.tenant.read.bytes",
 }
 
 
