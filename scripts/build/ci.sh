@@ -120,13 +120,16 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   || { echo "FAIL: second tune_probe run re-probed a fresh cache" \
        | tee -a "$ART/ci.log"; exit 1; }
 
-# Hierarchical exchange gate, quick mode (2x4 virtual mesh): the
-# two-stage pod exchange must be byte-identical to the flat exchange
-# and the host oracles, and the accounting invariant must hold —
-# hierarchical per-round DCN messages <= the pod-pair bound and <= the
-# flat device-pair count, DCN bytes no higher than flat (full 8/16/64
-# runs ride MULTICHIP_SCALE_r*.json).
-echo "-- hierarchical exchange bench (quick)" | tee -a "$ART/ci.log"
+# Hierarchical + CODED exchange gate, quick mode (2x4 virtual mesh):
+# the two-stage pod exchange AND the coded multicast stage B must be
+# byte-identical to the flat exchange and the host oracles, and the
+# accounting invariants must hold — hierarchical per-round DCN
+# messages <= the pod-pair bound and <= the flat device-pair count,
+# DCN bytes no higher than flat, coded + saved == uncoded payload,
+# uniform coded charge <= 0.67x hierarchical, zero coded overhead on
+# the uncodable shapes (full 8/16/64 runs ride
+# MULTICHIP_SCALE_r*.json and feed perfwatch).
+echo "-- hierarchical + coded exchange bench (quick)" | tee -a "$ART/ci.log"
 env -u PALLAS_AXON_POOL_IPS \
   python scripts/exchange_bench.py --quick \
   --out "$ART/exchange_bench.json" 2>&1 | tee -a "$ART/ci.log" | tail -5
@@ -160,6 +163,8 @@ python scripts/perfwatch.py --check "$ART/bench_pipeline.json" \
 python scripts/perfwatch.py --check "$ART/bench_io.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 python scripts/perfwatch.py --check "$ART/bench_tenant.json" \
+  --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
+python scripts/perfwatch.py --check "$ART/exchange_bench.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 
 # CPU-only gates run with the accelerator-pool env stripped: the pool's

@@ -1,33 +1,38 @@
 #!/usr/bin/env python
-"""A/B bench + correctness gate: flat vs hierarchical exchange on CPU
-virtual multi-pod meshes.
+"""A/B/C bench + correctness gate: flat vs hierarchical vs CODED
+exchange on CPU virtual multi-pod meshes.
 
 For each mesh size (default ``dcn:2,ici:4`` / ``dcn:4,ici:4`` /
 ``dcn:8,ici:8`` — 8/16/64 virtual devices, each in a FRESH interpreter:
 the device count locks at backend init) the child runs uniform, skewed
-and pod-local workloads through ``shuffle_exchange`` twice on the SAME
-2-axis mesh — ``mode="flat"`` (one global all_to_all per round, every
-cross-pod device pair its own DCN lane) vs ``mode="hierarchical"``
-(pod-local all_to_all + ONE coalesced DCN tile per pod pair) — and
+and pod-local workloads through ``shuffle_exchange`` THREE times on the
+SAME 2-axis mesh — ``mode="flat"`` (one global all_to_all per round,
+every cross-pod device pair its own DCN lane), ``mode="hierarchical"``
+(pod-local all_to_all + ONE coalesced DCN tile per pod pair) and
+``mode="coded"`` (the pair tile carries GF(2^8)-coded chunks every
+member decodes locally — the Coded TeraSort multicast phase) — and
 checks, per round:
 
-- **byte-identity**: the hierarchical delivery equals the flat delivery
-  array-for-array, AND both equal a pure-numpy host oracle of the
-  window protocol; the per-destination record multiset equals the
-  RecordBatch host oracle (``exchange_record_batches``);
+- **byte-identity**: the hierarchical AND coded deliveries equal the
+  flat delivery array-for-array, and all equal a pure-numpy host
+  oracle of the window protocol; the per-destination record multiset
+  equals the RecordBatch host oracle (``exchange_record_batches``);
 - **accounting invariants**: hierarchical per-round DCN messages <=
   pods*(pods-1) (the pod-pair bound) and <= the flat per-round count;
-  total hierarchical DCN bytes <= flat DCN bytes. Byte figures are the
-  planner's RECORD-payload ledger (equal across modes by construction);
-  the dense lax.all_to_all lowering additionally pads the staged
-  body's collective buffers on the wire — see the scope note in
-  uda_tpu/parallel/exchange.py.
+  total hierarchical DCN bytes <= flat DCN bytes; the coded ledger sum
+  ``coded + saved == uncoded payload``; on the uniform workload the
+  coded DCN payload charge <= 0.67x hierarchical (the ~k-fold
+  multicast cut, k = pod size); on the UNCODABLE workloads (skew,
+  pod-local) zero coded overhead bytes — the plan routes every window
+  to the plain tile. Byte figures are the planner's RECORD-payload
+  ledger; the coded series charge the redundant-map multicast model —
+  see the scope notes in uda_tpu/parallel/exchange.py + planner.py.
 
 Wall clock is measured on the post-compile run (every mode executes
 once to compile, then the timed pass). Output (default
-``MULTICHIP_SCALE_r07.json``) carries per-size flat/hier accounting +
-timing; exit != 0 on any identity/invariant failure — the ci.sh
-``--quick`` gate (size 8 only).
+``MULTICHIP_SCALE_r15.json``) carries per-size flat/hier/coded
+accounting + timing; exit != 0 on any identity/invariant failure —
+the ci.sh ``--quick`` gate (size 8 only).
 
 Usage: scripts/exchange_bench.py [--quick] [--out PATH]
        [--sizes dcn:2,ici:4;dcn:4,ici:4;dcn:8,ici:8]
@@ -118,6 +123,12 @@ def run_child(spec: str, rows_per_device: int, quick: bool) -> dict:
         sdest = (skew[:, 1] % ndev).astype(np.int32)
         sdest[: (3 * n) // 4] = 0          # 75% of records hit device 0
         yield "skewed", skew, sdest, max(2, rows_per_device // 8)
+        hot = rng.integers(0, 2**32, size=(n, wcols), dtype=np.uint32)
+        # every record to ONE chip: every pod pair has a single
+        # destination block — nothing to encode across, the plan must
+        # decline every window (zero coded bytes)
+        yield "skew_single_dest", hot, np.zeros(n, np.int32), \
+            max(2, rows_per_device // 8)
         pod = rng.integers(0, 2**32, size=(n, wcols), dtype=np.uint32)
         pdest = np.zeros(n, np.int32)      # pod-local: no DCN traffic
         shard = n // ndev
@@ -144,7 +155,8 @@ def run_child(spec: str, rows_per_device: int, quick: bool) -> dict:
             np.asarray(rw)                 # block until delivered
         wall = time.perf_counter() - t0
         plan = plan_rounds(layout.counts, capacity, layout.topology,
-                           rec_bytes, layout.hierarchical)
+                           rec_bytes, layout.hierarchical,
+                           coded=layout.coded)
         per_round_msgs = [w.dcn_messages for w in plan.windows]
         return {
             "rounds": len(host),
@@ -156,6 +168,13 @@ def run_child(spec: str, rows_per_device: int, quick: bool) -> dict:
             "dcn_messages": int(snap.get("exchange.dcn.messages", 0)),
             "dcn_messages_per_round_max":
                 max(per_round_msgs, default=0),
+            "dcn_coded_bytes":
+                int(snap.get("exchange.dcn.coded.bytes", 0)),
+            "dcn_saved_bytes":
+                int(snap.get("exchange.dcn.saved.bytes", 0)),
+            "decode_fallbacks":
+                int(snap.get("exchange.decode.fallbacks", 0)),
+            "coded_windows": sum(1 for w in plan.windows if w.coded),
         }, host
 
     def batch_of(rows):
@@ -167,10 +186,19 @@ def run_child(spec: str, rows_per_device: int, quick: bool) -> dict:
         flat_acct, flat_rounds = run_mode(words, dest, capacity, "flat")
         hier_acct, hier_rounds = run_mode(words, dest, capacity,
                                           "hierarchical")
+        coded_acct, coded_rounds = run_mode(words, dest, capacity,
+                                            "coded")
         checks = {"byte_identical": True, "oracle_identical": True,
-                  "recordbatch_identical": True}
+                  "recordbatch_identical": True,
+                  "coded_byte_identical": True}
         if len(flat_rounds) != len(hier_rounds):
             checks["byte_identical"] = False
+        if len(flat_rounds) != len(coded_rounds):
+            checks["coded_byte_identical"] = False
+        for r, ((fw, fc), (cw, cc)) in enumerate(zip(flat_rounds,
+                                                     coded_rounds)):
+            if not (np.array_equal(fw, cw) and np.array_equal(fc, cc)):
+                checks["coded_byte_identical"] = False
         for r, ((fw, fc), (hw, hc)) in enumerate(zip(flat_rounds,
                                                      hier_rounds)):
             if not (np.array_equal(fw, hw) and np.array_equal(fc, hc)):
@@ -205,9 +233,35 @@ def run_child(spec: str, rows_per_device: int, quick: bool) -> dict:
             hier_acct["dcn_messages"] <= flat_acct["dcn_messages"]
         checks["dcn_bytes_le_flat"] = \
             hier_acct["dcn_bytes"] <= flat_acct["dcn_bytes"]
+        # the coded ledger-sum invariant: every window books either
+        # its full payload (plain) or coded + saved == payload
+        checks["coded_ledger_sum"] = (
+            coded_acct["dcn_bytes"] + coded_acct["dcn_saved_bytes"]
+            == hier_acct["dcn_bytes"])
+        if label == "uniform" and hier_acct["dcn_bytes"]:
+            # THE acceptance figure: the multicast charge cuts the
+            # uniform cross-pod DCN payload to <= 0.67x hierarchical
+            checks["coded_dcn_le_067x_hier"] = (
+                coded_acct["dcn_bytes"]
+                <= 0.67 * hier_acct["dcn_bytes"])
+        elif label == "skewed":
+            # partial skew: the break-even guard may still code the
+            # balanced early windows (a genuine saving) but must NEVER
+            # regress the ledger past the plain tile
+            checks["skew_never_regresses"] = (
+                coded_acct["dcn_bytes"] <= hier_acct["dcn_bytes"])
+        else:
+            # fully-uncodable shapes (single-destination skew,
+            # pod-local): the plan must route every window to the
+            # plain tile — zero coded overhead, byte-for-byte the
+            # hierarchical ledger
+            checks["uncodable_zero_coded_overhead"] = (
+                coded_acct["dcn_coded_bytes"] == 0
+                and coded_acct["dcn_bytes"] == hier_acct["dcn_bytes"])
         ok = ok and all(checks.values())
         cases.append({"workload": label, "capacity": int(capacity),
                       "flat": flat_acct, "hierarchical": hier_acct,
+                      "coded": coded_acct,
                       "pod_pair_bound": pair_bound,
                       "device_pair_bound": ndev * (ndev - 1),
                       "checks": checks})
@@ -224,7 +278,7 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true",
                     help="size 8 only, small rows (the ci.sh gate)")
     ap.add_argument("--out", default=os.path.join(
-        REPO, "MULTICHIP_SCALE_r07.json"))
+        REPO, "MULTICHIP_SCALE_r15.json"))
     ap.add_argument("--sizes", default=None,
                     help=f"';'-separated mesh specs "
                          f"(default {DEFAULT_SIZES})")
@@ -284,16 +338,19 @@ def main() -> int:
         if acct:
             for case in acct["cases"]:
                 f, h = case["flat"], case["hierarchical"]
+                c = case.get("coded", {})
                 print(f"  {case['workload']:>9}: DCN msgs/round "
                       f"{f['dcn_messages_per_round_max']} -> "
                       f"{h['dcn_messages_per_round_max']} "
                       f"(pod-pair bound {case['pod_pair_bound']}), "
-                      f"DCN bytes {f['dcn_bytes']} -> {h['dcn_bytes']}, "
-                      f"wall {f['wall_s']}s -> {h['wall_s']}s, "
-                      f"checks "
+                      f"DCN bytes {f['dcn_bytes']} -> {h['dcn_bytes']} "
+                      f"-> coded {c.get('dcn_bytes', 0)} "
+                      f"(saved {c.get('dcn_saved_bytes', 0)}), "
+                      f"wall {f['wall_s']}s -> {h['wall_s']}s -> "
+                      f"{c.get('wall_s', 0)}s, checks "
                       f"{'PASS' if all(case['checks'].values()) else case['checks']}")
 
-    report = {"bench": "exchange_flat_vs_hierarchical", "round": "r07",
+    report = {"bench": "exchange_modes", "round": "r15",
               "quick": args.quick, "rows_per_device": rows,
               "runs": runs, "ok": ok}
     with open(args.out, "w") as f:

@@ -190,6 +190,42 @@ def _extract_tenant(run: str, data: Dict, out: List[Dict]) -> None:
         # the bench itself gates the [1.4, 3.0] band on full runs
 
 
+def _extract_exchange(run: str, data: Dict, out: List[Dict]) -> None:
+    """scripts/exchange_bench.py output (bench "exchange_modes", r15+):
+    flat vs hierarchical vs coded accounting per mesh x workload.
+    Identity/invariant booleans are hard gates (tol 0); the structural
+    figures — per-round DCN message coalescing and the coded-over-
+    hierarchical payload ratio — gate direction-of-change (they are
+    planner ledger counts, not wall clock, so they are exact)."""
+    quick = bool(data.get("quick"))
+    w = "exchange_quick" if quick else "exchange"
+    for runrec in data.get("runs", []):
+        rep = runrec.get("report") or {}
+        meshname = str(runrec.get("mesh", "")).replace(":", "").replace(
+            ",", "_")
+        _add(out, run, w, f"{meshname}_ok",
+             1.0 if runrec.get("ok") else 0.0, "up", tol=0.0)
+        for case in rep.get("cases", []):
+            label = f"{meshname}_{case.get('workload')}"
+            checks = case.get("checks") or {}
+            _add(out, run, w, f"{label}_checks_pass",
+                 1.0 if checks and all(checks.values()) else 0.0,
+                 "up", tol=0.0)
+            f, h = case.get("flat") or {}, case.get("hierarchical") or {}
+            c = case.get("coded") or {}
+            if f.get("dcn_messages_per_round_max") and h:
+                _add(out, run, w, f"{label}_dcn_msgs_coalescing",
+                     f["dcn_messages_per_round_max"]
+                     / max(1, h.get("dcn_messages_per_round_max", 1)),
+                     "up")
+            if h.get("dcn_bytes") and c:
+                # THE coded figure: multicast charge / uncoded payload
+                _add(out, run, w, f"{label}_coded_over_hier_dcn",
+                     c.get("dcn_bytes", 0) / h["dcn_bytes"], "down")
+                _add(out, run, w, f"{label}_dcn_saved_bytes",
+                     c.get("dcn_saved_bytes", 0), "up")
+
+
 def _extract_regression(run: str, data: Dict, out: List[Dict]) -> None:
     w = f"regression_{data.get('size', 'unknown')}"
     for rec in data.get("results", []):
@@ -259,6 +295,8 @@ def extract(run: str, data) -> List[Dict]:
         _extract_io(run, data, out)
     elif data.get("bench") == "tenant_fairness":
         _extract_tenant(run, data, out)
+    elif data.get("bench") == "exchange_modes":
+        _extract_exchange(run, data, out)
     elif "identity" in data and "speedup_sorted" in data:
         _extract_pipeline(run, data, out)
     elif isinstance(data.get("results"), list):
@@ -313,7 +351,9 @@ def ingest(files: List[str], out: str) -> int:
     if not files:
         files = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))
                        + glob.glob(os.path.join(REPO,
-                                                "REGRESSION_*.json")))
+                                                "REGRESSION_*.json"))
+                       + glob.glob(os.path.join(
+                           REPO, "MULTICHIP_SCALE_*.json")))
     entries: List[Dict] = []
     skipped = []
     for path in files:

@@ -400,6 +400,47 @@ def wl_terasort_shuffle_auto(size: str, work_dir: str) -> dict:
     return _terasort_shuffle(size, work_dir, "auto")
 
 
+def wl_coded_shuffle(size: str, work_dir: str) -> dict:
+    # the CODED-job regression (ROADMAP item 3 follow-up): the full
+    # sort workload with rs:2:3 map-output stripes fanned across three
+    # supplier roots under failure-domain placement — the sortedness +
+    # record-multiset gates of the plain sort PLUS a clean stripe
+    # scrub over the written layout (every parity section re-derives,
+    # every peer shard matches its placement)
+    import numpy as np
+
+    from uda_tpu.coding.scrub import scrub_roots
+    from uda_tpu.models.sort_job import run_sort
+    from uda_tpu.utils.comparators import memcmp
+    from uda_tpu.utils.config import Config
+
+    n = _size("sort_records", size)
+    rng = np.random.default_rng(23)
+    records = [(rng.bytes(int(rng.integers(1, 24))),
+                rng.bytes(int(rng.integers(0, 64)))) for _ in range(n)]
+    roots = [work_dir] + [work_dir + f"_peer{i}" for i in (1, 2)]
+    domains = ",".join(f"{r}=rack{i % 2}" for i, r in enumerate(roots))
+    cfg = Config({"uda.tpu.coding.scheme": "rs:2:3",
+                  "uda.tpu.coding.domains": domains})
+    out = run_sort(records, num_maps=4, num_reducers=3, config=cfg,
+                   work_dir=work_dir, supplier_roots=roots)
+    got = []
+    for r, recs in sorted(out.items()):
+        keys = [k for k, _ in recs]
+        assert all(memcmp(a, b) <= 0 for a, b in zip(keys, keys[1:])), \
+            f"coded reducer {r} output not sorted"
+        got.extend(recs)
+    assert sorted(got) == sorted(records), \
+        "coded sort record multiset changed"
+    rep = scrub_roots(roots, domains={r: f"rack{i % 2}"
+                                      for i, r in enumerate(roots)})
+    assert rep["maps"] > 0 and rep["stripes"] > 0, rep
+    assert rep["parity_mismatches"] == 0 and rep["shard_faults"] == 0, \
+        rep
+    return {"records": n, "coded_maps": rep["maps"],
+            "stripes_scrubbed": rep["stripes"]}
+
+
 def wl_pi(size: str, work_dir: str) -> dict:
     from uda_tpu.models.pi import run_pi
 
@@ -425,6 +466,7 @@ WORKLOADS = {
     "inverted_index": wl_inverted_index,
     "grep": wl_grep,
     "compressed_shuffle": wl_compressed_shuffle,
+    "coded_shuffle": wl_coded_shuffle,
     "mesh_shuffle": wl_mesh_shuffle,
     "pi": wl_pi,
     "dfsio": wl_dfsio,

@@ -89,23 +89,28 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
 
 # Exchange rung: the exchange-marked faults tier (the hierarchical
 # two-stage data plane: a stage-B fault must surface as TransportError,
-# never a hang or silent loss) under the lock-order validator. The
+# never a hang or silent loss — and, since ISSUE 15, the CODED stage-B
+# path: a decode failure must complete the round byte-correct on the
+# plain coalesced tile) under the lock-order validator. The
 # exchange.round schedules are armed by the tests themselves
 # (failpoints.scoped — the stage-B match needs precise phase, an
-# ambient periodic spec would mis-fire on the planner loop); the rung's
-# job is running them with lockdep watching the metrics/layout locks
-# the device exchange shares with everything else.
+# ambient periodic spec would mis-fire on the planner loop); the rung
+# layers a SEEDED ambient exchange.decode probability on top (it only
+# ever fires on coded windows, where fallback is byte-correct by
+# construction) and runs it all with lockdep watching the metrics/
+# layout locks the device exchange shares with everything else.
+ESPEC="exchange.decode=error:prob:0.$((SEED % 4 + 2)):seed:${SEED}"
 ECOUNTERS="$(mktemp)"
 ECYCLES="$(mktemp)"
 trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}"; rm -rf "${FRROOT}"' EXIT
-echo "exchange rung:       scoped exchange.round schedules (UDA_TPU_LOCKDEP=1)"
+echo "exchange rung:       ${ESPEC} + scoped exchange.round/decode schedules (UDA_TPU_LOCKDEP=1)"
 erc=0
-env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${ESPEC}" UDA_TPU_STATS=1 \
     UDA_TPU_FLIGHTREC_DIR="${FRROOT}/exchange" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${ECYCLES}" \
     UDA_TPU_CHAOS_TELEMETRY="${ECOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
-    -k "exchange" \
+    -k "exchange or coded" \
     --continue-on-collection-errors "$@" || erc=$?
 
 # Completion rung: the survivable-shuffle guarantee (ISSUE 8) — a
@@ -313,8 +318,20 @@ def resledger_block(block, leaks_path):
     return reports
 network, n_reports = lockdep_block(nspec, nrc, ncounters, ncycles)
 n_leaks = resledger_block(network, nleaks_path)
-exchange, e_reports = lockdep_block("scoped exchange.round (per-test)",
-                                    erc, ecounters, ecycles)
+exchange, e_reports = lockdep_block(
+    "seeded exchange.decode + scoped exchange.round (per-test)",
+    erc, ecounters, ecycles)
+# the coded-multicast guarantee, surfaced: injected decode failures,
+# in-round fallbacks to the plain tile, and the multicast-model
+# saved/coded byte split — the per-test asserts enforce byte-identity
+# and the ledger-sum invariant; this block is the diffable record
+ecc = exchange["telemetry"].get("counters", {})
+exchange["coded"] = {
+    "decode_failpoint_fires": ecc.get("failpoint.exchange.decode", 0),
+    "decode_fallbacks": ecc.get("exchange.decode.fallbacks", 0),
+    "coded_bytes": ecc.get("exchange.dcn.coded.bytes", 0),
+    "saved_bytes": ecc.get("exchange.dcn.saved.bytes", 0),
+}
 completion, c_reports = lockdep_block(
     f"seeded supplier kill + warm restart (seed {seed})",
     crc_, ccounters, ccycles)

@@ -897,3 +897,269 @@ def test_completion_reconstruct_through_seeded_supplier_kill(tmp_path):
     assert sorted(got) == sorted(expected), "merged output not byte-correct"
     assert metrics.get("coding.reconstructed.partitions") > 0
     assert metrics.get("fallback.signals") == 0
+
+
+# -- failure-domain-aware stripe placement (ISSUE 15) ------------------------
+
+def test_parse_domains():
+    from uda_tpu.coding import parse_domains
+
+    assert parse_domains("") == {} and parse_domains(None) == {}
+    assert parse_domains("a=r0, b=r0 ,c=r1") == \
+        {"a": "r0", "b": "r0", "c": "r1"}
+    for bad in ("a", "a=", "=r0", "a=r0,b"):
+        with pytest.raises(ConfigError):
+            parse_domains(bad)
+
+
+def test_stripe_order_rotation_and_domain_interleave():
+    from uda_tpu.coding import stripe_order
+
+    # no domains: the PR 8 positional rotation, unchanged
+    assert stripe_order(4, 1) == [1, 2, 3, 0]
+    # domains: round-robin across domains, primary (and its domain)
+    # first, rotation order within each domain
+    #   hosts 0,1 -> rack0; hosts 2,3 -> rack1
+    order = stripe_order(4, 0, ["r0", "r0", "r1", "r1"])
+    assert order[0] == 0                      # primary stays chunk 0
+    assert order == [0, 2, 1, 3]              # r0, r1, r0, r1
+    # consecutive chunks land in distinct domains while any remain
+    doms = ["r0", "r0", "r1", "r1"]
+    for a, b in zip(order, order[1:]):
+        assert doms[a] != doms[b]
+    with pytest.raises(ConfigError):
+        stripe_order(4, 0, ["r0"])            # label/count mismatch
+
+
+def test_stripe_host_domains_spread_no_domain_holds_too_many():
+    # THE satellite invariant: with declared failure domains, no
+    # domain holds >= n-k+1 shards of one stripe (losing a whole
+    # domain never makes a stripe unrecoverable) — checked over every
+    # primary and a spread of (k, n, domain) configurations
+    hosts = ["h0", "h1", "h2", "h3", "h4", "h5"]
+    domains = {"h0": "rackA", "h1": "rackA", "h2": "rackB",
+               "h3": "rackB", "h4": "rackC", "h5": "rackC"}
+    for k, n in ((2, 4), (4, 6), (3, 5)):
+        for primary in hosts:
+            placed = [stripe_host(hosts, primary, i, domains=domains)
+                      for i in range(n)]
+            per_dom: dict = {}
+            for h in placed:
+                per_dom[domains[h]] = per_dom.get(domains[h], 0) + 1
+            assert max(per_dom.values()) < n - k + 1, \
+                (k, n, primary, placed, per_dom)
+            assert placed[0] == primary
+    # rotation (undeclared) keeps the historical placement
+    assert [stripe_host(hosts[:3], "h1", i) for i in range(4)] == \
+        ["h1", "h2", "h0", "h1"]
+    # partially-declared hosts fall back to singleton domains
+    part = {"h0": "rackA", "h1": "rackA"}
+    placed = [stripe_host(hosts[:4], "h0", i, domains=part)
+              for i in range(4)]
+    assert placed[0] == "h0" and len(set(placed)) == 4
+
+
+def test_striped_writer_and_recovery_agree_on_domain_placement(tmp_path):
+    # writer fan-out and reduce-side StripeContext must derive the
+    # SAME placement from the same domain declaration (no metadata
+    # travels) — shards land exactly where host_of says they are
+    from uda_tpu.coding import stripe_order
+
+    roots = [str(tmp_path / f"s{i}") for i in range(4)]
+    domains = {r: f"rack{i % 2}" for i, r in enumerate(roots)}
+    scheme = parse_scheme("rs:2:4")
+    parts = [[(b"k%d" % i, b"v" * i)] for i in range(3)]
+    write_striped_map_output(roots, 1, "job", "m_0", parts, scheme,
+                             domains=domains)
+    ctx = StripeContext(scheme, roots, domains=domains)
+    order = stripe_order(4, 1, [domains[r] for r in roots])
+    for i in range(scheme.n):
+        expect = roots[order[i % 4]]
+        assert ctx.host_of(roots[1], i) == expect
+        sdir = os.path.join(expect, "job", shard_map_id("m_0", i))
+        if expect == roots[1]:
+            assert not os.path.exists(sdir)   # synthesized, no bytes
+        else:
+            assert os.path.exists(os.path.join(sdir, "file.out"))
+
+
+# -- background stripe scrub (ISSUE 15) --------------------------------------
+
+def _write_coded_tree(tmp_path, nroots=3, scheme_spec="rs:2:3"):
+    roots = [str(tmp_path / f"r{i}") for i in range(nroots)]
+    scheme = parse_scheme(scheme_spec)
+    parts = [[(b"key%03d" % i, bytes(range(i % 7)) * 5)]
+             for i in range(4)]
+    write_striped_map_output(roots, 0, "jobS", "m_000", parts, scheme)
+    return roots, scheme
+
+
+def test_scrub_clean_tree_counts_stripes(tmp_path):
+    from uda_tpu.coding.scrub import scrub_roots
+
+    roots, scheme = _write_coded_tree(tmp_path)
+    metrics.reset()
+    rep = scrub_roots(roots)
+    assert rep["maps"] == 1 and rep["stripes"] == 4
+    assert rep["parity_mismatches"] == 0 and rep["shard_faults"] == 0
+    assert metrics.get("coding.scrub.stripes") == 4.0
+    assert metrics.get("coding.scrub.repairs") == 0.0
+
+
+def test_scrub_detects_lost_shard_dump_only_then_repairs(tmp_path):
+    from uda_tpu.coding.scrub import scrub_roots
+
+    roots, scheme = _write_coded_tree(tmp_path)
+    # find a peer shard and destroy it
+    victim = None
+    for root in roots[1:]:
+        for dirpath, _dirs, files in os.walk(root):
+            if "file.out" in files:
+                victim = os.path.join(dirpath, "file.out")
+    assert victim is not None
+    with open(victim, "rb") as f:
+        original = f.read()
+    os.remove(victim)
+    metrics.reset()
+    rep = scrub_roots(roots)                   # dump-only default
+    assert rep["shard_faults"] >= 1 and rep["repaired"] == 0
+    assert not os.path.exists(victim)          # bytes never touched
+    assert metrics.get("coding.scrub.repairs") >= 1.0
+    rep2 = scrub_roots(roots, repair=True)     # proactive rebuild
+    assert rep2["repaired"] >= 1
+    with open(victim, "rb") as f:
+        assert f.read() == original            # byte-exact rebuild
+    rep3 = scrub_roots(roots)
+    assert rep3["shard_faults"] == 0           # tree healthy again
+
+
+def test_scrub_detects_corrupt_shard_and_parity(tmp_path):
+    from uda_tpu.coding.scrub import scrub_roots
+
+    roots, scheme = _write_coded_tree(tmp_path)
+    victim = None
+    for root in roots[1:]:
+        for dirpath, _dirs, files in os.walk(root):
+            if "file.out" in files:
+                victim = os.path.join(dirpath, "file.out")
+    with open(victim, "r+b") as f:
+        f.seek(0)
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    rep = scrub_roots(roots)
+    assert rep["shard_faults"] >= 1
+    rep2 = scrub_roots(roots, repair=True)
+    assert rep2["repaired"] >= 1
+    assert scrub_roots(roots)["shard_faults"] == 0
+
+
+def test_maybe_scrub_interval_and_single_flight(tmp_path):
+    from uda_tpu.coding import scrub as scrub_mod
+
+    roots, _ = _write_coded_tree(tmp_path)
+    scrub_mod.scrub_state_reset()
+    cfg = Config({"uda.tpu.coding.scheme": "rs:2:3",
+                  "uda.tpu.coding.scrub.s": 3600})
+    assert scrub_mod.maybe_scrub(cfg, roots) is True
+    # within the interval (and/or in flight): no second pass
+    assert scrub_mod.maybe_scrub(cfg, roots) is False
+    deadline = time.time() + 5
+    while scrub_mod._SCRUB_ACTIVE and time.time() < deadline:
+        time.sleep(0.01)
+    assert not scrub_mod._SCRUB_ACTIVE
+    # off switch and coding-off both decline
+    scrub_mod.scrub_state_reset()
+    assert scrub_mod.maybe_scrub(
+        Config({"uda.tpu.coding.scheme": "rs:2:3"}), roots) is False
+    assert scrub_mod.maybe_scrub(
+        Config({"uda.tpu.coding.scrub.s": 10}), roots) is False
+
+
+# -- coded jobs through the models/ map phase (ISSUE 15) ---------------------
+
+def test_map_phase_writes_coded_layout_behind_scheme_flag(tmp_path):
+    # the full-workload wiring: a sort job with uda.tpu.coding.scheme
+    # set writes parity sections + v2 indexes (single root) and the
+    # striped fan-out (multi root), with output validity intact
+    from uda_tpu.coding.scrub import scrub_roots
+    from uda_tpu.models.sort_job import run_sort
+    from uda_tpu.utils.comparators import memcmp
+
+    rng = np.random.default_rng(31)
+    records = [(rng.bytes(int(rng.integers(1, 16))),
+                rng.bytes(int(rng.integers(0, 32)))) for _ in range(64)]
+    roots = [str(tmp_path / "w")] + [str(tmp_path / f"p{i}")
+                                     for i in (1, 2)]
+    cfg = Config({"uda.tpu.coding.scheme": "rs:2:3"})
+    out = run_sort(records, num_maps=3, num_reducers=2, config=cfg,
+                   work_dir=roots[0], supplier_roots=roots)
+    got = []
+    for r, recs in sorted(out.items()):
+        keys = [k for k, _ in recs]
+        assert all(memcmp(a, b) <= 0 for a, b in zip(keys, keys[1:]))
+        got.extend(recs)
+    assert sorted(got) == sorted(records)
+    # the layout really is coded: v2 stripes scrub clean, shards exist
+    rep = scrub_roots(roots)
+    assert rep["maps"] == 3 and rep["stripes"] > 0
+    assert rep["parity_mismatches"] == 0 and rep["shard_faults"] == 0
+
+
+def test_scrub_min_age_skips_fresh_maps(tmp_path):
+    # review hardening: a pass racing a live (non-atomic) striped
+    # write must not book phantom faults — fresh maps are skipped
+    # until the quiesce window passes (the daemon rung always sets it)
+    from uda_tpu.coding.scrub import scrub_roots
+
+    roots, _ = _write_coded_tree(tmp_path)
+    rep = scrub_roots(roots, min_age_s=3600)
+    assert rep["maps"] == 0 and rep["stripes"] == 0
+    rep2 = scrub_roots(roots, min_age_s=0)
+    assert rep2["maps"] == 1 and rep2["shard_faults"] == 0
+
+
+def test_scrub_survives_damaged_primary(tmp_path):
+    # review hardening (round 5): one torn/lost PRIMARY must be a
+    # counted finding, never an aborted pass — the neighbor maps still
+    # get scrubbed
+    from uda_tpu.coding.scrub import scrub_roots
+
+    roots = [str(tmp_path / f"r{i}") for i in range(3)]
+    scheme = parse_scheme("rs:2:3")
+    for mid in ("m_000", "m_001"):
+        parts = [[(b"k", b"v" * 9)] for _ in range(2)]
+        write_striped_map_output(roots, 0, "jobP", mid, parts, scheme)
+    os.remove(os.path.join(roots[0], "jobP", "m_000", "file.out"))
+    rep = scrub_roots(roots)
+    assert rep["primary_faults"] == 1
+    assert rep["maps"] == 1 and rep["stripes"] == 2   # m_001 scrubbed
+    assert rep["shard_faults"] == 0
+
+
+def test_scrub_corrupt_primary_never_repairs_healthy_shards(tmp_path):
+    # review hardening (round 6): a parity mismatch marks the PRIMARY
+    # untrusted — the shard pass (and especially repair) is skipped so
+    # corrupt primary bytes can never overwrite the last good copies
+    from uda_tpu.coding.scrub import scrub_roots
+
+    roots, _ = _write_coded_tree(tmp_path)
+    # flip a byte inside the PRIMARY's file.out data region
+    primary = os.path.join(roots[0], "jobS", "m_000", "file.out")
+    with open(primary, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    shard_files = {}
+    for root in roots[1:]:
+        for dirpath, _dirs, files in os.walk(root):
+            if "file.out" in files:
+                p = os.path.join(dirpath, "file.out")
+                with open(p, "rb") as f:
+                    shard_files[p] = f.read()
+    rep = scrub_roots(roots, repair=True)
+    assert rep["parity_mismatches"] >= 1
+    assert rep["repaired"] == 0 and rep["shard_faults"] == 0
+    for p, want in shard_files.items():      # peer bytes untouched
+        with open(p, "rb") as f:
+            assert f.read() == want

@@ -1,0 +1,401 @@
+"""Coded multicast exchange (ISSUE 15): the GF(2^8)-coded stage-B
+path vs the hierarchical and flat bodies — byte-identity on every
+workload shape, the coding-aware window plan, the multicast-model
+ledger (coded + saved == uncoded payload), the uncodable-case
+zero-overhead guarantees, and the in-round decode-failure fallback.
+
+Runs on the conftest 8-virtual-device CPU mesh shaped (dcn=2, ici=4)
+and (dcn=4, ici=2); the 4x4/8x8 shapes ride scripts/exchange_bench.py
+(the shared subprocess driver, gated in ci.sh --quick and committed
+as MULTICHIP_SCALE_r15.json)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from uda_tpu.parallel import (distributed_sort_step, make_mesh,
+                              mesh_topology, plan_rounds,
+                              shuffle_exchange, uniform_splitters)
+from uda_tpu.parallel.exchange import resolve_exchange_mode
+from uda_tpu.parallel.planner import CODED_CHUNK_ROWS, CODED_WIN_FACTOR
+from uda_tpu.utils.failpoints import failpoints
+from uda_tpu.utils.metrics import metrics
+
+AXIS = "shuffle"
+AXIS2 = ("dcn", AXIS)
+
+
+def _mesh2(p=2, c=4):
+    devs = np.asarray(jax.devices()[:p * c])
+    return Mesh(devs.reshape(p, c), ("dcn", AXIS))
+
+
+def _random_words(n, w, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2**32, size=(n, w), dtype=np.uint32)
+
+
+def _assert_rounds_identical(a, b):
+    assert len(a) == len(b)
+    for r, ((aw, ac), (bw, bc)) in enumerate(zip(a, b)):
+        np.testing.assert_array_equal(np.asarray(ac), np.asarray(bc),
+                                      err_msg=f"counts, round {r}")
+        np.testing.assert_array_equal(np.asarray(aw), np.asarray(bw),
+                                      err_msg=f"words, round {r}")
+
+
+# -- the on-device GF kernel -------------------------------------------------
+
+def test_gfjax_encode_decode_roundtrip():
+    # the jitted field arithmetic must invert exactly — and agree with
+    # the host codec's byte-level matmul on the word byte view
+    import jax.numpy as jnp
+
+    from uda_tpu.coding import gf256
+    from uda_tpu.coding.gfjax import (coded_matrices, gf_decode_row,
+                                      gf_matmul_words)
+
+    rng = np.random.default_rng(3)
+    for c in (2, 4, 8):
+        enc, dec = coded_matrices(c)
+        assert np.array_equal(gf256.inv_matrix(enc), dec)
+        # enc @ dec == identity over the field
+        eye = gf256.matmul(enc, dec)
+        assert np.array_equal(eye, np.eye(c, dtype=np.uint8))
+        blocks = rng.integers(0, 2**32, size=(c, 5, 3), dtype=np.uint32)
+        coded = np.asarray(gf_matmul_words(enc, jnp.asarray(blocks)))
+        # host reference: the same product on the byte view
+        host = gf256.matmul(enc, blocks.view(np.uint8).reshape(c, -1))
+        assert np.array_equal(coded.view(np.uint8).reshape(c, -1), host)
+        for row in range(c):
+            got = np.asarray(gf_decode_row(dec, jnp.int32(row),
+                                           jnp.asarray(coded)))
+            np.testing.assert_array_equal(got, blocks[row])
+
+
+def test_gfjax_rejects_bad_block_counts():
+    from uda_tpu.coding.gfjax import coded_matrices
+    from uda_tpu.utils.errors import ConfigError
+
+    for bad in (0, 1, 129):
+        with pytest.raises(ConfigError):
+            coded_matrices(bad)
+
+
+# -- mode resolution ---------------------------------------------------------
+
+def test_resolve_coded_mode_flags():
+    mesh2 = _mesh2(2, 4)
+    topo, hier, coded = resolve_exchange_mode(mesh2, AXIS2, "coded")
+    assert topo.hierarchical and hier and coded
+    assert topo.coded_capable
+    # a 1-axis mesh degrades to the flat path — zero coded overhead,
+    # not an error (unlike mode="hierarchical")
+    mesh1 = make_mesh(8, AXIS)
+    topo1, hier1, coded1 = resolve_exchange_mode(mesh1, AXIS, "coded")
+    assert not hier1 and not coded1
+    # auto never arms coding (opt-in dispatch)
+    _, _, coded_auto = resolve_exchange_mode(mesh2, AXIS2, "auto")
+    assert not coded_auto
+
+
+def test_coded_on_flat_mesh_runs_plain():
+    mesh1 = make_mesh(8, AXIS)
+    words = _random_words(64, 2, seed=1)
+    dest = (words[:, 0] % 8).astype(np.int32)
+    metrics.reset()
+    results, lay = shuffle_exchange(words, dest, mesh1, AXIS, capacity=8,
+                                    mode="coded")
+    assert not lay.coded and not lay.hierarchical and len(results) == 1
+    assert metrics.get("exchange.dcn.coded.bytes") == 0.0
+
+
+# -- byte-identity vs flat/hier across workload shapes -----------------------
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+def test_coded_matches_flat_and_hier_uniform(shape):
+    p, c = shape
+    mesh = _mesh2(p, c)
+    words = _random_words(8 * 32, 3, seed=2)
+    words[:64, 0] = words[64:128, 0]        # duplicate keys ride along
+    dest = (words[:, 1] % 8).astype(np.int32)
+    flat, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=9,
+                               mode="flat")
+    hier, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=9,
+                               mode="hierarchical")
+    coded, lay = shuffle_exchange(words, dest, mesh, AXIS2, capacity=9,
+                                  mode="coded")
+    assert lay.coded and lay.hierarchical
+    _assert_rounds_identical(coded, flat)
+    _assert_rounds_identical(coded, hier)
+
+
+def test_coded_skew_single_dest_identity_and_zero_overhead():
+    # every record to ONE chip: single-destination pairs are uncodable
+    # — the plan routes every window to the plain tile, the multiround
+    # backlog drains identically, zero coded bytes ever booked
+    mesh = _mesh2(2, 4)
+    words = _random_words(8 * 16, 2, seed=3)
+    dest = np.zeros(8 * 16, np.int32)
+    flat, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=4,
+                               mode="flat")
+    metrics.reset()
+    coded, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=4,
+                                mode="coded")
+    assert len(coded) == 4
+    _assert_rounds_identical(coded, flat)
+    assert metrics.get("exchange.dcn.coded.bytes") == 0.0
+    assert metrics.get("exchange.dcn.saved.bytes") == 0.0
+
+
+def test_coded_empty_pod_edge():
+    # every record lands in pod 0: pod 1 only sends; its pair codes
+    # across pod 0's four member chips
+    mesh = _mesh2(2, 4)
+    words = _random_words(8 * 24, 2, seed=4)
+    dest = (words[:, 0] % 4).astype(np.int32)    # devices 0..3 = pod 0
+    flat, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=24,
+                               mode="flat")
+    metrics.reset()
+    coded, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=24,
+                                mode="coded")
+    _assert_rounds_identical(coded, flat)
+    # only pod1 -> pod0 traffic; source-pod labels follow the charge
+    assert metrics.get("exchange.dcn.messages") == 1.0
+    if metrics.get("exchange.dcn.coded.bytes"):
+        assert metrics.get("exchange.dcn.coded.bytes", pod=1) > 0
+        assert metrics.get("exchange.dcn.coded.bytes", pod=0) == 0.0
+
+
+def test_coded_capacity_one_many_rounds():
+    # capacity 1 windows hold <= 1 row per (src, dst): blocks pad far
+    # past their payload, the break-even guard declines every window
+    # and the round ladder still drains byte-identically
+    mesh = _mesh2(4, 2)
+    words = _random_words(8 * 6, 2, seed=5)
+    dest = (words[:, 0] % 8).astype(np.int32)
+    flat, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=1,
+                               mode="flat")
+    metrics.reset()
+    coded, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=1,
+                                mode="coded")
+    assert len(coded) > 1
+    _assert_rounds_identical(coded, flat)
+    assert metrics.get("exchange.dcn.coded.bytes") == 0.0
+
+
+def test_coded_pod_local_zero_dcn():
+    mesh = _mesh2(2, 4)
+    n = 8 * 16
+    words = _random_words(n, 2, seed=6)
+    dest = np.zeros(n, np.int32)
+    shard = n // 8
+    for s in range(8):
+        base = (s // 4) * 4
+        dest[s * shard:(s + 1) * shard] = \
+            base + words[s * shard:(s + 1) * shard, 1] % 4
+    metrics.reset()
+    coded, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=16,
+                                mode="coded")
+    assert metrics.get("exchange.dcn.bytes") == 0.0
+    assert metrics.get("exchange.dcn.coded.bytes") == 0.0
+    flat, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=16,
+                               mode="flat")
+    _assert_rounds_identical(coded, flat)
+
+
+# -- the multicast-model ledger ----------------------------------------------
+
+def test_coded_ledger_sum_and_acceptance_ratio():
+    # THE acceptance gates at test scale: coded + saved == the uncoded
+    # payload, and the uniform cross-pod charge is <= 0.67x
+    # hierarchical (pod size 4 -> the plan's chunk cut approaches 4x)
+    mesh = _mesh2(2, 4)
+    words = _random_words(8 * 32, 3, seed=7)
+    dest = (words[:, 1] % 8).astype(np.int32)
+    metrics.reset()
+    shuffle_exchange(words, dest, mesh, AXIS2, capacity=32,
+                     mode="hierarchical")
+    hier_dcn = metrics.get("exchange.dcn.bytes")
+    assert hier_dcn > 0
+    metrics.reset()
+    shuffle_exchange(words, dest, mesh, AXIS2, capacity=32,
+                     mode="coded")
+    coded_dcn = metrics.get("exchange.dcn.bytes")
+    cb = metrics.get("exchange.dcn.coded.bytes")
+    sb = metrics.get("exchange.dcn.saved.bytes")
+    assert coded_dcn == cb > 0
+    assert cb + sb == hier_dcn            # the ledger-sum invariant
+    assert cb <= 0.67 * hier_dcn          # the acceptance figure
+    # messages stay the pod-pair coalesced count
+    assert metrics.get("exchange.dcn.messages") == 2.0
+
+
+# -- the coding-aware window plan --------------------------------------------
+
+def test_plan_rounds_coded_window_decision():
+    mesh = _mesh2(2, 4)
+    topo = mesh_topology(mesh, AXIS2)
+    counts = np.zeros((8, 8), np.int64)
+    # pair pod0 -> pod1: 4 destination chips, 8 rows each = 32 rows;
+    # max block 8 -> L pads to 8, 8 * FACTOR <= 32 -> codable
+    for j in range(4):
+        counts[j, 4 + j] = 8
+    plan = plan_rounds(counts, 8, topo, record_bytes=8,
+                       hierarchical=True, coded=True)
+    assert plan.coded
+    w0 = plan.windows[0]
+    assert w0.coded
+    assert w0.l_rows == 8 and plan.coded_l_rows == 8
+    assert w0.coded_rows == 8 and w0.saved_rows == 24
+    assert w0.coded_rows + w0.saved_rows == w0.dcn_rows == 32
+    assert w0.per_pod_coded == ((0, 8, 24),)
+    # the coded stage-C broadcast charges ICI: (c-1) * c * L per pair
+    assert w0.ici_rows_coded >= (4 - 1) * 4 * 8
+    # chunk granularity: a 5-row max block pads to CODED_CHUNK_ROWS
+    counts2 = np.zeros((8, 8), np.int64)
+    counts2[0, 4] = 5
+    counts2[1, 5] = 5
+    counts2[2, 6] = 5
+    counts2[3, 7] = 5
+    plan2 = plan_rounds(counts2, 8, topo, record_bytes=8,
+                        hierarchical=True, coded=True)
+    w = plan2.windows[0]
+    assert w.coded
+    assert w.l_rows == -(-5 // CODED_CHUNK_ROWS) * CODED_CHUNK_ROWS
+
+
+def test_plan_rounds_break_even_guard_declines_skew():
+    mesh = _mesh2(2, 4)
+    topo = mesh_topology(mesh, AXIS2)
+    # one dominant destination chip: L ~ S, coding is a loss -> the
+    # whole window rides plain (and a window with ONE uncodable pair
+    # among codable ones also rides plain)
+    counts = np.zeros((8, 8), np.int64)
+    counts[0, 4] = 30
+    counts[1, 5] = 2
+    plan = plan_rounds(counts, 32, topo, record_bytes=8,
+                       hierarchical=True, coded=True)
+    assert plan.coded                      # dispatch armed...
+    assert not plan.windows[0].coded       # ...but the window declined
+    assert plan.coded_l_rows == 0
+    assert CODED_WIN_FACTOR >= 2           # the guard the test pins
+    # coded=False planning never sets coded fields (the hier baseline)
+    plan_h = plan_rounds(counts, 32, topo, record_bytes=8,
+                         hierarchical=True)
+    assert not plan_h.coded and not plan_h.windows[0].coded
+
+
+# -- distributed-step dispatch ------------------------------------------------
+
+def test_multiround_coded_matches_flat_mesh():
+    mesh1 = make_mesh(8, AXIS)
+    mesh2 = _mesh2(2, 4)
+    words = _random_words(1024, 4, seed=8)
+    spl = uniform_splitters(8)
+    metrics.reset()
+    a = distributed_sort_step(words, spl, mesh2, AXIS2, capacity=32,
+                              num_keys=2, multiround="always",
+                              exchange_mode="coded")
+    coded_bytes = metrics.get("exchange.dcn.coded.bytes")
+    b = distributed_sort_step(words, spl, mesh1, AXIS, capacity=32,
+                              num_keys=2, multiround="always")
+    a.check()
+    b.check()
+    np.testing.assert_array_equal(np.asarray(a.words),
+                                  np.asarray(b.words))
+    assert coded_bytes > 0                # the windows really coded
+
+
+def test_fused_step_coded_downgrades_to_staged_body():
+    # the fused single-round program has no host plan: coded dispatch
+    # runs the plain staged body, byte-identical to the flat mesh
+    mesh1 = make_mesh(8, AXIS)
+    mesh2 = _mesh2(2, 4)
+    words = _random_words(1024, 4, seed=9)
+    spl = uniform_splitters(8)
+    metrics.reset()
+    a = distributed_sort_step(words, spl, mesh2, AXIS2, capacity=256,
+                              num_keys=2, exchange_mode="coded")
+    b = distributed_sort_step(words, spl, mesh1, AXIS, capacity=256,
+                              num_keys=2)
+    a.check()
+    b.check()
+    np.testing.assert_array_equal(np.asarray(a.words),
+                                  np.asarray(b.words))
+    assert metrics.get("exchange.dcn.coded.bytes") == 0.0
+
+
+# -- failure semantics -------------------------------------------------------
+
+@pytest.mark.faults
+def test_coded_decode_failpoint_falls_back_within_round():
+    # a forced decode failure on a coded window must complete the
+    # round byte-correct on the plain coalesced tile, count the
+    # fallback, and book the PLAIN ledger for that window
+    mesh = _mesh2(2, 4)
+    words = _random_words(8 * 32, 3, seed=10)
+    dest = (words[:, 1] % 8).astype(np.int32)
+    flat, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=32,
+                               mode="flat")
+    metrics.reset()
+    shuffle_exchange(words, dest, mesh, AXIS2, capacity=32,
+                     mode="hierarchical")
+    hier_dcn = metrics.get("exchange.dcn.bytes")
+    metrics.reset()
+    with failpoints.scoped("exchange.decode=error"):
+        coded, _ = shuffle_exchange(words, dest, mesh, AXIS2,
+                                    capacity=32, mode="coded")
+    _assert_rounds_identical(coded, flat)
+    assert metrics.get("exchange.decode.fallbacks") >= 1.0
+    assert metrics.get("exchange.dcn.coded.bytes") == 0.0
+    assert metrics.get("exchange.dcn.bytes") == hier_dcn
+
+
+@pytest.mark.faults
+def test_coded_decode_failpoint_multiround_scatter():
+    # same contract through the multiround accumulator path
+    mesh1 = make_mesh(8, AXIS)
+    mesh2 = _mesh2(2, 4)
+    words = _random_words(1024, 4, seed=11)
+    spl = uniform_splitters(8)
+    metrics.reset()
+    with failpoints.scoped("exchange.decode=error"):
+        a = distributed_sort_step(words, spl, mesh2, AXIS2, capacity=32,
+                                  num_keys=2, multiround="always",
+                                  exchange_mode="coded")
+    b = distributed_sort_step(words, spl, mesh1, AXIS, capacity=32,
+                              num_keys=2, multiround="always")
+    a.check()
+    b.check()
+    np.testing.assert_array_equal(np.asarray(a.words),
+                                  np.asarray(b.words))
+    assert metrics.get("exchange.decode.fallbacks") >= 1.0
+    assert metrics.get("exchange.dcn.coded.bytes") == 0.0
+
+
+@pytest.mark.faults
+def test_coded_seeded_chaos_rung():
+    # the run_chaos.sh coded rung shape: a seeded PROBABILISTIC decode
+    # schedule — some windows code, some fall back mid-round — and the
+    # exchange must stay byte-identical to flat with the ledger-sum
+    # invariant holding for WHATEVER mix executed:
+    #   dcn.bytes + saved.bytes == the uncoded payload (hier figure)
+    mesh = _mesh2(2, 4)
+    words = _random_words(8 * 32, 3, seed=12)
+    dest = (words[:, 1] % 8).astype(np.int32)
+    flat, _ = shuffle_exchange(words, dest, mesh, AXIS2, capacity=4,
+                               mode="flat")
+    metrics.reset()
+    shuffle_exchange(words, dest, mesh, AXIS2, capacity=4,
+                     mode="hierarchical")
+    hier_dcn = metrics.get("exchange.dcn.bytes")
+    metrics.reset()
+    with failpoints.scoped("exchange.decode=error:prob:0.5:seed:12"):
+        coded, _ = shuffle_exchange(words, dest, mesh, AXIS2,
+                                    capacity=4, mode="coded")
+    _assert_rounds_identical(coded, flat)
+    assert (metrics.get("exchange.dcn.bytes")
+            + metrics.get("exchange.dcn.saved.bytes")) == hier_dcn

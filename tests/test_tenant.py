@@ -146,6 +146,109 @@ def test_wdrr_weight_proportionality_and_deficit_bounds():
         assert tq.deficit <= sched.quantum * weights[t] + 1e-9
 
 
+def test_wdrr_byte_cost_proportionality_mixed_chunk_sizes():
+    # ISSUE 15 satellite (ROADMAP item 1 follow-up): deficits earned/
+    # charged in BYTES — a tenant fetching big chunks must not
+    # out-draw an equal-weight tenant fetching small ones. a(2x
+    # weight, 64 KB chunks) vs b(1x, 256 KB) vs c(1x, 16 KB): granted
+    # BYTES converge to the 2:1:1 weight ratio over the contended
+    # window even though the request COUNTS wildly differ
+    weights = {"a": 2, "b": 1, "c": 1}
+    sizes = {"a": 64 << 10, "b": 256 << 10, "c": 16 << 10}
+    sched = CreditScheduler(4, weight_of=lambda t: weights.get(t, 1),
+                            quantum=float(64 << 10))
+    conn = _Conn()
+    live, parked_cost = [], {t: 0 for t in weights}
+    order = ([t for _ in range(120) for t in ("c",) * 8]
+             + [t for _ in range(120) for t in ("b",) * 1]
+             + [t for _ in range(120) for t in ("a",) * 2])
+    # interleave arrivals so every queue holds backlog throughout
+    arrivals = [t for trio in zip(order[:960:8], order[960:1080],
+                                  order[1080:1320:2]) for t in trio]
+    for i, t in enumerate(arrivals):
+        if sched.admit(t, (conn, (t, i)), cost=sizes[t]):
+            live.append((t, i))
+        else:
+            parked_cost[t] += sizes[t]
+    assert all(parked_cost[t] > 0 for t in weights)
+    served_bytes = {t: 0 for t in weights}
+    guard = 0
+    while live and guard < 100_000:
+        guard += 1
+        t, _i = live.pop(0)
+        sched.release(t)
+        for _conn, entry in sched.grant_parked():
+            served_bytes[entry[0]] += sizes[entry[0]]
+            live.append(entry)
+        # stop once the contended window ends (some queue drained)
+        if any(sched.backlog(t) == 0 for t in weights):
+            break
+    total = sum(served_bytes.values())
+    assert total > 0
+    # byte shares within the contended window: a ~1/2, b ~1/4, c ~1/4
+    share = {t: served_bytes[t] / total for t in weights}
+    assert 0.35 <= share["a"] <= 0.65, share
+    assert abs(share["b"] - share["c"]) < 0.15, share
+
+
+def test_wdrr_oversized_heads_keep_weighted_byte_shares():
+    # review hardening (round 4): when EVERY head costs far more than
+    # one turn's earning (4 MB chunks vs 64 KB quantum — the bench
+    # regime), deficits must keep accumulating weight-proportionally
+    # (uncapped while backlogged); the saturating cap degenerated
+    # grants to round-robin and 2x weight earned ~1.3x bytes
+    weights = {"a": 2, "b": 1, "c": 1}
+    cost = 4 << 20
+    sched = CreditScheduler(4, weight_of=lambda t: weights.get(t, 1),
+                            quantum=float(64 << 10))
+    conn = _Conn()
+    live = []
+    for i in range(240):
+        t = ("a", "b", "c")[i % 3]
+        if sched.admit(t, (conn, (t, i)), cost=cost):
+            live.append((t, i))
+    served = {t: 0 for t in weights}
+    guard = 0
+    while live and guard < 50_000:
+        guard += 1
+        t, _i = live.pop(0)
+        sched.release(t)
+        for _conn, entry in sched.grant_parked():
+            served[entry[0]] += 1
+            live.append(entry)
+        if any(sched.backlog(t) == 0 for t in weights):
+            break
+    total = sum(served.values())
+    assert total > 20
+    share = served["a"] / total
+    # 2:1:1 weights -> a should take ~half the bytes (all costs equal,
+    # so grant counts are byte shares); the round-robin failure mode
+    # gave ~1/3
+    assert share >= 0.42, (share, served)
+    assert abs(served["b"] - served["c"]) <= max(4, 0.25 * served["b"])
+
+
+def test_wdrr_oversized_head_accumulates_never_starves():
+    # a head request dearer than one turn's earning accumulates
+    # deficit across turns; an otherwise-empty sweep force-serves the
+    # most-indebted head instead of idling free credits
+    sched = CreditScheduler(1, quantum=float(1 << 10))  # 1 KB quantum
+    conn = _Conn()
+    assert sched.admit("big", (conn, ("big", 0)), cost=1 << 10)
+    assert sched.admit("big", (conn, ("big", 1)), cost=1 << 20) is False
+    sched.release("big")
+    granted = []
+    for _ in range(10):
+        granted += [e for _, e in sched.grant_parked()]
+        if granted:
+            break
+    assert granted == [("big", 1)]          # served, never stranded
+    assert sched.backlog() == 0
+    # the byte debt is on the books: deficit went negative
+    assert sched._tenants["big"].deficit < 0
+    assert sched.granted_cost["big"] == (1 << 10) + (1 << 20)
+
+
 def test_wdrr_fifo_within_tenant_and_inline_grant():
     sched = CreditScheduler(1)
     conn = _Conn()
@@ -626,3 +729,22 @@ def test_abusive_tenant_degrades_only_itself(two_job_supplier):
     assert metrics.get("failpoint.tenant.validate") >= 3
     # victim served zero errors and the credit pool drained clean
     _await(lambda: server._sched.free == server._sched.total)
+
+
+def test_wdrr_inline_grant_deepens_existing_debt():
+    # review hardening (round 5): a debtor's uncontended inline draw
+    # stays granted (work conservation) but the byte debt keeps
+    # growing — it cannot be laundered by arriving one-at-a-time into
+    # free credits
+    sched = CreditScheduler(1, quantum=float(1 << 10))
+    conn = _Conn()
+    assert sched.admit("big", (conn, ("big", 0)), cost=1 << 10)
+    assert sched.admit("big", (conn, ("big", 1)), cost=1 << 20) is False
+    sched.release("big")
+    while not sched.grant_parked():
+        pass                                 # force-serve books debt
+    debt0 = sched._tenants["big"].deficit
+    assert debt0 < 0
+    sched.release("big")
+    assert sched.admit("big", (conn, ("big", 2)), cost=1 << 20) is True
+    assert sched._tenants["big"].deficit == debt0 - (1 << 20)

@@ -1,0 +1,120 @@
+"""On-device GF(2^8) arithmetic for the coded multicast exchange.
+
+The coded stage-B path (uda_tpu.parallel.exchange ``coded_round_body``)
+encodes a pod pair's per-destination row blocks INSIDE the jitted round
+program, so the field arithmetic has to be expressible in XLA ops. The
+records are uint32 row matrices; GF(2^8) acts bytewise, so a
+scalar-by-tensor product is four table gathers (one per byte lane of
+the word) through the same 256x256 ``MUL`` table uda_tpu.coding.gf256
+built for the host codec — addition stays ``bitwise_xor`` on whole
+words. Everything is exact integer arithmetic: encode -> decode is
+byte-identical by construction, which is what lets the coded exchange
+keep the flat oracle's byte-identity gate.
+
+The code itself is the square Cauchy matrix ``A[t, j] = 1/((c + t) ^
+j)`` over the ``c = pod_size`` destination blocks — literally the
+parity rows of the in-tree Cauchy-RS construction at ``k = c, n = 2c``
+(uda_tpu.coding.rs.parity_matrix), whose every square submatrix is
+invertible, so the full matrix is too. ``coded_matrices`` returns the
+matrix and its inverse (host-side Gauss-Jordan, gf256.inv_matrix);
+both ride into the jitted body as compile-time constants.
+
+A decoder only ever needs its OWN destination block, and the owning
+chip index is a traced value inside the SPMD body — ``gf_decode_row``
+therefore takes the inverse-matrix ROW by traced index and combines
+the coded chunks with traced coefficients (the flattened MUL table
+indexed at ``coeff * 256 + byte``), instead of materializing all c
+decoded blocks and dynamically slicing one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from uda_tpu.coding import gf256, rs
+from uda_tpu.utils.errors import ConfigError
+
+__all__ = ["coded_matrices", "gf_scale_words", "gf_matmul_words",
+           "gf_decode_row", "MAX_CODED_BLOCKS"]
+
+# the Cauchy points c+t and j must stay distinct inside GF(2^8):
+# c + (c-1) <= 255 -> c <= 128
+MAX_CODED_BLOCKS = 128
+
+_BYTE_SHIFTS = (0, 8, 16, 24)
+
+
+def coded_matrices(c: int) -> tuple[np.ndarray, np.ndarray]:
+    """The (c, c) encode matrix for ``c`` destination blocks and its
+    inverse, both uint8. ``c`` is the pod size — one coded chunk per
+    member chip, full rank so any member can recover any block."""
+    if not (2 <= c <= MAX_CODED_BLOCKS):
+        raise ConfigError(f"coded exchange needs 2 <= pod_size <= "
+                          f"{MAX_CODED_BLOCKS}, got {c}")
+    enc = rs.parity_matrix(c, 2 * c)
+    return enc, gf256.inv_matrix(enc)
+
+
+def gf_scale_words(coeff: int, x):
+    """``coeff * x`` in GF(2^8), bytewise over a uint32 tensor.
+    ``coeff`` is a STATIC python int (an encode-matrix entry)."""
+    import jax.numpy as jnp
+
+    coeff = int(coeff)
+    if coeff == 0:
+        return jnp.zeros_like(x)
+    if coeff == 1:
+        return x
+    tab = jnp.asarray(gf256.MUL[coeff], jnp.uint32)
+    out = jnp.zeros_like(x)
+    for shift in _BYTE_SHIFTS:
+        b = (x >> np.uint32(shift)) & np.uint32(0xFF)
+        out = out | (jnp.take(tab, b) << np.uint32(shift))
+    return out
+
+
+def gf_matmul_words(mat: np.ndarray, blocks):
+    """GF(2^8) matrix action on stacked uint32 blocks: ``mat`` is a
+    STATIC (r, k) uint8 matrix, ``blocks`` is uint32[k, ...]; returns
+    uint32[r, ...] where row t = XOR_j mat[t, j] * blocks[j]. The
+    static coefficients unroll at trace time (k^2 scalar products of
+    4 gathers each — c <= 8 on every bench mesh)."""
+    import jax.numpy as jnp
+
+    outs = []
+    for t in range(mat.shape[0]):
+        acc = None
+        for j in range(mat.shape[1]):
+            coeff = int(mat[t, j])
+            if coeff == 0:
+                continue
+            term = gf_scale_words(coeff, blocks[j])
+            acc = term if acc is None else acc ^ term
+        outs.append(acc if acc is not None
+                    else jnp.zeros_like(blocks[0]))
+    return jnp.stack(outs)
+
+
+def gf_decode_row(inv, row_index, chunks):
+    """One decoded block: ``XOR_t inv[row_index, t] * chunks[t]`` with
+    ``row_index`` TRACED (the decoder's own chip index inside the SPMD
+    body). ``inv`` is the static (k, k) uint8 inverse; the traced
+    coefficients index the flattened MUL table at ``coeff*256 + byte``
+    (coeff 0 rows of the table are all zero, so zero coefficients
+    vanish without a branch)."""
+    import jax.numpy as jnp
+
+    k = int(inv.shape[0])
+    inv_dev = jnp.asarray(inv, jnp.uint32)
+    mul_flat = jnp.asarray(gf256.MUL.reshape(-1), jnp.uint32)
+    coeffs = inv_dev[row_index]                   # [k], traced
+    acc = jnp.zeros_like(chunks[0])
+    for t in range(k):
+        base = coeffs[t] * np.uint32(256)
+        term = jnp.zeros_like(chunks[t])
+        for shift in _BYTE_SHIFTS:
+            b = (chunks[t] >> np.uint32(shift)) & np.uint32(0xFF)
+            term = term | (jnp.take(mul_flat, base + b)
+                           << np.uint32(shift))
+        acc = acc ^ term
+    return acc

@@ -43,16 +43,31 @@ log = get_logger()
 class StripeContext:
     """Everything the reconstruction needs that the failing request
     does not carry: the coding scheme, the job's canonically-ordered
-    supplier list (the placement domain — sorted unique hosts), and
-    the task's recovery ledger for source ranking/accounting."""
+    supplier list (the placement universe — sorted unique hosts), the
+    declared failure-domain map (``uda.tpu.coding.domains``; empty =
+    positional rotation), and the task's recovery ledger for source
+    ranking/accounting."""
 
-    def __init__(self, scheme, suppliers: Sequence[str], ledger=None):
+    def __init__(self, scheme, suppliers: Sequence[str], ledger=None,
+                 domains=None):
         self.scheme = scheme
         self.suppliers = list(suppliers)
         self.ledger = ledger
+        self.domains = dict(domains or {})
+        # per-primary placement cache: the permutation depends only on
+        # (suppliers, domains, primary), and host_of runs once per
+        # CHUNK on the reconstruction hot path — rebuilding the
+        # domain-interleave per chunk would be O(h) each
+        self._orders: dict = {}
 
     def host_of(self, primary: str, chunk: int) -> str:
-        return stripe_host(self.suppliers, primary, chunk)
+        order = self._orders.get(primary)
+        if order is None:
+            order = self._orders[primary] = [
+                stripe_host(self.suppliers, primary, c,
+                            domains=self.domains)
+                for c in range(max(1, len(self.suppliers)))]
+        return order[chunk % len(order)]
 
 
 def start_recovery(client, req, ctx: StripeContext,

@@ -288,8 +288,12 @@ class MergeManager:
             # supplier_roots; the all-local degenerate keeps [""]
             universe = sorted({h for hosts, _ in entries
                                for h in hosts if h}) or [""]
-            stripe_ctx = StripeContext(self.coding_scheme, universe,
-                                       ledger=self.ledger)
+            from uda_tpu.coding import parse_domains
+
+            stripe_ctx = StripeContext(
+                self.coding_scheme, universe, ledger=self.ledger,
+                domains=parse_domains(
+                    str(self.cfg.get("uda.tpu.coding.domains"))))
         segs = [Segment(self.client, job_id, mid, reduce_id,
                         self.chunk_size, host=hosts[0],
                         policy=self.retry_policy, hosts=hosts,

@@ -74,7 +74,8 @@ class MapReduceJob:
                  num_reducers: int = 2,
                  partitioner: Callable[[bytes, int], int] = hash_partitioner,
                  config: Optional[Config] = None,
-                 work_dir: Optional[str] = None):
+                 work_dir: Optional[str] = None,
+                 supplier_roots: Optional[Sequence[str]] = None):
         self.job_id = job_id
         self.mapper = mapper
         self.reducer = reducer
@@ -84,6 +85,26 @@ class MapReduceJob:
         self.partitioner = partitioner
         self.cfg = config or Config()
         self.work_dir = work_dir or tempfile.mkdtemp(prefix=f"uda_{job_id}_")
+        # erasure-coded deployments: the job's supplier roots
+        # (write_striped_map_output fans stripe chunks across them);
+        # default = the single work_dir (parity section only, no
+        # fan-out). Placement is derived INDEPENDENTLY by writer and
+        # reducer from the canonical order — "sorted unique" is that
+        # order (uda_tpu.coding), so the list is canonicalized here:
+        # an arbitrary caller order would place shards where the
+        # reduce-side stripe_host never looks, failing exactly at the
+        # k-th-loss reconstruction this layout exists for. The reduce
+        # side reads work_dir, so the primary MUST be among the roots
+        # — a list that omits it would silently land the full MOF
+        # elsewhere and the job would merge nothing; fail loudly.
+        self.supplier_roots = sorted(set(supplier_roots or []))
+        if self.supplier_roots and self.work_dir not in self.supplier_roots:
+            from uda_tpu.utils.errors import ConfigError
+
+            raise ConfigError(
+                f"supplier_roots must include work_dir "
+                f"{self.work_dir!r} (the primary MOF root the reduce "
+                f"side reads); got {sorted(supplier_roots)}")
 
     # -- map phase ----------------------------------------------------------
 
@@ -96,8 +117,23 @@ class MapReduceJob:
 
     def run_maps(self, inputs: Sequence[object]) -> MOFWriter:
         """Run the mapper over each input split; write sorted partitioned
-        MOFs (what Hadoop's map-side sort+spill produces)."""
-        writer = MOFWriter(self.work_dir, self.job_id, codec=self._codec())
+        MOFs (what Hadoop's map-side sort+spill produces). With
+        ``uda.tpu.coding.scheme`` set the map phase writes the CODED
+        layout — parity section + v2 index always, and the cross-
+        supplier stripe fan-out (write_striped_map_output, failure-
+        domain placement per ``uda.tpu.coding.domains``) when the job
+        carries >1 supplier root — so coded jobs ride every workload's
+        full map->shuffle->reduce path, not just the chaos rung."""
+        from uda_tpu.coding import parse_domains, parse_scheme
+
+        scheme = parse_scheme(str(self.cfg.get("uda.tpu.coding.scheme")))
+        writer = MOFWriter(
+            self.work_dir, self.job_id, codec=self._codec(),
+            scheme=scheme, supplier_roots=self.supplier_roots,
+            supplier_index=(self.supplier_roots.index(self.work_dir)
+                            if self.supplier_roots else 0),
+            domains=parse_domains(
+                str(self.cfg.get("uda.tpu.coding.domains"))))
         cmp = self.key_type.compare
         sort_key = functools.cmp_to_key(cmp)
         with metrics.timer("map_phase"):
@@ -108,6 +144,13 @@ class MapReduceJob:
                 for p in parts:
                     p.sort(key=lambda kv: sort_key(kv[0]))
                 writer.write(f"attempt_{self.job_id}_m_{m:06d}_0", parts)
+        if scheme is not None:
+            # low-priority insurance: kick the background stripe scrub
+            # when the interval knob arms it (non-blocking, one in
+            # flight per process — uda_tpu.coding.scrub)
+            from uda_tpu.coding.scrub import maybe_scrub
+
+            maybe_scrub(self.cfg, self.supplier_roots or [self.work_dir])
         return writer
 
     # -- reduce phase -------------------------------------------------------

@@ -44,7 +44,8 @@ def _reducer(key: bytes, values: list[bytes]) -> Iterable[Record]:
 
 def run_sort(records: Sequence[Tuple[bytes, bytes]], num_maps: int = 4,
              num_reducers: int = 3, config: Optional[Config] = None,
-             work_dir: Optional[str] = None
+             work_dir: Optional[str] = None,
+             supplier_roots: Optional[Sequence[str]] = None
              ) -> dict[int, list[Tuple[bytes, bytes]]]:
     """Run the identity sort job over ``records`` ((key content, value)
     pairs). Returns {reducer: [(key content, value), ...]} where each
@@ -55,7 +56,7 @@ def run_sort(records: Sequence[Tuple[bytes, bytes]], num_maps: int = 4,
     job = MapReduceJob("sortjob", _mapper, _reducer,
                        key_type="org.apache.hadoop.io.BytesWritable",
                        num_reducers=num_reducers, config=config,
-                       work_dir=work_dir)
+                       work_dir=work_dir, supplier_roots=supplier_roots)
     outputs = job.run(splits)
     return {r: [(parse_bytes_key(k), v) for k, v in recs]
             for r, recs in outputs.items()}

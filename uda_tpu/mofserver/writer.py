@@ -17,11 +17,13 @@ blobs (post-codec, so coding is byte-agnostic about compression):
   stays byte-identical to the uncoded layout — recorded by the v2
   index (:func:`uda_tpu.mofserver.index.write_index_file`);
 - :func:`write_striped_map_output` additionally fans the stripe out:
-  chunk i of every partition goes to supplier ``(p + i) % H`` (the
-  placement rule in uda_tpu.coding) as a tiny shard MOF
-  ``<map_id>~s<i>`` on that supplier's root. Chunks that land back on
-  the primary are NOT duplicated — the resolver synthesizes them from
-  the primary's file.out byte ranges.
+  chunk i of every partition goes to the supplier ``stripe_order``
+  names (uda_tpu.coding — the positional rotation ``(p + i) % H`` by
+  default, the failure-domain interleave when ``uda.tpu.coding.
+  domains`` declares domains) as a tiny shard MOF ``<map_id>~s<i>``
+  on that supplier's root. Chunks that land back on the primary are
+  NOT duplicated — the resolver synthesizes them from the primary's
+  file.out byte ranges.
 
 Shard index triples carry ``raw_length = the full partition's
 part_length`` (the decode-trim total) and ``part_length = the stored
@@ -142,14 +144,19 @@ def write_map_output(map_dir: str,
 def write_striped_map_output(
         supplier_roots: Sequence[str], primary_index: int, job_id: str,
         map_id: str, partitions: Sequence[Iterable[Tuple[bytes, bytes]]],
-        scheme, codec=None) -> list[tuple[int, int, int]]:
+        scheme, codec=None,
+        domains: Optional[dict] = None) -> list[tuple[int, int, int]]:
     """The coded write with cross-supplier fan-out: the primary
     (``supplier_roots[primary_index]``) gets the full MOF + parity
     section; every stripe chunk whose placement lands on a PEER
     supplier gets a shard MOF under that peer's root. ``supplier_roots``
     must be ordered like the reduce side's canonical supplier list
-    (sorted unique hosts) for the placement rules to agree."""
-    from uda_tpu.coding import rs
+    (sorted unique hosts) for the placement rules to agree, and
+    ``domains`` (a {supplier-root: failure domain} map, the writer-side
+    spelling of ``uda.tpu.coding.domains``) must name the same domains
+    the reduce side declares — the stripe_order interleave spreads a
+    stripe's shards across them (uda_tpu.coding)."""
+    from uda_tpu.coding import domain_labels, rs, stripe_order
 
     blobs = partition_blobs(partitions, codec)
     h = len(supplier_roots)
@@ -163,8 +170,10 @@ def write_striped_map_output(
     full_parts = [len(blob) for blob, _ in blobs]
     stripes = [rs.split_data(blob, scheme.k) + parity
                for (blob, _), parity in zip(blobs, parities)]
+    order = stripe_order(h, primary_index,
+                         domain_labels(supplier_roots, domains))
     for i in range(scheme.n):
-        target = (primary_index + i) % h
+        target = order[i % h]
         if target == primary_index:
             continue  # served off the primary's file.out by synthesis
         _write_shard(os.path.join(supplier_roots[target], job_id,
@@ -182,13 +191,15 @@ class MOFWriter:
 
     def __init__(self, root: str, job_id: str, codec=None, scheme=None,
                  supplier_roots: Optional[Sequence[str]] = None,
-                 supplier_index: int = 0):
+                 supplier_index: int = 0,
+                 domains: Optional[dict] = None):
         self.root = root
         self.job_id = job_id
         self.codec = codec
         self.scheme = scheme
         self.supplier_roots = list(supplier_roots or [])
         self.supplier_index = supplier_index
+        self.domains = dict(domains or {})
         self.map_ids: list[str] = []
 
     def map_dir(self, map_id: str) -> str:
@@ -200,7 +211,7 @@ class MOFWriter:
             write_striped_map_output(self.supplier_roots,
                                      self.supplier_index, self.job_id,
                                      map_id, partitions, self.scheme,
-                                     self.codec)
+                                     self.codec, domains=self.domains)
         else:
             write_map_output(self.map_dir(map_id), partitions, self.codec,
                              self.scheme)

@@ -545,6 +545,23 @@ class _EvConn:
         bound = self.bindings.get(job)
         return (bound[0] or self.tenant) if bound else self.tenant
 
+    def _entry_cost(self, entry) -> int:
+        """The WDRR deficit charge of one request: its REQUESTED bytes
+        under byte quanta (uda.tpu.tenant.quantum.kb > 0), 1 in
+        request-count mode. chunk_size == 0 means 'the server default'
+        on the wire — charge what the engine will actually serve
+        (data_engine resolves it the same way), or a zero-size request
+        would draw default-sized chunks at cost 1 and defeat the byte
+        fairness. SIZE probes are metadata — nominal cost 1 either
+        way."""
+        if not self.server.quantum_bytes:
+            return 1
+        kind, _rid, body = entry
+        if kind != "req":
+            return 1
+        return max(1, int(body[0].chunk_size)
+                   or self.server.chunk_bytes_default)
+
     # -- credit + request admission (loop thread) ----------------------------
 
     def _admit(self, entry) -> None:
@@ -562,7 +579,8 @@ class _EvConn:
             # zero before refilling, and weights cannot bite unless
             # several tenants hold backlog simultaneously
             if not self.server._sched.admit(self._entry_tenant(entry),
-                                            (self, entry)):
+                                            (self, entry),
+                                            cost=self._entry_cost(entry)):
                 self._tparked += 1
                 if not self._read_paused \
                         and self._tparked >= self.server.credit:
@@ -1227,6 +1245,7 @@ class EvLoopShuffleServer:
             or bool(cfg.get("uda.tpu.tenant.enable"))
         self.registry = registry
         self._sched = None
+        self.quantum_bytes = 0
         self.default_tenant = ""
         self.strict_tenancy = False
         self._sweeping = False
@@ -1242,8 +1261,22 @@ class EvLoopShuffleServer:
             # now weighted-fair ACROSS connections and jobs)
             total = int(cfg.get("uda.tpu.tenant.wqe.total")) \
                 or self.credit
+            # byte-cost quanta: deficits earned/charged in requested
+            # bytes so mixed chunk sizes stay byte-fair (0 = the
+            # request-count quanta of the original scheduler); a
+            # chunk_size=0 REQ is charged the engine's default serve
+            # size (the same resolution data_engine applies)
+            self.quantum_bytes = max(
+                0, int(cfg.get("uda.tpu.tenant.quantum.kb"))) * 1024
+            # the ENGINE's own default-serve size — one resolution,
+            # read not re-derived (stub engines in tests fall back to
+            # the same flag the engine derives it from)
+            self.chunk_bytes_default = max(1, int(getattr(
+                engine, "chunk_size_default",
+                int(cfg.get("mapred.rdma.buf.size")) * 1024)))
             self._sched = CreditScheduler(
                 total, weight_of=self.registry.weight_of,
+                quantum=float(self.quantum_bytes or 1),
                 penalty_threshold=int(
                     cfg.get("uda.tpu.tenant.penalty.threshold")),
                 penalty_ms=int(cfg.get("uda.tpu.tenant.penalty.ms")))

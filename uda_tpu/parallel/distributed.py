@@ -352,7 +352,12 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
     has a DCN-tagged outer axis with >1 pod of >1 chip; ``"flat"``
     forces the single-stage body (the A/B baseline, where XLA routes
     one global all_to_all per axis); ``"hierarchical"`` demands a pod
-    mesh.
+    mesh. ``"coded"`` arms the coded multicast stage B on the WINDOWED
+    path: the fused single-round attempt runs the plain staged body
+    (coding is a per-window host-plan decision and the fused program
+    has no plan), while the multiround path codes every window the
+    plan approves — so ``multiround="always"`` is the fully-coded
+    entry and the auto overflow re-run inherits it.
     ``capacity``: per-(src, dst) records per round — the credit window.
     ``payload_path``: how the local sort moves value columns ("auto":
     operand-carry on CPU meshes, chunked operand-carry ("carrychunk",
@@ -375,7 +380,7 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
                                          num_keys, int(words.shape[0]))
     if multiround not in ("auto", "never", "always"):
         raise ValueError(f"unknown multiround policy {multiround!r}")
-    topo, hier = resolve_exchange_mode(mesh, axis, exchange_mode)
+    topo, hier, _coded = resolve_exchange_mode(mesh, axis, exchange_mode)
     if multiround == "always":
         return distributed_sort_multiround(words, splitters, mesh, axis,
                                            capacity, num_keys, payload_path,
@@ -397,10 +402,11 @@ def distributed_sort_step(words, splitters, mesh: Mesh, axis: str,
 
 @partial(jax.jit, static_argnames=("mesh", "axis", "capacity",
                                    "exchange_mode", "dcn_axis",
-                                   "ici_axis"),
+                                   "ici_axis", "coded_l_rows"),
          donate_argnames=("acc",))
 def _round_scatter(words, dest, pos, acc, colbase, r, mesh, axis, capacity,
-                   exchange_mode="flat", dcn_axis=None, ici_axis=None):
+                   exchange_mode="flat", dcn_axis=None, ici_axis=None,
+                   coded_l_rows=None):
     """One windowed exchange round scattered into the accumulator.
 
     The accumulator (donated: updated in place across rounds) holds each
@@ -423,7 +429,7 @@ def _round_scatter(words, dest, pos, acc, colbase, r, mesh, axis, capacity,
         lo = rr[0] * capacity
         flat, recv_counts = run_round_body(w, d, q, lo, capacity, axis,
                                            exchange_mode, dcn_axis,
-                                           ici_axis)
+                                           ici_axis, coded_l_rows)
         row = jnp.arange(p * capacity, dtype=jnp.int32)
         peer = row // capacity
         slot = row % capacity
@@ -475,9 +481,9 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
     is compacted into the accumulator immediately (donated buffer), so
     nothing scales with the round count.
     """
-    from uda_tpu.parallel.exchange import prepare_layout
+    from uda_tpu.parallel.exchange import (execute_planned_window,
+                                           prepare_layout)
     from uda_tpu.parallel.planner import (plan_layout_rounds,
-                                          record_executed_window,
                                           record_plan_skips)
 
     payload_path = _resolve_payload_path(payload_path, int(words.shape[1]),
@@ -509,10 +515,21 @@ def distributed_sort_multiround(words, splitters, mesh: Mesh, axis: str,
     colbase_dev = put_global(colbase, spec)
     dispatch = layout.dispatch()
     for win in plan.windows:
-        acc = _round_scatter(layout.words, layout.dest, layout.pos, acc,
-                             colbase_dev, jnp.int32(win.index), mesh,
-                             axis, capacity, **dispatch)
-        record_executed_window(win, plan)
+        # the shared coded-window dispatch (decode-failure rung +
+        # in-round fallback + coded-vs-plain ledger; the exchange.
+        # decode failpoint fires BEFORE the scatter runs, so the
+        # fallback re-dispatches the untouched donated accumulator)
+        acc = execute_planned_window(
+            win, plan,
+            lambda: _round_scatter(
+                layout.words, layout.dest, layout.pos, acc,
+                colbase_dev, jnp.int32(win.index), mesh, axis,
+                capacity, **dict(dispatch, exchange_mode="coded",
+                                 coded_l_rows=plan.coded_l_rows)),
+            lambda: _round_scatter(layout.words, layout.dest,
+                                   layout.pos, acc, colbase_dev,
+                                   jnp.int32(win.index), mesh, axis,
+                                   capacity, **dispatch))
     record_plan_skips(plan)
     nvalid = put_global(per_dst.astype(np.int32), spec)
     out = _sort_shard(acc, nvalid, mesh, axis, num_keys, payload_path,

@@ -34,6 +34,26 @@ planner (parallel/planner.py) proves the per-round message reduction
 and accounts the RECORD bytes each tier carries (identical to flat on
 the DCN by construction — the same rows cross pods either way).
 
+Coded multicast stage B (``mode="coded"``, Coded TeraSort
+arXiv:1702.04850): when the host plan says a window's pod pairs are
+*codable* (cross rows spread over >= 2 destination chips and the
+padded multicast chunk beats the payload — parallel/planner.py), the
+egress chip compacts each destination chip's rows into an ``L``-row
+block and GF(2^8)-encodes the ``pod_size`` blocks through a full-rank
+Cauchy matrix (uda_tpu.coding.gfjax — the in-tree RS machinery's
+square case), so the pair's ONE DCN tile carries coded chunks instead
+of disjoint per-destination blocks; stage C broadcasts the arrived
+chunks pod-locally (``lax.all_gather`` over ICI — the cheap fabric
+pays for the expensive one, the Coded TeraSort trade) and every
+member decodes its OWN block locally with the inverse row of its chip
+index. Delivery tags ride through encode/decode untouched, so the
+post-decode scatter reproduces the exact flat (peer row-block, slot)
+layout — byte-identity vs the flat oracle stays gated by
+construction. Windows the plan declines (skew, single-destination
+pairs, 1-pod meshes) ride the plain coalesced tile with zero coded
+overhead, and a decode failure (failpoint site ``exchange.decode``)
+falls back to the plain tile within the round.
+
 Scope of the byte accounting: ``lax.all_to_all`` lowers to DENSE
 static buffers, so the stage-B collective's wire footprint includes
 the unpopulated tile slots of non-egress chips (a ~pod_size padding
@@ -43,9 +63,17 @@ the lever that makes the wire footprint match the record accounting —
 until then the hierarchical win this module claims, measures and
 gates is the MESSAGE/coalescing structure (per-transfer setup cost,
 the per-QP analogy) plus the per-tier record-byte ledger, not the
-padded collective payload. ``shuffle_exchange``/``prepare_layout``
-dispatch on the mesh topology (flat 1-axis meshes keep the
-single-stage path).
+padded collective payload. The CODED ledger extends the same
+discipline one step: ``exchange.dcn.coded.bytes`` charges what a
+redundant-map Coded-TeraSort deployment would move — one L-row
+multicast packet per pod pair serving every member at once, decode
+side information being map-redundancy the deployment computes
+locally. This virtual mesh has no map redundancy, so the coded tile
+ships the full-rank chunk set (any member can decode every block) and
+the side-information share of the tile rides the wire outside the
+model charge — see the planner docstring, README and PARITY for the
+full statement. ``shuffle_exchange``/``prepare_layout`` dispatch on
+the mesh topology (flat 1-axis meshes keep the single-stage path).
 """
 
 from __future__ import annotations
@@ -63,26 +91,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from uda_tpu.parallel.mesh import MeshTopology, mesh_topology
 from uda_tpu.parallel.multihost import allgather, put_rows
-from uda_tpu.utils.errors import ConfigError, TransportError
+from uda_tpu.utils.errors import (ConfigError, StorageError,
+                                  TransportError)
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.ifile import RecordBatch
+from uda_tpu.utils.metrics import metrics
 
 __all__ = ["ShuffleLayout", "prepare_layout", "window_round_body",
-           "hierarchical_round_body", "run_round_body",
-           "resolve_exchange_mode", "exchange_dispatch",
-           "exchange_round", "shuffle_exchange", "exchange_record_batches"]
+           "hierarchical_round_body", "coded_round_body",
+           "run_round_body", "resolve_exchange_mode",
+           "exchange_dispatch", "exchange_round",
+           "execute_planned_window", "shuffle_exchange",
+           "exchange_record_batches"]
 
-EXCHANGE_MODES = ("auto", "flat", "hierarchical")
+EXCHANGE_MODES = ("auto", "flat", "hierarchical", "coded")
 
 
 def resolve_exchange_mode(mesh: Mesh, axis, mode: str = "auto"):
     """Resolve the exchange dispatch for a (mesh, axis) pair.
 
-    Returns ``(topology, hierarchical)``. ``auto`` takes the two-stage
-    path exactly when the mesh has a real pod structure (a DCN-tagged
-    outer axis with >1 pod of >1 chip); ``flat`` forces the
+    Returns ``(topology, hierarchical, coded)``. ``auto`` takes the
+    two-stage path exactly when the mesh has a real pod structure (a
+    DCN-tagged outer axis with >1 pod of >1 chip); ``flat`` forces the
     single-stage path on any mesh (the A/B baseline); ``hierarchical``
-    demands a hierarchical mesh and refuses otherwise."""
+    demands a hierarchical mesh and refuses otherwise. ``coded`` ARMS
+    the coded stage-B dispatch on hierarchical meshes — whether any
+    window actually codes is the host plan's per-window decision — and
+    deliberately degrades to the plain path elsewhere (a 1-pod mesh
+    has no pod pairs to encode across: zero coded overhead, not an
+    error)."""
     if mode not in EXCHANGE_MODES:
         raise ConfigError(f"unknown exchange mode {mode!r} "
                           f"(one of {EXCHANGE_MODES})")
@@ -92,8 +129,9 @@ def resolve_exchange_mode(mesh: Mesh, axis, mode: str = "auto"):
             f"exchange mode 'hierarchical' needs a (dcn, ici) mesh with "
             f">1 pod of >1 chip; got axes {axis!r} on mesh "
             f"{dict(mesh.shape)}")
-    return topo, (topo.hierarchical if mode == "auto"
-                  else mode == "hierarchical")
+    hier = topo.hierarchical if mode in ("auto", "coded") \
+        else mode == "hierarchical"
+    return topo, hier, (mode == "coded" and topo.hierarchical)
 
 
 def exchange_dispatch(topology: Optional[MeshTopology],
@@ -121,8 +159,11 @@ class ShuffleLayout:
       bucket — ``pos // capacity`` is the round it travels in;
     - ``counts``: int32[P, P] full count matrix (row = src device,
       col = dst) gathered to every device for round planning;
-    - ``topology``/``hierarchical``: the resolved fabric dispatch —
-      which round body :func:`exchange_round` runs.
+    - ``topology``/``hierarchical``/``coded``: the resolved fabric
+      dispatch — which round body :func:`exchange_round` runs
+      (``coded`` arms the per-window coded stage-B decision in the
+      host plan; the staged machinery is shared, so coded implies
+      hierarchical).
     """
 
     words: jax.Array
@@ -133,6 +174,7 @@ class ShuffleLayout:
     axis: str
     topology: Optional[MeshTopology] = None
     hierarchical: bool = False
+    coded: bool = False
 
     def dispatch(self) -> dict:
         """Static round-body dispatch kwargs (see
@@ -165,7 +207,7 @@ def prepare_layout(words: jax.Array, dest: jax.Array, mesh: Mesh,
     """Bucket every device's records and gather the count matrix.
     ``mode`` resolves the fabric dispatch (see
     :func:`resolve_exchange_mode`)."""
-    topo, hier = resolve_exchange_mode(mesh, axis, mode)
+    topo, hier, coded = resolve_exchange_mode(mesh, axis, mode)
 
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
              out_specs=(P(axis), P(axis), P(axis), P(axis)))
@@ -179,7 +221,7 @@ def prepare_layout(words: jax.Array, dest: jax.Array, mesh: Mesh,
     # count-matrix readback: allgather works on multi-process meshes
     # where the sharded array is not host-addressable
     return ShuffleLayout(sw, sd, pos, allgather(counts), mesh, axis,
-                         topo, hier)
+                         topo, hier, coded)
 
 
 def window_round_body(w, d, q, lo, axis: str, capacity: int):
@@ -205,6 +247,63 @@ def window_round_body(w, d, q, lo, axis: str, capacity: int):
                                  split_axis=0, concat_axis=0,
                                  tiled=False).reshape(p)
     return recv.reshape(p * capacity, wcols), recv_counts
+
+
+def _staged_stage_a(w, d, q, lo, dcn_axis: str, ici_axis: str,
+                    capacity: int):
+    """The staged bodies' shared prologue + stage A (pod-local
+    all_to_all: intra-pod records straight to their final chip,
+    cross-pod records onto the pair's egress chip, every row tagged
+    ``src_device * capacity + slot + 1``). ONE definition for the
+    hierarchical and coded bodies — the staging row formula, the
+    trash-row trick and the tag discipline can never diverge between
+    them. Returns ``(p, c, g, i, m, wcols, wex, intra_rows, cross)``
+    with ``cross`` shaped [src chip, peer-pod rank, dst chip, slot,
+    word]."""
+    p = lax.psum(1, dcn_axis)           # pods
+    c = lax.psum(1, ici_axis)           # chips per pod
+    g = lax.axis_index(dcn_axis)        # my pod
+    i = lax.axis_index(ici_axis)        # my chip
+    m = -(-p // c)                      # peer-pod slots per egress chip
+    wcols = w.shape[1]
+    in_round = (q >= lo) & (q < lo + capacity)
+    slot = q - lo
+    tag = ((g * c + i) * capacity + slot + 1).astype(w.dtype)
+    ext = jnp.concatenate([w, tag[:, None]], axis=1)
+    wex = wcols + 1
+    dpod = d // c
+    dchip = d % c
+    intra = dpod == g
+    rows_a = capacity + m * c * capacity
+    blk = jnp.where(intra, dchip, (g + dpod) % c)
+    row = jnp.where(intra, slot,
+                    capacity + (dpod // c) * (c * capacity)
+                    + dchip * capacity + slot)
+    row = jnp.where(in_round, row, rows_a)      # trash row, sliced off
+    send_a = jnp.zeros((c, rows_a + 1, wex), w.dtype)
+    send_a = send_a.at[blk, row].set(ext, mode="drop")
+    recv_a = lax.all_to_all(send_a[:, :rows_a], ici_axis, split_axis=0,
+                            concat_axis=0, tiled=False)
+    intra_rows = recv_a[:, :capacity].reshape(c * capacity, wex)
+    cross = recv_a[:, capacity:].reshape(c, m, c, capacity, wex)
+    return p, c, g, i, m, wcols, wex, intra_rows, cross
+
+
+def _tag_assemble(arrived, wcols, nd, capacity: int):
+    """The staged bodies' shared delivery: tag - 1 IS the output row
+    of the flat ``[P*capacity, W]`` layout (0 marks an empty slot),
+    recv_counts from the tags' source devices. Shared so the
+    byte-identity contract has exactly one assembly definition."""
+    atag = arrived[:, wcols].astype(jnp.int32)
+    valid = atag > 0
+    idx = jnp.where(valid, atag - 1, nd * capacity)
+    out = jnp.zeros((nd * capacity + 1, wcols), arrived.dtype)
+    out = out.at[idx].set(arrived[:, :wcols],
+                          mode="drop")[:nd * capacity]
+    peer_dev = jnp.where(valid, (atag - 1) // capacity, nd)
+    recv_counts = jnp.bincount(peer_dev, length=nd + 1)[:nd].astype(
+        jnp.int32)
+    return out, recv_counts
 
 
 def hierarchical_round_body(w, d, q, lo, dcn_axis: str, ici_axis: str,
@@ -236,36 +335,11 @@ def hierarchical_round_body(w, d, q, lo, dcn_axis: str, ici_axis: str,
     long before the tag does, and which the host planner
     (parallel/planner.py plan_rounds) rejects loudly.
     """
-    p = lax.psum(1, dcn_axis)           # pods
-    c = lax.psum(1, ici_axis)           # chips per pod
-    g = lax.axis_index(dcn_axis)        # my pod
-    i = lax.axis_index(ici_axis)        # my chip
-    m = -(-p // c)                      # peer-pod slots per egress chip
+    # -- stage A (shared with the coded body): pod-local all_to_all
+    # (direct delivery / egress stage)
+    p, c, g, i, m, wcols, wex, intra_rows, cross = _staged_stage_a(
+        w, d, q, lo, dcn_axis, ici_axis, capacity)
     nd = p * c
-    wcols = w.shape[1]
-    in_round = (q >= lo) & (q < lo + capacity)
-    slot = q - lo
-    tag = ((g * c + i) * capacity + slot + 1).astype(w.dtype)
-    ext = jnp.concatenate([w, tag[:, None]], axis=1)
-    wex = wcols + 1
-
-    # -- stage A: pod-local all_to_all (direct delivery / egress stage)
-    dpod = d // c
-    dchip = d % c
-    intra = dpod == g
-    rows_a = capacity + m * c * capacity
-    blk = jnp.where(intra, dchip, (g + dpod) % c)
-    row = jnp.where(intra, slot,
-                    capacity + (dpod // c) * (c * capacity)
-                    + dchip * capacity + slot)
-    row = jnp.where(in_round, row, rows_a)      # trash row, sliced off
-    send_a = jnp.zeros((c, rows_a + 1, wex), w.dtype)
-    send_a = send_a.at[blk, row].set(ext, mode="drop")
-    recv_a = lax.all_to_all(send_a[:, :rows_a], ici_axis, split_axis=0,
-                            concat_axis=0, tiled=False)
-    intra_rows = recv_a[:, :capacity].reshape(c * capacity, wex)
-    # [src chip, peer-pod rank, dst chip, slot, word]
-    cross = recv_a[:, capacity:].reshape(c, m, c, capacity, wex)
 
     # -- stage B: ONE coalesced tile per pod pair over the DCN axis.
     # I am the egress chip of peer pods g' with (g + g') % c == i, i.e.
@@ -290,29 +364,118 @@ def hierarchical_round_body(w, d, q, lo, dcn_axis: str, ici_axis: str,
     recv_c = lax.all_to_all(send_c, ici_axis, split_axis=0,
                             concat_axis=0, tiled=False)
 
-    # -- final assembly: tag - 1 IS the output row
+    # -- final assembly: tag - 1 IS the output row (shared)
     arrived = jnp.concatenate([
         intra_rows, recv_c.reshape(c * m * c * capacity, wex)])
-    atag = arrived[:, wcols].astype(jnp.int32)
-    valid = atag > 0
-    idx = jnp.where(valid, atag - 1, nd * capacity)
-    out = jnp.zeros((nd * capacity + 1, wcols), w.dtype)
-    out = out.at[idx].set(arrived[:, :wcols], mode="drop")[:nd * capacity]
-    peer_dev = jnp.where(valid, (atag - 1) // capacity, nd)
-    recv_counts = jnp.bincount(peer_dev, length=nd + 1)[:nd].astype(
-        jnp.int32)
-    return out, recv_counts
+    return _tag_assemble(arrived, wcols, nd, capacity)
+
+
+def coded_round_body(w, d, q, lo, dcn_axis: str, ici_axis: str,
+                     capacity: int, l_rows: int):
+    """The CODED two-stage round body: same staging as
+    :func:`hierarchical_round_body`, but the pod-pair DCN tile carries
+    GF(2^8)-coded chunks instead of disjoint per-destination blocks
+    (the Coded TeraSort multicast phase, arXiv:1702.04850):
+
+    - **stage A** is byte-for-byte the hierarchical staging (cross-pod
+      rows onto the pair's egress chip, tags riding along);
+    - **encode:** the egress chip COMPACTS each destination chip's
+      rows to the front of an ``l_rows``-row block (``l_rows`` is the
+      host plan's padded chunk length — the plan guarantees every
+      block fits) and multiplies the ``c`` blocks through the full-
+      rank Cauchy matrix (uda_tpu.coding.gfjax), one coded chunk per
+      member chip;
+    - **stage B** moves ONE ``[c, l_rows]`` coded tile per pod pair
+      over the DCN axis — the same O(p^2) coalescing, with the tile
+      now ``c*l_rows`` rows instead of ``c^2*capacity`` slots (the
+      compaction also shrinks the dense collective buffer);
+    - **stage C** is an ICI ``all_gather``: every member receives
+      every arrived tile (the broadcast that stands in for the CDC
+      side information — charged to the ICI ledger by the planner)
+      and decodes its OWN destination block with the inverse-matrix
+      row of its chip index (``gfjax.gf_decode_row``, traced row).
+
+    Tags ride INSIDE the coded words (the GF action is exact), so the
+    final tag-indexed scatter reproduces the flat layout precisely —
+    byte-identity by construction, the same contract as the plain
+    staged body. ``l_rows`` must be positive and cover the biggest
+    per-(pair, destination-chip) in-window block; the host plan
+    (parallel/planner.py) guarantees both before dispatching here.
+    """
+    from uda_tpu.coding.gfjax import (coded_matrices, gf_decode_row,
+                                      gf_matmul_words)
+
+    # -- stage A: the SHARED hierarchical staging (_staged_stage_a)
+    p, c, g, i, m, wcols, wex, intra_rows, cross = _staged_stage_a(
+        w, d, q, lo, dcn_axis, ici_axis, capacity)
+    nd = p * c
+    # [src chip, peer-pod rank, dst chip, slot, word] -> destination-
+    # block view [peer slot, dst chip, (src chip, slot), word]
+    blocks_full = jnp.transpose(cross, (1, 2, 0, 3, 4)).reshape(
+        m, c, c * capacity, wex)
+
+    # -- compaction: populated rows (tag > 0) to the chunk front; the
+    # plan guarantees rank < l_rows for every populated row, so the
+    # trash row at l_rows only ever receives empties
+    populated = blocks_full[:, :, :, wcols] > 0
+    rank = jnp.cumsum(populated.astype(jnp.int32), axis=2) - 1
+    idx = jnp.where(populated, rank, l_rows)
+    mi = jnp.arange(m)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    blocks = jnp.zeros((m, c, l_rows + 1, wex), w.dtype)
+    blocks = blocks.at[mi, ci, idx].set(blocks_full,
+                                        mode="drop")[:, :, :l_rows]
+
+    # -- encode: coded chunk t = XOR_j A[t, j] * block[j] (per peer
+    # slot; A static, built at trace time from the static pod size)
+    enc, dec = coded_matrices(c)
+    coded = gf_matmul_words(enc, jnp.swapaxes(blocks, 0, 1))
+    tiles = jnp.swapaxes(coded, 0, 1).reshape(m, c * l_rows, wex)
+
+    # -- stage B: one coded tile per pod pair over the DCN axis
+    peers = ((i - g) % c) + jnp.arange(m) * c
+    send_b = jnp.zeros((p + 1, c * l_rows, wex), w.dtype)
+    send_b = send_b.at[jnp.where(peers < p, peers, p)].set(
+        tiles, mode="drop")
+    recv_b = lax.all_to_all(send_b[:p], dcn_axis, split_axis=0,
+                            concat_axis=0, tiled=False)
+    compact = jnp.take(recv_b, jnp.minimum(peers, p - 1), axis=0)
+    compact = jnp.where((peers < p)[:, None, None], compact, 0)
+
+    # -- stage C: pod-local broadcast of the arrived coded tiles —
+    # every member needs the full chunk set to decode its block
+    gathered = lax.all_gather(compact, ici_axis, axis=0, tiled=False)
+    chunks = jnp.transpose(
+        gathered.reshape(c, m, c, l_rows, wex),
+        (2, 0, 1, 3, 4))                # [chunk t, ingress, slot, ...]
+
+    # -- local decode: my destination block only (inverse row = my
+    # chip index, traced — gf_decode_row combines with traced coeffs)
+    mine = gf_decode_row(dec, i, chunks)
+
+    # -- final assembly: tag - 1 IS the output row (shared)
+    arrived = jnp.concatenate([
+        intra_rows, mine.reshape(c * m * l_rows, wex)])
+    return _tag_assemble(arrived, wcols, nd, capacity)
 
 
 def run_round_body(w, d, q, lo, capacity: int, axis,
-                   exchange_mode="flat", dcn_axis=None, ici_axis=None):
-    """The flat-vs-hierarchical body dispatch, for use INSIDE a
-    shard_map body — the single branch shared by ``_round_impl``,
+                   exchange_mode="flat", dcn_axis=None, ici_axis=None,
+                   coded_l_rows=None):
+    """The flat-vs-hierarchical-vs-coded body dispatch, for use INSIDE
+    a shard_map body — the single branch shared by ``_round_impl``,
     ``distributed._sort_step`` and ``distributed._round_scatter``
     (fed the static kwargs of :func:`exchange_dispatch`), completing
     the one-definition contract: a new mode or body signature changes
-    exactly here."""
-    if exchange_mode == "hierarchical":
+    exactly here. ``exchange_mode="coded"`` needs the host plan's
+    static chunk length (``coded_l_rows``); a coded dispatch WITHOUT
+    one runs the plain staged body — the plan is what turns coding on
+    per window (the fused single-round step has no plan and lands
+    there by design)."""
+    if exchange_mode == "coded" and coded_l_rows:
+        return coded_round_body(w, d, q, lo, dcn_axis, ici_axis,
+                                capacity, int(coded_l_rows))
+    if exchange_mode in ("hierarchical", "coded"):
         return hierarchical_round_body(w, d, q, lo, dcn_axis, ici_axis,
                                        capacity)
     return window_round_body(w, d, q, lo, axis, capacity)
@@ -320,34 +483,77 @@ def run_round_body(w, d, q, lo, capacity: int, axis,
 
 @partial(jax.jit, static_argnames=("capacity", "axis", "mesh",
                                    "exchange_mode", "dcn_axis",
-                                   "ici_axis"))
+                                   "ici_axis", "coded_l_rows"))
 def _round_impl(words, dest, pos, round_index, mesh, axis, capacity,
-                exchange_mode="flat", dcn_axis=None, ici_axis=None):
+                exchange_mode="flat", dcn_axis=None, ici_axis=None,
+                coded_l_rows=None):
     # round_index is TRACED: one compiled program serves every round
+    # (and, coded, every coded window — the plan's single coded_l_rows)
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis), P(axis), P(axis), P()),
              out_specs=(P(axis), P(axis)))
     def _go(w, d, q, r):
         flat, recv_counts = run_round_body(
             w, d, q, r[0] * capacity, capacity, axis,
-            exchange_mode, dcn_axis, ici_axis)
+            exchange_mode, dcn_axis, ici_axis, coded_l_rows)
         return flat, recv_counts.reshape(1, -1)
 
     return _go(words, dest, pos, round_index)
 
 
-def exchange_round(layout: ShuffleLayout, capacity: int, round_index: int):
-    """One windowed exchange round (single-stage, or the two-stage
-    hierarchical body when the layout resolved a pod topology).
+def exchange_round(layout: ShuffleLayout, capacity: int,
+                   round_index: int, coded_l_rows: Optional[int] = None):
+    """One windowed exchange round (single-stage, the two-stage
+    hierarchical body when the layout resolved a pod topology, or the
+    coded stage-B body when ``coded_l_rows`` carries the host plan's
+    chunk length for a coded window).
 
     Returns ``(recv_words, recv_counts)``: per device, ``capacity`` rows
     from each peer (``recv_words`` row-block i = peer i's contribution,
     of which ``recv_counts[i]`` rows are valid).
     """
+    dispatch = layout.dispatch()
+    if coded_l_rows:
+        dispatch = dict(dispatch, exchange_mode="coded",
+                        coded_l_rows=int(coded_l_rows))
     return _round_impl(layout.words, layout.dest, layout.pos,
                        jnp.asarray([round_index], jnp.int32),
-                       layout.mesh, layout.axis, capacity,
-                       **layout.dispatch())
+                       layout.mesh, layout.axis, capacity, **dispatch)
+
+
+def execute_planned_window(win, plan, coded_exec, plain_exec):
+    """The ONE coded-window dispatch, shared by ``shuffle_exchange``
+    and ``distributed.distributed_sort_multiround`` (the same
+    one-definition contract as :func:`run_round_body`): fire the
+    decode-failure rung (failpoint site ``exchange.decode``, keyed
+    ``round<i>`` — it fires BEFORE the coded body runs, so the
+    fallback re-dispatches an untouched window), run ``coded_exec``
+    for plan-approved windows with in-round fallback to
+    ``plain_exec`` on a decode failure (counted
+    ``exchange.decode.fallbacks``), and book the ledger for the body
+    that ACTUALLY ran."""
+    from uda_tpu.parallel.planner import record_executed_window
+
+    if plan.coded and win.coded:
+        decode_ok = True
+        try:
+            failpoint("exchange.decode", key=f"round{win.index}")
+        except StorageError:
+            metrics.add("exchange.decode.fallbacks")
+            decode_ok = False
+        if decode_ok:
+            # OUTSIDE the try by design: the multiround caller's
+            # coded executor consumes a DONATED accumulator — an
+            # error escaping the coded body itself must propagate,
+            # never re-dispatch the already-deleted buffer on the
+            # plain path (the fallback contract covers decode
+            # failures, which fire before the body runs)
+            out = coded_exec()
+            record_executed_window(win, plan, coded=True)
+            return out
+    out = plain_exec()
+    record_executed_window(win, plan, coded=False)
+    return out
 
 
 def shuffle_exchange(words, dest, mesh: Mesh, axis: str,
@@ -366,11 +572,16 @@ def shuffle_exchange(words, dest, mesh: Mesh, axis: str,
     (``exchange.rounds.skipped``) and records the per-axis fabric
     accounting (``exchange.ici.bytes`` / ``exchange.dcn.bytes`` /
     ``exchange.dcn.messages``) for each executed round. ``mode``
-    picks flat vs two-stage hierarchical dispatch (see
-    :func:`resolve_exchange_mode`).
+    picks flat vs two-stage hierarchical vs coded dispatch (see
+    :func:`resolve_exchange_mode`); with ``mode="coded"`` the plan
+    decides per window whether the coded stage-B body runs (skew and
+    single-destination pairs stay on the plain tile at zero coded
+    overhead), a decode failure (failpoint ``exchange.decode``) falls
+    back to the plain tile within the round, and coded windows
+    additionally book ``exchange.dcn.coded.bytes`` /
+    ``exchange.dcn.saved.bytes``.
     """
     from uda_tpu.parallel.planner import (plan_layout_rounds,
-                                          record_executed_window,
                                           record_plan_skips)
 
     layout = prepare_layout(words, dest, mesh, axis, mode)
@@ -391,8 +602,11 @@ def shuffle_exchange(words, dest, mesh: Mesh, axis: str,
             # (arm with match:stageB) must surface exactly like a
             # whole-round collective failure
             failpoint("exchange.round", key=f"round{win.index}.stageB")
-        results.append(exchange_round(layout, capacity, win.index))
-        record_executed_window(win, plan)
+        results.append(execute_planned_window(
+            win, plan,
+            lambda: exchange_round(layout, capacity, win.index,
+                                   plan.coded_l_rows),
+            lambda: exchange_round(layout, capacity, win.index)))
     record_plan_skips(plan)
     return results, layout
 

@@ -15,7 +15,9 @@ ICI. A ``uda.tpu.mesh.shape`` of ``dcn:4,ici:8`` therefore describes 4
 pods of 8 chips. :func:`mesh_topology` classifies a (mesh, axis-spec)
 pair into a :class:`MeshTopology`, which the exchange uses to pick the
 two-stage hierarchical round body (pod-local all-to-all + one coalesced
-DCN tile per pod pair) over the flat single-stage path.
+DCN tile per pod pair) over the flat single-stage path — and, when
+``coded_capable``, to arm the coded multicast stage B (GF(2^8)-coded
+pod-pair tiles, parallel/exchange.py ``coded_round_body``).
 """
 
 from __future__ import annotations
@@ -70,6 +72,16 @@ class MeshTopology:
         exchange can exploit (>1 pod of >1 chip)."""
         return (self.dcn_axis is not None and self.num_pods > 1
                 and self.pod_size > 1)
+
+    @property
+    def coded_capable(self) -> bool:
+        """True when the CODED stage-B dispatch can run at all on this
+        topology: a real pod structure whose pod size keeps the
+        Cauchy-code points inside GF(2^8) (pod_size <= 128 — one coded
+        chunk per member chip, uda_tpu.coding.gfjax). Whether a given
+        WINDOW actually codes is the host plan's per-pair decision
+        (parallel/planner.py)."""
+        return self.hierarchical and self.pod_size <= 128
 
     def pod_of(self, device_index: int) -> int:
         return int(device_index) // self.pod_size

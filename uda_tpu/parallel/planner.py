@@ -16,9 +16,36 @@ evidence:
   for the flat single-stage exchange, coalesced *pod* pairs for the
   two-stage path (the reference's per-QP aggregation win,
   RDMAServer.cc chunked server pool);
+- with ``coded=True`` the plan additionally decides, per window,
+  whether the CODED stage-B path runs (the Coded TeraSort multicast
+  discipline, arXiv:1702.04850): a pod pair is *codable* when its
+  in-window cross rows spread over >= 2 destination chips and the
+  padded multicast chunk (``L`` = the largest per-destination block,
+  rounded up to :data:`CODED_CHUNK_ROWS` — the code's chunk
+  granularity) at least halves the pair's payload
+  (:data:`CODED_WIN_FACTOR`, the break-even guard). A window
+  is coded only when EVERY pair with cross traffic is codable — mixed
+  or skewed windows fall back to the plain coalesced tile with zero
+  coded overhead, by plan;
 - :func:`record_window_metrics` lands the numbers in
   ``exchange.ici.bytes`` / ``exchange.dcn.bytes`` /
-  ``exchange.dcn.messages`` (DCN series labeled by source pod).
+  ``exchange.dcn.messages`` (DCN series labeled by source pod), plus
+  — for coded windows — ``exchange.dcn.coded.bytes`` (the multicast
+  charge, which IS the window's ``exchange.dcn.bytes``) and
+  ``exchange.dcn.saved.bytes``, with the bookkeeping invariant
+  ``coded + saved == uncoded payload`` per pair and in total.
+
+Scope of the coded charge (the PR 7 scope-note discipline): the coded
+ledger books what a redundant-map Coded-TeraSort deployment moves over
+the DCN — ONE multicast packet of ``L`` rows per pod pair serving all
+``pod_size`` member reducers at once, their decode side information
+being locally (re)computed from replicated map work. This virtual mesh
+has no map redundancy to replicate, so the device tile ships the
+full-rank coded chunk set (every member can decode every block) and
+the side-information share of the tile rides the wire uncharged — the
+gap between the model charge and the dense collective's wire footprint
+is documented in parallel/exchange.py, README and PARITY, exactly like
+the dense-padding note the hierarchical ledger already carries.
 
 The counts are *predictions* only in the sense that the host computes
 them before the device program runs; they are exact — the round bodies
@@ -43,7 +70,22 @@ from uda_tpu.utils.metrics import metrics
 
 __all__ = ["WindowPlan", "RoundPlan", "plan_rounds",
            "plan_layout_rounds", "record_window_metrics",
-           "record_executed_window", "record_plan_skips"]
+           "record_executed_window", "record_plan_skips",
+           "CODED_CHUNK_ROWS"]
+
+# the code's chunk granularity: a pair's multicast chunk length L is
+# the largest per-destination block padded UP to this many rows (the
+# rs.chunk_len discipline applied to rows instead of bytes), so the
+# device tile shape quantizes and the charge stays honest about the
+# pad. A pair only codes when the padded L still beats its payload.
+CODED_CHUNK_ROWS = 4
+
+# break-even guard: a pair codes only when the multicast chunk at
+# least HALVES its payload (L_pad * FACTOR <= S). The k-fold cut
+# presumes roughly balanced destination blocks; a skew-dominant block
+# makes L ~ S and coding pure overhead — those pairs (and any window
+# containing one) ride the plain coalesced tile.
+CODED_WIN_FACTOR = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,7 +93,13 @@ class WindowPlan:
     """One planned exchange window (round ``index`` moves each bucket's
     rows with in-bucket position in ``[index*capacity,
     (index+1)*capacity)``). Row counts are records, not bytes —
-    multiply by the layout's record stride for bytes."""
+    multiply by the layout's record stride for bytes.
+
+    ``dcn_rows``/``per_pod`` always hold the UNCODED payload figures
+    (what the plain coalesced tile moves — and what a coded window
+    books if its decode falls back mid-round); the ``coded*`` fields
+    hold the multicast-model charges of the coded stage-B path and are
+    meaningful only when ``coded`` is True."""
 
     index: int
     moved_rows: int       # in-window rows over all (src, dst) pairs
@@ -62,6 +110,17 @@ class WindowPlan:
     #                       hierarchical: pod pairs with traffic
     per_pod: Tuple[Tuple[int, int, int], ...]  # (src pod, dcn rows,
     #                                             dcn messages)
+    coded: bool = False   # this window runs the coded stage-B path
+    l_rows: int = 0       # max padded chunk length over the window's
+    #                       pairs (the device tile's static row count)
+    coded_rows: int = 0   # multicast-model DCN charge (sum of L_pair)
+    saved_rows: int = 0   # dcn_rows - coded_rows (>= 1 per coded pair)
+    ici_rows_coded: int = 0  # ICI rows when the coded body runs (the
+    #                       stage-C broadcast replaces the delivery
+    #                       scatter: each coded chunk reaches every
+    #                       member, the side-information trade)
+    per_pod_coded: Tuple[Tuple[int, int, int], ...] = ()  # (src pod,
+    #                       coded rows, saved rows)
 
     @property
     def empty(self) -> bool:
@@ -75,6 +134,12 @@ class RoundPlan:
     skipped: int                      # globally-empty windows dropped
     record_bytes: int
     hierarchical: bool
+    coded: bool = False               # coded dispatch requested AND
+    #                                   possible on this topology
+    coded_l_rows: int = 0             # ONE static chunk length for the
+    #                                   whole plan (max over coded
+    #                                   windows: one compiled coded
+    #                                   program per shuffle)
 
 
 def _pod_vectors(n: int, topology: Optional[MeshTopology]):
@@ -88,10 +153,59 @@ def _pod_vectors(n: int, topology: Optional[MeshTopology]):
     return dev // c, dev % c
 
 
+def _pad_chunk(rows: int) -> int:
+    """Pad a block length up to the code's chunk granularity."""
+    if rows <= 0:
+        return 0
+    return -(-rows // CODED_CHUNK_ROWS) * CODED_CHUNK_ROWS
+
+
+def _plan_window_coding(inwin, topology):
+    """The per-window coding decision over the in-window counts.
+
+    Returns ``(coded, l_rows, per_pod_coded, extra_ici)`` — coded is
+    True only when EVERY pod pair with cross traffic is codable
+    (>= 2 destination chips AND the padded multicast chunk at least
+    halves the pair's payload) and at least one such pair exists.
+    ``extra_ici``
+    is the stage-C broadcast cost of the coded body: every coded chunk
+    reaches all ``pod_size`` members ((c-1) off-device copies of the
+    c-chunk tile per pair) instead of the plain delivery scatter."""
+    p, c = topology.num_pods, topology.pod_size
+    if not topology.coded_capable:
+        return False, 0, (), 0
+    # per (src pod, dst pod, dst chip): in-window rows
+    chip_mat = inwin.reshape(p, c, p, c).sum(axis=1)
+    pair_rows = chip_mat.sum(axis=2)            # [src pod, dst pod]
+    np.fill_diagonal(pair_rows, 0)
+    if not pair_rows.any():
+        return False, 0, (), 0
+    l_rows = 0
+    extra_ici = 0
+    per_pod: dict[int, list[int]] = {}
+    for g in range(p):
+        for g2 in range(p):
+            if g == g2 or pair_rows[g, g2] == 0:
+                continue
+            s = int(pair_rows[g, g2])
+            k_eff = int((chip_mat[g, g2] > 0).sum())
+            l_pad = _pad_chunk(int(chip_mat[g, g2].max()))
+            if k_eff < 2 or l_pad * CODED_WIN_FACTOR > s:
+                return False, 0, (), 0      # one uncodable pair ->
+                # the whole window rides the plain coalesced tile
+            l_rows = max(l_rows, l_pad)
+            extra_ici += (c - 1) * c * l_pad
+            cr, sv = per_pod.setdefault(g, [0, 0])
+            per_pod[g] = [cr + l_pad, sv + (s - l_pad)]
+    ppc = tuple((g, cr, sv) for g, (cr, sv) in sorted(per_pod.items()))
+    return True, l_rows, ppc, extra_ici
+
+
 def plan_rounds(counts, capacity: int,
                 topology: Optional[MeshTopology] = None,
                 record_bytes: int = 0,
-                hierarchical: bool = False) -> RoundPlan:
+                hierarchical: bool = False,
+                coded: bool = False) -> RoundPlan:
     """Plan the windowed rounds for one exchange from its gathered
     counts matrix (``counts[src, dst]``, any integer dtype).
 
@@ -118,6 +232,7 @@ def plan_rounds(counts, capacity: int,
                          f"{capacity}")
     counts = np.asarray(counts, dtype=np.int64)
     n = counts.shape[0] if counts.ndim == 2 else 0
+    coded = bool(coded) and bool(hierarchical) and topology is not None
     if hierarchical and n * capacity >= 1 << 31:
         # the staged body's delivery tag (src_device*capacity + slot)
         # is computed in int32 on device — past this it wraps and rows
@@ -138,8 +253,8 @@ def plan_rounds(counts, capacity: int,
             # the egress/ingress chip of pair (g, g') is
             # MeshTopology.egress_chip = (g + g') % pod_size
             egress = (pod[:, None] + pod[None, :]) % c
-            hops = ((chip[:, None] != egress).astype(np.int64)
-                    + (egress != chip[None, :]).astype(np.int64))
+            hop_a = (chip[:, None] != egress).astype(np.int64)
+            hops = hop_a + (egress != chip[None, :]).astype(np.int64)
     windows = []
     skipped = 0
     for r in range(total):
@@ -165,8 +280,23 @@ def plan_rounds(counts, capacity: int,
             per_pod = tuple(
                 (g, int(off[g].sum()), int(msgs_mat[g].sum()))
                 for g in range(p) if off[g].sum() or msgs_mat[g].sum())
-            windows.append(WindowPlan(r, moved, ici, dcn_rows,
-                                      int(msgs_mat.sum()), per_pod))
+            win_coded, l_win, ppc, extra_ici = (
+                _plan_window_coding(inwin, topology) if coded
+                else (False, 0, (), 0))
+            ici_coded = 0
+            if win_coded:
+                # the coded body keeps stage A's egress staging hop
+                # but replaces the stage-C delivery scatter with the
+                # chunk broadcast (extra_ici): intra + hop A + bcast
+                ici_coded = (int(inwin[intra_off].sum())
+                             + int((inwin * hop_a)[cross].sum())
+                             + extra_ici)
+            windows.append(WindowPlan(
+                r, moved, ici, dcn_rows, int(msgs_mat.sum()), per_pod,
+                coded=win_coded, l_rows=l_win,
+                coded_rows=sum(cr for _, cr, _ in ppc),
+                saved_rows=sum(sv for _, _, sv in ppc),
+                ici_rows_coded=ici_coded, per_pod_coded=ppc))
         else:
             dcn_rows = int(inwin[cross].sum())
             msgs = (inwin > 0) & cross
@@ -180,8 +310,10 @@ def plan_rounds(counts, capacity: int,
             windows.append(WindowPlan(
                 r, moved, int(inwin[intra_off].sum()), dcn_rows,
                 int(msgs.sum()), tuple(per_pod)))
+    l_plan = max((w.l_rows for w in windows if w.coded), default=0)
     return RoundPlan(tuple(windows), total, skipped, int(record_bytes),
-                     bool(hierarchical))
+                     bool(hierarchical), coded=coded,
+                     coded_l_rows=l_plan)
 
 
 def plan_layout_rounds(layout, capacity: int) -> RoundPlan:
@@ -190,14 +322,18 @@ def plan_layout_rounds(layout, capacity: int) -> RoundPlan:
     record stride) shared by ``exchange.shuffle_exchange`` and
     ``distributed.distributed_sort_multiround``."""
     return plan_rounds(layout.counts, capacity, layout.topology,
-                       layout.record_bytes(), layout.hierarchical)
+                       layout.record_bytes(), layout.hierarchical,
+                       coded=getattr(layout, "coded", False))
 
 
-def record_executed_window(win: WindowPlan, plan: RoundPlan) -> None:
+def record_executed_window(win: WindowPlan, plan: RoundPlan,
+                           coded: bool = False) -> None:
     """Account one executed window: the round counter plus its per-axis
-    fabric metrics (one call site contract for every round loop)."""
+    fabric metrics (one call site contract for every round loop).
+    ``coded`` says which body ACTUALLY ran — a coded window whose
+    decode fell back mid-round books the plain-tile figures."""
     metrics.add("exchange.rounds")
-    record_window_metrics(win, plan.record_bytes)
+    record_window_metrics(win, plan.record_bytes, coded=coded)
 
 
 def record_plan_skips(plan: RoundPlan) -> None:
@@ -205,10 +341,31 @@ def record_plan_skips(plan: RoundPlan) -> None:
         metrics.add("exchange.rounds.skipped", plan.skipped)
 
 
-def record_window_metrics(win: WindowPlan, record_bytes: int) -> None:
+def record_window_metrics(win: WindowPlan, record_bytes: int,
+                          coded: bool = False) -> None:
     """Land one executed window's per-axis accounting in the metrics
     hub. The DCN series carry a source-pod label (the labeled-counter
-    machinery advances the unlabeled totals too)."""
+    machinery advances the unlabeled totals too). A CODED window books
+    the multicast charge as its ``exchange.dcn.bytes`` plus the coded/
+    saved breakdown — ``coded + saved == the plain window's payload``
+    by construction (the ledger-sum invariant the tests pin)."""
+    if coded and win.coded:
+        if win.ici_rows_coded:
+            metrics.add("exchange.ici.bytes",
+                        win.ici_rows_coded * record_bytes)
+        for g, crows, srows in win.per_pod_coded:
+            if crows:
+                metrics.add("exchange.dcn.bytes", crows * record_bytes,
+                            pod=g)
+                metrics.add("exchange.dcn.coded.bytes",
+                            crows * record_bytes, pod=g)
+            if srows:
+                metrics.add("exchange.dcn.saved.bytes",
+                            srows * record_bytes, pod=g)
+        for g, _rows, msgs in win.per_pod:
+            if msgs:
+                metrics.add("exchange.dcn.messages", msgs, pod=g)
+        return
     if win.ici_rows:
         metrics.add("exchange.ici.bytes", win.ici_rows * record_bytes)
     for g, rows, msgs in win.per_pod:

@@ -205,6 +205,32 @@ _FLAG_LIST = [
          "primary supplier is dead or penalized. empty = coding off; "
          "rs:k:k = chunked layout with zero parity (byte-identical "
          "data path)"),
+    Flag("uda.tpu.coding.domains", "", str,
+         "failure-domain map for stripe shard placement, "
+         "'host=domain,host=domain,...'. The reduce side keys by "
+         "canonical supplier HOST names and the writer by supplier "
+         "ROOTS — declare BOTH namespaces in this one spec (extra "
+         "keys are harmless; a spec matching neither side warns "
+         "loudly and degrades to rotation). Declared domains spread "
+         "each stripe's n shards "
+         "round-robin ACROSS domains (no rack/power domain "
+         "accumulates enough shards to make a stripe unrecoverable); "
+         "undeclared hosts count as their own singleton domain; empty "
+         "= the positional rotation over the sorted supplier list "
+         "(the PR 8 placement, unchanged)"),
+    Flag("uda.tpu.coding.scrub.s", 0, int,
+         "background stripe-scrub interval in seconds: a low-priority "
+         "daemon pass (one in flight per process, the "
+         "tuncache.ensure_fresh idiom) re-verifies each coded map "
+         "output's parity section against its data region and checks "
+         "peer shard MOFs, counting coding.scrub.stripes / "
+         "coding.scrub.repairs. 0 = scrub off (explicit scrub_roots "
+         "calls still work)"),
+    Flag("uda.tpu.coding.scrub.repair", False, bool,
+         "let the scrub REBUILD lost or corrupt peer stripe shards "
+         "from the primary's data+parity (proactive repair). Default "
+         "off = dump-only: mismatches are counted and logged, bytes "
+         "on disk are never touched"),
     Flag("uda.tpu.net.handoff.path", "", str,
          "supplier warm-restart handoff record: stop(drain=True) "
          "persists {generation, served-offset watermarks} to this "
@@ -331,6 +357,17 @@ _FLAG_LIST = [
          "(tenant/registry.sign_job); empty = unauthenticated (the "
          "trusted-fabric default, like the reference's rdma_cm "
          "plane). Both sides must agree"),
+    Flag("uda.tpu.tenant.quantum.kb", 64, int,
+         "byte quantum of the weighted-deficit round robin: each "
+         "tenant's deficit EARNS quantum.kb x weight KB per turn and "
+         "is CHARGED each granted request's requested bytes "
+         "(chunk_size), so mixed chunk sizes stay byte-fair — a "
+         "tenant fetching 1 MB chunks no longer out-draws one "
+         "fetching 64 KB chunks at equal weight. A head request "
+         "larger than one turn's earning accumulates deficit across "
+         "turns (and the sweep force-serves the most-indebted head "
+         "rather than idle credits). 0 = request-count quanta (the "
+         "PR 14 behavior)"),
     Flag("uda.tpu.tenant.wqe.total", 0, int,
          "the daemon-wide credit pool the CreditScheduler grants by "
          "weighted deficit round-robin (requests in flight across ALL "

@@ -102,6 +102,11 @@ _SITE_ERRORS = {
     # server's warm-restart handoff persistence (key "load"/"save")
     "coding.decode": StorageError,
     "net.handoff": StorageError,
+    # the coded multicast exchange's decode rung (keyed "round<i>"):
+    # an injected failure on a CODED window must complete the round
+    # byte-correct on the plain coalesced tile (the in-round fallback,
+    # counted exchange.decode.fallbacks) — never a hang or data loss
+    "exchange.decode": StorageError,
     # block decompression on the staging pipeline's hot path (keyed by
     # "<map>@<offset>"): a corrupt/injected block must abort the fetch
     # cleanly — the stage pool drains, no in-flight budget bytes leak

@@ -210,6 +210,36 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                          "exchange) vs coalesced pod "
                                          "pairs (hierarchical) [labels: "
                                          "pod (source pod)]"),
+    "exchange.dcn.coded.bytes": ("counter", "multicast-model DCN charge "
+                                            "of coded windows: one "
+                                            "L-row coded packet per "
+                                            "pod pair serving every "
+                                            "member reducer (equals "
+                                            "the window's exchange."
+                                            "dcn.bytes when coded) "
+                                            "[labels: pod (source "
+                                            "pod)]"),
+    "exchange.dcn.saved.bytes": ("counter", "DCN payload bytes the "
+                                            "coded stage B removed vs "
+                                            "the plain coalesced tile "
+                                            "(invariant: coded + "
+                                            "saved == the uncoded "
+                                            "payload) [labels: pod "
+                                            "(source pod)]"),
+    "exchange.decode.fallbacks": ("counter", "coded windows whose "
+                                             "decode failed (failpoint "
+                                             "exchange.decode) and "
+                                             "completed byte-correct "
+                                             "on the plain coalesced "
+                                             "tile"),
+    "coding.scrub.stripes": ("counter", "map-output stripes whose "
+                                        "parity section was verified "
+                                        "against the data region by "
+                                        "the background scrub"),
+    "coding.scrub.repairs": ("counter", "lost/corrupt stripe shards "
+                                        "the scrub rebuilt (repair "
+                                        "mode) or reported (dump-only "
+                                        "default)"),
     "decompress.bytes": ("counter", "uncompressed bytes produced by the "
                                     "decompressing fetch client"),
     # -- counters: network data plane (uda_tpu/net/) ---------------------
