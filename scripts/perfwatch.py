@@ -190,6 +190,36 @@ def _extract_tenant(run: str, data: Dict, out: List[Dict]) -> None:
         # the bench itself gates the [1.4, 3.0] band on full runs
 
 
+def _extract_ckpt(run: str, data: Dict, out: List[Dict]) -> None:
+    """scripts/bench_ckpt.py output (bench "ckpt_overhead", r16+):
+    crash-consistent snapshot plane on vs off. The identity/resume
+    booleans are hard gates (tol 0 — a resume that restarts from
+    scratch or drifts a byte is a correctness break, not a trend);
+    overhead_pct gates full runs direction-of-change DOWN (the armed
+    plane must stay within its <=5% budget and not creep) while quick
+    runs only trend it — wall-clock deltas this small flake on shared
+    CI hosts."""
+    quick = bool(data.get("quick"))
+    w = "ckpt_overhead_quick" if quick else "ckpt_overhead"
+    res = data.get("resume") or {}
+    for key in ("ckpt_on_identical", "resume_identical",
+                "resumed_not_restarted"):
+        if key in res:
+            _add(out, run, w, key, 1.0 if res[key] else 0.0, "up",
+                 tol=0.0)
+    if "overhead_pct" in data:
+        _add(out, run, w, "overhead_pct", data["overhead_pct"],
+             "info" if quick else "down")
+    if "ckpt_on_MBps" in data:
+        _add(out, run, w, "ckpt_on_MBps", data["ckpt_on_MBps"],
+             "info" if quick else "up")
+    if "snapshots" in data:
+        # structural, not wall clock: snapshot count at the default
+        # interval on the reference shape — creep here means the
+        # rate-limiter regressed
+        _add(out, run, w, "snapshots", data["snapshots"], "info")
+
+
 def _extract_exchange(run: str, data: Dict, out: List[Dict]) -> None:
     """scripts/exchange_bench.py output (bench "exchange_modes", r15+):
     flat vs hierarchical vs coded accounting per mesh x workload.
@@ -297,6 +327,8 @@ def extract(run: str, data) -> List[Dict]:
         _extract_tenant(run, data, out)
     elif data.get("bench") == "exchange_modes":
         _extract_exchange(run, data, out)
+    elif data.get("bench") == "ckpt_overhead":
+        _extract_ckpt(run, data, out)
     elif "identity" in data and "speedup_sorted" in data:
         _extract_pipeline(run, data, out)
     elif isinstance(data.get("results"), list):

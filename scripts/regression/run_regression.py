@@ -441,6 +441,70 @@ def wl_coded_shuffle(size: str, work_dir: str) -> dict:
             "stripes_scrubbed": rep["stripes"]}
 
 
+def wl_resume_shuffle(size: str, work_dir: str) -> dict:
+    # the crash/resume regression (ISSUE 16): a checkpoint-armed
+    # streaming shuffle is killed at a DETERMINISTIC point (a terminal
+    # injected fetch fault on one map, zero retries), then restarted.
+    # Gates: the resumed output passes the sortedness + record-count
+    # stream gate, AND the second attempt RESUMED rather than silently
+    # restarting from scratch (ckpt.resumed counted, at least one
+    # checkpointed run file adopted instead of refetched).
+    from uda_tpu.merger import LocalFetchClient, MergeManager
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.utils import comparators
+    from uda_tpu.utils.config import Config
+    from uda_tpu.utils.errors import FallbackSignal
+    from uda_tpu.utils.failpoints import failpoints
+    from uda_tpu.utils.metrics import metrics
+
+    total = _size("shuffle_records", size)
+    num_maps = max(4, min(64, total // 160_000 or 4))
+    per_map = (total + num_maps - 1) // num_maps
+    job = "shufresume"
+    _make_terasort_mofs(work_dir, job, num_maps, per_map)
+    mids = [f"attempt_{job}_m_{m:06d}_0" for m in range(num_maps)]
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    ckdir = os.path.join(work_dir, "ckpt")
+    out_path = os.path.join(work_dir, "reduce.out")
+
+    def attempt(fault: str, retries: int):
+        cfg = Config({"uda.tpu.online.streaming": True,
+                      "uda.tpu.ckpt.dir": ckdir,
+                      "uda.tpu.ckpt.interval.s": 0.0,
+                      "uda.tpu.fetch.retries": retries,
+                      "mapred.rdma.wqe.per.conn": 8})
+        engine = DataEngine(DirIndexResolver(work_dir), cfg)
+        try:
+            mm = MergeManager(LocalFetchClient(engine), kt, cfg)
+            with open(out_path, "wb") as out:
+                if fault:
+                    with failpoints.scoped(fault):
+                        mm.run(job, mids, 0, lambda mv: out.write(mv))
+                else:
+                    mm.run(job, mids, 0, lambda mv: out.write(mv))
+        finally:
+            engine.stop()
+
+    # attempt 1: dies on the seeded kill point, leaving the checkpoint
+    fault = f"segment.fetch=error:match:m_{num_maps - 1:06d}"
+    try:
+        attempt(fault, retries=0)
+        raise AssertionError("seeded kill point did not fire")
+    except FallbackSignal:
+        pass
+    snap0 = metrics.snapshot()
+    attempt("", retries=3)  # attempt 2: must RESUME
+    snap1 = metrics.snapshot()
+    resumed = snap1.get("ckpt.resumed", 0) - snap0.get("ckpt.resumed", 0)
+    adopted = (snap1.get("ckpt.runs.adopted", 0)
+               - snap0.get("ckpt.runs.adopted", 0))
+    assert resumed >= 1, "second attempt restarted from scratch"
+    assert adopted >= 1, "no checkpointed run file was adopted"
+    _verify_sorted_stream(out_path, num_maps * per_map)
+    return {"maps": num_maps, "records": num_maps * per_map,
+            "runs_adopted": int(adopted)}
+
+
 def wl_pi(size: str, work_dir: str) -> dict:
     from uda_tpu.models.pi import run_pi
 
@@ -473,6 +537,7 @@ WORKLOADS = {
     "terasort_shuffle_hybrid": wl_terasort_shuffle_hybrid,
     "terasort_shuffle_streaming": wl_terasort_shuffle_streaming,
     "terasort_shuffle_auto": wl_terasort_shuffle_auto,
+    "resume_shuffle": wl_resume_shuffle,
 }
 
 

@@ -29,7 +29,7 @@ COUNTERS="$(mktemp)"
 # its post-mortem defeats the recorder's purpose).
 FRROOT="$(mktemp -d)"
 export FRROOT  # the telemetry merge below reads the dumps from it
-for r in main pressure network exchange completion pipeline iobatch tenant lockdep; do
+for r in main pressure network exchange completion pipeline iobatch tenant resume lockdep; do
   mkdir -p "${FRROOT}/${r}"
 done
 trap 'rm -f "${COUNTERS}"; rm -rf "${FRROOT}"' EXIT
@@ -211,6 +211,36 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${TSPEC}" UDA_TPU_STATS=1 \
     -k "tenant" \
     --continue-on-collection-errors "$@" || tenrc=$?
 
+# Resume rung: the crash-consistent reduce guarantee (ISSUE 16) — a
+# seeded kill -9 of the reduce process mid-merge (and once DURING a
+# snapshot, via a ckpt.save truncate that tears the newest manifest),
+# then a restart. The faults-marked checkpoint tests assert the whole
+# contract: the resumed attempt's output is BYTE-IDENTICAL to an
+# uninterrupted run, ckpt.resumed advances (a silent restart-from-
+# scratch FAILS), ZERO manifest-recorded run files are refetched, and
+# the torn manifest is skipped for the previous durable one. The kill
+# point is derived from UDA_TPU_CHAOS_SEED (the child process arms its
+# own deterministic faults); the rung layers only a seeded pread-delay
+# storm on the parent so the kill lands at varied merge states without
+# breaking determinism. Lockdep + the resource ledger watch the new
+# checkpoint lock against everything the spool path touches.
+RESSPEC="data_engine.pread=delay:$((SEED % 10 + 1)):prob:0.2:seed:${SEED}"
+RESCOUNTERS="$(mktemp)"
+RESCYCLES="$(mktemp)"
+RESLEAKS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}"; rm -rf "${FRROOT}"' EXIT
+echo "resume rung:         seeded kill -9 mid-merge + mid-snapshot (seed ${SEED}, ${RESSPEC}, UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
+resrc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${RESSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_CHAOS_SEED="${SEED}" \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/resume" \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${RESCYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${RESLEAKS}" \
+    UDA_TPU_CHAOS_TELEMETRY="${RESCOUNTERS}" \
+    python -m pytest tests/test_checkpoint.py -m faults -q \
+    -p no:cacheprovider \
+    --continue-on-collection-errors "$@" || resrc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -221,7 +251,7 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${TSPEC}" UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -243,7 +273,9 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${IOSPEC}" "${IOCOUNTERS}" "${iorc}" "${IOCYCLES}" \
     "${IOLEAKS}" \
     "${TSPEC}" "${TENCOUNTERS}" "${tenrc}" "${TENCYCLES}" \
-    "${TENLEAKS}" <<'EOF' || mrc=$?
+    "${TENLEAKS}" \
+    "${RESSPEC}" "${RESCOUNTERS}" "${resrc}" "${RESCYCLES}" \
+    "${RESLEAKS}" <<'EOF' || mrc=$?
 import glob, json, os, sys
 sys.path.insert(0, os.getcwd())
 from uda_tpu.utils.critpath import buckets_from_counters
@@ -255,8 +287,9 @@ from uda_tpu.utils.critpath import buckets_from_counters
  lcounters, lrc, lcycles,
  nleaks_path, cleaks_path, pileaks_path,
  iospec, iocounters, iorc, iocycles, ioleaks_path,
- tenspec, tencounters, tenrc, tencycles, tenleaks_path) = \
-    sys.argv[1:39]
+ tenspec, tencounters, tenrc, tencycles, tenleaks_path,
+ resspec, rescounters, resrc_, rescycles, resleaks_path) = \
+    sys.argv[1:44]
 frroot = os.environ.get("FRROOT", "")
 def flightrec_block(rung, exit_code):
     """Archive the rung's black-box dumps (cause + structured extra +
@@ -396,9 +429,30 @@ pipeline["drained"] = {
     "inflight_bytes_left": pipeline["telemetry"].get(
         "gauges", {}).get("stage.inflight.bytes", 0),
 }
+resume, res_reports = lockdep_block(
+    f"{resspec} + seeded kill -9 mid-merge/mid-snapshot", resrc_,
+    rescounters, rescycles)
+res_leaks = resledger_block(resume, resleaks_path)
+# the crash-consistent resume contract, surfaced: resumed-not-
+# restarted counts, banked bytes, adopted run files and the
+# invalidation ladder's verdicts (the per-test asserts enforce
+# byte-identity and zero refetch; this is the diffable record). The
+# parent pytest process hosts the RESUMED attempts, so its session
+# counters carry the resume-side evidence; the killed child's counters
+# die with it by design.
+rsc = resume["telemetry"].get("counters", {})
+resume["resumed"] = {
+    "ckpt_resumed": rsc.get("ckpt.resumed", 0),
+    "runs_adopted": rsc.get("ckpt.runs.adopted", 0),
+    "resumed_fetches": rsc.get("fetch.resumed", 0),
+    "resumed_bytes": rsc.get("fetch.resumed.bytes", 0),
+    "snapshots": rsc.get("ckpt.snapshots", 0),
+    "invalidated": rsc.get("ckpt.invalidated", 0),
+    "save_errors": rsc.get("ckpt.save.errors", 0),
+}
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 nleak = (len(n_leaks) + len(c_leaks) + len(pi_leaks) + len(io_leaks)
-         + len(ten_leaks))
+         + len(ten_leaks) + len(res_leaks))
 # flight-recorder archive, one block per rung; a rung that failed
 # without a single black-box dump flags failed_without_dump
 fr = {"main": flightrec_block("main", rc),
@@ -409,6 +463,7 @@ fr = {"main": flightrec_block("main", rc),
       "pipeline": flightrec_block("pipeline", pirc),
       "iobatch": flightrec_block("iobatch", iorc),
       "tenant": flightrec_block("tenant", tenrc),
+      "resume": flightrec_block("resume", resrc_),
       "lockdep": flightrec_block("lockdep", lrc)}
 network["flightrec"] = fr["network"]
 exchange["flightrec"] = fr["exchange"]
@@ -416,6 +471,7 @@ completion["flightrec"] = fr["completion"]
 pipeline["flightrec"] = fr["pipeline"]
 iobatch["flightrec"] = fr["iobatch"]
 tenant["flightrec"] = fr["tenant"]
+resume["flightrec"] = fr["resume"]
 lockdep["flightrec"] = fr["lockdep"]
 no_postmortem = sorted(r for r, b in fr.items()
                        if b["failed_without_dump"])
@@ -437,17 +493,18 @@ with open(out, "w") as f:
                "pipeline": pipeline,
                "iobatch": iobatch,
                "tenant": tenant,
+               "resume": resume,
                "lockdep": lockdep,
                "resledger": {"armed_rungs": ["network", "completion",
                                              "pipeline", "iobatch",
-                                             "tenant"],
+                                             "tenant", "resume"],
                              "leaks": nleak},
                "flightrec_missing_postmortem": no_postmortem},
               f, indent=1, sort_keys=True)
     f.write("\n")
 ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
         + len(pi_reports) + len(io_reports) + len(ten_reports)
-        + len(l_reports))
+        + len(res_reports) + len(l_reports))
 ndumps = sum(b["dumps"] for b in fr.values())
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc}, "
       f"resledger leaks: {nleak}, flightrec dumps: {ndumps})")
@@ -469,6 +526,7 @@ if [ "${crc}" -ne 0 ]; then rc="${crc}"; fi
 if [ "${pirc}" -ne 0 ]; then rc="${pirc}"; fi
 if [ "${iorc}" -ne 0 ]; then rc="${iorc}"; fi
 if [ "${tenrc}" -ne 0 ]; then rc="${tenrc}"; fi
+if [ "${resrc}" -ne 0 ]; then rc="${resrc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP/RESLEDGER/FLIGHTREC: cycle reports, leaked obligations" \

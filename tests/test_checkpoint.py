@@ -1,0 +1,658 @@
+"""Crash-consistent checkpoint/resume of a half-merged reduce
+(uda_tpu.merger.checkpoint, ISSUE 16).
+
+The contract under test, layer by layer:
+
+- RunStore fixed-dir mode: run files spool into the checkpoint's
+  directory with a CRC recorded per run, survive cleanup(), and can be
+  adopted back by a successor attempt.
+- Segment offset-ledger export/preload: the framed-batches+carry
+  snapshot round-trips byte-exactly and re-arms the mid-partition
+  resume (fetch.resumed.bytes), with the first-chunk identity check
+  still guarding it.
+- TaskCheckpoint manifests: atomic (write-to-temp + fsync + rename),
+  versioned, consumed-on-load (zombie fencing via the tenant epoch),
+  and torn-manifest-tolerant — a kill mid-snapshot (or an injected
+  ``ckpt.save`` truncate) falls back to the previous manifest, never a
+  broken one, never a crash.
+- MergeManager resume: a restarted attempt produces BYTE-IDENTICAL
+  output to the uninterrupted run, refetches ZERO bytes of the maps
+  whose run files the manifest recorded (``ckpt.runs.adopted``), and
+  counts ``ckpt.resumed`` — a silent restart-from-scratch is a test
+  failure, not a pass.
+- The faults-marked tests are the chaos resume rung
+  (scripts/run_chaos.sh): a seeded kill -9 of the reduce process
+  mid-merge, once mid-checkpoint, then the resume asserts above.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import zlib
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.merger import LocalFetchClient, MergeManager
+from uda_tpu.merger import checkpoint as ckpt_mod
+from uda_tpu.merger.checkpoint import TaskCheckpoint, read_run
+from uda_tpu.merger.segment import InputClient, Segment
+from uda_tpu.merger.streaming import RunStore
+from uda_tpu.mofserver import DataEngine, DirIndexResolver, ShuffleRequest
+from uda_tpu.utils import comparators
+from uda_tpu.utils.budget import MemoryBudget
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import FallbackSignal, MergeError, StorageError
+from uda_tpu.utils.failpoints import failpoints
+from uda_tpu.utils.ifile import EOF_MARKER, crack, crack_partial, \
+    write_records
+from uda_tpu.utils.metrics import metrics
+
+KT = comparators.get_key_type("uda.tpu.RawBytes")
+
+
+def _counter(name: str) -> float:
+    """Unlabeled counter total (labeled adds advance it too)."""
+    return float(metrics.snapshot().get(name, 0))
+
+
+def _recs(n, seed=0, key_bytes=10, val_bytes=24):
+    rng = np.random.default_rng(seed)
+    return [(rng.bytes(key_bytes), rng.bytes(val_bytes)) for _ in range(n)]
+
+
+# -- RunStore fixed-dir mode -------------------------------------------------
+
+def test_runstore_fixed_dir_crc_adopt_discard(tmp_path):
+    fixed = os.path.join(str(tmp_path), "runs")
+    store = RunStore(tag="t", fixed_dir=fixed)
+    recs = sorted(_recs(50, seed=1), key=lambda kv: kv[0])
+    batch = crack(write_records(recs))
+    store.write_run(3, batch, np.arange(50, dtype=np.int64))
+    man = store.manifest()
+    assert set(man) == {3}
+    n, nbytes, crc = man[3]
+    assert n == 50
+    run_path = store.run_path(3)
+    with open(run_path, "rb") as f:
+        data = f.read()
+    assert len(data) == nbytes + len(EOF_MARKER)
+    assert zlib.crc32(data) & 0xFFFFFFFF == crc  # whole file incl. EOF
+    # fixed mode: cleanup() keeps the files — they ARE the resume state
+    store.cleanup()
+    assert os.path.exists(run_path)
+    # a successor adopts the same accounting without rewriting
+    store2 = RunStore(tag="t", fixed_dir=fixed)
+    store2.adopt(3, n, nbytes, crc)
+    assert store2.manifest() == {3: (n, nbytes, crc)}
+    with pytest.raises(MergeError):
+        store2.adopt(3, n, nbytes, crc)  # staged twice
+    store2.discard(3)
+    assert not os.path.exists(run_path)
+
+
+def test_read_run_validates_length_crc_offsets(tmp_path):
+    fixed = os.path.join(str(tmp_path), "runs")
+    store = RunStore(tag="t", fixed_dir=fixed)
+    recs = sorted(_recs(40, seed=2), key=lambda kv: kv[0])
+    store.write_run(0, crack(write_records(recs)),
+                    np.arange(40, dtype=np.int64))
+    n, nbytes, crc = store.manifest()[0]
+    rec = {"records": n, "bytes": nbytes,
+           "length": nbytes + len(EOF_MARKER), "crc": crc}
+    run_path, off_path = store._paths(0)
+    batch = read_run(run_path, off_path, rec)
+    assert batch.num_records == 40
+    # torn spool: truncated file fails the length check
+    with open(run_path, "rb") as f:
+        data = f.read()
+    with open(run_path, "wb") as f:
+        f.write(data[:-7])
+    with pytest.raises(StorageError):
+        read_run(run_path, off_path, rec)
+    # right length, flipped byte: fails the CRC check
+    with open(run_path, "wb") as f:
+        f.write(data[:10] + bytes([data[10] ^ 0xFF]) + data[11:])
+    with pytest.raises(StorageError):
+        read_run(run_path, off_path, rec)
+
+
+# -- Segment offset-ledger export/preload ------------------------------------
+
+def _null_segment(tmp_path, chunk=1 << 16):
+    class _Null(InputClient):
+        def start_fetch(self, req, on_complete):
+            raise AssertionError("no fetch expected")
+
+    return Segment(_Null(), "j", "m_0", 0, chunk)
+
+
+def test_segment_export_preload_roundtrip(tmp_path):
+    recs = _recs(30, seed=3)
+    framed = write_records(recs)[:-len(EOF_MARKER)]
+    carry = write_records(_recs(1, seed=4))[:3]  # a torn record head
+    data = framed + carry
+    seg = _null_segment(tmp_path)
+    seg.ckpt_preload(data=data, carry_len=len(carry),
+                     next_offset=len(data), raw_length=4096,
+                     num_records=30)
+    ex = seg.ckpt_export()
+    assert ex is not None
+    assert ex["next_offset"] == len(data)
+    assert ex["raw_length"] == 4096
+    assert ex["num_records"] == 30
+    assert ex["carry_len"] == len(carry)
+    assert ex["data"] == data  # byte-exact round trip
+    # nothing fetched yet -> nothing to export
+    assert _null_segment(tmp_path).ckpt_export() is None
+
+
+def test_segment_preload_rejects_mismatch(tmp_path):
+    recs = _recs(10, seed=5)
+    framed = write_records(recs)[:-len(EOF_MARKER)]
+    with pytest.raises(StorageError):  # record count drifted
+        _null_segment(tmp_path).ckpt_preload(
+            data=framed, carry_len=0, next_offset=len(framed),
+            raw_length=None, num_records=11)
+    with pytest.raises(StorageError):  # carry longer than the payload
+        _null_segment(tmp_path).ckpt_preload(
+            data=b"xy", carry_len=5, next_offset=2, raw_length=None,
+            num_records=0)
+
+
+def test_segment_preload_resumes_mid_partition(tmp_path):
+    """A preloaded ledger picks the fetch up at next_offset: the final
+    batch equals the full fetch, fetch.resumed counts it, and only the
+    tail bytes move."""
+    root = os.path.join(str(tmp_path), "mof")
+    make_mof_tree(root, "jobL", 1, 1, 400, seed=7)
+    cfg = Config()
+    engine = DataEngine(DirIndexResolver(root), cfg)
+    try:
+        mid = map_ids("jobL", 1)[0]
+        chunk = 2048
+        res = engine.submit(
+            ShuffleRequest("jobL", mid, 0, 0, chunk)).result()
+        first = bytes(res.data)
+        assert not res.is_last  # the partition must span chunks
+        batch, consumed, _ = crack_partial(first, expect_eof=False)
+        from uda_tpu import native
+
+        data = native.frame_batch(batch, write_eof=False) + \
+            first[consumed:]
+        r0 = _counter("fetch.resumed")
+        b0 = _counter("fetch.resumed.bytes")
+        seg = Segment(LocalFetchClient(engine), "jobL", mid, 0, chunk)
+        seg.ckpt_preload(data=data, carry_len=len(first) - consumed,
+                         next_offset=len(first),
+                         raw_length=res.raw_length,
+                         num_records=batch.num_records)
+        seg.start()
+        seg.wait()
+        resumed = seg.record_batch()  # raises if the resume errored
+        full = Segment(LocalFetchClient(engine), "jobL", mid, 0,
+                       1 << 20)
+        full.start()
+        full.wait()
+        ref = full.record_batch()
+        assert resumed.num_records == ref.num_records
+        assert list(resumed.iter_records()) == list(ref.iter_records())
+        assert _counter("fetch.resumed") == r0 + 1
+        assert _counter("fetch.resumed.bytes") == b0 + len(first)
+    finally:
+        engine.stop()
+
+
+# -- TaskCheckpoint manifests ------------------------------------------------
+
+def _collect_factory(payload_runs=None, ledgers=None, parts=None):
+    def collect():
+        payload = {"maps": ["m_0"], "runs": dict(payload_runs or {}),
+                   "ledgers": {k: dict(v)
+                               for k, v in (ledgers or {}).items()},
+                   "journal": [], "penalty": {}, "forest": {}}
+        return payload, dict(parts or {})
+    return collect
+
+
+def test_manifest_atomic_roundtrip_and_consume(tmp_path):
+    ck = TaskCheckpoint(str(tmp_path), "jobM", 0, interval_s=0.0)
+    part = b"ledger-bytes" * 9
+    ck.save(_collect_factory(
+        payload_runs={"0": {"map": "m_0", "records": 1}},
+        ledgers={"1": {"map": "m_1"}}, parts={1: part}))
+    assert ck.version >= 2  # part write + manifest write
+    # the part file landed and is integrity-checked on the way back
+    loaded = TaskCheckpoint(str(tmp_path), "jobM", 0)
+    man = loaded.load()
+    assert man is not None and man["seq"] == 1
+    assert man["runs"]["0"]["records"] == 1
+    assert loaded.part_bytes(man["ledgers"]["1"]) == part
+    # consumed-on-load: a second claimant finds nothing
+    assert TaskCheckpoint(str(tmp_path), "jobM", 0).load() is None
+    # corrupt part entry -> StorageError (caller refetches from zero)
+    bad = dict(man["ledgers"]["1"], part_crc=123)
+    with pytest.raises(StorageError):
+        loaded.part_bytes(bad)
+    with pytest.raises(StorageError):
+        loaded.part_bytes({"part": "../../etc/passwd",
+                           "part_len": 1, "part_crc": 0})
+
+
+def test_torn_manifest_falls_back_to_previous(tmp_path):
+    ck = TaskCheckpoint(str(tmp_path), "jobT", 1, interval_s=0.0)
+    ck.save(_collect_factory(payload_runs={"0": {"gen": 1}}))
+    ck.save(_collect_factory(payload_runs={"0": {"gen": 2}}))
+    newest = sorted(glob.glob(os.path.join(ck.task_dir,
+                                           "manifest-*.uckp")))[-1]
+    with open(newest, "rb") as f:
+        raw = f.read()
+    with open(newest, "wb") as f:
+        f.write(raw[:len(raw) // 2])  # the kill-mid-snapshot shape
+    t0 = _counter("ckpt.invalidated")
+    man = TaskCheckpoint(str(tmp_path), "jobT", 1).load()
+    assert man is not None and man["seq"] == 1  # previous, never broken
+    assert man["runs"]["0"]["gen"] == 1
+    assert _counter("ckpt.invalidated") == t0 + 1
+
+
+def test_torn_manifest_via_ckpt_save_failpoint(tmp_path):
+    """The injectable version of the same guarantee: a ckpt.save
+    truncate fault writes a torn manifest; load skips it cleanly."""
+    ck = TaskCheckpoint(str(tmp_path), "jobF", 2, interval_s=0.0)
+    ck.save(_collect_factory(payload_runs={"0": {"gen": 1}}))
+    with failpoints.scoped("ckpt.save=truncate"):
+        ck.save(_collect_factory(payload_runs={"0": {"gen": 2}}))
+    man = TaskCheckpoint(str(tmp_path), "jobF", 2).load()
+    assert man is not None and man["runs"]["0"]["gen"] == 1
+
+
+def test_ckpt_save_error_is_absorbed(tmp_path):
+    ck = TaskCheckpoint(str(tmp_path), "jobE", 3, interval_s=0.0)
+    e0 = _counter("ckpt.save.errors")
+    with failpoints.scoped("ckpt.save=error"):
+        assert ck.maybe_save(_collect_factory(), force=True) is False
+    assert _counter("ckpt.save.errors") == e0 + 1
+    assert TaskCheckpoint(str(tmp_path), "jobE", 3).load() is None
+
+
+def test_ckpt_load_failpoint_degrades_to_fresh_start(tmp_path):
+    ck = TaskCheckpoint(str(tmp_path), "jobG", 4, interval_s=0.0)
+    ck.save(_collect_factory())
+    with failpoints.scoped("ckpt.load=error"):
+        assert TaskCheckpoint(str(tmp_path), "jobG", 4).load() is None
+    # the manifest itself survived the failed load attempt
+    assert TaskCheckpoint(str(tmp_path), "jobG", 4).load() is not None
+
+
+def test_epoch_fence_refuses_successor_manifest(tmp_path):
+    ck2 = TaskCheckpoint(str(tmp_path), "jobZ", 5, interval_s=0.0,
+                         epoch=2)
+    ck2.save(_collect_factory(payload_runs={"0": {"gen": 1}}))
+    # the epoch-1 zombie must not consume its successor's state
+    zombie = TaskCheckpoint(str(tmp_path), "jobZ", 5, epoch=1)
+    assert zombie.load() is None
+    assert glob.glob(os.path.join(ck2.task_dir, "manifest-*.uckp"))
+    # the rightful epoch-2 owner still can
+    assert TaskCheckpoint(str(tmp_path), "jobZ", 5,
+                          epoch=2).load() is not None
+
+
+def test_manifest_prune_keeps_recent_generations(tmp_path):
+    ck = TaskCheckpoint(str(tmp_path), "jobP", 6, interval_s=0.0,
+                        keep=2)
+    for g in range(5):
+        ck.save(_collect_factory(
+            ledgers={"0": {"map": "m_0"}}, parts={0: b"x%d" % g}))
+    manifests = sorted(glob.glob(os.path.join(ck.task_dir,
+                                              "manifest-*.uckp")))
+    assert len(manifests) == 2
+    # retained manifests only reference parts of their own seq; older
+    # part files are pruned with their manifests
+    parts = sorted(os.listdir(ck.parts_dir))
+    assert parts == ["p00000004-s00000.part", "p00000005-s00000.part"]
+
+
+# -- MergeManager wiring -----------------------------------------------------
+
+def test_budget_route_prefer_streaming(tmp_path):
+    b = MemoryBudget.from_config(Config({
+        "uda.tpu.hbm.budget.mb": 4096, "uda.tpu.host.budget.mb": 4096}))
+    small = 1 << 20
+    assert b.route(small, 1 << 30).decision == "hybrid"
+    adm = b.route(small, 1 << 30, prefer_streaming=True)
+    assert adm.decision == "streaming"
+    assert adm.cause == "ckpt"
+    # budget-forced decisions are unaffected by the preference
+    assert b.route(None, 1 << 30, prefer_streaming=True).cause == ""
+
+
+def test_watchdog_token_tracks_ckpt_version(tmp_path):
+    class _Null(InputClient):
+        def start_fetch(self, req, on_complete):
+            raise AssertionError("no fetch expected")
+
+    mm = MergeManager(_Null(), KT, Config())
+    t0 = mm._progress_token()
+    mm._ckpt = TaskCheckpoint(str(tmp_path), "jobW", 0, interval_s=0.0)
+    t1 = mm._progress_token()
+    mm._ckpt.save(_collect_factory())
+    t2 = mm._progress_token()
+    # a completed snapshot (long fsync included) IS progress
+    assert t2 != t1
+    assert t1[:-1] == t2[:-1] == t0[:-1]
+
+
+def test_generation_mismatch_drops_ledger_keeps_runs(tmp_path):
+    """The revalidation ladder's generation rung: a cold supplier
+    restart (recorded generation != live one) drops that source's
+    offset ledger but still adopts its self-contained run files."""
+    root = os.path.join(str(tmp_path), "mof")
+    make_mof_tree(root, "jobD", 2, 1, 60, seed=9)
+    engine = DataEngine(DirIndexResolver(root), Config())
+    try:
+        class GenClient(LocalFetchClient):
+            def generation(self, host=""):
+                return 7  # the supplier restarted since the manifest
+
+        mm = MergeManager(GenClient(engine), KT, Config())
+        mids = map_ids("jobD", 2)
+        ck = TaskCheckpoint(str(tmp_path), "jobD", 0, interval_s=0.0)
+        store = RunStore(tag="jobD.r0", fixed_dir=ck.runs_dir)
+        recs = sorted(_recs(20, seed=10), key=lambda kv: kv[0])
+        store.write_run(0, crack(write_records(recs)),
+                        np.arange(20, dtype=np.int64))
+        n, nbytes, crc = store.manifest()[0]
+        part = write_records(_recs(5, seed=11))[:-len(EOF_MARKER)]
+        ck.save(_collect_factory(
+            payload_runs={"0": {"map": mids[0], "records": n,
+                                "bytes": nbytes,
+                                "length": nbytes + len(EOF_MARKER),
+                                "crc": crc}},
+            ledgers={"1": {"map": mids[1], "host": "", "generation": 3,
+                           "next_offset": len(part), "carry_len": 0,
+                           "raw_length": None, "num_records": 5}},
+            parts={1: part}))
+        # patch the maps list to the real two-map identity
+        man = TaskCheckpoint(str(tmp_path), "jobD", 0).load()
+        man["maps"] = list(mids)
+
+        class _Forest:
+            adopted = []
+
+            def adopt_run(self, i, batch):
+                self.adopted.append((i, batch.num_records))
+
+        om = _Forest()
+        store2 = RunStore(tag="jobD.r0", fixed_dir=ck.runs_dir)
+        g0 = _counter("ckpt.invalidated")
+        adopted, preload, nrec = mm._resume_from_manifest(
+            man, mids, store2, om, ck)
+        assert adopted == {0} and nrec == 20
+        assert om.adopted == [(0, 20)]
+        assert preload == {}  # the gen-3 ledger was dropped
+        assert _counter("ckpt.invalidated") == g0 + 1
+    finally:
+        engine.stop()
+
+
+# -- end-to-end resume -------------------------------------------------------
+
+class CountingClient(LocalFetchClient):
+    """LocalFetchClient that counts start_fetch calls per map — the
+    zero-refetch assertion's probe."""
+
+    def __init__(self, engine):
+        super().__init__(engine)
+        self.fetches: dict = {}
+
+    def start_fetch(self, req, on_complete):
+        self.fetches[req.map_id] = self.fetches.get(req.map_id, 0) + 1
+        super().start_fetch(req, on_complete)
+
+
+def _run_merge(root, ckdir, *, fault=None, client_cls=LocalFetchClient,
+               num_maps=6, interval=0.0, extra=None):
+    cfg = Config(dict({"uda.tpu.online.streaming": True,
+                       "uda.tpu.ckpt.dir": ckdir,
+                       "uda.tpu.ckpt.interval.s": interval},
+                      **(extra or {})))
+    engine = DataEngine(DirIndexResolver(root), cfg)
+    client = client_cls(engine)
+    mm = MergeManager(client, KT, cfg)
+    blocks = []
+    try:
+        if fault:
+            with failpoints.scoped(fault):
+                mm.run("jobK", map_ids("jobK", num_maps), 0,
+                       lambda b: blocks.append(bytes(b)))
+        else:
+            mm.run("jobK", map_ids("jobK", num_maps), 0,
+                   lambda b: blocks.append(bytes(b)))
+        return b"".join(blocks), client, None
+    except FallbackSignal as e:
+        return b"".join(blocks), client, e
+    finally:
+        engine.stop()
+
+
+def _manifest_runs(ckdir):
+    """Maps whose run files the newest on-disk manifest records (read
+    WITHOUT consuming — the probe the zero-refetch assert keys on)."""
+    paths = sorted(glob.glob(os.path.join(ckdir, "*",
+                                          "manifest-*.uckp")))
+    assert paths, "no manifest survived the failed attempt"
+    man = TaskCheckpoint._read_manifest(paths[-1])
+    assert man is not None
+    return [rec["map"] for rec in man.get("runs", {}).values()]
+
+
+def test_resume_is_byte_identical_and_refetches_nothing(tmp_path):
+    root = os.path.join(str(tmp_path), "mof")
+    make_mof_tree(root, "jobK", 6, 1, 120, seed=5)
+    ref, _, err = _run_merge(root, os.path.join(str(tmp_path), "ck0"))
+    assert err is None and ref
+    ckdir = os.path.join(str(tmp_path), "ck")
+    # attempt 1 dies on a terminal injected fault mid-fetch
+    _, _, err1 = _run_merge(
+        root, ckdir, fault="segment.fetch=error:match:m_000005",
+        extra={"uda.tpu.fetch.retries": 0})
+    assert isinstance(err1, FallbackSignal)
+    checkpointed = _manifest_runs(ckdir)
+    assert checkpointed  # at least one run spooled before the death
+    # attempt 2 resumes: byte-identical, resumed-not-restarted, and
+    # ZERO refetch of any checkpointed run's source bytes
+    r0, a0 = _counter("ckpt.resumed"), _counter("ckpt.runs.adopted")
+    out, client, err2 = _run_merge(root, ckdir,
+                                   client_cls=CountingClient)
+    assert err2 is None
+    assert out == ref
+    assert _counter("ckpt.resumed") == r0 + 1
+    assert _counter("ckpt.runs.adopted") >= a0 + len(checkpointed)
+    for mid in checkpointed:
+        assert client.fetches.get(mid, 0) == 0, \
+            f"checkpointed run {mid} was refetched"
+    # success discards the checkpoint: nothing left to resume
+    assert not os.path.exists(os.path.join(ckdir, "jobK.r0"))
+
+
+def test_ckpt_save_fault_never_fails_the_task(tmp_path):
+    root = os.path.join(str(tmp_path), "mof")
+    make_mof_tree(root, "jobK", 4, 1, 80, seed=6)
+    ref, _, err = _run_merge(root, os.path.join(str(tmp_path), "ck0"),
+                             num_maps=4)
+    assert err is None
+    e0 = _counter("ckpt.save.errors")
+    out, _, err2 = _run_merge(root, os.path.join(str(tmp_path), "ck"),
+                              num_maps=4, fault="ckpt.save=error")
+    assert err2 is None  # best-effort: the task never fails for its ckpt
+    assert out == ref
+    assert _counter("ckpt.save.errors") > e0
+
+
+# -- chaos: kill -9 mid-merge / mid-checkpoint (the resume rung) -------------
+
+_CHILD = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from uda_tpu.merger import LocalFetchClient, MergeManager
+from uda_tpu.merger.checkpoint import TaskCheckpoint
+from uda_tpu.mofserver import DataEngine, DirIndexResolver
+from uda_tpu.utils import comparators
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.failpoints import failpoints
+from tests.helpers import map_ids
+
+kill_after = int(sys.argv[1])     # SIGKILL after this many saves
+torn_spec = sys.argv[2]           # "" or a ckpt.save spec to arm
+
+saves = [0]
+orig = TaskCheckpoint._save_locked
+def killing_save(self, collect):
+    orig(self, collect)
+    saves[0] += 1
+    if saves[0] >= kill_after:
+        os.kill(os.getpid(), signal.SIGKILL)  # no unwind, no atexit
+TaskCheckpoint._save_locked = killing_save
+
+if torn_spec:
+    failpoints.arm_spec(torn_spec)
+cfg = Config({{"uda.tpu.online.streaming": True,
+              "uda.tpu.ckpt.dir": {ckdir!r},
+              "uda.tpu.ckpt.interval.s": 0.0}})
+engine = DataEngine(DirIndexResolver({root!r}), cfg)
+mm = MergeManager(LocalFetchClient(engine),
+                  comparators.get_key_type("uda.tpu.RawBytes"), cfg)
+mm.run("jobK", map_ids("jobK", 6), 0, lambda b: None)
+sys.exit(7)  # the kill must preempt completion
+"""
+
+
+def _kill9_attempt(root, ckdir, kill_after, torn_spec=""):
+    code = _CHILD.format(repo=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ckdir=ckdir, root=root)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the chaos tier's ambient schedule targets the PARENT's tests; the
+    # child arms only its own torn-save spec
+    env.pop("UDA_FAILPOINTS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code, str(kill_after), torn_spec],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL, \
+        f"child survived: rc={proc.returncode}\n{proc.stderr[-2000:]}"
+
+
+@pytest.mark.faults
+def test_chaos_kill9_mid_merge_resumes_byte_identical(tmp_path):
+    """The resume rung's core guarantee: kill -9 at a seeded point
+    mid-merge; the restarted task produces byte-identical output,
+    reuses every checkpointed run file (zero refetch of their source
+    bytes) and counts ckpt.resumed — restart-from-scratch FAILS."""
+    seed = int(os.environ.get("UDA_TPU_CHAOS_SEED", "42"))
+    root = os.path.join(str(tmp_path), "mof")
+    make_mof_tree(root, "jobK", 6, 1, 120, seed=5)
+    ref, _, err = _run_merge(root, os.path.join(str(tmp_path), "ck0"))
+    assert err is None
+    ckdir = os.path.join(str(tmp_path), "ck")
+    _kill9_attempt(root, ckdir, kill_after=seed % 3 + 1)
+    checkpointed = _manifest_runs(ckdir)
+    r0 = _counter("ckpt.resumed")
+    out, client, err2 = _run_merge(root, ckdir,
+                                   client_cls=CountingClient)
+    assert err2 is None
+    assert out == ref  # byte-identical vs the uninterrupted run
+    assert _counter("ckpt.resumed") == r0 + 1  # resumed, NOT restarted
+    for mid in checkpointed:
+        assert client.fetches.get(mid, 0) == 0, \
+            f"checkpointed run {mid} was refetched"
+    assert not os.path.exists(os.path.join(ckdir, "jobK.r0"))
+
+
+@pytest.mark.faults
+def test_chaos_ledger_resume_banks_bytes(tmp_path):
+    """The rung's fetch.resumed.bytes>0 guarantee, deterministically: a
+    crashed attempt's manifest carries a MID-PARTITION offset ledger
+    (first chunk banked, no run files yet); the restart must bank those
+    bytes — resume the fetch at next_offset, never offset 0 — and still
+    finish byte-identical."""
+    # quiesce the rung's ambient schedule: the in-process analogue of
+    # the kill -9 subprocesses scrubbing UDA_FAILPOINTS from their env
+    with failpoints.quiesced():
+        root = os.path.join(str(tmp_path), "mof")
+        make_mof_tree(root, "jobK", 6, 1, 400, seed=8)
+        # 2 KB chunks: every map spans several fetch rounds
+        extra = {"mapred.rdma.buf.size": 2}
+        ref, _, err = _run_merge(root,
+                                 os.path.join(str(tmp_path), "ck0"),
+                                 extra=extra)
+        assert err is None
+        mids = map_ids("jobK", 6)
+        ckdir = os.path.join(str(tmp_path), "ck")
+        # craft the crash state: fetch map 0's first chunk for real,
+        # bank it as a checkpointed ledger exactly as a mid-flight
+        # snapshot would
+        cfg = Config(dict({"uda.tpu.online.streaming": True}, **extra))
+        engine = DataEngine(DirIndexResolver(root), cfg)
+        try:
+            res = engine.submit(
+                ShuffleRequest("jobK", mids[0], 0, 0, 2048)).result()
+        finally:
+            engine.stop()
+        first = bytes(res.data)
+        assert not res.is_last
+        batch, consumed, _ = crack_partial(first, expect_eof=False)
+        from uda_tpu import native
+
+        part = native.frame_batch(batch, write_eof=False) + \
+            first[consumed:]
+        ck = TaskCheckpoint(ckdir, "jobK", 0, interval_s=0.0)
+        ck.save(lambda: (
+            {"maps": list(mids), "runs": {},
+             "ledgers": {"0": {"map": mids[0], "host": "",
+                               "generation": None,
+                               "next_offset": len(first),
+                               "carry_len": len(first) - consumed,
+                               "raw_length": res.raw_length,
+                               "num_records": batch.num_records}},
+             "journal": [], "penalty": {}, "forest": {}},
+            {0: part}))
+        r0 = _counter("ckpt.resumed")
+        b0 = _counter("fetch.resumed.bytes")
+        out, _, err2 = _run_merge(root, ckdir, extra=extra)
+        assert err2 is None
+        assert out == ref
+        assert _counter("ckpt.resumed") == r0 + 1
+        # the banked first chunk was NOT refetched: its bytes count as
+        # resumed, the fetch restarted at next_offset
+        assert _counter("fetch.resumed.bytes") >= b0 + len(first)
+
+
+@pytest.mark.faults
+def test_chaos_kill9_mid_checkpoint_falls_back(tmp_path):
+    """Kill -9 DURING a snapshot (ckpt.save truncate tears the second
+    manifest, then the kill lands): resume must load the previous
+    manifest cleanly — never the torn one, never a crash — and still
+    finish byte-identical."""
+    root = os.path.join(str(tmp_path), "mof")
+    make_mof_tree(root, "jobK", 6, 1, 120, seed=5)
+    ref, _, err = _run_merge(root, os.path.join(str(tmp_path), "ck0"))
+    assert err is None
+    ckdir = os.path.join(str(tmp_path), "ck")
+    _kill9_attempt(root, ckdir, kill_after=2,
+                   torn_spec="ckpt.save=truncate:every:2")
+    # the torn manifest is on disk next to the good seq-1 one
+    paths = sorted(glob.glob(os.path.join(ckdir, "*",
+                                          "manifest-*.uckp")))
+    assert len(paths) == 2
+    assert TaskCheckpoint._read_manifest(paths[-1]) is None  # torn
+    t0, r0 = _counter("ckpt.invalidated"), _counter("ckpt.resumed")
+    out, _, err2 = _run_merge(root, ckdir)
+    assert err2 is None
+    assert out == ref
+    assert _counter("ckpt.resumed") == r0 + 1
+    assert _counter("ckpt.invalidated") >= t0 + 1  # the torn skip

@@ -380,6 +380,18 @@ class UdaBridge:
 
             self._mm = MergeManager(client, self._key_class, self.cfg,
                                     progress=_fetch_progress)
+            ckpt_dir = str(self.cfg.get("uda.tpu.ckpt.dir"))
+            if ckpt_dir:
+                # crash-consistent checkpointing armed
+                # (merger/checkpoint.py): a restarted attempt of this
+                # reduce resumes from the newest valid manifest there.
+                # EXIT deliberately leaves the checkpoint alone — EXIT
+                # also follows failed attempts, and the manifest IS the
+                # retry's resume state; the manager discards it itself
+                # on successful completion
+                log.info(f"bridge INIT: crash-consistent checkpointing "
+                         f"armed under {ckpt_dir} (interval "
+                         f"{self.cfg.get('uda.tpu.ckpt.interval.s')} s)")
         elif header == Cmd.FETCH:
             # reference FETCH: host:jobid:attemptid:partition
             # (UdaPlugin.java:322-334); host rides with the attempt so

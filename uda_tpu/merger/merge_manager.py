@@ -26,6 +26,7 @@ hybrid LPQ/RPQ spilling lives in uda_tpu.merger.hybrid.
 
 from __future__ import annotations
 
+import functools
 import random
 import threading
 import time
@@ -40,7 +41,8 @@ from uda_tpu.ops import merge as merge_ops
 from uda_tpu.utils.budget import MemoryBudget, stage_inflight_cap
 from uda_tpu.utils.comparators import KeyType, get_key_type
 from uda_tpu.utils.config import Config
-from uda_tpu.utils.errors import FallbackSignal, MergeError, UdaError
+from uda_tpu.utils.errors import (FallbackSignal, MergeError, StorageError,
+                                  UdaError)
 from uda_tpu.utils.failpoints import failpoints
 from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.locks import TrackedLock
@@ -164,6 +166,18 @@ class PenaltyBox:
                     "boxed": [k for k, t in self._until.items()
                               if t > now]}
 
+    def restore(self, snap: dict) -> None:
+        """Re-seed fault/streak records from a checkpoint manifest
+        (resume path). Active box TIMERS are deliberately NOT restored —
+        ``_until`` holds monotonic deadlines that do not survive process
+        death; a supplier at/over the threshold re-boxes on its next
+        fault anyway (the parole posture in :meth:`penalized`)."""
+        with self._lock:
+            for k, v in (snap.get("faults") or {}).items():
+                self._faults[str(k)] = int(v)
+            for k, v in (snap.get("streaks") or {}).items():
+                self._streak[str(k)] = int(v)
+
 
 class MergeManager:
     """Orchestrates fetch -> pack -> device merge -> framed emission for
@@ -225,8 +239,11 @@ class MergeManager:
         # for explicitly-configured approaches), the watchdog per run()
         self._budget_obj: Optional[MemoryBudget] = None
         self.last_admission = None     # routing decision (tests/diag)
-        self._live_segments: list[Segment] = []
+        self._live_segments: list[Optional[Segment]] = []
         self._active_overlap = None
+        # crash-consistent checkpointing (merger/checkpoint.py): live
+        # only while a run() with uda.tpu.ckpt.dir set is in flight
+        self._ckpt = None
         self._watchdog: Optional[StallWatchdog] = None
         self._stall_error: Optional[StallError] = None
         self._emit_progress = 0
@@ -240,9 +257,18 @@ class MergeManager:
 
     def fetch_all(self, job_id: str, map_ids: Sequence,
                   reduce_id: int,
-                  on_segment: Optional[Callable[[int, Segment], None]] = None
-                  ) -> list[Segment]:
+                  on_segment: Optional[Callable[[int, Segment], None]] = None,
+                  skip=None, preload: Optional[dict] = None
+                  ) -> list:
         """Fetch every map's partition, randomized order, sliding window.
+
+        Resume hooks (merger/checkpoint.py): ``skip`` holds indexes
+        whose run files a previous attempt already spooled — no segment
+        is built (the returned list holds None there) and no byte is
+        refetched; ``preload`` maps index -> a checkpointed offset
+        ledger, applied via Segment.ckpt_preload before start() so the
+        fetch resumes mid-stream (an invalid ledger degrades to a fresh
+        fetch from zero, never an error).
 
         The window refills as individual segments complete (true
         credit-flow semantics: in-flight count stays at ``window`` until
@@ -294,16 +320,32 @@ class MergeManager:
                 self.coding_scheme, universe, ledger=self.ledger,
                 domains=parse_domains(
                     str(self.cfg.get("uda.tpu.coding.domains"))))
-        segs = [Segment(self.client, job_id, mid, reduce_id,
+        skip = frozenset(skip or ())
+        segs = [None if i in skip else
+                Segment(self.client, job_id, mid, reduce_id,
                         self.chunk_size, host=hosts[0],
                         policy=self.retry_policy, hosts=hosts,
                         ledger=self.ledger,
                         speculation=self.speculation,
                         resume=self.resume_fetch, stripe=stripe_ctx)
-                for hosts, mid in entries]
-        index_of = {id(s): i for i, s in enumerate(segs)}
-        order = list(range(len(segs)))
+                for i, (hosts, mid) in enumerate(entries)]
+        for i, kw in (preload or {}).items():
+            if segs[i] is None:
+                continue
+            try:
+                segs[i].ckpt_preload(**kw)
+            except UdaError as e:
+                # a ledger that fails revalidation degrades to a fresh
+                # fetch from zero — resume is an optimization, never a
+                # correctness dependency
+                metrics.add("ckpt.invalidated", cause="ledger")
+                log.warn(f"checkpointed ledger of map "
+                         f"{segs[i].map_id} rejected, refetching: {e}")
+        index_of = {id(s): i for i, s in enumerate(segs) if s is not None}
+        order = [i for i in range(len(segs)) if i not in skip]
         random.Random(self.seed).shuffle(order)  # MergeManager.cc:58-63
+        nskip = len(segs) - len(order)
+        live_total = len(order)
         credits = threading.Semaphore(self.window)
         done_lock = TrackedLock("merge.fetch_done")
         done = 0
@@ -341,10 +383,10 @@ class MergeManager:
                 with done_lock:
                     done += 1
                     d = done
-                if d == len(segs):
+                if d == live_total:
                     all_notified.set()
-            if self.progress and d % PROGRESS_INTERVAL == 0:
-                self.progress(d, len(segs))
+            if self.progress and (d + nskip) % PROGRESS_INTERVAL == 0:
+                self.progress(d + nskip, len(segs))
 
         started: list[Segment] = []
 
@@ -396,7 +438,8 @@ class MergeManager:
                 started.append(segs[i])
                 segs[i].start()
             for s in segs:
-                s.wait()
+                if s is not None:
+                    s.wait()
             # a segment's _done fires BEFORE its on_done callback runs:
             # wait for the callbacks too, or a caller could finalize its
             # on_segment consumer (e.g. the overlapped merger) while the
@@ -405,7 +448,7 @@ class MergeManager:
             # consumer (e.g. blocked in the overlapped merger's bounded
             # feed) — a watchdog/stop() must be able to break this wait
             # too, not only the credit wait above
-            if segs:
+            if live_total:
                 while not all_notified.wait(timeout=0.25):
                     if self._stop.is_set():
                         stop_drain()
@@ -559,6 +602,8 @@ class MergeManager:
         segs = self._live_segments
         ndone = nrec = noff = nret = 0
         for s in segs:
+            if s is None:  # checkpoint-adopted slot: nothing to sample
+                continue
             nrec += s.num_records
             noff += s._next_offset
             nret += s._retries_left
@@ -569,9 +614,15 @@ class MergeManager:
                    om.stats["pending"]) if om is not None else ())
         # the ledger version makes RECOVERY progress visible: a
         # reconstruction fetching stripe shards advances nothing on the
-        # segment itself, but it is progress, not a stall
+        # segment itself, but it is progress, not a stall. Same for the
+        # checkpoint version: a long fsync/snapshot quiesces the
+        # counters above, yet each completed save IS progress — without
+        # it the watchdog would administratively fail a task for being
+        # durable (the ISSUE 16 watchdog fix)
+        ckpt = self._ckpt
         return (len(segs), ndone, nrec, noff, nret, om_sig,
-                self.ledger.version, getattr(self, "_emit_progress", 0))
+                self.ledger.version, getattr(self, "_emit_progress", 0),
+                ckpt.version if ckpt is not None else 0)
 
     def _start_watchdog(self, reduce_id: int) -> Optional[StallWatchdog]:
         stall_s = float(self.cfg.get("uda.tpu.watchdog.stall.s"))
@@ -608,11 +659,141 @@ class MergeManager:
             except Exception as e:  # noqa: BLE001
                 log.warn(f"watchdog: overlap abort failed: {e}")
         for seg in list(self._live_segments):
+            if seg is None:
+                continue
             try:
                 seg.fail(err)
             except Exception as e:  # noqa: BLE001
                 log.warn(f"watchdog: failing segment "
                          f"{seg.map_id} raised: {e}")
+
+    # -- crash-consistent checkpointing (merger/checkpoint.py) ---------------
+
+    def _ckpt_state(self, job_id: str, reduce_id: int, mids: list,
+                    store) -> tuple:
+        """The snapshot collector handed to TaskCheckpoint: one
+        crash-consistent view of everything the task would lose to a
+        kill — spooled run files (already durable; recorded with
+        length+CRC so a torn one is detected), in-flight fetch offset
+        ledgers (Segment.ckpt_export), the recovery journal, penalty-box
+        state and the merge-forest watermark. Returns
+        ``(payload, parts)`` per the TaskCheckpoint.save contract."""
+        from uda_tpu.merger import checkpoint
+
+        runs: dict = {}
+        for i, (n, nbytes, crc) in store.manifest().items():
+            runs[str(i)] = {"map": mids[i], "records": int(n),
+                            "bytes": int(nbytes),
+                            "length": int(nbytes) + checkpoint.RUN_EOF_LEN,
+                            "crc": int(crc)}
+        ledgers: dict = {}
+        parts: dict = {}
+        for i, seg in enumerate(self._live_segments):
+            if seg is None or str(i) in runs:
+                continue
+            ex = seg.ckpt_export()
+            if ex is None:
+                continue
+            parts[i] = ex.pop("data")
+            host = seg.supplier
+            ex.update(map=seg.map_id, host=host,
+                      generation=self.client.generation(host))
+            ledgers[str(i)] = ex
+        om = self._active_overlap
+        payload = {"job": job_id, "reduce": int(reduce_id),
+                   "maps": list(mids), "runs": runs, "ledgers": ledgers,
+                   "journal": self.ledger.snapshot()["events"],
+                   "penalty": self.penalty_box.snapshot(),
+                   "forest": dict(om.stats) if om is not None else {}}
+        return payload, parts
+
+    def _resume_from_manifest(self, man: dict, mids: list, store, om,
+                              ckpt) -> tuple:
+        """Revalidate a loaded manifest and adopt what survives the
+        ladder (generation -> epoch [at load] -> length+CRC ->
+        drop-and-refetch). Returns ``(adopted, preload,
+        adopted_records)``: indexes whose run files re-join the merge
+        forest without refetching, and per-index ckpt_preload kwargs
+        for mid-fetch offset-ledger resume. Anything that fails a check
+        degrades to a fresh fetch of that segment — never an error."""
+        from uda_tpu.merger import checkpoint
+
+        if list(man.get("maps") or []) != list(mids):
+            # a different map list is a different shuffle: nothing in
+            # this manifest is addressable by index
+            metrics.add("ckpt.invalidated", cause="maps")
+            log.warn(f"checkpoint manifest for {ckpt.task} lists a "
+                     f"different map set; starting fresh")
+            return set(), {}, 0
+        adopted: set = set()
+        preload: dict = {}
+        adopted_records = 0
+        for key, rec in (man.get("runs") or {}).items():
+            try:
+                i = int(key)
+                if not (0 <= i < len(mids)) or rec.get("map") != mids[i]:
+                    raise StorageError(f"run index {key} does not map")
+                run_path, off_path = store._paths(i)
+                batch = checkpoint.read_run(run_path, off_path, rec)
+            except (OSError, UdaError, ValueError, KeyError) as e:
+                metrics.add("ckpt.invalidated", cause="crc")
+                log.warn(f"checkpointed run {key} failed revalidation, "
+                         f"refetching: {e}")
+                try:
+                    store.discard(int(key))
+                except (ValueError, OSError):
+                    pass  # udalint: disable=UDA006 - cleanup best effort
+                continue
+            store.adopt(i, int(rec["records"]), int(rec["bytes"]),
+                        int(rec["crc"]))
+            om.adopt_run(i, batch)
+            adopted.add(i)
+            adopted_records += batch.num_records
+        for key, rec in (man.get("ledgers") or {}).items():
+            try:
+                i = int(key)
+            except ValueError:
+                continue
+            if i in adopted or not (0 <= i < len(mids)) \
+                    or rec.get("map") != mids[i]:
+                continue
+            host = str(rec.get("host") or "")
+            gen_then = rec.get("generation")
+            gen_now = self.client.generation(host)
+            if (gen_then is not None and gen_now is not None
+                    and int(gen_then) != int(gen_now)) \
+                    or not self.client.resume_ok(host):
+                # cold supplier restart: its map output was rebuilt, so
+                # mid-stream offsets no longer address the same bytes
+                metrics.add("ckpt.invalidated", cause="generation")
+                log.warn(f"supplier {host!r} restarted since the "
+                         f"checkpoint; refetching map {rec.get('map')} "
+                         f"from zero")
+                continue
+            try:
+                data = ckpt.part_bytes(rec)
+            except StorageError as e:
+                metrics.add("ckpt.invalidated", cause="ledger")
+                log.warn(f"checkpointed ledger part of map "
+                         f"{rec.get('map')} rejected, refetching: {e}")
+                continue
+            preload[i] = {"data": data,
+                          "carry_len": int(rec.get("carry_len", 0)),
+                          "next_offset": int(rec.get("next_offset", 0)),
+                          "raw_length": rec.get("raw_length"),
+                          "num_records": int(rec.get("num_records", 0))}
+        self.ledger.restore(man.get("journal") or [])
+        self.penalty_box.restore(man.get("penalty") or {})
+        metrics.add("ckpt.resumed")
+        metrics.add("ckpt.runs.adopted", len(adopted))
+        log.info(f"resuming {ckpt.task} from checkpoint seq "
+                 f"{man.get('seq')}: {len(adopted)} run(s) adopted, "
+                 f"{len(preload)} in-flight ledger(s), "
+                 f"{len(mids) - len(adopted)} map(s) to fetch")
+        flightrec.record("ckpt.resume", task=ckpt.task,
+                         seq=man.get("seq"), adopted=len(adopted),
+                         ledgers=len(preload))
+        return adopted, preload, adopted_records
 
     def _run(self, job_id: str, map_ids: Sequence, reduce_id: int,
              consumer: Callable[[memoryview], None]) -> int:
@@ -640,7 +821,13 @@ class MergeManager:
                 job_id, map_ids, reduce_id)
             threshold = (self.cfg.get("uda.tpu.auto.approach.threshold.mb")
                          * (1 << 20))
-            adm = self.budget().route(est, threshold)
+            # checkpointing needs the run-spool (streaming) path: the
+            # sorted run files ARE the durable half of the snapshot, and
+            # hybrid's LPQ/RPQ state has no resume story — so an armed
+            # ckpt dir steers the auto policy away from hybrid
+            adm = self.budget().route(
+                est, threshold,
+                prefer_streaming=bool(str(self.cfg.get("uda.tpu.ckpt.dir"))))
             self.last_admission = adm
             # admission decisions carry their STRUCTURED cause into the
             # black box — a post-mortem reads why the task took the
@@ -672,6 +859,9 @@ class MergeManager:
         from uda_tpu.merger.overlap import OverlappedMerger
 
         store = None
+        ckpt = None
+        manifest = None
+        collect = None
         if streaming:
             # bounded-host-memory online mode (uda.tpu.online.streaming):
             # segments spool to sorted runs + release their bytes; the
@@ -681,8 +871,28 @@ class MergeManager:
             # staging-loop memory model, StreamRW.cc:151-225)
             from uda_tpu.merger.streaming import RunStore, spill_dirs
 
-            store = RunStore(spill_dirs(self.cfg),
-                             tag=f"{job_id}.r{reduce_id}")
+            ckpt_dir = str(self.cfg.get("uda.tpu.ckpt.dir"))
+            if ckpt_dir:
+                # crash-consistent checkpointing (merger/checkpoint.py):
+                # run files spool into the checkpoint's FIXED dir (they
+                # are the durable half of every snapshot; a tmpdir would
+                # die with the process) and each spool boundary offers a
+                # manifest save
+                from uda_tpu.merger.checkpoint import TaskCheckpoint
+
+                ckpt = TaskCheckpoint(
+                    ckpt_dir, job_id, reduce_id,
+                    interval_s=float(
+                        self.cfg.get("uda.tpu.ckpt.interval.s")),
+                    keep=int(self.cfg.get("uda.tpu.ckpt.keep")),
+                    epoch=int(self.cfg.get("uda.tpu.tenant.epoch")))
+                self._ckpt = ckpt
+                manifest = ckpt.load()
+                store = RunStore(tag=f"{job_id}.r{reduce_id}",
+                                 fixed_dir=ckpt.runs_dir)
+            else:
+                store = RunStore(spill_dirs(self.cfg),
+                                 tag=f"{job_id}.r{reduce_id}")
         # admission may have rerouted here BECAUSE the device row forest
         # would blow the HBM budget: then the streaming merger must not
         # stage runs to the device at all — run files + bounded k-way
@@ -697,6 +907,10 @@ class MergeManager:
         pipelined = bool(self.cfg.get("uda.tpu.stage.pipeline"))
         pool = int(self.cfg.get("uda.tpu.stage.pool"))
         stagers = int(self.cfg.get("uda.tpu.online.stagers"))
+        if ckpt is not None:
+            mids = [m[1] if isinstance(m, tuple) else m for m in map_ids]
+            collect = functools.partial(self._ckpt_state, job_id,
+                                        reduce_id, mids, store)
         om = OverlappedMerger(
             self.key_type, self.key_width, run_store=store,
             max_pending=self.window if streaming else 0,
@@ -705,19 +919,38 @@ class MergeManager:
             pipeline=pipelined,
             inflight_bytes=stage_inflight_cap(
                 self.cfg, self.window, self.chunk_size,
-                budget=self._budget_obj))
+                budget=self._budget_obj),
+            on_spool=((lambda i: ckpt.maybe_save(collect))
+                      if ckpt is not None else None))
         self._active_overlap = om  # observability (tests/diagnostics)
+        adopted: set = set()
+        preload: dict = {}
+        adopted_records = 0
+        self._live_segments = []
+        if manifest is not None:
+            adopted, preload, adopted_records = self._resume_from_manifest(
+                manifest, mids, store, om, ckpt)
+            # snapshot #0: the loaded manifest was consumed-on-load
+            # (zombie fencing), so re-persist the adopted state before
+            # fetching — a crash during THIS attempt's fetch phase must
+            # still find a manifest (older retained generations back it
+            # up, but re-persisting keeps the walk short)
+            ckpt.maybe_save(collect, force=True)
         try:
             # feed the Segment itself: record_batch() (a full concat of
             # the segment's chunks) then runs on the merge thread, not
             # on the transport's completion thread
             segments = self.fetch_all(job_id, map_ids, reduce_id,
-                                      on_segment=om.feed)
+                                      on_segment=om.feed,
+                                      skip=adopted, preload=preload)
         except Exception:
             # the abort (which also cleans up the run store) must never
             # MASK the fetch error that got us here: a failing cleanup
             # replacing the root cause is how errors get dropped on the
-            # floor mid-unwind
+            # floor mid-unwind. In checkpoint mode nothing here discards
+            # the manifest or the fixed-dir run files — they ARE the
+            # next attempt's resume state (RunStore.cleanup is a no-op
+            # for a fixed dir)
             try:
                 om.abort()
             except Exception as cleanup_err:  # noqa: BLE001
@@ -728,9 +961,17 @@ class MergeManager:
         # the "merge" timer covers drain + forest carry inside the
         # finish paths; emission stays under the emitter's "emit" timer
         if streaming:
-            return om.finish_streaming(
+            out = om.finish_streaming(
                 self.emitter, consumer,
-                expected_records=sum(s.num_records for s in segments))
+                expected_records=(sum(s.num_records for s in segments
+                                      if s is not None)
+                                  + adopted_records))
+            if ckpt is not None:
+                # the emitted output is the durable artifact now; a
+                # retained checkpoint would resume a FINISHED task
+                ckpt.discard()
+                self._ckpt = None
+            return out
         return om.emit_stream([s.record_batch() for s in segments],
                               self.emitter, consumer)
 

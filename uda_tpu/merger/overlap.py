@@ -189,9 +189,14 @@ class OverlappedMerger:
     def __init__(self, key_type: KeyType, width: int, engine: str = "auto",
                  run_store=None, max_pending: int = 0, stagers: int = 0,
                  device_runs: bool = True, pipeline: bool = False,
-                 inflight_bytes: int = 0):
+                 inflight_bytes: int = 0, on_spool=None):
         self.key_type = key_type
         self.width = width
+        # run-spool boundary hook (merger/checkpoint.py): called with the
+        # segment index right after its sorted run file is durable — the
+        # natural crash-consistent snapshot trigger. Contract: the hook
+        # never raises (TaskCheckpoint.maybe_save catches internally).
+        self._on_spool = on_spool
         # device_runs=False (streaming mode only): admission control
         # decided the full row forest would not fit the HBM budget —
         # segments still spool to sorted run files, but no run is ever
@@ -524,6 +529,44 @@ class OverlappedMerger:
         if release is not None:
             release()
 
+    def _notify_spool(self, seg_index: int) -> None:
+        """Fire the run-spool boundary hook (checkpoint trigger) outside
+        every merger lock — the hook fsyncs."""
+        hook = self._on_spool
+        if hook is not None:
+            hook(seg_index)
+
+    def adopt_run(self, seg_index: int, batch: RecordBatch) -> None:
+        """Resume path (merger/checkpoint.py): account a run file that a
+        PREVIOUS attempt already spooled — the re-cracked, already-sorted
+        batch joins the forest without re-spooling. Single-threaded by
+        contract: called before any feed(), so no staging worker races
+        the forest. Byte-identity with the uninterrupted run holds
+        because the run file is in sorted order, so the identity order
+        (row index = file position) reproduces exactly the rows the
+        original ``_prepare`` built."""
+        n = batch.num_records
+        if n == 0:
+            return
+        with metrics.timer("overlap_pack"):
+            packed = packing.pack_keys(batch, self.key_type, self.width)
+        kw = packed.key_words.shape[1]
+        if int(np.max(packed.key_lens, initial=0)) > self.width:
+            # oversize keys: same posture as _prepare — disable the fast
+            # path; finish_streaming's comparator k-way file merge (which
+            # reads this adopted run file) is the correctness fallback
+            self._overflow = True
+        with self._state_lock:
+            self._staged += 1
+        metrics.add("merge.records", n)
+        if self._overflow or not self.device_runs:
+            return
+        cap = _next_pow2(n) if self.engine == "pallas" else n
+        rows = np.empty((cap, kw + merge_ops.ROW_EXTRA_COLS), np.uint32)
+        merge_ops.fill_run_rows(rows, packed, None, seg_index)
+        self._consume_run(_StagedRun(seg_index, rows, n, None,
+                                     time.perf_counter(), 0))
+
     def _prepare(self, seg_index: int, source,
                  fed_t: float) -> Optional[_StagedRun]:
         """The host half of staging: materialize (the decompress tail
@@ -560,6 +603,7 @@ class OverlappedMerger:
             with self._state_lock:
                 self._staged += 1
             metrics.add("merge.records", n)
+            self._notify_spool(seg_index)
             self._observe_wait(fed_t)
             self._release(source)
             return None
@@ -576,6 +620,7 @@ class OverlappedMerger:
                            else order)
             self.run_store.write_run(seg_index, batch, spool_order)
             self._release(source)
+            self._notify_spool(seg_index)
         with self._state_lock:
             self._staged += 1
         metrics.add("merge.records", n)

@@ -74,6 +74,21 @@ class RecoveryLedger:
         return evs if kind is None else [e for e in evs
                                          if e["kind"] == kind]
 
+    def restore(self, events: Sequence[dict]) -> None:
+        """Re-seed the journal from a checkpoint manifest (resume path).
+        Only the structured keys are taken — a manifest is outside
+        input, so unknown keys are dropped rather than trusted. Bumps
+        ``version`` once so the watchdog sees the load as progress."""
+        with self._lock:
+            for e in events:
+                self._events.append(
+                    {"kind": str(e.get("kind", "")),
+                     "supplier": str(e.get("supplier", "")),
+                     "map_id": str(e.get("map_id", "")),
+                     "error": (str(e["error"])
+                               if e.get("error") is not None else None)})
+            self.version += 1
+
     def snapshot(self) -> dict:
         """Diagnostics view (watchdog dumps, tests)."""
         with self._lock:

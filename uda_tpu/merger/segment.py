@@ -78,6 +78,17 @@ class InputClient(abc.ABC):
         the healthy primary's completion into a fabricated fault."""
         return True
 
+    def generation(self, host: str = "") -> Optional[int]:
+        """The supplier's observed restart generation for ``host`` (the
+        HELLO banner's counter), or None when the transport has no
+        generation concept or has not connected yet. The checkpoint
+        resume path (merger/checkpoint.py) compares a manifest's
+        recorded generation against this: a changed generation means
+        the supplier restarted since the ledger was written, so the
+        offset ledger is dropped and that segment re-fetches from zero
+        (its run files, being self-contained, are kept)."""
+        return None
+
     def recover_partition(self, req: ShuffleRequest, ctx,
                           on_complete) -> bool:
         """k-of-n stripe reconstruction (uda_tpu.coding): rebuild
@@ -244,6 +255,15 @@ class HostRoutingClient(InputClient):
         with self._lock:
             client = self._clients.get(host)
         return True if client is None else client.resume_ok(host)
+
+    def generation(self, host: str = "") -> Optional[int]:
+        """Delegate to the host's transport; an unconnected host has no
+        observed generation yet (None — the checkpoint resume path then
+        accepts optimistically and lets the first resumed chunk's
+        identity check revalidate)."""
+        with self._lock:
+            client = self._clients.get(host)
+        return None if client is None else client.generation(host)
 
     def estimate_partition_bytes(self, job_id: str, map_ids,
                                  reduce_id: int):
@@ -445,7 +465,20 @@ class Segment:
             reduce=self.reduce_id)
         flightrec.record("segment.start", map=self.map_id,
                          supplier=self.supplier)
-        self._drive(self._try_issue(0))
+        with self._lock:
+            resume_at = self._next_offset
+        if resume_at > 0:
+            # checkpoint-preloaded offset ledger (ckpt_preload): the
+            # fetch continues mid-partition; the bytes below the offset
+            # are never refetched, and the first chunk revalidates the
+            # partition identity through the _resume_check ladder
+            metrics.add("fetch.resumed", supplier=self.supplier)
+            metrics.add("fetch.resumed.bytes", resume_at)
+            flightrec.record("segment.ckpt_resume", map=self.map_id,
+                             supplier=self.supplier, offset=resume_at)
+            log.info(f"fetch of {self.map_id} resuming at offset "
+                     f"{resume_at} from a checkpointed ledger")
+        self._drive(self._try_issue(resume_at))
 
     def _try_issue(self, offset: int):
         """Issue one fetch. Returns None when the transport took it
@@ -1076,5 +1109,69 @@ class Segment:
         with self._lock:
             self.batches = []
             self._released = True
+
+    # -- checkpoint (merger/checkpoint.py) ----------------------------------
+
+    def ckpt_export(self) -> Optional[dict]:
+        """Snapshot this segment's fetch offset ledger for a checkpoint
+        manifest: the cracked batches re-framed (IFile framing, no EOF)
+        plus the carry tail, with the offsets that make the state
+        resumable. None when there is nothing worth persisting — the
+        segment is done/released (its run file carries the records) or
+        has fetched nothing yet (a fresh fetch costs the same).
+
+        Crash-consistent by construction: state is copied under the
+        segment lock (batches are immutable once appended and
+        ``_next_offset`` advances in the same critical section as the
+        append, so the copy is internally consistent); the re-framing
+        runs outside the lock."""
+        with self._lock:
+            if self._done.is_set() or self._released \
+                    or self._next_offset <= 0:
+                return None
+            batches = list(self.batches)
+            carry = self._carry
+            state = {"next_offset": self._next_offset,
+                     "raw_length": self.raw_length,
+                     "num_records": self.num_records,
+                     "carry_len": len(carry)}
+        from uda_tpu import native
+
+        framed = b"".join(native.frame_batch(b, write_eof=False)
+                          for b in batches)
+        state["data"] = framed + bytes(carry)
+        return state
+
+    def ckpt_preload(self, *, data: bytes, carry_len: int,
+                     next_offset: int, raw_length, num_records: int) -> None:
+        """Restore a checkpointed offset ledger BEFORE start(): re-crack
+        the persisted framed bytes, verify they account for exactly the
+        recorded records, and arm the resume (start() then issues at
+        ``next_offset`` and the first chunk revalidates identity).
+        Raises :class:`StorageError` on any mismatch — the caller drops
+        the ledger and the segment fetches from zero."""
+        framed_len = len(data) - int(carry_len)
+        if framed_len < 0:
+            raise StorageError(
+                f"checkpoint ledger of {self.map_id}: carry "
+                f"{carry_len} B exceeds payload {len(data)} B")
+        batch, consumed, _ = crack_partial(bytes(data[:framed_len]),
+                                           expect_eof=False)
+        if consumed != framed_len or batch.num_records != int(num_records):
+            raise StorageError(
+                f"checkpoint ledger of {self.map_id} re-cracked to "
+                f"{batch.num_records} records/{consumed} B, manifest "
+                f"says {num_records}/{framed_len}")
+        with self._lock:
+            if self._next_epoch:
+                raise StorageError(
+                    f"ckpt_preload of {self.map_id} after start()")
+            self.batches = [batch] if batch.num_records else []
+            self.num_records = int(num_records)
+            self._carry = bytes(data[framed_len:])
+            self._next_offset = int(next_offset)
+            self.raw_length = (int(raw_length) if raw_length is not None
+                               else None)
+            self._resume_check = True  # first chunk revalidates identity
 
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
+import zlib
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
@@ -148,17 +149,33 @@ class RunStore:
     pool may spool different segments concurrently.
     """
 
-    def __init__(self, base_dirs=None, tag: str = "online"):
-        if isinstance(base_dirs, str):
-            base_dirs = [base_dirs]
-        roots = list(base_dirs) if base_dirs else [tempfile.gettempdir()]
-        self.dirs = []
-        for root in roots:
-            os.makedirs(root, exist_ok=True)
-            self.dirs.append(
-                tempfile.mkdtemp(prefix=f"uda.{tag}.runs.", dir=root))
+    def __init__(self, base_dirs=None, tag: str = "online",
+                 fixed_dir: Optional[str] = None):
+        # fixed_dir (checkpointing, merger/checkpoint.py): run files
+        # live at a STABLE path that survives the process, so a
+        # restarted attempt finds them where the manifest says; the
+        # checkpoint owns the directory's lifetime (cleanup() keeps the
+        # files — they ARE the durable state; TaskCheckpoint.discard
+        # removes them on task success)
+        self.fixed = fixed_dir is not None
+        if self.fixed:
+            os.makedirs(fixed_dir, exist_ok=True)
+            self.dirs = [fixed_dir]
+        else:
+            if isinstance(base_dirs, str):
+                base_dirs = [base_dirs]
+            roots = (list(base_dirs) if base_dirs
+                     else [tempfile.gettempdir()])
+            self.dirs = []
+            for root in roots:
+                os.makedirs(root, exist_ok=True)
+                self.dirs.append(
+                    tempfile.mkdtemp(prefix=f"uda.{tag}.runs.", dir=root))
         self.counts: dict[int, int] = {}   # seg index -> record count
         self.bytes: dict[int, int] = {}    # seg index -> framed bytes (no EOF)
+        self.crcs: dict[int, int] = {}     # seg index -> crc32 of the
+        # whole run file including the EOF marker (the checkpoint
+        # manifest's torn-spool detector)
         self._lock = threading.Lock()
         self._closed = False
 
@@ -219,15 +236,26 @@ class RunStore:
                                        np.arange(order.shape[0])))
         span = self._contiguous_framed_span(batch, lens) \
             if identity else None
+        # CRC accumulated while writing (whole file incl. EOF): the
+        # checkpoint manifest's torn-spool detector costs one pass over
+        # bytes already in cache, no re-read
+        crc = 0
         with metrics.timer("run_spool"):
             with open(run_path, "wb") as f:
                 if span is not None:
-                    f.write(memoryview(batch.data[span[0]:span[1]]))
+                    piece = memoryview(batch.data[span[0]:span[1]])
+                    f.write(piece)
+                    crc = zlib.crc32(piece)
                     f.write(EOF_MARKER)
+                    crc = zlib.crc32(EOF_MARKER, crc)
                 else:
                     for piece in native.iter_framed_chunks(
                             sub, write_eof=True):
                         f.write(piece)
+                        crc = zlib.crc32(piece, crc)
+                if self.fixed:
+                    f.flush()
+                    os.fsync(f.fileno())
             wrote = os.path.getsize(run_path)
             if wrote != total + len(EOF_MARKER):
                 raise StorageError(
@@ -235,10 +263,47 @@ class RunStore:
                     f"predict {total + len(EOF_MARKER)}")
             with open(off_path, "wb") as f:
                 ends.astype("<i8").tofile(f)
+                f.flush()
+                if self.fixed:
+                    # checkpoint mode: the sidecar must be durable
+                    # before a manifest can reference this run
+                    os.fsync(f.fileno())
         with self._lock:
             self.counts[seg_index] = sub.num_records
             self.bytes[seg_index] = total
+            self.crcs[seg_index] = crc & 0xFFFFFFFF
         metrics.add("spool.bytes", total)
+
+    def adopt(self, seg_index: int, records: int, nbytes: int,
+              crc: int) -> None:
+        """Register an already-on-disk run (checkpoint resume: the file
+        was written — and validated against the manifest — by a prior
+        attempt). Accounting only; no bytes move."""
+        with self._lock:
+            if seg_index in self.counts:
+                raise MergeError(f"segment {seg_index} staged twice")
+            self.counts[seg_index] = int(records)
+            self.bytes[seg_index] = int(nbytes)
+            self.crcs[seg_index] = int(crc) & 0xFFFFFFFF
+
+    def discard(self, seg_index: int) -> None:
+        """Unlink an UNREGISTERED run's files (a checkpoint adoption
+        that failed revalidation — the segment re-fetches and write_run
+        later rewrites the path)."""
+        for p in self._paths(seg_index):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass  # udalint: disable=UDA006 - cleanup best effort
+
+    def manifest(self) -> dict[int, tuple[int, int, int]]:
+        """Snapshot of COMPLETED runs for the checkpoint writer:
+        {seg_index: (records, framed_bytes, crc)} — reserved-but-
+        unfinished spools (count -1) are excluded; they will appear in
+        a later snapshot once durable."""
+        with self._lock:
+            return {s: (n, self.bytes[s], self.crcs[s])
+                    for s, n in self.counts.items() if n >= 0}
 
     def cleanup(self) -> None:
         with self._lock:
@@ -246,6 +311,12 @@ class RunStore:
                 return
             self._closed = True
             segs = list(self.counts)
+        if self.fixed:
+            # checkpoint-owned directory: the run files ARE the durable
+            # resume state — a failed attempt must leave them for the
+            # next one; TaskCheckpoint.discard removes the whole task
+            # dir once the merge output is delivered
+            return
         for seg in segs:
             for p in self._paths(seg):
                 try:

@@ -404,6 +404,14 @@ class EvLoopFetchClient(InputClient):
         with self._lock:
             return self._resumable
 
+    def generation(self, host: str = "") -> Optional[int]:
+        """Last HELLO generation observed from this supplier (None until
+        the first handshake). Checkpoint manifests record it so a resume
+        can tell a same-generation supplier (ledger still valid) from a
+        restarted one (drop the ledger, keep the run files)."""
+        with self._lock:
+            return self._generation
+
     # -- connection management ----------------------------------------------
 
     def _ensure_connected(self) -> _ClientConn:

@@ -192,8 +192,9 @@ class Admission:
     hbm_budget_bytes: int
     host_budget_bytes: int
     # structured decision basis — what callers branch on: which budget
-    # forced the decision ("hbm" | "host" | "hard" | "init" | "", the
-    # empty string meaning no budget was binding)
+    # forced the decision ("hbm" | "host" | "hard" | "init", "ckpt" for
+    # the checkpoint-steered streaming route, or "", the empty string
+    # meaning no budget was binding)
     cause: str = ""
     rerouted: bool = False
 
@@ -342,7 +343,8 @@ class MemoryBudget:
     # -- admission point 2: merge-approach routing --------------------------
 
     def route(self, estimate_bytes: Optional[int],
-              threshold_bytes: int) -> Admission:
+              threshold_bytes: int,
+              prefer_streaming: bool = False) -> Admission:
         """The budget-aware auto merge-approach decision.
 
         - unknown estimate -> streaming (bounded memory for unbounded
@@ -354,6 +356,12 @@ class MemoryBudget:
         - small (within the measured hybrid crossover AND in budget) ->
           hybrid; in-budget above the crossover -> streaming (the
           measured-fastest large-scale path, which is also bounded).
+
+        ``prefer_streaming`` (checkpointing armed, ``uda.tpu.ckpt.dir``)
+        steers the in-budget-small case to streaming too: the hybrid
+        LPQ/RPQ path has no durable run spool to snapshot, so
+        crash-consistent resume needs the streaming path (cause
+        ``"ckpt"``). Budget-forced decisions are unaffected.
         """
         hbm = self.hbm_budget_bytes
         host = self.host_budget_bytes
@@ -387,7 +395,12 @@ class MemoryBudget:
                 hbm, host, cause="host", rerouted=True)
             self._record(adm, "budget.rerouted")
             return adm
-        if estimate_bytes <= threshold_bytes:
+        if estimate_bytes <= threshold_bytes and prefer_streaming:
+            adm = Admission(
+                "streaming", "in-budget-small-ckpt: checkpoint/resume "
+                "needs the run-spool (streaming) path", estimate_bytes,
+                dev, hbm, host, cause="ckpt")
+        elif estimate_bytes <= threshold_bytes:
             adm = Admission("hybrid", "in-budget-small", estimate_bytes,
                             dev, hbm, host)
         else:

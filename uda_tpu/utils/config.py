@@ -472,6 +472,26 @@ _FLAG_LIST = [
          "larger or unknown sizes take bounded-memory streaming online "
          "(measured crossover between the 1 GB and 10 GB regression "
          "rungs, REGRESSION_cpu_x{,x}large_r05.json)"),
+    Flag("uda.tpu.ckpt.dir", "", str,
+         "crash-consistent checkpoint root (merger/checkpoint.py): "
+         "non-empty arms periodic snapshots of each running reduce — "
+         "sorted run files spool under <dir>/<job>.r<reduce>/runs/ and "
+         "an atomic versioned UCKP manifest records run CRCs, in-flight "
+         "fetch offset ledgers, the recovery journal and penalty-box "
+         "state; a restarted attempt resumes instead of refetching. "
+         "Also steers the auto merge approach to the streaming path "
+         "(hybrid has no durable run spool). Empty = off (the seed "
+         "behavior: a reducer death loses all fetched bytes)"),
+    Flag("uda.tpu.ckpt.interval.s", 30.0, float,
+         "minimum seconds between checkpoint snapshots; saves trigger "
+         "at run-spool boundaries and are rate-limited by this "
+         "interval (0 = snapshot at every spool boundary — the chaos "
+         "and resume tests run there)"),
+    Flag("uda.tpu.ckpt.keep", 2, int,
+         "checkpoint manifest generations retained after a save: a "
+         "torn newest manifest (kill mid-snapshot) falls back to the "
+         "previous one, and consumed-on-load walks backward across "
+         "crash-retry loops (min 1)"),
 ]
 
 FLAGS: Dict[str, Flag] = {f.key: f for f in _FLAG_LIST}

@@ -51,7 +51,11 @@ handoff record, keyed ``load``/``save`` — an injected save fault
 degrades the next start to cold, never breaks the stop). The batched
 host-I/O plane adds ``data_engine.preadv`` (per-request bytes after a
 coalesced vectored read, keyed ``<fd>@<file offset>`` — damages one
-request of a batch, never its batch-mates).
+request of a batch, never its batch-mates). Crash-consistent
+checkpointing (merger/checkpoint.py) adds ``ckpt.save`` (the assembled
+manifest bytes, keyed by task — truncate writes a torn manifest the
+next load must skip) and ``ckpt.load`` (the manifest walk, keyed by
+task — error degrades to a fresh start).
 """
 
 from __future__ import annotations
@@ -125,6 +129,15 @@ _SITE_ERRORS = {
     # complete byte-correct (the abusive-tenant isolation rung)
     "tenant.register": TenantError,
     "tenant.validate": TenantError,
+    # crash-consistent checkpointing (merger/checkpoint.py), both keyed
+    # by task ("<job>.r<reduce>"): ckpt.save fires on the assembled
+    # manifest bytes (truncate = a torn manifest on disk — load must
+    # fall back to the previous one; error = a failed snapshot, which
+    # maybe_save absorbs: the task never fails for its checkpoint);
+    # ckpt.load fires before the manifest walk (error = an unreadable
+    # checkpoint store, which degrades to a fresh start, never a crash)
+    "ckpt.save": StorageError,
+    "ckpt.load": StorageError,
 }
 
 # The registered-site inventory. udalint's UDA003 rule checks every
@@ -304,6 +317,27 @@ class FailpointRegistry:
             with self._lock:
                 self._sites = saved
             resledger.settle("ctx.failpoints.scoped", key=spec)
+
+    @contextlib.contextmanager
+    def quiesced(self) -> Iterator["FailpointRegistry"]:
+        """Suspend every armed failpoint for a with-block, restoring
+        the EXACT Failpoint objects (trigger counters included) on
+        exit. A deterministic crafted-state scenario inside a chaos
+        run uses this so the ambient schedule neither fires during it
+        nor shifts phase because of it — ``every:N`` counters see the
+        block as zero hits."""
+        from uda_tpu.utils.resledger import resledger
+
+        with self._lock:
+            saved = self._sites
+            self._sites = {}
+        try:
+            resledger.acquire("ctx.failpoints.scoped", key="<quiesced>")
+            yield self
+        finally:
+            with self._lock:
+                self._sites = saved
+            resledger.settle("ctx.failpoints.scoped", key="<quiesced>")
 
     def evaluate(self, site: str, data: Optional[bytes],
                  key: str) -> Optional[bytes]:

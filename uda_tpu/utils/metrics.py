@@ -374,6 +374,28 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                     "budget share (the global "
                                     "supplier.admission.rejections "
                                     "also advances) [labels: tenant]"),
+    # -- counters: crash-consistent checkpoints (merger/checkpoint.py) ---
+    "ckpt.snapshots": ("counter", "checkpoint manifests durably "
+                                  "written (one per successful save)"),
+    "ckpt.bytes": ("counter", "bytes written by checkpoint saves "
+                              "(manifest + ledger part files; run "
+                              "files are spooled by the RunStore and "
+                              "charged to stage.bytes, not here)"),
+    "ckpt.save.errors": ("counter", "checkpoint saves that failed and "
+                                    "were absorbed (best-effort "
+                                    "contract: the task continues on "
+                                    "its previous resume point)"),
+    "ckpt.resumed": ("counter", "reduce tasks that resumed from a "
+                                "checkpoint manifest instead of "
+                                "starting fresh"),
+    "ckpt.runs.adopted": ("counter", "checkpointed run files adopted "
+                                     "on resume (CRC-verified, re-"
+                                     "joined the merge forest with "
+                                     "zero refetch)"),
+    "ckpt.invalidated": ("counter", "checkpoint state dropped by the "
+                                    "revalidation ladder [labels: "
+                                    "cause=load|torn|epoch|maps|crc|"
+                                    "generation|ledger]"),
     # -- counters: time-accounting plane (profiler + critpath) -----------
     "profile.samples": ("counter", "sampling-profiler stack samples, "
                                    "attributed to the sampled thread's "
@@ -461,6 +483,11 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                           "written; client: request "
                                           "sent to completion "
                                           "dispatched]"),
+    "ckpt.save_ms": ("histogram", "wall time of one checkpoint save "
+                                  "(collect + part files + manifest "
+                                  "write + fsync + prune) — the "
+                                  "snapshot-overhead signal perfwatch "
+                                  "gates on"),
 }
 
 # Dynamically-named families (f-string call sites): the static prefix
