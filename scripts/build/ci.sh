@@ -105,6 +105,16 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python scripts/tenant_bench.py --quick \
   --out "$ART/bench_tenant.json" 2>&1 | tee -a "$ART/ci.log" | tail -4
 
+# Fleet observability gate: one tenanted, observability-armed daemon,
+# 8 equal-weight tenant drivers, scripts/udafleet.py --once --json
+# polled live against it — the CAP_OBS sections must round-trip and
+# every tenant's fleet share of scheduled bytes must land within 2% of
+# its weight-proportional entitlement (the WDRR fairness audit the SLI
+# book exists to answer).
+echo "-- fleet observability smoke (udafleet --once --json)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/fleet_smoke.py 2>&1 | tee -a "$ART/ci.log" | tail -1
+
 # Tuning-cache round trip: a quick io.read fly-off probe must persist
 # a winner, and a SECOND probe run must serve from the cache without
 # re-measuring (tune_probe prints "0 probe(s)" — the self-service

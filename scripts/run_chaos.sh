@@ -29,7 +29,7 @@ COUNTERS="$(mktemp)"
 # its post-mortem defeats the recorder's purpose).
 FRROOT="$(mktemp -d)"
 export FRROOT  # the telemetry merge below reads the dumps from it
-for r in main pressure network exchange completion pipeline iobatch tenant resume lockdep; do
+for r in main pressure network exchange completion pipeline iobatch tenant resume anomaly lockdep; do
   mkdir -p "${FRROOT}/${r}"
 done
 trap 'rm -f "${COUNTERS}"; rm -rf "${FRROOT}"' EXIT
@@ -241,6 +241,29 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${RESSPEC}" UDA_TPU_STATS=1 \
     -p no:cacheprovider \
     --continue-on-collection-errors "$@" || resrc=$?
 
+# Anomaly rung: the observability plane's proactive-capture guarantee
+# (ISSUE 17) — a seeded slow-supplier storm (delays only: every fetch
+# COMPLETES, nothing falls back) with the online detectors armed and
+# proactive dumping ON. The faults-marked anomaly test asserts the
+# whole contract: the p99-inflation detector fires on the live fetch
+# path and leaves exactly ONE black-box dump (cause=anomaly) while
+# fallback.signals is still zero — the recorder captures the minutes
+# BEFORE a failure, not after. Enforced below like lockdep/resledger:
+# an anomaly rung that ends with no cause=anomaly dump in its archive
+# fails the tier even if pytest passed.
+ASPEC="data_engine.pread=delay:$((SEED % 20 + 5)):prob:0.3:seed:${SEED}"
+ACOUNTERS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${ACOUNTERS}"; rm -rf "${FRROOT}"' EXIT
+echo "anomaly schedule:    ${ASPEC} (UDA_TPU_ANOMALY_DUMP=1)"
+anrc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${ASPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_ANOMALY_DUMP=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/anomaly" \
+    UDA_TPU_CHAOS_TELEMETRY="${ACOUNTERS}" \
+    python -m pytest tests/ -m faults -q -p no:cacheprovider \
+    -k "anomaly" \
+    --continue-on-collection-errors "$@" || anrc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -251,7 +274,7 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${RESSPEC}" UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${ACOUNTERS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -275,7 +298,8 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${TSPEC}" "${TENCOUNTERS}" "${tenrc}" "${TENCYCLES}" \
     "${TENLEAKS}" \
     "${RESSPEC}" "${RESCOUNTERS}" "${resrc}" "${RESCYCLES}" \
-    "${RESLEAKS}" <<'EOF' || mrc=$?
+    "${RESLEAKS}" \
+    "${ASPEC}" "${ACOUNTERS}" "${anrc}" <<'EOF' || mrc=$?
 import glob, json, os, sys
 sys.path.insert(0, os.getcwd())
 from uda_tpu.utils.critpath import buckets_from_counters
@@ -288,8 +312,9 @@ from uda_tpu.utils.critpath import buckets_from_counters
  nleaks_path, cleaks_path, pileaks_path,
  iospec, iocounters, iorc, iocycles, ioleaks_path,
  tenspec, tencounters, tenrc, tencycles, tenleaks_path,
- resspec, rescounters, resrc_, rescycles, resleaks_path) = \
-    sys.argv[1:44]
+ resspec, rescounters, resrc_, rescycles, resleaks_path,
+ aspec, acounters, anrc) = \
+    sys.argv[1:47]
 frroot = os.environ.get("FRROOT", "")
 def flightrec_block(rung, exit_code):
     """Archive the rung's black-box dumps (cause + structured extra +
@@ -450,6 +475,22 @@ resume["resumed"] = {
     "invalidated": rsc.get("ckpt.invalidated", 0),
     "save_errors": rsc.get("ckpt.save.errors", 0),
 }
+anomaly_telem = load(acounters)
+# the proactive-capture contract, surfaced: detector firings, the
+# rate-limited black-box dumps, and the PROACTIVE guarantee — zero
+# FallbackSignals in a rung whose detectors fired (the per-test
+# asserts enforce the exactly-one ordering; this block is the
+# cross-round diffable record)
+acc = anomaly_telem.get("counters", {})
+anomaly = {"schedule": aspec, "pytest_exit": int(anrc),
+           "telemetry": anomaly_telem,
+           "time_accounting": timeacct_block(anomaly_telem),
+           "detected": {
+               "fired": acc.get("anomaly.fired", 0),
+               "dumps": acc.get("anomaly.dumps", 0),
+               "p99_firings": acc.get(
+                   "anomaly.p99{key=fetch.latency_ms}", 0),
+               "fallback_signals": acc.get("fallback.signals", 0)}}
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 nleak = (len(n_leaks) + len(c_leaks) + len(pi_leaks) + len(io_leaks)
          + len(ten_leaks) + len(res_leaks))
@@ -464,6 +505,7 @@ fr = {"main": flightrec_block("main", rc),
       "iobatch": flightrec_block("iobatch", iorc),
       "tenant": flightrec_block("tenant", tenrc),
       "resume": flightrec_block("resume", resrc_),
+      "anomaly": flightrec_block("anomaly", anrc),
       "lockdep": flightrec_block("lockdep", lrc)}
 network["flightrec"] = fr["network"]
 exchange["flightrec"] = fr["exchange"]
@@ -472,7 +514,16 @@ pipeline["flightrec"] = fr["pipeline"]
 iobatch["flightrec"] = fr["iobatch"]
 tenant["flightrec"] = fr["tenant"]
 resume["flightrec"] = fr["resume"]
+anomaly["flightrec"] = fr["anomaly"]
 lockdep["flightrec"] = fr["lockdep"]
+# the anomaly rung's enforced guarantee (the flip side of
+# failed_without_dump): a PASSING anomaly rung that left no proactive
+# cause=anomaly dump means the detectors never fired under the storm —
+# the capture machinery is dead and the rung must fail the tier
+anomaly_proactive = [r for r in fr["anomaly"]["reports"]
+                     if r.get("cause") == "anomaly"]
+anomaly["detected"]["proactive_dumps"] = len(anomaly_proactive)
+no_proactive = not int(anrc) and not anomaly_proactive
 no_postmortem = sorted(r for r, b in fr.items()
                        if b["failed_without_dump"])
 with open(out, "w") as f:
@@ -494,6 +545,7 @@ with open(out, "w") as f:
                "iobatch": iobatch,
                "tenant": tenant,
                "resume": resume,
+               "anomaly": anomaly,
                "lockdep": lockdep,
                "resledger": {"armed_rungs": ["network", "completion",
                                              "pipeline", "iobatch",
@@ -512,12 +564,18 @@ if no_postmortem:
     print(f"FLIGHTREC: rung(s) failed with NO black-box dump: "
           f"{', '.join(no_postmortem)} — the post-mortem record is "
           f"part of the failure contract", file=sys.stderr)
-# the zero-cycles / zero-leaks / dump-on-failure guarantees are
-# ENFORCED, not just printed: a detected inversion, a leaked
-# obligation, or a failing rung with no post-mortem record all fail
-# the tier — that is the entire point of lockdep, the ledger and the
-# flight recorder
-sys.exit(3 if (ncyc or nleak or no_postmortem) else 0)
+if no_proactive:
+    print("ANOMALY: the anomaly rung passed but left NO proactive "
+          "cause=anomaly dump — the detectors never fired under the "
+          "slow-supplier storm, which defeats the rung's purpose",
+          file=sys.stderr)
+# the zero-cycles / zero-leaks / dump-on-failure / proactive-capture
+# guarantees are ENFORCED, not just printed: a detected inversion, a
+# leaked obligation, a failing rung with no post-mortem record, or an
+# anomaly rung with no proactive capture all fail the tier — that is
+# the entire point of lockdep, the ledger and the flight recorder
+sys.exit(3 if (ncyc or nleak or no_postmortem or no_proactive)
+         else 0)
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
 if [ "${nrc}" -ne 0 ]; then rc="${nrc}"; fi
@@ -527,6 +585,7 @@ if [ "${pirc}" -ne 0 ]; then rc="${pirc}"; fi
 if [ "${iorc}" -ne 0 ]; then rc="${iorc}"; fi
 if [ "${tenrc}" -ne 0 ]; then rc="${tenrc}"; fi
 if [ "${resrc}" -ne 0 ]; then rc="${resrc}"; fi
+if [ "${anrc}" -ne 0 ]; then rc="${anrc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP/RESLEDGER/FLIGHTREC: cycle reports, leaked obligations" \

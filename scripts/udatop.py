@@ -19,6 +19,13 @@ dumps the raw snapshots instead of the table). A peer that refuses
 MSG_STATS (old version: typed ERR or disconnect) renders as
 ``unsupported``; an unreachable one as ``down`` — the console never
 crashes over one sick supplier.
+
+``--window N`` asks each CAP_OBS peer for its observability sections
+(time-series rollups for the trailing N seconds, per-tenant SLIs,
+active anomalies): tenanted peers grow per-tenant sub-rows (scheduled
+share vs entitlement, worst SLO burn rate, starvation streak) and an
+``anomalies:`` line. A pre-observability peer simply renders the
+plain table — never ``unsupported``.
 """
 
 from __future__ import annotations
@@ -64,6 +71,48 @@ def parse_host(spec: str, default_port: int):
     return host or "127.0.0.1", int(port) if port else default_port
 
 
+def worst_burn(tslo: dict) -> tuple:
+    """(burn, sli name) of the tenant's hottest SLO, ('-', '-') when
+    nothing is judged yet."""
+    best = None
+    for sli, block in (tslo or {}).items():
+        burn = block.get("burn") if isinstance(block, dict) else None
+        if burn is None:
+            continue
+        if best is None or burn > best[0]:
+            best = (burn, sli)
+    return best if best else ("-", "-")
+
+
+def tenant_rows(snap: dict) -> list:
+    """Per-tenant sub-rows from a CAP_OBS peer's ``sli`` block (empty
+    for untenanted or pre-observability peers)."""
+    sli = snap.get("sli")
+    if not isinstance(sli, dict) or not sli.get("tenants"):
+        return []
+    lines = []
+    for t, blk in sli["tenants"].items():
+        share = blk.get("window_share")
+        entitled = blk.get("entitled")
+        burn, burn_sli = worst_burn(blk.get("slo"))
+        share_txt = (f"{share * 100:5.1f}%" if share is not None
+                     else "    -")
+        tail = (f" of {entitled * 100:5.1f}% entitled"
+                if entitled else "")
+        burn_txt = (f"  burn {burn:g} ({burn_sli})"
+                    if burn != "-" else "  burn -")
+        starve = blk.get("starve_streak_s") or 0
+        lines.append(f"  └ {t:<19} share {share_txt}{tail}"
+                     f"{burn_txt}"
+                     + (f"  STARVED {starve:g}s" if starve else ""))
+    anomalies = snap.get("anomalies")
+    if isinstance(anomalies, dict) and anomalies.get("active"):
+        kinds = ", ".join(f"{a['kind']}({a['key']})"
+                          for a in anomalies["active"])
+        lines.append(f"  ! anomalies: {kinds}")
+    return lines
+
+
 def row(spec: str, snap, prev, dt: float) -> str:
     if isinstance(snap, str):  # "down" / "unsupported"
         return f"{spec:<22} {snap}"
@@ -90,11 +139,12 @@ def row(spec: str, snap, prev, dt: float) -> str:
             f"{where_time_goes(prov):<16}")
 
 
-def poll(targets, timeout: float):
+def poll(targets, timeout: float, window_s=None):
     snaps = {}
     for spec, (host, port) in targets.items():
         try:
-            snaps[spec] = fetch_remote_stats(host, port, timeout=timeout)
+            snaps[spec] = fetch_remote_stats(host, port, timeout=timeout,
+                                             window_s=window_s)
         except UdaError as e:
             # a typed refusal (ProtocolError from an old peer) vs a
             # dead endpoint — branch on the exception TYPE, never its
@@ -115,6 +165,11 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="dump raw snapshots as JSON (implies no table)")
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--window", type=int, default=None, metavar="S",
+                    help="request CAP_OBS observability sections for "
+                         "the trailing S seconds (per-tenant SLI "
+                         "sub-rows + anomalies; old peers degrade to "
+                         "the plain table)")
     args = ap.parse_args()
     default_port = int(Config().get("uda.tpu.net.port"))
     targets = {spec: parse_host(spec, default_port)
@@ -122,7 +177,7 @@ def main() -> int:
     prev: dict = {}
     prev_t = time.monotonic()
     while True:
-        snaps = poll(targets, args.timeout)
+        snaps = poll(targets, args.timeout, window_s=args.window)
         now = time.monotonic()
         dt = max(now - prev_t, 1e-9)
         if args.json:
@@ -138,6 +193,9 @@ def main() -> int:
             print(_HEADER)
             for spec in args.hosts:
                 print(row(spec, snaps[spec], prev.get(spec), dt))
+                if isinstance(snaps[spec], dict):
+                    for line in tenant_rows(snaps[spec]):
+                        print(line)
             sys.stdout.flush()
         if args.once:
             return 0

@@ -156,6 +156,12 @@ def _metrics_hygiene():
     from uda_tpu.utils.profiler import profiler
     profiler.stop()
     profiler.reset()
+    # observability-plane hygiene: a test that armed the rollup ring
+    # (and with it the anomaly detectors, SLI book, or OpenMetrics
+    # endpoint) must not keep its sampler thread, listeners, or HTTP
+    # port alive into later tests
+    from uda_tpu.utils.timeseries import disarm_observability_plane
+    disarm_observability_plane()
     if unbalanced or leaked:
         parts = []
         if unbalanced:

@@ -120,7 +120,13 @@ def test_submit_batch_byte_identity_vs_file(tmp_path, quiet_sites):
     path = _write(str(tmp_path), "f.mof", data_len)
     with open(path, "rb") as f:
         blob = f.read()
-    engine = DataEngine(SyntheticResolver(path, data_len), Config())
+    # pin the preadv rung: the coalescer under test only exists there —
+    # on a host with the native lib built, "auto" resolves to io_uring,
+    # which correctly submits one SQE per request (the kernel batches)
+    # and the reads < requests assertion below would test the host's
+    # build state instead of the scatter logic
+    engine = DataEngine(SyntheticResolver(path, data_len),
+                        Config({"uda.tpu.read.backend": "preadv"}))
     try:
         # adjacent, gapped, duplicate and tail-clamped ranges in one
         # batch — every shape the coalescer must scatter correctly

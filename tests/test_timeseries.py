@@ -1,0 +1,594 @@
+"""Tier-1 coverage of the live telemetry plane (ISSUE 17).
+
+Four layers, mirroring the subsystem split:
+
+1. the rollup ring (utils/timeseries.py): counter deltas, per-interval
+   histogram percentiles recomputed from bucket deltas, ring bounds,
+   window queries and the one-timer listener contract;
+2. the online anomaly detectors (utils/anomaly.py): warmup + hysteresis
+   before a throughput collapse or p99 inflation fires, transition-edge
+   dedup, detect-only default, and the rate-limited PROACTIVE
+   flight-recorder dump (exactly one, before anything fails);
+3. the per-tenant SLI book (tenant/sli.py): scheduled-vs-entitled share
+   from the WDRR scheduler's granted-byte deltas, SLO compliance /
+   attainment / burn rate, and starvation streaks;
+4. MSG_STATS interop (CAP_OBS): a windowed poll returns the new
+   sections, an old-style empty-payload poll returns the unchanged
+   PR 11 snapshot, a wrong-length tail is a torn frame, and the
+   udafleet console merges a live daemon end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.merger import LocalFetchClient, MergeManager
+from uda_tpu.mofserver import DataEngine, DirIndexResolver
+from uda_tpu.net import ShuffleServer, wire
+from uda_tpu.net.client import fetch_remote_stats
+from uda_tpu.utils.anomaly import AnomalyEngine
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import TransportError
+from uda_tpu.utils.failpoints import failpoints
+from uda_tpu.utils.flightrec import flightrec
+from uda_tpu.utils.metrics import Metrics, metrics
+from uda_tpu.utils.timeseries import TimeSeries
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+JOB = "jobTs"
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+
+def make_ts(window: int = 16, stats: bool = True):
+    m = Metrics(stats=stats)
+    clock = FakeClock()
+    ts = TimeSeries(m, interval_s=1.0, window=window, clock=clock)
+    return ts, m, clock
+
+
+# -- the rollup ring ----------------------------------------------------------
+
+
+def test_rollup_carries_counter_deltas_not_cumulatives():
+    ts, m, clock = make_ts()
+    m.add("fetch.bytes", 1000)
+    ts.sample()  # self-baseline: first rollup is all-zero deltas
+    m.add("fetch.bytes", 500)
+    m.add("fetch.chunks")
+    m.gauge("fetch.on_air", 7)
+    clock.tick()
+    roll = ts.sample()
+    assert roll["counters"]["fetch.bytes"] == 500  # delta, not 1500
+    assert roll["counters"]["fetch.chunks"] == 1
+    assert "idle.counter" not in roll["counters"]  # nonzero only
+    assert roll["gauges"]["fetch.on_air"] == 7  # level, not delta
+    assert roll["dt"] == pytest.approx(1.0)
+    clock.tick()
+    quiet = ts.sample()
+    assert quiet["counters"] == {}  # an idle interval rolls up empty
+
+
+def test_interval_percentiles_see_one_bad_interval():
+    """The cumulative-summary blind spot the ring exists to fix: a p99
+    step in ONE interval must show at that interval's percentile, not
+    be averaged into a long healthy history."""
+    ts, m, clock = make_ts()
+    for _ in range(500):
+        m.observe("fetch.latency_ms", 5.0)
+    ts.sample()
+    clock.tick()
+    for _ in range(100):
+        m.observe("fetch.latency_ms", 5.0)
+    roll1 = ts.sample()
+    p1 = roll1["percentiles"]["fetch.latency_ms"]
+    assert p1["count"] == 100
+    assert p1["p99"] < 50
+    clock.tick()
+    for _ in range(100):
+        m.observe("fetch.latency_ms", 900.0)
+    roll2 = ts.sample()
+    p2 = roll2["percentiles"]["fetch.latency_ms"]
+    assert p2["count"] == 100
+    # the interval view: pure 900 ms traffic, the 600 earlier 5 ms
+    # samples cannot drag it down (cumulatively p99 would be ~5 ms)
+    assert p2["p99"] > 500
+    cum = m.histogram_summaries()["fetch.latency_ms"]
+    assert cum["count"] == 700
+
+
+def test_ring_bound_and_window_queries():
+    ts, m, clock = make_ts(window=5)
+    for i in range(9):
+        m.add("fetch.bytes", 100 * (i + 1))
+        ts.sample()
+        clock.tick()
+    rolls = ts.window()
+    assert len(rolls) == 5  # oldest rolled off
+    assert [r["seq"] for r in rolls] == [5, 6, 7, 8, 9]
+    assert len(ts.window(count=2)) == 2
+    # the trailing-seconds cut: each interval spans 1 s
+    assert len(ts.window(seconds=3.0)) == 3
+    assert len(ts.counter_rate_series("fetch.bytes")) == 5
+    blk = ts.wire_block(seconds=2.0)
+    assert blk["samples"] == 5 and len(blk["rollups"]) == 2
+
+
+def test_configure_rebounds_ring_keeping_newest():
+    ts, m, clock = make_ts(window=8)
+    for _ in range(6):
+        ts.sample()
+        clock.tick()
+    ts.configure(window=3)
+    assert [r["seq"] for r in ts.window()] == [4, 5, 6]
+    assert ts.window_len == 3
+
+
+def test_listener_failure_is_counted_and_isolated():
+    ts, m, clock = make_ts()
+    seen = []
+
+    def bad(roll):
+        raise RuntimeError("consumer bug")
+
+    ts.add_listener(bad)
+    ts.add_listener(seen.append)
+    before = metrics.snapshot().get("ts.listener.errors", 0)
+    ts.sample()
+    # one consumer failing neither stops the clock nor the others
+    assert len(seen) == 1
+    assert metrics.snapshot()["ts.listener.errors"] == before + 1
+    ts.remove_listener(bad)
+    clock.tick()
+    ts.sample()
+    assert len(seen) == 2
+    assert metrics.snapshot()["ts.listener.errors"] == before + 1
+
+
+# -- anomaly detection --------------------------------------------------------
+
+
+def _roll(seq, counters=None, percentiles=None, gauges=None, dt=1.0):
+    return {"seq": seq, "ts": 0.0, "dt": dt,
+            "counters": counters or {}, "gauges": gauges or {},
+            "percentiles": percentiles or {}}
+
+
+def _armed_engine(tmp_path, overrides=None, ts=None):
+    cfg = Config(dict({"uda.tpu.anomaly.consec": 2,
+                       "uda.tpu.anomaly.warmup": 3}, **(overrides or {})))
+    eng = AnomalyEngine()
+    own_ts = ts or TimeSeries(Metrics(stats=True), clock=FakeClock())
+    assert eng.arm_from_config(cfg, own_ts)
+    flightrec._dump_dir = str(tmp_path)
+    return eng
+
+
+def test_throughput_collapse_fires_once_and_clears(tmp_path):
+    eng = _armed_engine(tmp_path)
+    seq = 0
+    for _ in range(5):  # healthy: 10 MB/s, builds the EWMA past warmup
+        seq += 1
+        eng.on_rollup(_roll(seq, {"fetch.bytes": 10e6}))
+    assert eng.fired == 0
+    for _ in range(4):  # collapsed: 2% of baseline, under the 25% frac
+        seq += 1
+        eng.on_rollup(_roll(seq, {"fetch.bytes": 0.2e6}))
+    # consec=2 hysteresis: fired on the 2nd breach; transition-edge
+    # dedup: still ONE anomaly after 4 breaching intervals
+    assert eng.fired == 1
+    active = eng.active()
+    assert [a["kind"] for a in active] == ["throughput"]
+    assert active[0]["key"] == "fetch.bytes"
+    assert metrics.snapshot()["anomaly.fired"] == 1
+    assert metrics.snapshot()["anomaly.throughput{key=fetch.bytes}"] == 1
+    # detect-only default: no proactive dump
+    assert eng.dumps == 0 and not list(tmp_path.iterdir())
+    for _ in range(3):  # recovery: _CLEAR_AFTER clean intervals
+        seq += 1
+        eng.on_rollup(_roll(seq, {"fetch.bytes": 10e6}))
+    assert eng.active() == []
+
+
+def test_single_noisy_interval_stays_silent(tmp_path):
+    eng = _armed_engine(tmp_path)
+    for seq in range(1, 6):
+        eng.on_rollup(_roll(seq, {"fetch.bytes": 10e6}))
+    eng.on_rollup(_roll(6, {"fetch.bytes": 0.1e6}))  # one blip
+    eng.on_rollup(_roll(7, {"fetch.bytes": 10e6}))
+    eng.on_rollup(_roll(8, {"fetch.bytes": 0.1e6}))  # another blip
+    assert eng.fired == 0  # never consec=2 in a row
+
+
+def test_idle_process_cannot_alarm(tmp_path):
+    """The absolute guard: an EWMA below the collapse floor is not
+    'moving' — a near-idle counter dropping to zero is not a collapse."""
+    eng = _armed_engine(tmp_path)
+    for seq in range(1, 6):
+        eng.on_rollup(_roll(seq, {"fetch.bytes": 1e4}))  # 0.01 MB/s
+    for seq in range(6, 12):
+        eng.on_rollup(_roll(seq, {"fetch.bytes": 0.0}))
+    assert eng.fired == 0
+
+
+def test_p99_inflation_detector(tmp_path):
+    eng = _armed_engine(tmp_path)
+    pct = {"fetch.latency_ms": {"count": 50, "p50": 4.0, "p95": 8.0,
+                                "p99": 10.0}}
+    seq = 0
+    for _ in range(6):
+        seq += 1
+        eng.on_rollup(_roll(seq, percentiles=pct))
+    bad = {"fetch.latency_ms": {"count": 50, "p50": 300.0, "p95": 700.0,
+                                "p99": 900.0}}
+    for _ in range(3):
+        seq += 1
+        eng.on_rollup(_roll(seq, percentiles=bad))
+    assert eng.fired == 1
+    assert eng.active()[0]["kind"] == "p99"
+
+
+def test_gauge_leak_detector_needs_monotone_rise(tmp_path):
+    ts, m, clock = make_ts(window=16)
+    eng = _armed_engine(tmp_path, ts=ts)
+    for i in range(8):  # fetch.on_air rises 32/interval, monotone
+        m.gauge("fetch.on_air", 32 * (i + 1))
+        eng.on_rollup(ts.sample())
+        clock.tick()
+    assert eng.fired == 1
+    assert eng.active()[0]["kind"] == "leak"
+    # a sawtooth (rises but returns) is traffic, not a leak
+    eng2 = _armed_engine(tmp_path)
+    ts2, m2, clock2 = make_ts(window=16)
+    eng2.timeseries = ts2
+    for i in range(8):
+        m2.gauge("fetch.on_air", 256 if i % 2 else 0)
+        eng2.on_rollup(ts2.sample())
+        clock2.tick()
+    assert eng2.fired == 0
+
+
+def test_proactive_dump_fires_exactly_once_rate_limited(tmp_path):
+    eng = _armed_engine(tmp_path, overrides={
+        "uda.tpu.anomaly.dump": True,
+        "uda.tpu.anomaly.dump.interval.s": 3600.0})
+    assert eng.dump_enabled
+    pct = {"fetch.latency_ms": {"count": 50, "p50": 4.0, "p95": 8.0,
+                                "p99": 10.0}}
+    seq = 0
+    for _ in range(6):
+        seq += 1
+        eng.on_rollup(_roll(seq, {"fetch.bytes": 10e6}, pct))
+    bad = {"fetch.latency_ms": {"count": 50, "p50": 300.0, "p95": 700.0,
+                                "p99": 900.0}}
+    for _ in range(4):  # BOTH detectors breach simultaneously
+        seq += 1
+        eng.on_rollup(_roll(seq, {"fetch.bytes": 0.1e6}, bad))
+    assert eng.fired == 2  # two anomalies recognized...
+    dumps = [p for p in tmp_path.iterdir() if "anomaly" in p.name]
+    assert len(dumps) == 1  # ...ONE rate-limited black-box capture
+    assert eng.dumps == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["cause"] == "anomaly"
+    assert doc["extra"]["anomaly"]["kind"] in ("throughput", "p99")
+    # the events leading UP TO the anomaly are in the ring dump —
+    # that is the whole point of capturing proactively
+    assert any(e.get("kind") == "anomaly" for e in doc["events"])
+
+
+# -- the per-tenant SLI book --------------------------------------------------
+
+
+class FakeSched:
+    """A WDRR scheduler the book can audit: scripted granted_cost."""
+
+    def __init__(self, weights):
+        self.weights = weights
+        self.granted = {t: 0 for t in weights}
+        self.parked = {t: 0 for t in weights}
+
+    def grant(self, tenant, cost):
+        self.granted[tenant] += cost
+
+    def stats(self):
+        return {"total": 4, "free": 4, "grants": 0, "tenants": {
+            t: {"parked": self.parked[t], "parked_cost": 0,
+                "granted_cost": self.granted[t], "inflight": 0,
+                "deficit": 0.0, "weight": w, "boxed": False}
+            for t, w in self.weights.items()}}
+
+
+def _book(config=None, window=32):
+    from uda_tpu.tenant.sli import SliBook
+
+    ts = TimeSeries(Metrics(stats=True), window=window, clock=FakeClock())
+    book = SliBook()
+    book.arm_from_config(config or Config(), ts)
+    return book
+
+
+def test_share_tracks_scheduler_weights():
+    book = _book()
+    sched = FakeSched({"tA": 3, "tB": 1})
+    book.attach(scheduler=sched, registry=None)
+    for seq in range(1, 9):
+        sched.parked = {"tA": 2, "tB": 2}  # both have demand
+        sched.grant("tA", 300)
+        sched.grant("tB", 100)
+        book.on_rollup(_roll(seq))
+    snap = book.snapshot()
+    a, b = snap["tenants"]["tA"], snap["tenants"]["tB"]
+    # granted-byte share vs weight-proportional entitlement: 3:1
+    assert a["window_share"] == pytest.approx(0.75, abs=0.02)
+    assert b["window_share"] == pytest.approx(0.25, abs=0.02)
+    assert a["entitled"] == pytest.approx(0.75)
+    assert a["sched_bytes"] == 8 * 300
+    # both kept >= slo.share.frac (0.5) of entitlement: share SLO met
+    assert a["slo"]["share"]["attainment"] == 1.0
+    assert a["slo"]["share"]["burn"] == 0.0
+    assert a["starved_s"] == 0.0
+
+
+def test_starvation_streak_and_burn_rate():
+    book = _book(Config({"uda.tpu.slo.objective": 0.9}))
+    sched = FakeSched({"tA": 1, "tB": 1})
+    book.attach(scheduler=sched, registry=None)
+    for seq in range(1, 11):
+        sched.parked = {"tA": 2, "tB": 2}
+        sched.grant("tA", 100)  # tB: backlog, zero scheduled bytes
+        book.on_rollup(_roll(seq))
+    snap = book.snapshot()
+    b = snap["tenants"]["tB"]
+    assert b["starve_streak_s"] == pytest.approx(10.0)
+    assert book.starving_tenants(5.0) == {"tB": pytest.approx(10.0)}
+    # tB's share SLO burned every interval: attainment 0, burn capped
+    # by the objective's error budget (1-0)/(1-0.9) = 10x
+    assert b["slo"]["share"]["attainment"] == 0.0
+    assert b["slo"]["share"]["burn"] == pytest.approx(10.0)
+    assert metrics.snapshot()["sli.slo.breach{sli=share,tenant=tB}"] >= 1
+    # a granted interval resets the STREAK but not the cumulative
+    sched.grant("tB", 100)
+    book.on_rollup(_roll(11))
+    b = book.snapshot()["tenants"]["tB"]
+    assert b["starve_streak_s"] == 0.0
+    assert b["starved_s"] == pytest.approx(10.0)
+
+
+def test_latency_slo_and_final_slo_block():
+    book = _book(Config({"uda.tpu.slo.fetch.p99.ms": 50.0}))
+    sched = FakeSched({"tA": 1})
+    book.attach(scheduler=sched, registry=None)
+    good = {"fetch.latency_ms{supplier=s1,tenant=tA}":
+            {"count": 40, "p50": 5.0, "p95": 9.0, "p99": 10.0}}
+    bad = {"fetch.latency_ms{supplier=s1,tenant=tA}":
+           {"count": 40, "p50": 80.0, "p95": 180.0, "p99": 200.0}}
+    for seq in range(1, 9):
+        sched.parked = {"tA": 1}
+        sched.grant("tA", 100)
+        book.on_rollup(_roll(seq, percentiles=good if seq <= 6 else bad))
+    snap = book.snapshot()["tenants"]["tA"]
+    assert snap["p99_ms"]["fetch"] == pytest.approx(200.0)
+    assert snap["slo"]["fetch_p99_ms"]["attainment"] == pytest.approx(
+        6 / 8)
+    blk = book.slo_block()
+    assert blk["worst_attainment"] == pytest.approx(6 / 8)
+    assert blk["tenants"]["tA"]["fetch_p99_ms"]["target"] == 50.0
+
+
+def test_tenant_deltas_fold_labeled_series():
+    from uda_tpu.tenant.sli import series_labels
+
+    book = _book()
+    roll = _roll(1, counters={
+        "fetch.bytes{supplier=s1,tenant=tA}": 1000,
+        "fetch.bytes{supplier=s2,tenant=tA}": 500,
+        "fetch.bytes{supplier=s1,tenant=tB}": 200,
+        "fetch.bytes": 1700})  # the unlabeled total is NOT a tenant
+    book.on_rollup(roll)
+    snap = book.snapshot()
+    assert snap["tenants"]["tA"]["bytes_fetched"] == 1500
+    assert snap["tenants"]["tB"]["bytes_fetched"] == 200
+    assert set(snap["tenants"]) == {"tA", "tB"}
+    assert series_labels("a.b{x=1,y=2}") == ("a.b", {"x": "1", "y": "2"})
+    assert series_labels("a.b") == ("a.b", {})
+
+
+# -- MSG_STATS interop (CAP_OBS) ----------------------------------------------
+
+
+def _split(frame: bytes):
+    msg_type, req_id, length = wire.decode_header(frame[:wire.HEADER.size])
+    payload = frame[wire.HEADER.size:]
+    assert len(payload) == length
+    return msg_type, req_id, payload
+
+
+def test_stats_request_tail_encode_decode():
+    msg_type, req_id, payload = _split(
+        wire.encode_stats_request(9, window_s=60))
+    assert (msg_type, req_id) == (wire.MSG_STATS, 9)
+    assert wire.decode_stats_request(payload) == (60, wire.STATS_SEC_ALL)
+    # old shape: empty payload decodes to None (the PR 11 request)
+    _, _, empty = _split(wire.encode_stats_request(9))
+    assert len(empty) == 0 and wire.decode_stats_request(empty) is None
+    with pytest.raises(TransportError):
+        wire.decode_stats_request(b"\x01\x02\x03")  # torn tail
+
+
+@pytest.fixture
+def obs_supplier(tmp_path):
+    expected = make_mof_tree(str(tmp_path), JOB, num_maps=2,
+                             num_reducers=1, records_per_map=30, seed=7)
+    cfg = Config({"uda.tpu.stats.enable": True,
+                  "uda.tpu.ts.interval.s": 0.1})
+    engine = DataEngine(DirIndexResolver(str(tmp_path)), cfg)
+    server = ShuffleServer(engine, cfg, host="127.0.0.1", port=0)
+    server.start()
+    yield expected, server
+    server.stop()
+    engine.stop()
+
+
+def test_windowed_poll_returns_sections_plain_poll_does_not(obs_supplier):
+    _, server = obs_supplier
+    snap = fetch_remote_stats("127.0.0.1", server.port, window_s=30)
+    assert snap["timeseries"]["window"] > 0
+    assert isinstance(snap["timeseries"]["rollups"], list)
+    assert "armed" in snap["sli"]
+    assert "active" in snap["anomalies"]
+    # an old-style poll (no tail) gets the PR 11 snapshot unchanged —
+    # pre-observability pollers pay nothing for the new sections
+    plain = fetch_remote_stats("127.0.0.1", server.port)
+    assert "counters" in plain
+    assert "timeseries" not in plain
+    assert "sli" not in plain
+
+
+def test_raw_old_peer_empty_stats_payload_still_served(obs_supplier):
+    """A pre-CAP_OBS peer hand-rolling the empty MSG_STATS frame (the
+    PR 11 wire shape) must keep working against a new server."""
+    _, server = obs_supplier
+    sock = socket.create_connection(("127.0.0.1", server.port),
+                                    timeout=10.0)
+    try:
+        sock.settimeout(10.0)
+        msg_type, _, payload = wire.recv_frame(sock)
+        assert msg_type == wire.MSG_HELLO
+        _, _, caps = wire.decode_hello_ex(payload)
+        assert caps & wire.CAP_OBS  # the server advertises it...
+        sock.sendall(wire.encode_frame(wire.MSG_STATS, 3, b""))
+        msg_type, req_id, payload = wire.recv_frame(sock)
+        assert (msg_type, req_id) == (wire.MSG_STATS_REPLY, 3)
+        snap = wire.decode_stats_reply(payload)
+        assert "counters" in snap and "timeseries" not in snap
+    finally:
+        wire.close_hard(sock)
+
+
+def test_malformed_stats_tail_is_torn_frame(obs_supplier):
+    """A wrong-length tail is indistinguishable from corruption — the
+    length-IS-the-version discipline tears the connection down, exactly
+    like the trace tail."""
+    _, server = obs_supplier
+    sock = socket.create_connection(("127.0.0.1", server.port),
+                                    timeout=10.0)
+    try:
+        sock.settimeout(10.0)
+        assert wire.recv_frame(sock)[0] == wire.MSG_HELLO
+        sock.sendall(wire.encode_frame(wire.MSG_STATS, 4, b"\x00" * 3))
+        assert wire.recv_frame(sock) is None  # peer hung up
+    finally:
+        wire.close_hard(sock)
+
+
+def test_udafleet_once_merges_live_daemon(obs_supplier):
+    """The fleet console end to end: one --once --json merge over a
+    live daemon plus one dead endpoint — the dead one renders 'down',
+    the live one 'ok', and the document carries the fleet sections."""
+    _, server = obs_supplier
+    dead_port = server.port + 1 if server.port < 65000 else server.port - 1
+    out = subprocess.run(
+        [sys.executable, f"{REPO}/scripts/udafleet.py",
+         f"127.0.0.1:{server.port}", f"127.0.0.1:{dead_port}",
+         "--once", "--json", "--window", "30", "--timeout", "5"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    fleet = json.loads(out.stdout.strip().splitlines()[-1])
+    assert fleet["daemons"][f"127.0.0.1:{server.port}"] == "ok"
+    assert fleet["daemons"][f"127.0.0.1:{dead_port}"] == "down"
+    assert "throughput" in fleet and "tenants" in fleet
+    assert isinstance(fleet["anomalies"], list)
+
+
+# -- the anomaly chaos rung ---------------------------------------------------
+
+
+@pytest.mark.faults
+def test_anomaly_rung_slow_supplier_dumps_before_any_fallback(tmp_path):
+    """The chaos-rung acceptance (scripts/run_chaos.sh anomaly rung):
+    a slow-supplier degradation — DELAYS, not errors, so every fetch
+    still completes — must fire the p99-inflation detector on the live
+    fetch path and leave exactly one proactive black-box dump
+    (cause=anomaly) while ``fallback.signals`` is still ZERO. That is
+    the recorder's reason to exist: the minutes before a failure are on
+    disk even though nothing has failed yet."""
+    metrics.enable_stats()  # the rung runs UDA_TPU_STATS=1; tier-1
+    # needs the histograms on explicitly for the p99 feed to exist
+    mof = tmp_path / "mof"
+    mof.mkdir()
+    make_mof_tree(str(mof), JOB, num_maps=2, num_reducers=1,
+                  records_per_map=30, seed=17)
+    engine = DataEngine(DirIndexResolver(str(mof)), Config())
+    client = LocalFetchClient(engine)
+    # the detectors judge the GLOBAL metrics hub the real fetch path
+    # writes into; collapse floor parked sky-high so this rung is
+    # deterministic on the latency detector alone (the rung's ambient
+    # seeded schedule may be delaying the baseline rounds too)
+    ts = TimeSeries(interval_s=0.05, window=64)
+    eng = AnomalyEngine()
+    assert eng.arm_from_config(Config({
+        "uda.tpu.anomaly.warmup": 3,
+        "uda.tpu.anomaly.consec": 2,
+        "uda.tpu.anomaly.p99.floor.ms": 50.0,
+        "uda.tpu.anomaly.collapse.floor.mb_s": 1e9,
+        "uda.tpu.anomaly.dump": True,
+        "uda.tpu.anomaly.dump.interval.s": 3600.0}), ts)
+    # dumps land where the rung archives them (UDA_TPU_FLIGHTREC_DIR)
+    # or in the test's own dir; count only NEW anomaly dumps either way
+    frdir = os.environ.get("UDA_TPU_FLIGHTREC_DIR") or str(tmp_path / "fr")
+    saved_dir = flightrec._dump_dir
+    flightrec._dump_dir = frdir
+
+    def anomaly_dumps():
+        import glob as _glob
+        return set(_glob.glob(os.path.join(frdir,
+                                           "flightrec_*_anomaly.json")))
+
+    before = anomaly_dumps()
+
+    def fetch_round():
+        mm = MergeManager(client, "uda.tpu.RawBytes", Config())
+        got = mm.run(JOB, map_ids(JOB, 2), 0, lambda b: None)
+        assert got > 0
+        ts.sample()  # one rollup interval per round -> detector feed
+
+    try:
+        for _ in range(4):      # healthy baseline past warmup=3
+            fetch_round()
+        assert eng.fired == 0
+        # the slow supplier: every pread held 150 ms — far over the
+        # 50 ms absolute floor and any ambient-chaos baseline jitter,
+        # yet every fetch still SUCCEEDS
+        with failpoints.scoped("data_engine.pread=delay:150"):
+            for _ in range(3):  # consec=2 -> fires inside this window
+                fetch_round()
+        assert eng.fired >= 1
+        assert any(a["kind"] == "p99" for a in eng.active())
+        # proactive: the black box hit disk while nothing had failed
+        assert metrics.get("fallback.signals") == 0
+        new = anomaly_dumps() - before
+        assert len(new) == 1, sorted(new)
+        doc = json.loads(open(new.pop()).read())
+        assert doc["cause"] == "anomaly"
+        assert doc["extra"]["anomaly"]["kind"] == "p99"
+        assert any(e.get("kind") == "anomaly" for e in doc["events"])
+    finally:
+        flightrec._dump_dir = saved_dir
+        ts.reset()
+        engine.stop()

@@ -197,6 +197,10 @@ class UdaBridge:
             interval_s=self.cfg.get("uda.tpu.stats.interval.ms") / 1e3,
             out=reporter_output_from_env(
                 str(self.cfg.get("uda.tpu.stats.jsonl", default="")))).start()
+        # the live telemetry plane rides the same opt-in: rollup ring,
+        # anomaly detectors, SLO book, optional OpenMetrics endpoint
+        from uda_tpu.utils.timeseries import arm_observability_plane
+        arm_observability_plane(self.cfg)
 
     def _fresh_cfg(self) -> Config:
         """Config rebuilt from the start-time argv + conf up-call. Each

@@ -46,6 +46,7 @@ from uda_tpu.utils.errors import (FallbackSignal, MergeError, StorageError,
 from uda_tpu.utils.failpoints import failpoints
 from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.locks import TrackedLock
+from uda_tpu.tenant import current_tenant
 from uda_tpu.utils.ifile import RecordBatch
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
@@ -95,7 +96,11 @@ class PenaltyBox:
             if n < self.threshold:
                 return False
             self._until[key] = time.monotonic() + self.penalty_s
-        metrics.add("fetch.penalties", supplier=key)
+        tenant = current_tenant()
+        if tenant:
+            metrics.add("fetch.penalties", supplier=key, tenant=tenant)
+        else:
+            metrics.add("fetch.penalties", supplier=key)
         return True
 
     def forgive(self, key: str) -> None:

@@ -585,7 +585,12 @@ class Segment:
             if epoch not in (self._epoch, spec_epoch) \
                     or self._epoch_settled:
                 return  # the attempt completed first
-        metrics.add("fetch.timeouts", supplier=self.supplier)
+        tenant = current_tenant()
+        if tenant:
+            metrics.add("fetch.timeouts", supplier=self.supplier,
+                        tenant=tenant)
+        else:
+            metrics.add("fetch.timeouts", supplier=self.supplier)
         self._on_complete(TransportError(
             f"fetch of {self.map_id} attempt timed out after "
             f"{self.policy.attempt_timeout_ms:g} ms"), epoch)
@@ -859,7 +864,12 @@ class Segment:
                 else:
                     log.warn(f"fetch of {self.map_id} failed ({result}); "
                              f"retrying ({self._retries_left} left)")
-                metrics.add("fetch.retries", supplier=self.supplier)
+                tenant = current_tenant()
+                if tenant:
+                    metrics.add("fetch.retries", supplier=self.supplier,
+                                tenant=tenant)
+                else:
+                    metrics.add("fetch.retries", supplier=self.supplier)
                 flightrec.record("segment.retry", map=self.map_id,
                                  supplier=self.supplier,
                                  error=type(result).__name__,
@@ -967,10 +977,17 @@ class Segment:
             metrics.add("fetch.bytes", len(res.data),
                         supplier=self.supplier)
             metrics.add("fetch.chunks", supplier=self.supplier)
-        metrics.observe("fetch.latency_ms",
-                        (time.perf_counter() - issue_t0) * 1e3,
-                        supplier=self.supplier)
-        metrics.observe("fetch.chunk.bytes", len(res.data))
+        if tenant:
+            metrics.observe("fetch.latency_ms",
+                            (time.perf_counter() - issue_t0) * 1e3,
+                            supplier=self.supplier, tenant=tenant)
+            metrics.observe("fetch.chunk.bytes", len(res.data),
+                            tenant=tenant)
+        else:
+            metrics.observe("fetch.latency_ms",
+                            (time.perf_counter() - issue_t0) * 1e3,
+                            supplier=self.supplier)
+            metrics.observe("fetch.chunk.bytes", len(res.data))
         return last
 
     def _try_recover(self, cause: Exception) -> bool:

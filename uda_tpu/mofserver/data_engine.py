@@ -879,8 +879,13 @@ class DataEngine:
         metrics.gauge_add("supplier.reads.on_air", -1)
         metrics.gauge_add("io.batch.inflight", -1)
         if observe:
-            metrics.observe("supplier.read.latency_ms",
-                            (time.perf_counter() - t0) * 1e3)
+            if e.req.tenant:
+                metrics.observe("supplier.read.latency_ms",
+                                (time.perf_counter() - t0) * 1e3,
+                                tenant=e.req.tenant)
+            else:
+                metrics.observe("supplier.read.latency_ms",
+                                (time.perf_counter() - t0) * 1e3)
 
     def _serve_batch(self, entries: List[_BatchEntry]) -> None:
         """Worker-side body of submit_batch, on ONE pool thread for
@@ -1158,8 +1163,13 @@ class DataEngine:
             if admitted and not sliced:
                 self._unadmit(admitted, req.tenant)
             metrics.gauge_add("supplier.reads.on_air", -1)
-            metrics.observe("supplier.read.latency_ms",
-                            (time.perf_counter() - t0) * 1e3)
+            if req.tenant:
+                metrics.observe("supplier.read.latency_ms",
+                                (time.perf_counter() - t0) * 1e3,
+                                tenant=req.tenant)
+            else:
+                metrics.observe("supplier.read.latency_ms",
+                                (time.perf_counter() - t0) * 1e3)
 
     def _plan_inner(self, req: ShuffleRequest, admitted: int) -> FdSlice:
         rec = self.resolver.resolve(req.job_id, req.map_id, req.reduce_id)
@@ -1235,8 +1245,13 @@ class DataEngine:
             if admitted:
                 self._unadmit(admitted, req.tenant)
             metrics.gauge_add("supplier.reads.on_air", -1)
-            metrics.observe("supplier.read.latency_ms",
-                            (time.perf_counter() - t0) * 1e3)
+            if req.tenant:
+                metrics.observe("supplier.read.latency_ms",
+                                (time.perf_counter() - t0) * 1e3,
+                                tenant=req.tenant)
+            else:
+                metrics.observe("supplier.read.latency_ms",
+                                (time.perf_counter() - t0) * 1e3)
 
     def _serve_inner(self, req: ShuffleRequest) -> FetchResult:
         with metrics.timer("supplier_read"):

@@ -762,13 +762,19 @@ class EvLoopFetchClient(InputClient):
         result = box[0]
         return None if isinstance(result, Exception) else result
 
-    def fetch_stats(self, timeout: float = _SIZE_PROBE_TIMEOUT_S
-                    ) -> Optional[dict]:
+    def fetch_stats(self, timeout: float = _SIZE_PROBE_TIMEOUT_S,
+                    window_s: Optional[int] = None) -> Optional[dict]:
         """Snapshot the supplier's live introspection record over the
         multiplexed connection (MSG_STATS — uncredited on the server,
         so it answers even when data holds every credit). Best effort:
         transport trouble, a typed ERR (old peer), or a timeout
-        returns None."""
+        returns None.
+
+        ``window_s`` additionally requests the observability sections
+        (rollup window, per-tenant SLIs, active anomalies) — sent only
+        when the peer's HELLO advertised :data:`wire.CAP_OBS` (an old
+        decoder would tear on the tail); against an older peer the
+        plain snapshot is returned instead."""
         try:
             conn = self._ensure_connected()
         except TransportError:
@@ -789,7 +795,11 @@ class EvLoopFetchClient(InputClient):
             req_id = self._next_id
             self._pending[req_id] = _Waiter(on_stats, span,
                                             time.perf_counter())
-        self._post(conn, wire.encode_stats_request(req_id))
+        if window_s is not None and self._peer_caps & wire.CAP_OBS:
+            frame = wire.encode_stats_request(req_id, window_s=window_s)
+        else:
+            frame = wire.encode_stats_request(req_id)
+        self._post(conn, frame)
         if not got.wait(timeout=timeout):
             with self._lock:
                 self._pending.pop(req_id, None)
@@ -827,15 +837,22 @@ RemoteFetchClient = EvLoopFetchClient
 
 def fetch_remote_stats(host: str, port: Optional[int] = None,
                        timeout: float = 5.0,
-                       config: Optional[Config] = None) -> dict:
+                       config: Optional[Config] = None,
+                       window_s: Optional[int] = None) -> dict:
     """One-shot MSG_STATS poll over a plain blocking socket — the
-    scripts/udatop.py scrape path: no shared loop, no client object,
-    one dial per poll (an introspection console must work against a
-    process whose client plane it is not part of). Consumes the HELLO
-    banner, sends MSG_STATS, returns the decoded snapshot dict.
-    Raises TransportError on dial failure/timeout and re-raises the
-    typed remote error when the peer answers ERR (an old peer's
-    ProtocolError refusal included)."""
+    scripts/udatop.py / udafleet.py scrape path: no shared loop, no
+    client object, one dial per poll (an introspection console must
+    work against a process whose client plane it is not part of).
+    Consumes the HELLO banner, sends MSG_STATS, returns the decoded
+    snapshot dict. Raises TransportError on dial failure/timeout and
+    re-raises the typed remote error when the peer answers ERR (an old
+    peer's ProtocolError refusal included).
+
+    ``window_s`` requests the CAP_OBS observability sections
+    (time-series rollups for the trailing window, per-tenant SLIs,
+    active anomalies). The tail is sent only after the peer's HELLO
+    advertised :data:`wire.CAP_OBS`; an older peer degrades to the
+    plain snapshot — never a torn frame."""
     cfg = config or Config()
     if port is None:
         port = int(cfg.get("uda.tpu.net.port"))
@@ -847,11 +864,14 @@ def fetch_remote_stats(host: str, port: Optional[int] = None,
     try:
         sock.settimeout(timeout)
         wire.tune_socket(sock)
-        try:
-            sock.sendall(wire.encode_stats_request(1))
-        except OSError as e:  # peer died between accept and our send
-            raise TransportError(
-                f"stats poll: send to {host}:{port} failed: {e}") from e
+        sent = window_s is None  # plain polls need no caps knowledge
+        if sent:
+            try:
+                sock.sendall(wire.encode_stats_request(1))
+            except OSError as e:  # peer died between accept and send
+                raise TransportError(
+                    f"stats poll: send to {host}:{port} failed: "
+                    f"{e}") from e
         while True:
             try:
                 frame = wire.recv_frame(sock)
@@ -877,6 +897,26 @@ def fetch_remote_stats(host: str, port: Optional[int] = None,
                     f"on MSG_STATS (pre-observability peer)")
             msg_type, _req_id, payload = frame
             if msg_type == wire.MSG_HELLO:
+                if not sent:
+                    # windowed polls hold the request until the banner
+                    # tells us the peer's capabilities: the _STATS_OPT
+                    # tail would tear an old decoder's framing, so a
+                    # pre-CAP_OBS peer gets the plain request instead
+                    # (degrade to the PR 11 snapshot, never a torn
+                    # frame)
+                    _gen, _warm, caps = wire.decode_hello_ex(payload)
+                    if caps & wire.CAP_OBS:
+                        req = wire.encode_stats_request(
+                            1, window_s=window_s)
+                    else:
+                        req = wire.encode_stats_request(1)
+                    try:
+                        sock.sendall(req)
+                    except OSError as e:
+                        raise TransportError(
+                            f"stats poll: send to {host}:{port} "
+                            f"failed: {e}") from e
+                    sent = True
                 continue  # the banner precedes every reply
             if msg_type == wire.MSG_STATS_REPLY:
                 return wire.decode_stats_reply(payload)

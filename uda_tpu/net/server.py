@@ -438,8 +438,11 @@ class _EvConn:
             # uncredited, the HELLO precedent: an introspection poll
             # must answer even when the data pipeline holds every
             # credit (that contended state is exactly what the poller
-            # wants to see)
-            self._start_stats(req_id)
+            # wants to see). The optional CAP_OBS tail (requested
+            # rollup window + sections) is length-versioned exactly
+            # like the trace context — a wrong-length tail is a torn
+            # frame, an absent one is the PR 11 snapshot shape.
+            self._start_stats(req_id, wire.decode_stats_request(payload))
         elif msg_type == wire.MSG_JOB:
             # the tenant handshake, uncredited like HELLO. Handled
             # INLINE on the loop thread deliberately: TCP ordering is
@@ -966,22 +969,40 @@ class _EvConn:
         self._enqueue(_BufItem([frame], credited=True, t0=t0,
                                tenant=tenant), frame)
 
-    def _start_stats(self, req_id: int) -> None:
+    def _start_stats(self, req_id: int,
+                     opt: Optional[tuple] = None) -> None:
         """MSG_STATS (loop thread): snapshot building walks metrics and
         provider locks — cheap, but off the loop on principle (a
         provider is component code). Uncredited: the reply rides the
-        outbound queue like the HELLO banner."""
-        self.loop.dispatch(self._do_stats, req_id)
+        outbound queue like the HELLO banner. ``opt`` is the decoded
+        CAP_OBS tail (window seconds, section bits) or None for the
+        plain PR 11 poll."""
+        self.loop.dispatch(self._do_stats, req_id, opt)
 
-    def _do_stats(self, req_id: int) -> None:
+    def _do_stats(self, req_id: int, opt: Optional[tuple] = None) -> None:
         """Dispatcher thread: build + encode the introspection
-        snapshot."""
+        snapshot, folding in the observability sections a CAP_OBS
+        poller asked for (time-series window, per-tenant SLI book,
+        active anomalies). Old pollers pay nothing: the sections are
+        built only on request."""
         from uda_tpu.utils.stats import introspection_snapshot
 
         metrics.add("net.stats.requests")
         try:
-            frame = wire.encode_stats_reply(req_id,
-                                            introspection_snapshot())
+            snap = introspection_snapshot()
+            if opt is not None:
+                window_s, sections = opt
+                if sections & wire.STATS_SEC_TS:
+                    from uda_tpu.utils.timeseries import timeseries
+                    snap["timeseries"] = timeseries.wire_block(
+                        seconds=window_s or None)
+                if sections & wire.STATS_SEC_SLI:
+                    from uda_tpu.tenant.sli import sli_book
+                    snap["sli"] = sli_book.snapshot()
+                if sections & wire.STATS_SEC_ANOMALY:
+                    from uda_tpu.utils.anomaly import anomaly_engine
+                    snap["anomalies"] = anomaly_engine.snapshot()
+            frame = wire.encode_stats_reply(req_id, snap)
         except Exception as e:  # noqa: BLE001 - an unencodable snapshot
             # must degrade to a typed ERR, never strand the poller
             log.warn(f"net: stats snapshot failed: {e}")
@@ -1302,6 +1323,7 @@ class EvLoopShuffleServer:
         # in tests working)
         self.batch_reads = bool(getattr(engine, "batch_enabled", False))
         self.batch_max = int(getattr(engine, "batch_max", 256))
+        self._cfg = cfg  # start() arms the live-telemetry plane from it
         self._listener: Optional[socket.socket] = None
         self._loop: Optional[EventLoop] = None
         self._conns: set = set()
@@ -1486,6 +1508,19 @@ class EvLoopShuffleServer:
         from uda_tpu.utils.stats import register_stats_provider
         register_stats_provider("net.server", self._stats_snapshot)
         install_stats_provider()
+        # the live-telemetry plane (ISSUE 17): rollup ring + anomaly
+        # detectors + SLI book + optional OpenMetrics exposition —
+        # armed once per process, gated on the stats plane like the
+        # StatsReporter (arm_observability_plane is idempotent)
+        from uda_tpu.utils.timeseries import arm_observability_plane
+        arm_observability_plane(self._cfg)
+        if self.tenancy and self._sched is not None:
+            # the fairness audit needs the scheduler's granted-byte
+            # view regardless of whether the ring is armed yet — the
+            # book holds state only once rollups flow
+            from uda_tpu.tenant.sli import sli_book
+            sli_book.attach(scheduler=self._sched,
+                            registry=self.registry)
         log.info(f"shuffle server listening on {self.address[0]}:"
                  f"{self.address[1]} (credit/conn={self.credit}, "
                  f"core=evloop, zerocopy={self.zero_copy}, "
@@ -1546,8 +1581,8 @@ class EvLoopShuffleServer:
             # frame on the connection (uncredited — it answers no
             # request); rides _enqueue so the net.frame failpoint can
             # tear it like any other frame
-            caps = wire.CAP_TRACE | (wire.CAP_TENANT if self.tenancy
-                                     else 0)
+            caps = wire.CAP_TRACE | wire.CAP_OBS \
+                | (wire.CAP_TENANT if self.tenancy else 0)
             hello = wire.encode_hello(self.generation, self.warm_restart,
                                       caps=caps)
             conn._enqueue(_BufItem([hello], credited=False,
@@ -1623,6 +1658,9 @@ class EvLoopShuffleServer:
         self._stopping.set()
         from uda_tpu.utils.stats import unregister_stats_provider
         unregister_stats_provider("net.server", self._stats_snapshot)
+        if self.tenancy and self._sched is not None:
+            from uda_tpu.tenant.sli import sli_book
+            sli_book.detach(self._sched)  # only if still ours
         loop = self._loop
         ls, self._listener = self._listener, None
         if ls is not None:

@@ -98,7 +98,9 @@ from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
 __all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
            "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
            "MSG_HELLO", "MSG_STATS", "MSG_STATS_REPLY",
-           "MSG_JOB", "MSG_JOB_OK", "CAP_TRACE", "CAP_TENANT",
+           "MSG_JOB", "MSG_JOB_OK", "CAP_TRACE", "CAP_TENANT", "CAP_OBS",
+           "STATS_SEC_TS", "STATS_SEC_SLI", "STATS_SEC_ANOMALY",
+           "STATS_SEC_ALL", "decode_stats_request",
            "encode_job", "decode_job", "encode_job_ok", "decode_job_ok",
            "encode_request", "decode_request", "decode_request_ex",
            "encode_result",
@@ -171,6 +173,24 @@ CAP_TENANT = 0x04   # peer runs the multi-tenant service plane: it
                     # Clients without a tenant binding ignore it; old
                     # clients never see it (decode_hello masks only
                     # the warm bit)
+CAP_OBS = 0x08      # peer runs the live-telemetry plane (ISSUE 17):
+                    # its MSG_STATS decoder accepts the optional
+                    # trailing window/sections block (the _take_trace
+                    # length-versioning discipline) and its replies can
+                    # carry time-series rollup windows, per-tenant SLI
+                    # blocks and the active-anomaly table. Send the
+                    # tail ONLY to CAP_OBS peers — an older server
+                    # treats trailing bytes as a torn frame
+
+# the optional MSG_STATS request tail: requested rollup-window seconds
+# + a section bitmask. Exactly 0 bytes (the PR 11 shape: plain
+# snapshot) or exactly _STATS_OPT.size bytes may follow the (empty)
+# base payload — the length IS the version.
+_STATS_OPT = struct.Struct("!II")
+STATS_SEC_TS = 0x01       # timeseries: the rollup-ring window
+STATS_SEC_SLI = 0x02      # sli: the per-tenant SLI/SLO book
+STATS_SEC_ANOMALY = 0x04  # anomalies: the active-anomaly table
+STATS_SEC_ALL = STATS_SEC_TS | STATS_SEC_SLI | STATS_SEC_ANOMALY
 
 _FLAG_LAST = 0x01
 _FLAG_CRC = 0x02
@@ -358,11 +378,35 @@ def decode_job_ok(payload) -> int:
     return _JOB_OK.unpack(bytes(payload))[0]
 
 
-def encode_stats_request(req_id: int) -> bytes:
+def encode_stats_request(req_id: int, window_s: Optional[int] = None,
+                         sections: int = STATS_SEC_ALL) -> bytes:
     """MSG_STATS: snapshot a remote process's live telemetry. Empty
     payload; uncredited on the server (the HELLO precedent) so an
-    introspection poll can never be starved by a full data pipeline."""
-    return encode_frame(MSG_STATS, req_id, b"")
+    introspection poll can never be starved by a full data pipeline.
+
+    ``window_s`` asks a :data:`CAP_OBS` peer to append the requested
+    observability ``sections`` (time-series rollups over the trailing
+    ``window_s`` seconds, per-tenant SLI blocks, active anomalies) —
+    the optional tail rides the same exactly-0-or-exactly-N
+    length-versioning as the trace context. Append it ONLY to CAP_OBS
+    peers."""
+    payload = b""
+    if window_s is not None:
+        payload = _STATS_OPT.pack(max(0, int(window_s)) & 0xFFFFFFFF,
+                                  sections & 0xFFFFFFFF)
+    return encode_frame(MSG_STATS, req_id, payload)
+
+
+def decode_stats_request(payload) -> Optional[tuple]:
+    """-> ``(window_s, sections)`` when the CAP_OBS tail is present,
+    None for the PR 11 empty-payload shape. Anything else is a torn
+    frame (the _take_trace discipline)."""
+    if len(payload) == 0:
+        return None
+    if len(payload) == _STATS_OPT.size:
+        return _STATS_OPT.unpack(bytes(payload))
+    raise TransportError(f"malformed STATS frame: {len(payload)} "
+                         f"trailing bytes")
 
 
 def encode_stats_reply(req_id: int, snapshot: dict) -> bytes:

@@ -163,7 +163,7 @@ class CreditScheduler:
                 tq.deficit -= max(1, int(cost))
             self._grant(tenant, cost)
             return True
-        tq.queue.append((item, max(1, int(cost))))
+        tq.queue.append((item, max(1, int(cost)), now))
         metrics.add("tenant.sched.parked")
         return False
 
@@ -262,9 +262,11 @@ class CreditScheduler:
                 self._turn_earned = True
             while tq.queue and tq.deficit >= tq.queue[0][1] \
                     and self._free > 0:
-                item, cost = tq.queue.popleft()
+                item, cost, t_enq = tq.queue.popleft()
                 tq.deficit -= cost
                 self._grant(tenant, cost)
+                metrics.observe("tenant.queue.wait_ms",
+                                (now - t_enq) * 1000.0, tenant=tenant)
                 granted.append(item)
             if tq.queue and tq.deficit >= tq.queue[0][1]:
                 break  # credits ran out mid-turn: the NEXT sweep
@@ -296,9 +298,11 @@ class CreditScheduler:
         # deficit clock cannot bite within one sweep's visit budget
         tenant, tq = min(
             pool, key=lambda x: max(x[1].vfinish, self._vtime))
-        item, cost = tq.queue.popleft()
+        item, cost, t_enq = tq.queue.popleft()
         tq.deficit -= cost
         self._grant(tenant, cost)
+        metrics.observe("tenant.queue.wait_ms",
+                        (now - t_enq) * 1000.0, tenant=tenant)
         granted.append(item)
 
     def _advance(self) -> None:
@@ -350,7 +354,7 @@ class CreditScheduler:
             "grants": self.grants,
             "tenants": {
                 t: {"parked": len(tq.queue),
-                    "parked_cost": sum(c for _, c in tq.queue),
+                    "parked_cost": sum(e[1] for e in tq.queue),
                     "granted_cost": self.granted_cost.get(t, 0),
                     "inflight": self._inflight.get(t, 0),
                     "deficit": round(tq.deficit, 3),

@@ -466,6 +466,76 @@ _FLAG_LIST = [
          "(flightrec_<pid>_<seq>_<cause>.json); empty = "
          "UDA_TPU_FLIGHTREC_DIR env, else dumps stay in-memory only "
          "(FlightRecorder.reports)"),
+    # --- the live telemetry plane (ISSUE 17: rollups / SLO / anomaly) ---
+    Flag("uda.tpu.ts.enable", True, bool,
+         "the in-process time-series rollup ring (utils/timeseries.py):"
+         " one timer folds per-interval counter deltas, gauge levels "
+         "and histogram percentiles into a bounded recent-history ring "
+         "— armed only when the stats plane is on (uda.tpu.stats."
+         "enable / UDA_TPU_STATS=1); false keeps even an armed stats "
+         "plane ring-less"),
+    Flag("uda.tpu.ts.interval.s", 1.0, float,
+         "rollup sampling interval in seconds (the one timer the "
+         "anomaly detectors and the per-tenant SLI book also ride)"),
+    Flag("uda.tpu.ts.window", 120, int,
+         "rollup ring capacity in intervals (oldest roll off); also "
+         "the SLO attainment / fairness-audit window"),
+    Flag("uda.tpu.anomaly.enable", True, bool,
+         "online anomaly detectors over the rollup ring (utils/"
+         "anomaly.py): throughput collapse, p99 inflation, gauge "
+         "leak-slope, tenant starvation — each fires anomaly.* "
+         "counters and flight-recorder events (armed with the ring)"),
+    Flag("uda.tpu.anomaly.dump", False, bool,
+         "proactive flight-recorder dumps on detection (cause="
+         "anomaly, BEFORE anything fails); false = detect-only (the "
+         "default: counters + events, no files). UDA_TPU_ANOMALY_DUMP"
+         "=1 is the env equivalent"),
+    Flag("uda.tpu.anomaly.dump.interval.s", 300.0, float,
+         "minimum seconds between proactive anomaly dumps (a flapping "
+         "detector must not fill a disk)"),
+    Flag("uda.tpu.anomaly.warmup", 5, int,
+         "intervals of baseline history a detector needs before it may "
+         "judge (EWMA warm-up)"),
+    Flag("uda.tpu.anomaly.zscore", 4.0, float,
+         "z-score threshold for the p99-inflation detector"),
+    Flag("uda.tpu.anomaly.consec", 3, int,
+         "consecutive breaching intervals before an anomaly fires "
+         "(hysteresis against single-interval noise)"),
+    Flag("uda.tpu.anomaly.collapse.frac", 0.25, float,
+         "throughput-collapse threshold: per-interval rate below this "
+         "fraction of its EWMA while the plane was moving"),
+    Flag("uda.tpu.anomaly.collapse.floor.mb_s", 1.0, float,
+         "absolute guard for the collapse detector: the EWMA rate in "
+         "MB/s a counter must sustain before a collapse is judgeable "
+         "(an idle process is not an outage)"),
+    Flag("uda.tpu.anomaly.p99.floor.ms", 50.0, float,
+         "absolute guard for the p99-inflation detector: interval p99 "
+         "below this never alarms regardless of z-score"),
+    Flag("uda.tpu.anomaly.leak.gauges", "fetch.on_air", str,
+         "comma-separated gauges watched by the leak-slope detector "
+         "(monotone rise across the whole window = leak shape)"),
+    Flag("uda.tpu.anomaly.leak.rise", 64.0, float,
+         "minimum whole-window rise of a watched gauge before the "
+         "leak-slope detector fires"),
+    Flag("uda.tpu.anomaly.starve.s", 5.0, float,
+         "continuous seconds a tenant may sit with backlog and zero "
+         "scheduled bytes before the starvation detector fires"),
+    Flag("uda.tpu.slo.fetch.p99.ms", 0.0, float,
+         "per-tenant SLO target on interval fetch p99 latency in ms "
+         "(0 = SLI tracked, no target/burn accounting)"),
+    Flag("uda.tpu.slo.serve.p99.ms", 0.0, float,
+         "per-tenant SLO target on interval supplier-read p99 latency "
+         "in ms (0 = no target)"),
+    Flag("uda.tpu.slo.share.frac", 0.5, float,
+         "fairness SLO: an interval complies when a tenant with demand "
+         "received at least this fraction of its weight-entitled "
+         "scheduled-byte share (the WDRR audit threshold)"),
+    Flag("uda.tpu.slo.objective", 0.99, float,
+         "the SLO objective (fraction of intervals that must comply); "
+         "burn rate = (1-attainment)/(1-objective)"),
+    Flag("uda.tpu.metrics.http.port", 0, int,
+         "OpenMetrics/Prometheus text exposition port (utils/"
+         "openmetrics.py GET /metrics) for standard scrapers; 0 = off"),
     Flag("uda.tpu.auto.approach.threshold.mb", 2048, int,
          "auto merge-approach crossover: partitions at most this many "
          "MB take the hybrid LPQ/RPQ path (fastest at small/mid scale), "

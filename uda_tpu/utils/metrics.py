@@ -460,10 +460,16 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                             "obligation)"),
     # -- histograms (recorded only while stats are enabled) --------------
     "fetch.latency_ms": ("histogram", "per-chunk fetch latency "
-                                      "[labels: supplier]"),
-    "fetch.chunk.bytes": ("histogram", "fetched chunk sizes"),
+                                      "[labels: supplier, tenant — "
+                                      "tenant stamped when the "
+                                      "process carries an identity]"),
+    "fetch.chunk.bytes": ("histogram", "fetched chunk sizes [labels: "
+                                       "tenant when stamped]"),
     "supplier.read.latency_ms": ("histogram", "DataEngine chunk read+"
-                                              "resolve latency"),
+                                              "resolve latency [labels:"
+                                              " tenant when the "
+                                              "request is tenant-"
+                                              "stamped]"),
     "merge.wait_ms": ("histogram", "how long the merge waited for a "
                                    "run to become mergeable after its "
                                    "segment was fed (queue wait + "
@@ -488,11 +494,40 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                   "write + fsync + prune) — the "
                                   "snapshot-overhead signal perfwatch "
                                   "gates on"),
+    # -- the live telemetry plane (ISSUE 17) -----------------------------
+    "ts.listener.errors": ("counter", "rollup-listener callbacks "
+                                      "(anomaly detectors, SLI book) "
+                                      "that raised — the one timer "
+                                      "keeps ticking for the others"),
+    "anomaly.fired": ("counter", "anomalies fired (inactive->active "
+                                 "edges across every detector; the "
+                                 "per-kind anomaly.<kind> family "
+                                 "carries the labeled breakdown)"),
+    "anomaly.throughput": ("counter", "throughput-collapse detections "
+                                      "[labels: key — the collapsed "
+                                      "counter]"),
+    "anomaly.p99": ("counter", "p99-inflation detections [labels: key "
+                               "— the inflated histogram]"),
+    "anomaly.leak": ("counter", "gauge leak-slope detections [labels: "
+                                "key — the rising gauge]"),
+    "anomaly.starvation": ("counter", "tenant-starvation detections "
+                                      "(the WDRR fairness audit's "
+                                      "alarm) [labels: key — the "
+                                      "starved tenant]"),
+    "anomaly.dumps": ("counter", "proactive flight-recorder dumps "
+                                 "(cause=anomaly, rate-limited by "
+                                 "uda.tpu.anomaly.dump.interval.s)"),
+    "sli.slo.breach": ("counter", "per-interval SLO compliance misses "
+                                  "[labels: tenant, sli]"),
+    "tenant.queue.wait_ms": ("histogram", "parked time of a WDRR-"
+                                          "queued request, enqueue to "
+                                          "grant (the queue-wait SLI) "
+                                          "[labels: tenant]"),
 }
 
 # Dynamically-named families (f-string call sites): the static prefix
 # must be listed here.
-REGISTRY_PREFIXES = ("failpoint.",)
+REGISTRY_PREFIXES = ("failpoint.", "anomaly.")
 
 # The span-name registry: every literal name passed to
 # ``metrics.start_span``/``metrics.span`` must be listed here (udalint

@@ -208,6 +208,19 @@ def _time_accounting_block(m: Optional[Metrics]) -> Optional[Dict]:
         return None
 
 
+def _slo_block() -> Optional[Dict]:
+    """Per-tenant SLO attainment/burn from the armed SLI book, or
+    None — same additive contract as the profile block (the lazy
+    import keeps stats.py free of a tenant-layer dependency for
+    single-tenant runs)."""
+    try:
+        from uda_tpu.tenant.sli import sli_book
+
+        return sli_book.slo_block()
+    except Exception:  # udalint: disable=UDA006 - additive block
+        return None
+
+
 class StatsReporter:
     """Periodic snapshot/delta/rate reporter over a :class:`Metrics`.
 
@@ -331,6 +344,12 @@ class StatsReporter:
                 ta = _time_accounting_block(self.metrics)
                 if ta is not None:
                     record["time_accounting"] = ta
+                # the SLO post-mortem: per-tenant attainment + burn
+                # rate over the run (None when the SLI book never
+                # armed — additive, never a failure)
+                slo = _slo_block()
+                if slo is not None:
+                    record["slo"] = slo
             self._latest = record
             self._write_jsonl(record)
         self._progress_line(record)
