@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""Elastic disaggregated-store bench: the ISSUE 18 acceptance numbers.
+
+Two workloads, each with a correctness gate and a perf figure:
+
+- **spill** — a shuffle writing ~10x the host retention budget through
+  ``MOFWriter(store=...)`` with the spill ladder armed. Gates: the
+  job COMPLETES with the merged output byte-identical to an unspilled
+  reference run, and local retention stays bounded — the post-write
+  floor is the watermark, the mid-write peak is allowed one partition
+  of slack (the write that crosses the line spills synchronously
+  before returning, so the ladder can never owe more than the
+  partition in hand). Throughput (``spill_MBps``) and process maxrss
+  ride along as trend data.
+
+- **join** — a degraded primary supplier (fails the first F attempts
+  per hot map, then serves; deterministic, no dice) against a healthy
+  replica holding the same partitions. Baseline: the reduce grinds
+  through the primary's failures alone, paying F backoffs per hot map.
+  Joined: the replica registers mid-job via
+  ``MergeManager.notify_join`` — in-flight Segments widen, the first
+  retry re-ranks onto the joiner, and the stall collapses. Gates: both
+  variants byte-identical to the clean reference, and (full mode) the
+  join run beats the baseline by >= JOIN_SPEEDUP_GATE.
+
+Usage: python scripts/bench_elastic.py [--quick] [--overbudget 10]
+       [--out BENCH_ELASTIC.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+JOIN_SPEEDUP_GATE = 1.2  # full mode only: quick walls are host noise
+
+
+def _force_cpu() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _maxrss_mb() -> float:
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def _write_shuffle(root, job, num_maps, recs_per_map, val_bytes,
+                   store=None, track=None):
+    import numpy as np
+
+    from uda_tpu.mofserver.writer import MOFWriter
+
+    rng = np.random.default_rng(1812)
+    writer = MOFWriter(root, job, store=store)
+    for m in range(num_maps):
+        recs = sorted((rng.bytes(10), rng.bytes(val_bytes))
+                      for _ in range(recs_per_map))
+        writer.write(f"attempt_{job}_m_{m:06d}_0", [recs])
+        if track is not None:
+            track(store)
+    return writer.map_ids
+
+
+def _merge(root, job, mids, blob_root=None, client_wrap=None):
+    """One single-host merge; returns (bytes, wall_s)."""
+    from uda_tpu.merger import LocalFetchClient, MergeManager
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver, StoreManager
+    from uda_tpu.utils.comparators import get_key_type
+    from uda_tpu.utils.config import Config
+
+    resolver = DirIndexResolver(root)
+    engine = DataEngine(resolver)
+    mgr = None
+    if blob_root is not None:
+        mgr = StoreManager(resolver, blob_root)
+        engine.attach_store(mgr)
+    client = LocalFetchClient(engine)
+    if client_wrap is not None:
+        client = client_wrap(client)
+    mm = MergeManager(client, get_key_type("uda.tpu.RawBytes"), Config())
+    blocks = []
+    t0 = time.monotonic()
+    try:
+        mm.run(job, mids, 0, lambda b: blocks.append(bytes(b)))
+    finally:
+        if mgr is not None:
+            mgr.close()
+        engine.stop()
+    return b"".join(blocks), time.monotonic() - t0
+
+
+def _bench_spill(tmp, num_maps, recs_per_map, val_bytes, overbudget):
+    from uda_tpu.mofserver import DirIndexResolver, StoreManager
+    from uda_tpu.utils.metrics import metrics
+
+    job = "elspill"
+    # reference: same records, NO store, merged once for the oracle
+    ref_root = os.path.join(tmp, "ref")
+    mids = _write_shuffle(ref_root, job, num_maps, recs_per_map,
+                          val_bytes)
+    ref, _ = _merge(ref_root, job, mids)
+    total = sum(
+        os.path.getsize(os.path.join(dirpath, f))
+        for dirpath, _, files in os.walk(ref_root) for f in files
+        if f == "file.out")
+    watermark = max(1, int(total / overbudget))
+    metrics.reset()
+    local = os.path.join(tmp, "spill_local")
+    blob = os.path.join(tmp, "spill_blob")
+    resolver = DirIndexResolver(local)
+    mgr = StoreManager(resolver, blob, watermark_bytes=watermark)
+    peak = {"v": 0}
+
+    def track(store):
+        peak["v"] = max(peak["v"], store.retained_bytes())
+
+    t0 = time.monotonic()
+    _write_shuffle(local, job, num_maps, recs_per_map, val_bytes,
+                   store=mgr, track=track)
+    retained = mgr.retained_bytes()
+    migrations = len(mgr.migrations())
+    spilled = metrics.get("store.spilled.bytes") or 0.0
+    mgr.close()
+    out, merge_wall = _merge(local, job, mids, blob_root=blob)
+    wall = time.monotonic() - t0
+    # the mid-write peak may exceed the floor by at most the partition
+    # being written (it spills synchronously before write() returns)
+    slack = 2 * total / num_maps
+    return {
+        "total_mb": round(total / 1048576, 3),
+        "watermark_mb": round(watermark / 1048576, 3),
+        "overbudget_x": overbudget,
+        "spill_migrations": migrations,
+        "spilled_mb": round(spilled / 1048576, 3),
+        "peak_retained_mb": round(peak["v"] / 1048576, 3),
+        "final_retained_mb": round(retained / 1048576, 3),
+        "retained_bounded": bool(retained <= watermark
+                                 and peak["v"] <= watermark + slack),
+        "spill_identical": bool(out == ref and len(ref) > 0),
+        "spill_wall_s": round(wall, 3),
+        "spill_merge_s": round(merge_wall, 3),
+        "spill_MBps": round(total / 1048576 / wall, 1),
+        "maxrss_mb": round(_maxrss_mb(), 1),
+    }
+
+
+class _DegradedClient:
+    """Fails the first ``fail_first`` attempts per hot map with a
+    typed StorageError, then serves — a deterministic brown-out."""
+
+    def __init__(self, inner, hot, fail_first):
+        self.inner = inner
+        self.hot = set(hot)
+        self.fail_first = fail_first
+        self._attempts = {}
+        self._lock = threading.Lock()
+
+    def start_fetch(self, req, cb):
+        from uda_tpu.utils.errors import StorageError
+
+        if req.map_id in self.hot:
+            with self._lock:
+                n = self._attempts.get(req.map_id, 0)
+                self._attempts[req.map_id] = n + 1
+            if n < self.fail_first:
+                cb(StorageError(
+                    f"degraded supplier: {req.map_id} attempt {n}"))
+                return
+        self.inner.start_fetch(req, cb)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _bench_join(tmp, num_maps, recs_per_map, val_bytes, quick):
+    from uda_tpu.merger import (HostRoutingClient, LocalFetchClient,
+                                MergeManager)
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.utils.comparators import get_key_type
+    from uda_tpu.utils.config import Config
+    from uda_tpu.utils.metrics import metrics
+
+    job = "eljoin"
+    root = os.path.join(tmp, "join_root")
+    mids = _write_shuffle(root, job, num_maps, recs_per_map, val_bytes)
+    ref, _ = _merge(root, job, mids)
+    hot = mids[:: max(1, num_maps // 4)]  # every 4th map browns out
+    fail_first = 4 if quick else 6
+    backoff_ms = 60.0 if quick else 120.0
+    cfg = Config({"uda.tpu.fetch.retries": fail_first + 6,
+                  "mapred.rdma.fetch.retry.backoff.ms": backoff_ms,
+                  "mapred.rdma.fetch.retry.backoff.max.ms":
+                      backoff_ms * 2})
+    kt = get_key_type("uda.tpu.RawBytes")
+
+    def run(join_at_s):
+        metrics.reset()
+        engines = {"A": DataEngine(DirIndexResolver(root)),
+                   "B": DataEngine(DirIndexResolver(root))}
+
+        def connect(host):
+            inner = LocalFetchClient(engines[host])
+            if host == "A":
+                return _DegradedClient(inner, hot, fail_first)
+            return inner
+
+        router = HostRoutingClient(connect=connect)
+        mm = MergeManager(router, kt, cfg)
+        joiner = None
+        if join_at_s is not None:
+            joiner = threading.Timer(join_at_s,
+                                     lambda: mm.notify_join("B"))
+            joiner.daemon = True
+            joiner.start()
+        blocks = []
+        t0 = time.monotonic()
+        try:
+            mm.run(job, [("A", m) for m in mids], 0,
+                   lambda b: blocks.append(bytes(b)))
+            wall = time.monotonic() - t0
+        finally:
+            if joiner is not None:
+                joiner.cancel()
+            mm.stop()
+            for e in engines.values():
+                e.stop()
+        joins = metrics.get("elastic.joins") or 0.0
+        return b"".join(blocks), wall, joins
+
+    out_nojoin, wall_nojoin, _ = run(None)
+    out_join, wall_join, joins = run(0.1)
+    speedup = wall_nojoin / wall_join if wall_join > 0 else 0.0
+    return {
+        "join_hot_maps": len(hot),
+        "join_fail_first": fail_first,
+        "join_backoff_ms": backoff_ms,
+        "join_identical": bool(out_nojoin == ref == out_join
+                               and len(ref) > 0),
+        "join_registered": bool(joins > 0),
+        "wall_nojoin_s": round(wall_nojoin, 3),
+        "wall_join_s": round(wall_join, 3),
+        "join_speedup": round(speedup, 3),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--maps", type=int, default=16)
+    ap.add_argument("--recs", type=int, default=400)
+    ap.add_argument("--val-bytes", type=int, default=1024)
+    ap.add_argument("--overbudget", type=float, default=10.0,
+                    help="shuffle bytes / retention watermark")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shape; identity/bounded gates only — "
+                    "walls and speedups are trend data")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    _force_cpu()
+    num_maps = 8 if args.quick else args.maps
+    recs = 60 if args.quick else args.recs
+    val_bytes = 256 if args.quick else args.val_bytes
+    tmp = tempfile.mkdtemp(prefix="uda_elastic_")
+    try:
+        result = {"bench": "elastic", "quick": bool(args.quick),
+                  "maps": num_maps, "recs_per_map": recs,
+                  "val_bytes": val_bytes,
+                  "nproc": os.cpu_count()}
+        result.update(_bench_spill(tmp, num_maps, recs, val_bytes,
+                                   args.overbudget))
+        result.update(_bench_join(tmp, num_maps, recs, val_bytes,
+                                  args.quick))
+        result["join_speedup_ok"] = bool(
+            args.quick or result["join_speedup"] >= JOIN_SPEEDUP_GATE)
+        print(json.dumps(result))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1)
+                f.write("\n")
+        if not (result["spill_identical"] and result["join_identical"]):
+            print("FAIL: elastic bench identity gate", file=sys.stderr)
+            return 3
+        if not result["retained_bounded"]:
+            print("FAIL: spill ladder did not bound local retention",
+                  file=sys.stderr)
+            return 3
+        if not result["join_registered"]:
+            print("FAIL: mid-job join never registered", file=sys.stderr)
+            return 3
+        if not result["join_speedup_ok"]:
+            print(f"FAIL: join speedup {result['join_speedup']} < "
+                  f"{JOIN_SPEEDUP_GATE}", file=sys.stderr)
+            return 2
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

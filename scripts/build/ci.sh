@@ -105,6 +105,18 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python scripts/tenant_bench.py --quick \
   --out "$ART/bench_tenant.json" 2>&1 | tee -a "$ART/ci.log" | tail -4
 
+# Elastic disaggregated-store bench, quick mode: the spill ladder
+# (10x-over-budget shuffle completes byte-identical with local
+# retention bounded at the watermark) plus the mid-job supplier join
+# (a degraded primary's stall collapses when the replica registers) —
+# identity/bounded/registered are the gates (exit 3 on divergence);
+# walls and the join speedup are perfwatch trend data (full runs ride
+# BENCH_ELASTIC_r*.json and gate the >= 1.2x join speedup there).
+echo "-- elastic store spill + mid-job join bench (quick)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/bench_elastic.py --quick \
+  --out "$ART/bench_elastic.json" 2>&1 | tee -a "$ART/ci.log" | tail -2
+
 # Fleet observability gate: one tenanted, observability-armed daemon,
 # 8 equal-weight tenant drivers, scripts/udafleet.py --once --json
 # polled live against it — the CAP_OBS sections must round-trip and
@@ -175,6 +187,8 @@ python scripts/perfwatch.py --check "$ART/bench_io.json" \
 python scripts/perfwatch.py --check "$ART/bench_tenant.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 python scripts/perfwatch.py --check "$ART/exchange_bench.json" \
+  --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
+python scripts/perfwatch.py --check "$ART/bench_elastic.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 
 # CPU-only gates run with the accelerator-pool env stripped: the pool's

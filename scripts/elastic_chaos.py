@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Elastic chaos scenario: seeded blob-kill mid-job + drain-and-join.
+
+The run_chaos.sh elastic rung's dedicated driver (the analogue of the
+completion rung's seeded supplier kill): one reduce job over a
+disaggregated two-tier store while ALL of ISSUE 18's machinery fires
+at once —
+
+- half the partitions are pre-spilled to the blob tier WITH local
+  twins; a seeded ambient ``store.get`` schedule then kills a fraction
+  of blob reads for the whole job, so every kill must fail over to the
+  surviving local tier (``store.failover`` must advance);
+- mid-job a second supplier JOINS (``MergeManager.notify_join`` —
+  in-flight segments widen, retries re-rank onto it);
+- mid-job the primary supplier DRAINS: its remaining retained
+  partitions migrate to the blob tier cutover-style (no twin), so the
+  tail of the job reads them through the degraded blob backend and
+  converges on Segment retries alone.
+
+Contract, enforced by exit code: the merged output is BYTE-IDENTICAL
+to a chaos-free reference, store.failover > 0, the drain moved
+partitions (store.drained.partitions > 0), the join registered, and
+fallback.signals == 0 — the job completed, it never fell back. Runs
+under whatever UDA_TPU_LOCKDEP / UDA_TPU_RESLEDGER the rung arms.
+
+Usage: python scripts/elastic_chaos.py --seed N [--out FILE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+NUM_MAPS = 8
+RECS_PER_MAP = 500
+# per-READ kill probability for the blob tier. Calibrated against the
+# twin-LESS post-drain partitions, whose Segment retries restart from
+# zero: an attempt survives only if every one of its ~26 rounds reads
+# clean, so p must satisfy (1-p)^rounds >> 1/retries — 0.08 gives
+# ~0.11 per attempt, converging well inside the 40-retry budget, while
+# the twinned partitions still draw dozens of inline failovers per run
+KILL_PROB = 0.08
+
+
+def _force_cpu() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _run(seed: int, tmp: str) -> dict:
+    import numpy as np
+
+    from uda_tpu.merger import (HostRoutingClient, LocalFetchClient,
+                                MergeManager)
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver, StoreManager
+    from uda_tpu.mofserver.writer import MOFWriter
+    from uda_tpu.utils.comparators import get_key_type
+    from uda_tpu.utils.config import Config
+    from uda_tpu.utils.errors import FallbackSignal
+    from uda_tpu.utils.failpoints import failpoints
+    from uda_tpu.utils.metrics import metrics
+
+    job = "elchaos"
+    root = os.path.join(tmp, "supplier_a")
+    blob = os.path.join(tmp, "blob")
+    rng = np.random.default_rng(seed)
+    writer = MOFWriter(root, job)
+    for m in range(NUM_MAPS):
+        parts = [sorted((rng.bytes(10), rng.bytes(200))
+                        for _ in range(RECS_PER_MAP))
+                 for _ in range(2)]
+        writer.write(f"attempt_{job}_m_{m:06d}_0", parts)
+    mids = writer.map_ids
+    kt = get_key_type("uda.tpu.RawBytes")
+
+    # small fetch chunks: every partition spans many rounds, so the
+    # join/drain timers land MID-FETCH, not after the phase ended
+    cfg = Config({"uda.tpu.fetch.retries": 40,
+                  "mapred.rdma.buf.size": 4,
+                  "mapred.rdma.fetch.retry.backoff.ms": 10.0,
+                  "mapred.rdma.fetch.retry.backoff.max.ms": 40.0})
+
+    def merge(reduce_id, engines, join_at=None, drain_at=None,
+              drain_mgr=None):
+        router = HostRoutingClient(
+            connect=lambda host: LocalFetchClient(engines[host]))
+        mm = MergeManager(router, kt, cfg)
+        timers = []
+        if join_at is not None:
+            timers.append(threading.Timer(
+                join_at, lambda: mm.notify_join("B")))
+        if drain_at is not None:
+            def drain():
+                # the primary announces departure: routing marks it,
+                # its retained MOFs migrate cutover-style to blob
+                mm.notify_drain("A")
+                drain_mgr.drain(job)
+            timers.append(threading.Timer(drain_at, drain))
+        for t in timers:
+            t.daemon = True
+            t.start()
+        blocks = []
+        try:
+            mm.run(job, [("A", m) for m in mids], reduce_id,
+                   lambda b: blocks.append(bytes(b)))
+            return b"".join(blocks), None
+        except FallbackSignal as e:
+            return b"".join(blocks), e
+        finally:
+            for t in timers:
+                t.cancel()
+            mm.stop()
+
+    # chaos-free reference (no store plumbing at all)
+    refs = {}
+    ref_engine = DataEngine(DirIndexResolver(root), cfg)
+    try:
+        for r in range(2):
+            out, err = merge(r, {"A": ref_engine})
+            assert err is None and out
+            refs[r] = out
+    finally:
+        ref_engine.stop()
+    # two suppliers over the SAME local root + SHARED blob tier; each
+    # engine shares its manager's resolver so a mid-job cutover
+    # (index unlink + invalidate) re-routes its next read
+    mgrs, engines = {}, {}
+    for h in ("A", "B"):
+        resolver = DirIndexResolver(root)
+        mgrs[h] = StoreManager(resolver, blob)
+        engines[h] = DataEngine(resolver, cfg)
+        engines[h].attach_store(mgrs[h])
+    # pre-spill half the partitions WITH twins (the failover targets);
+    # the rest stay on A's retained book — the drain's cargo
+    for mid in mids[: NUM_MAPS // 2]:
+        mgrs["A"].migrate(job, mid, reason="spill", shadow=True)
+    for mid in mids[NUM_MAPS // 2:]:
+        path = os.path.join(root, job, mid, "file.out")
+        mgrs["A"].account_write(job, mid, os.path.getsize(path))
+    mgrs["B"].resolver.invalidate(job)
+    metrics.reset()
+    spec = (f"store.get=error:prob:{KILL_PROB}"
+            f":seed:{seed}:match:blob")
+    outs = {}
+    errs = {}
+    try:
+        with failpoints.scoped(spec):
+            for r in range(2):
+                # reduce 0 sees the join + drain mid-flight; reduce 1
+                # runs entirely in the post-drain world (blob-only
+                # partitions through the degraded backend, converging
+                # on Segment retries alone)
+                outs[r], errs[r] = merge(
+                    r, engines,
+                    join_at=0.05 if r == 0 else None,
+                    drain_at=0.12 if r == 0 else None,
+                    drain_mgr=mgrs["A"])
+                engines["B"].resolver.invalidate(job)
+        result = {
+            "seed": seed,
+            "schedule": spec,
+            "identical": bool(all(outs[r] == refs[r] and not errs[r]
+                                  for r in range(2))),
+            "fallback_signals": int(metrics.get("fallback.signals")
+                                    or 0)
+            + sum(1 for e in errs.values() if e),
+            "store_failover": metrics.get("store.failover") or 0,
+            "store_errors": metrics.get("store.errors") or 0,
+            "drained_partitions": metrics.get(
+                "store.drained.partitions") or 0,
+            "elastic_joins": metrics.get("elastic.joins") or 0,
+            "segment_retries": metrics.get("fetch.retries") or 0,
+        }
+    finally:
+        for h in ("A", "B"):
+            mgrs[h].close()
+            engines[h].stop()
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, required=True)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    _force_cpu()
+    tmp = tempfile.mkdtemp(prefix="uda_elchaos_")
+    try:
+        result = _run(args.seed, tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    ok = (result["identical"]
+          and result["fallback_signals"] == 0
+          and result["store_failover"] > 0
+          and result["drained_partitions"] > 0
+          and result["elastic_joins"] > 0)
+    if not ok:
+        print(f"FAIL: elastic chaos contract broken: {result}",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -256,6 +256,34 @@ def _extract_exchange(run: str, data: Dict, out: List[Dict]) -> None:
                      c.get("dcn_saved_bytes", 0), "up")
 
 
+def _extract_elastic(run: str, data: Dict, out: List[Dict]) -> None:
+    """scripts/bench_elastic.py output (bench "elastic", r18+): spill
+    ladder + mid-job join. The identity/bounded/registered booleans
+    are hard gates (tol 0 — a spilled shuffle that drifts a byte or a
+    ladder that stops bounding retention is a correctness break);
+    spill throughput and the join speedup gate full runs
+    direction-of-change and trend quick runs (shared-host walls)."""
+    quick = bool(data.get("quick"))
+    w = "elastic_quick" if quick else "elastic"
+    for key in ("spill_identical", "join_identical", "retained_bounded",
+                "join_registered"):
+        if key in data:
+            _add(out, run, w, key, 1.0 if data[key] else 0.0, "up",
+                 tol=0.0)
+    if "spill_MBps" in data:
+        _add(out, run, w, "spill_MBps", data["spill_MBps"],
+             "info" if quick else "up")
+    if "join_speedup" in data:
+        _add(out, run, w, "join_speedup", data["join_speedup"],
+             "info" if quick else "up")
+    for key in ("peak_retained_mb", "spilled_mb", "spill_migrations",
+                "maxrss_mb"):
+        if key in data:
+            # structural/trend figures: the retention peak and the
+            # spilled volume on the reference shape
+            _add(out, run, w, key, data[key], "info")
+
+
 def _extract_regression(run: str, data: Dict, out: List[Dict]) -> None:
     w = f"regression_{data.get('size', 'unknown')}"
     for rec in data.get("results", []):
@@ -329,6 +357,8 @@ def extract(run: str, data) -> List[Dict]:
         _extract_exchange(run, data, out)
     elif data.get("bench") == "ckpt_overhead":
         _extract_ckpt(run, data, out)
+    elif data.get("bench") == "elastic":
+        _extract_elastic(run, data, out)
     elif "identity" in data and "speedup_sorted" in data:
         _extract_pipeline(run, data, out)
     elif isinstance(data.get("results"), list):

@@ -29,7 +29,7 @@ COUNTERS="$(mktemp)"
 # its post-mortem defeats the recorder's purpose).
 FRROOT="$(mktemp -d)"
 export FRROOT  # the telemetry merge below reads the dumps from it
-for r in main pressure network exchange completion pipeline iobatch tenant resume anomaly lockdep; do
+for r in main pressure network exchange completion pipeline iobatch tenant resume anomaly elastic lockdep; do
   mkdir -p "${FRROOT}/${r}"
 done
 trap 'rm -f "${COUNTERS}"; rm -rf "${FRROOT}"' EXIT
@@ -264,6 +264,40 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${ASPEC}" UDA_TPU_STATS=1 \
     -k "anomaly" \
     --continue-on-collection-errors "$@" || anrc=$?
 
+# Elastic rung: the disaggregated-store elasticity contract (ISSUE 18)
+# — scripts/elastic_chaos.py drives ONE reduce job through a seeded
+# blob-tier brown-out (store.get=error:prob:...:match:blob) while a
+# second supplier JOINS mid-job and the primary DRAINS mid-job
+# (retained MOFs migrate cutover-style to the blob tier). The driver
+# enforces its own contract by exit code: merged output BYTE-IDENTICAL
+# to a chaos-free reference, store.failover > 0 (every twinned blob
+# kill re-routed to the surviving tier), the drain moved partitions,
+# the join registered, and ZERO FallbackSignals. The faults-marked
+# store tests (tests/test_store.py) run after it under the same
+# armed validators: typed StoreError causes, penalty-boxed re-routing,
+# batch-plane failover, spilled-locator revalidation.
+ELJSON="$(mktemp)"
+ELCOUNTERS="$(mktemp)"
+ELCYCLES="$(mktemp)"
+ELLEAKS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${ACOUNTERS}" "${ELJSON}" "${ELCOUNTERS}" "${ELCYCLES}" "${ELLEAKS}"; rm -rf "${FRROOT}"' EXIT
+echo "elastic rung:        seeded blob-kill + mid-job drain-and-join (seed ${SEED}, UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
+elrc=0
+env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/elastic" \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${ELCYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${ELLEAKS}" \
+    python scripts/elastic_chaos.py --seed "${SEED}" \
+    --out "${ELJSON}" || elrc=$?
+env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/elastic" \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${ELCYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${ELLEAKS}" \
+    UDA_TPU_CHAOS_TELEMETRY="${ELCOUNTERS}" \
+    python -m pytest tests/test_store.py -m faults -q \
+    -p no:cacheprovider \
+    --continue-on-collection-errors "$@" || elrc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -299,7 +333,9 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${TENLEAKS}" \
     "${RESSPEC}" "${RESCOUNTERS}" "${resrc}" "${RESCYCLES}" \
     "${RESLEAKS}" \
-    "${ASPEC}" "${ACOUNTERS}" "${anrc}" <<'EOF' || mrc=$?
+    "${ASPEC}" "${ACOUNTERS}" "${anrc}" \
+    "${ELJSON}" "${ELCOUNTERS}" "${elrc}" "${ELCYCLES}" \
+    "${ELLEAKS}" <<'EOF' || mrc=$?
 import glob, json, os, sys
 sys.path.insert(0, os.getcwd())
 from uda_tpu.utils.critpath import buckets_from_counters
@@ -313,8 +349,9 @@ from uda_tpu.utils.critpath import buckets_from_counters
  iospec, iocounters, iorc, iocycles, ioleaks_path,
  tenspec, tencounters, tenrc, tencycles, tenleaks_path,
  resspec, rescounters, resrc_, rescycles, resleaks_path,
- aspec, acounters, anrc) = \
-    sys.argv[1:47]
+ aspec, acounters, anrc,
+ eljson, elcounters, elrc_, elcycles, elleaks_path) = \
+    sys.argv[1:52]
 frroot = os.environ.get("FRROOT", "")
 def flightrec_block(rung, exit_code):
     """Archive the rung's black-box dumps (cause + structured extra +
@@ -475,6 +512,39 @@ resume["resumed"] = {
     "invalidated": rsc.get("ckpt.invalidated", 0),
     "save_errors": rsc.get("ckpt.save.errors", 0),
 }
+elastic, el_reports = lockdep_block(
+    f"seeded blob-kill + mid-job drain-and-join (seed {seed})",
+    elrc_, elcounters, elcycles)
+el_leaks = resledger_block(elastic, elleaks_path)
+# the elasticity contract, surfaced: the scenario driver's own JSON
+# (byte-identity, failover count, drained partitions, the join — its
+# exit code already enforces all of it) plus the store counters from
+# the faults-marked test pass; the cross-round diffable record
+try:
+    with open(eljson) as f:
+        el_scenario = json.load(f)
+except Exception:
+    el_scenario = {}
+elc = elastic["telemetry"].get("counters", {})
+elastic["scenario"] = el_scenario
+elastic["survived"] = {
+    "scenario_identical": el_scenario.get("identical"),
+    "scenario_failover": el_scenario.get("store_failover", 0),
+    "scenario_drained": el_scenario.get("drained_partitions", 0),
+    "scenario_joins": el_scenario.get("elastic_joins", 0),
+    "scenario_fallbacks": el_scenario.get("fallback_signals", 0),
+    "test_failover": elc.get("store.failover", 0),
+    "test_migrations": elc.get("store.migrations", 0),
+    "test_revalidated": elc.get("store.revalidated", 0),
+}
+# a passing elastic rung whose scenario shows NO failover (the blob
+# kills never re-routed) or ANY fallback means the machinery under
+# test never engaged — fail the tier like the anomaly rung's
+# no-proactive-dump check
+elastic_dead = (not int(elrc_)
+                and (not el_scenario.get("identical")
+                     or not el_scenario.get("store_failover", 0)
+                     or el_scenario.get("fallback_signals", 1)))
 anomaly_telem = load(acounters)
 # the proactive-capture contract, surfaced: detector firings, the
 # rate-limited black-box dumps, and the PROACTIVE guarantee — zero
@@ -493,7 +563,7 @@ anomaly = {"schedule": aspec, "pytest_exit": int(anrc),
                "fallback_signals": acc.get("fallback.signals", 0)}}
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 nleak = (len(n_leaks) + len(c_leaks) + len(pi_leaks) + len(io_leaks)
-         + len(ten_leaks) + len(res_leaks))
+         + len(ten_leaks) + len(res_leaks) + len(el_leaks))
 # flight-recorder archive, one block per rung; a rung that failed
 # without a single black-box dump flags failed_without_dump
 fr = {"main": flightrec_block("main", rc),
@@ -506,6 +576,7 @@ fr = {"main": flightrec_block("main", rc),
       "tenant": flightrec_block("tenant", tenrc),
       "resume": flightrec_block("resume", resrc_),
       "anomaly": flightrec_block("anomaly", anrc),
+      "elastic": flightrec_block("elastic", elrc_),
       "lockdep": flightrec_block("lockdep", lrc)}
 network["flightrec"] = fr["network"]
 exchange["flightrec"] = fr["exchange"]
@@ -515,6 +586,7 @@ iobatch["flightrec"] = fr["iobatch"]
 tenant["flightrec"] = fr["tenant"]
 resume["flightrec"] = fr["resume"]
 anomaly["flightrec"] = fr["anomaly"]
+elastic["flightrec"] = fr["elastic"]
 lockdep["flightrec"] = fr["lockdep"]
 # the anomaly rung's enforced guarantee (the flip side of
 # failed_without_dump): a PASSING anomaly rung that left no proactive
@@ -546,17 +618,19 @@ with open(out, "w") as f:
                "tenant": tenant,
                "resume": resume,
                "anomaly": anomaly,
+               "elastic": elastic,
                "lockdep": lockdep,
                "resledger": {"armed_rungs": ["network", "completion",
                                              "pipeline", "iobatch",
-                                             "tenant", "resume"],
+                                             "tenant", "resume",
+                                             "elastic"],
                              "leaks": nleak},
                "flightrec_missing_postmortem": no_postmortem},
               f, indent=1, sort_keys=True)
     f.write("\n")
 ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
         + len(pi_reports) + len(io_reports) + len(ten_reports)
-        + len(res_reports) + len(l_reports))
+        + len(res_reports) + len(el_reports) + len(l_reports))
 ndumps = sum(b["dumps"] for b in fr.values())
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc}, "
       f"resledger leaks: {nleak}, flightrec dumps: {ndumps})")
@@ -569,12 +643,18 @@ if no_proactive:
           "cause=anomaly dump — the detectors never fired under the "
           "slow-supplier storm, which defeats the rung's purpose",
           file=sys.stderr)
+if elastic_dead:
+    print("ELASTIC: the elastic rung passed but its scenario record "
+          "shows no engaged failover, a byte drift, or a fallback — "
+          "the blob-kill/drain/join machinery never exercised, which "
+          "defeats the rung's purpose", file=sys.stderr)
 # the zero-cycles / zero-leaks / dump-on-failure / proactive-capture
 # guarantees are ENFORCED, not just printed: a detected inversion, a
 # leaked obligation, a failing rung with no post-mortem record, or an
 # anomaly rung with no proactive capture all fail the tier — that is
 # the entire point of lockdep, the ledger and the flight recorder
-sys.exit(3 if (ncyc or nleak or no_postmortem or no_proactive)
+sys.exit(3 if (ncyc or nleak or no_postmortem or no_proactive
+               or elastic_dead)
          else 0)
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
@@ -586,6 +666,7 @@ if [ "${iorc}" -ne 0 ]; then rc="${iorc}"; fi
 if [ "${tenrc}" -ne 0 ]; then rc="${tenrc}"; fi
 if [ "${resrc}" -ne 0 ]; then rc="${resrc}"; fi
 if [ "${anrc}" -ne 0 ]; then rc="${anrc}"; fi
+if [ "${elrc}" -ne 0 ]; then rc="${elrc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP/RESLEDGER/FLIGHTREC: cycle reports, leaked obligations" \

@@ -1,0 +1,758 @@
+"""Elastic disaggregated MOF store (ISSUE 18): backend parity, the
+spill ladder, degraded-backend failover, mid-job join/drain, and the
+checkpoint-resume locator revalidation.
+
+The invariants under test:
+
+- byte parity: a partition reads byte-identical through every backend
+  arrangement (local fd path, blob tier, shadow twins), for plain AND
+  compressed jobs, while never-migrated local partitions keep the
+  zero-copy FdSlice fast path;
+- the spill ladder bounds local retention at the watermark and the
+  spilled shuffle still merges byte-identically;
+- a killed blob backend fails over to the surviving tier with zero
+  fallback signals and typed, structured errors;
+- a mid-job joiner widens in-flight segments and rescues a fetch whose
+  primary keeps failing; a drained supplier's partitions remain
+  fetchable (migrated, not reconstructed);
+- a resumed checkpointed task revalidates spilled locators before
+  trusting them.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_mof_tree, map_ids
+from uda_tpu.merger import (HostRoutingClient, LocalFetchClient,
+                            MergeManager, Segment)
+from uda_tpu.mofserver import (BackendHealth, BlobStore, DataEngine,
+                               DirIndexResolver, LocalFdStore,
+                               ShuffleRequest, StoreManager)
+from uda_tpu.mofserver.store import spill_watermark_bytes
+from uda_tpu.mofserver.writer import MOFWriter
+from uda_tpu.net import RemoteFetchClient, ShuffleServer, wire
+from uda_tpu.utils.config import Config
+from uda_tpu.utils.errors import FallbackSignal, StorageError, StoreError
+from uda_tpu.utils.failpoints import failpoints
+from uda_tpu.utils.ifile import crack
+from uda_tpu.utils.metrics import metrics
+
+from uda_tpu.utils import comparators
+
+KT = comparators.get_key_type("uda.tpu.RawBytes")
+
+
+def _counter(name: str) -> float:
+    return metrics.get(name) or 0.0
+
+
+@pytest.fixture(autouse=True)
+def _quiesce_ambient(request):
+    """Under the chaos tier, these tests craft exact backend states and
+    arm their own scoped failpoints — the rung's ambient schedule must
+    neither fire inside them nor shift phase because of them (the
+    test_checkpoint idiom: the in-process analogue of a subprocess
+    scrubbing UDA_FAILPOINTS from its env)."""
+    if request.node.get_closest_marker("faults"):
+        with failpoints.quiesced():
+            yield
+    else:
+        yield
+
+
+def _fetch_records(engine, job, mids, reduce_id=0):
+    got = []
+    for mid in mids:
+        offset, chunks = 0, []
+        while True:
+            res = engine.fetch(
+                ShuffleRequest(job, mid, reduce_id, offset, 1 << 20))
+            chunks.append(res.data)
+            offset += len(res.data)
+            if res.is_last:
+                break
+        got += list(crack(b"".join(chunks)).iter_records())
+    return sorted(got)
+
+
+def _manager(tmp_path, job, num_maps=3, num_reducers=2, **kw):
+    local = os.path.join(str(tmp_path), "local")
+    blob = os.path.join(str(tmp_path), "blob")
+    expected = make_mof_tree(local, job, num_maps, num_reducers, 40,
+                             seed=11)
+    resolver = DirIndexResolver(local)
+    engine = DataEngine(resolver)
+    mgr = StoreManager(resolver, blob, **kw)
+    engine.attach_store(mgr)
+    return expected, engine, mgr
+
+
+# -- backends ----------------------------------------------------------------
+
+def test_local_store_reads_exact_ranges(tmp_path):
+    p = str(tmp_path / "obj")
+    payload = bytes(range(256)) * 64
+    with open(p, "wb") as f:
+        f.write(payload)
+    store = LocalFdStore()
+    assert store.read(p, 100, 1000) == payload[100:1100]
+    got = store.read_ranges(p, [(0, 16), (4096, 256), (16000, 64)])
+    assert got == [payload[0:16], payload[4096:4352], payload[16000:16064]]
+    with pytest.raises(StoreError) as ei:
+        store.read(p, len(payload) - 10, 100)
+    assert ei.value.cause == "short_read" and ei.value.backend == "local"
+    with pytest.raises(StoreError) as ei:
+        store.read(str(tmp_path / "nope"), 0, 10)
+    assert ei.value.cause == "missing"
+    store.close()
+
+
+def test_blob_store_vectored_parity_and_put_crc(tmp_path):
+    blob = BlobStore(str(tmp_path / "blob"))
+    src = str(tmp_path / "src")
+    rng = np.random.default_rng(3)
+    payload = rng.bytes(3 << 20)  # multi-chunk: exercises streamed copy
+    with open(src, "wb") as f:
+        f.write(payload)
+    dst = os.path.join(blob.root, "j", "m", "file.out")
+    nbytes, crc = blob.put_file(src, dst, key="j/m")
+    assert nbytes == len(payload)
+    assert blob.object_crc(dst) == crc
+    # vectored read parity vs the scalar floor, including adjacent and
+    # gapped ranges in one run
+    ranges = [(0, 100), (100, 50), (8192, 1024), (1 << 20, 4096)]
+    vec = blob.read_ranges(dst, ranges)
+    assert vec == [payload[o:o + n] for o, n in ranges]
+    assert _counter("store.blob.reads") > 0
+    blob.close()
+
+
+def test_spill_watermark_resolution():
+    assert spill_watermark_bytes(
+        Config({"uda.tpu.store.spill.watermark.mb": 8})) == 8 << 20
+    assert spill_watermark_bytes(Config()) == 0  # ladder off by default
+
+    class Budget:
+        host_budget_bytes = 1000
+
+    assert spill_watermark_bytes(
+        Config({"uda.tpu.store.spill.frac": 0.5}), budget=Budget()) == 500
+
+
+def test_from_config_disabled_without_blob_root(tmp_path):
+    resolver = DirIndexResolver(str(tmp_path))
+    assert StoreManager.from_config(resolver, Config()) is None
+    mgr = StoreManager.from_config(
+        resolver, Config({"uda.tpu.store.blob.root":
+                          str(tmp_path / "blob"),
+                          "uda.tpu.store.spill.watermark.mb": 4}))
+    assert mgr is not None and mgr.watermark_bytes == 4 << 20
+    mgr.close()
+
+
+# -- migration parity --------------------------------------------------------
+
+def test_migration_byte_parity_and_zero_copy_preserved(tmp_path):
+    job = "jobP"
+    expected, engine, mgr = _manager(tmp_path, job)
+    mids = map_ids(job, 3)
+    try:
+        base = {r: _fetch_records(engine, job, mids, r) for r in range(2)}
+        assert base == {r: sorted(expected[r]) for r in range(2)}
+        # zero-copy stays engaged for local partitions (cache is warm
+        # after the fetches above)
+        req = ShuffleRequest(job, mids[2], 0, 0, 1 << 20)
+        plan = engine.try_plan(req)
+        assert plan is not None
+        plan.release()
+        mgr.migrate(job, mids[0], reason="spill", shadow=True)
+        mgr.migrate(job, mids[1], reason="spill", shadow=False)
+        for r in range(2):
+            assert _fetch_records(engine, job, mids, r) == base[r]
+        # the blob-managed partition can no longer plan a zero-copy
+        # slice; the untouched local one still can
+        engine.fetch(ShuffleRequest(job, mids[0], 0, 0, 1 << 20))
+        assert engine.try_plan(
+            ShuffleRequest(job, mids[0], 0, 0, 1 << 20)) is None
+        plan = engine.try_plan(req)
+        assert plan is not None
+        plan.release()
+        # the non-shadow migration removed the local bytes entirely
+        assert not os.path.exists(mgr.migrations()[1]["src"])
+        assert _counter("store.migrated.bytes") > 0
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+def test_migration_byte_parity_compressed_end_to_end(tmp_path):
+    """A compressed job merges byte-identically after its partitions
+    migrate to the blob tier (the decompressor never learns which tier
+    served the compressed bytes)."""
+    from uda_tpu.compress import DecompressingClient, get_codec
+
+    codec = get_codec("zlib")
+    job = "jobC"
+    local = os.path.join(str(tmp_path), "local")
+    blob = os.path.join(str(tmp_path), "blob")
+    rng = np.random.default_rng(29)
+    writer = MOFWriter(local, job, codec=codec)
+    for m in range(4):
+        recs = sorted((rng.bytes(8), rng.bytes(64)) for _ in range(80))
+        writer.write(f"attempt_{job}_m_{m:06d}_0", [recs])
+
+    def merge_once():
+        resolver = DirIndexResolver(local)
+        engine = DataEngine(resolver)
+        mgr = StoreManager(resolver, blob)
+        engine.attach_store(mgr)
+        blocks = []
+        mm = MergeManager(DecompressingClient(LocalFetchClient(engine),
+                                              codec), KT, Config())
+        try:
+            mm.run(job, writer.map_ids, 0,
+                   lambda b: blocks.append(bytes(b)))
+        finally:
+            engine.stop()
+        return b"".join(blocks), mgr
+
+    ref, mgr0 = merge_once()
+    mgr0.close()
+    # migrate everything, then the same merge must emit the same bytes
+    resolver = DirIndexResolver(local)
+    mgr = StoreManager(resolver, blob)
+    for mid in writer.map_ids:
+        mgr.migrate(job, mid, reason="spill", shadow=False)
+    mgr.close()
+    out, mgr1 = merge_once()
+    mgr1.close()
+    assert out == ref
+
+
+def test_stripe_locators_survive_migration(tmp_path):
+    """A coded (v2 UDIX) partition's stripe section is preserved
+    byte-for-byte by the index rewrite at the blob root."""
+    from uda_tpu.coding import parse_scheme
+    from uda_tpu.mofserver import read_index_file
+
+    job = "jobV2"
+    local = os.path.join(str(tmp_path), "local")
+    rng = np.random.default_rng(5)
+    writer = MOFWriter(local, job, scheme=parse_scheme("rs:2:3"))
+    recs = sorted((rng.bytes(8), rng.bytes(40)) for _ in range(60))
+    writer.write(f"attempt_{job}_m_000000_0", [recs])
+    mid = writer.map_ids[0]
+    src_idx = os.path.join(local, job, mid, "file.out.index")
+    before = read_index_file(src_idx, "x")
+    resolver = DirIndexResolver(local)
+    mgr = StoreManager(resolver, os.path.join(str(tmp_path), "blob"))
+    entry = mgr.migrate(job, mid, reason="spill", shadow=False)
+    after = read_index_file(entry["dst"] + ".index", entry["dst"])
+    assert [(r.start_offset, r.raw_length, r.part_length)
+            for r in after] == \
+        [(r.start_offset, r.raw_length, r.part_length) for r in before]
+    assert after[0].stripe is not None
+    assert (after[0].stripe.k, after[0].stripe.n) == \
+        (before[0].stripe.k, before[0].stripe.n)
+    assert after[0].stripe.parity == before[0].stripe.parity
+    mgr.close()
+
+
+# -- the spill ladder --------------------------------------------------------
+
+def test_spill_ladder_bounds_retention_and_keeps_parity(tmp_path):
+    job = "jobL"
+    local = os.path.join(str(tmp_path), "local")
+    blob = os.path.join(str(tmp_path), "blob")
+    resolver = DirIndexResolver(local)
+    mgr = StoreManager(resolver, blob, watermark_bytes=16 << 10)
+    writer = MOFWriter(local, job, store=mgr)
+    rng = np.random.default_rng(7)
+    expected = []
+    peak = 0
+    for m in range(12):
+        recs = sorted((rng.bytes(8), rng.bytes(512)) for _ in range(16))
+        writer.write(f"attempt_{job}_m_{m:06d}_0", [recs])
+        peak = max(peak, mgr.retained_bytes())
+        expected += recs
+    # retention never exceeded watermark + one partition (the write
+    # that crosses the line spills synchronously before returning)
+    assert mgr.retained_bytes() <= mgr.watermark_bytes
+    assert peak <= mgr.watermark_bytes + (10 << 10)
+    assert len(mgr.migrations()) > 0
+    assert _counter("store.spilled.bytes") > 0
+    engine = DataEngine(resolver)
+    engine.attach_store(mgr)
+    try:
+        assert _fetch_records(engine, job, writer.map_ids) == \
+            sorted(expected)
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+def test_failed_spill_keeps_partition_servable(tmp_path):
+    """A spill that dies mid-PUT is an optimization failure, never a
+    data loss: the partition stays locally servable and the on-air
+    migration gauge unwinds."""
+    job = "jobFS"
+    expected, engine, mgr = _manager(tmp_path, job, num_maps=1,
+                                     num_reducers=1)
+    mid = map_ids(job, 1)[0]
+    try:
+        with failpoints.scoped("store.put=error"):
+            mgr.account_write(job, mid, 1 << 30)  # far over watermark?
+            # no watermark set -> no spill; drive the ladder directly
+            with pytest.raises(StorageError):
+                mgr.migrate(job, mid, reason="spill")
+        assert metrics.get_gauge("store.migrate.bytes.on_air") == 0
+        assert _fetch_records(engine, job, [mid]) == sorted(expected[0])
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+# -- degraded-backend failover ----------------------------------------------
+
+@pytest.mark.faults
+def test_blob_kill_fails_over_byte_identical(tmp_path):
+    job = "jobFO"
+    expected, engine, mgr = _manager(tmp_path, job)
+    mids = map_ids(job, 3)
+    try:
+        base = {r: _fetch_records(engine, job, mids, r) for r in range(2)}
+        for mid in mids:
+            mgr.migrate(job, mid, reason="spill", shadow=True)
+        f0 = _counter("store.failover")
+        with failpoints.scoped("store.get=error::match:blob"):
+            for r in range(2):
+                assert _fetch_records(engine, job, mids, r) == base[r]
+        assert _counter("store.failover") > f0
+        assert _counter("store.errors") > 0
+        # the typed error carries STRUCTURED cause/backend (UDA005)
+        assert mgr.health.faults("blob") >= 0  # health saw the faults
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+@pytest.mark.faults
+def test_batch_plane_fails_over_per_request(tmp_path):
+    job = "jobFB"
+    expected, engine, mgr = _manager(tmp_path, job, num_reducers=1)
+    mids = map_ids(job, 3)
+    try:
+        for mid in mids:
+            mgr.migrate(job, mid, reason="spill", shadow=True)
+        with failpoints.scoped("store.get=error::match:blob"):
+            futs = engine.submit_batch(
+                [ShuffleRequest(job, m, 0, 0, 1 << 20) for m in mids])
+            datas = [f.result() for f in futs]
+        got = sorted(sum((list(crack(d.data).iter_records())
+                          for d in datas), []))
+        assert got == sorted(expected[0])
+        assert _counter("store.failover") > 0
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+@pytest.mark.faults
+def test_no_twin_surfaces_typed_store_error(tmp_path):
+    job = "jobNT"
+    _, engine, mgr = _manager(tmp_path, job, num_maps=1, num_reducers=1)
+    mid = map_ids(job, 1)[0]
+    try:
+        mgr.migrate(job, mid, reason="spill", shadow=False)  # no twin
+        with failpoints.scoped("store.get=error::match:blob"):
+            with pytest.raises(StoreError) as ei:
+                engine.fetch(ShuffleRequest(job, mid, 0, 0, 1 << 20))
+        assert ei.value.cause == "get" and ei.value.backend == "blob"
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+@pytest.mark.faults
+def test_boxed_backend_reroutes_proactively(tmp_path):
+    job = "jobRR"
+    _, engine, mgr = _manager(tmp_path, job, num_maps=1, num_reducers=1,
+                              health=BackendHealth(threshold=2,
+                                                   penalty_s=30.0))
+    mid = map_ids(job, 1)[0]
+    try:
+        mgr.migrate(job, mid, reason="spill", shadow=True)
+        with failpoints.scoped("store.get=error::match:blob"):
+            engine.fetch(ShuffleRequest(job, mid, 0, 0, 1 << 20))
+            engine.fetch(ShuffleRequest(job, mid, 0, 0, 1 << 20))
+        assert mgr.health.boxed("blob")
+        r0 = _counter("store.rerouted")
+        engine.fetch(ShuffleRequest(job, mid, 0, 0, 1 << 20))
+        assert _counter("store.rerouted") > r0  # twin served FIRST,
+        # without burning an attempt against the boxed tier
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+def test_backend_health_box_and_parole():
+    h = BackendHealth(threshold=2, penalty_s=0.05)
+    assert not h.punish("blob")
+    assert h.punish("blob")  # second fault boxes
+    assert h.boxed("blob")
+    time.sleep(0.08)
+    assert not h.boxed("blob")   # penalty expired -> parole
+    assert h.punish("blob")      # ONE more fault re-boxes
+    h.forgive("blob")
+    h.forgive("blob")
+    assert not h.boxed("blob") and h.faults("blob") == 0
+
+
+@pytest.mark.faults
+def test_store_faults_feed_recovery_ledger(tmp_path):
+    from uda_tpu.merger.merge_manager import PenaltyBox
+    from uda_tpu.merger.recovery import RecoveryLedger
+
+    ledger = RecoveryLedger(PenaltyBox())
+    job = "jobRL"
+    _, engine, mgr = _manager(tmp_path, job, num_maps=1, num_reducers=1,
+                              recovery=ledger)
+    mid = map_ids(job, 1)[0]
+    try:
+        mgr.migrate(job, mid, reason="spill", shadow=True)
+        with failpoints.scoped("store.get=error::match:blob"):
+            engine.fetch(ShuffleRequest(job, mid, 0, 0, 1 << 20))
+        snap = ledger.snapshot()
+        kinds = [e["kind"] for e in snap["events"]]
+        assert "store" in kinds  # the storage rung of the ladder
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+# -- checkpoint-resume locator revalidation ---------------------------------
+
+def test_validate_spilled_detects_damage(tmp_path):
+    job = "jobVS"
+    _, engine, mgr = _manager(tmp_path, job, num_maps=2, num_reducers=1)
+    mids = map_ids(job, 2)
+    try:
+        for mid in mids:
+            mgr.migrate(job, mid, reason="spill", shadow=False)
+        assert mgr.validate_spilled(job) == 2
+        assert _counter("store.revalidated") >= 2
+        # corrupt one spilled object: revalidation must raise TYPED
+        dst = mgr.migrations()[0]["dst"]
+        with open(dst, "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff\xff\xff\xff")
+        with pytest.raises(StoreError) as ei:
+            mgr.validate_spilled(job)
+        assert ei.value.cause == "crc" and ei.value.backend == "blob"
+        os.unlink(dst)
+        with pytest.raises(StoreError) as ei:
+            mgr.validate_spilled(job)
+        assert ei.value.cause == "missing"
+    finally:
+        mgr.close()
+        engine.stop()
+
+
+def test_checkpoint_resume_revalidates_spilled_locators(tmp_path):
+    """The resume interaction: attempt 1 checkpoints and dies; the
+    partitions then SPILL while the task is down; attempt 2 must
+    revalidate the spilled objects' CRCs before trusting the manifest
+    — intact objects resume byte-identically, a damaged one surfaces
+    as a typed failure at resume, not a late segment CRC mismatch."""
+    job = "jobCK"
+    local = os.path.join(str(tmp_path), "mof")
+    blob = os.path.join(str(tmp_path), "blob")
+    make_mof_tree(local, job, 6, 1, 100, seed=5)
+    ckdir = os.path.join(str(tmp_path), "ck")
+
+    def run(fault=None, extra=None, with_store=True):
+        cfg = Config(dict({"uda.tpu.online.streaming": True,
+                           "uda.tpu.ckpt.dir": ckdir,
+                           "uda.tpu.ckpt.interval.s": 0.0},
+                          **(extra or {})))
+        resolver = DirIndexResolver(local)
+        engine = DataEngine(resolver, cfg)
+        mgr = None
+        if with_store:
+            mgr = StoreManager(resolver, blob)
+            engine.attach_store(mgr)
+        mm = MergeManager(LocalFetchClient(engine), KT, cfg)
+        blocks = []
+        try:
+            if fault:
+                with failpoints.scoped(fault):
+                    mm.run(job, map_ids(job, 6), 0,
+                           lambda b: blocks.append(bytes(b)))
+            else:
+                mm.run(job, map_ids(job, 6), 0,
+                       lambda b: blocks.append(bytes(b)))
+            return b"".join(blocks), mgr, None
+        except FallbackSignal as e:
+            return b"".join(blocks), mgr, e
+        finally:
+            if mgr is not None:
+                mgr.close()
+            engine.stop()
+
+    ref, _, err = run(with_store=False)
+    assert err is None and ref
+    import shutil
+    shutil.rmtree(ckdir)
+    # attempt 1 dies mid-fetch, leaving a manifest
+    _, _, err1 = run(fault="segment.fetch=error:match:m_000005",
+                     extra={"uda.tpu.fetch.retries": 0})
+    assert isinstance(err1, FallbackSignal)
+    # partitions spill while the task is down; the next attempt's
+    # StoreManager must re-learn the migrations to revalidate them, so
+    # keep ONE manager across the window (the supplier process's view)
+    resolver = DirIndexResolver(local)
+    spill_mgr = StoreManager(resolver, blob)
+    for mid in map_ids(job, 3):
+        spill_mgr.migrate(job, mid, reason="spill", shadow=False)
+    r0 = _counter("store.revalidated")
+
+    def run_resume(mgr):
+        cfg = Config({"uda.tpu.online.streaming": True,
+                      "uda.tpu.ckpt.dir": ckdir,
+                      "uda.tpu.ckpt.interval.s": 0.0})
+        engine = DataEngine(DirIndexResolver(local), cfg)
+        engine.attach_store(mgr)
+        # share the spill manager's resolver roots (blob appended)
+        engine.resolver.roots = list(mgr.resolver.roots)
+        mm = MergeManager(LocalFetchClient(engine), KT, cfg)
+        blocks = []
+        try:
+            mm.run(job, map_ids(job, 6), 0,
+                   lambda b: blocks.append(bytes(b)))
+            return b"".join(blocks), None
+        except FallbackSignal as e:
+            return b"".join(blocks), e
+        finally:
+            engine.stop()
+
+    out, err2 = run_resume(spill_mgr)
+    assert err2 is None
+    assert out == ref  # byte-identical through the spilled tier
+    assert _counter("store.revalidated") > r0  # resume DID revalidate
+    # damaged spilled object: the NEXT resume must fail typed at load
+    import shutil as _sh
+    _sh.rmtree(ckdir, ignore_errors=True)
+    _, _, err3 = run(fault="segment.fetch=error:match:m_000004",
+                     extra={"uda.tpu.fetch.retries": 0})
+    assert isinstance(err3, FallbackSignal)
+    dst = spill_mgr.migrations()[0]["dst"]
+    with open(dst, "r+b") as f:
+        f.write(b"\x00\x00\x00\x00\x00\x00\x00\x00")
+    out4, err4 = run_resume(spill_mgr)
+    assert err4 is not None
+    assert isinstance(err4.cause, StoreError)
+    assert err4.cause.cause == "crc"
+    spill_mgr.close()
+
+
+# -- elasticity: join + drain ------------------------------------------------
+
+def test_hello_banner_advertises_elastic_and_draining(tmp_path):
+    job = "jobEB"
+    local = os.path.join(str(tmp_path), "local")
+    make_mof_tree(local, job, 1, 1, 10)
+    engine = DataEngine(DirIndexResolver(local))
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    mid = map_ids(job, 1)[0]
+    try:
+        c1 = RemoteFetchClient("127.0.0.1", server.port, Config())
+        done = threading.Event()
+        c1.start_fetch(ShuffleRequest(job, mid, 0, 0, 1 << 20),
+                       lambda res: done.set())
+        assert done.wait(10)
+        assert c1.peer_caps() & wire.CAP_ELASTIC
+        assert not c1.peer_draining()
+        c1.stop()
+        d0 = _counter("elastic.drains")
+        server.announce_drain()
+        server.announce_drain()  # idempotent
+        assert _counter("elastic.drains") == d0 + 1
+        c2 = RemoteFetchClient("127.0.0.1", server.port, Config())
+        done2 = threading.Event()
+        c2.start_fetch(ShuffleRequest(job, mid, 0, 0, 1 << 20),
+                       lambda res: done2.set())
+        assert done2.wait(10)
+        assert c2.peer_caps() & wire.CAP_DRAINING
+        assert c2.peer_draining()
+        c2.stop()
+    finally:
+        server.stop()
+        engine.stop()
+
+
+def test_drained_supplier_partitions_stay_fetchable(tmp_path):
+    """announce_drain migrates the supplier's retained MOFs to the
+    blob tier; fetches AFTER the migration serve the moved bytes
+    (migrated, not reconstructed) with consistent accounting."""
+    job = "jobDR"
+    local = os.path.join(str(tmp_path), "local")
+    blob = os.path.join(str(tmp_path), "blob")
+    resolver = DirIndexResolver(local)
+    mgr = StoreManager(resolver, blob)
+    writer = MOFWriter(local, job, store=mgr)
+    rng = np.random.default_rng(13)
+    expected = []
+    for m in range(3):
+        recs = sorted((rng.bytes(8), rng.bytes(32)) for _ in range(50))
+        writer.write(f"attempt_{job}_m_{m:06d}_0", [recs])
+        expected += recs
+    engine = DataEngine(resolver)
+    engine.attach_store(mgr)
+    server = ShuffleServer(engine, Config(), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        moved = server.announce_drain(store=mgr, job_id=job)
+        assert len(moved) == 3
+        assert all(e["reason"] == "drain" for e in moved)
+        assert _counter("store.drained.partitions") >= 3
+        assert mgr.retained_bytes() == 0
+        # the local bytes are gone; the blob tier serves byte-identical
+        for e in moved:
+            assert not os.path.exists(e["src"])
+            assert os.path.exists(e["dst"])
+        assert _fetch_records(engine, job, writer.map_ids) == \
+            sorted(expected)
+        rec0 = _counter("recovery.reconstructions") \
+            if metrics.get("recovery.reconstructions") else 0
+        assert rec0 == 0  # migrated, NOT reconstructed
+    finally:
+        server.stop()
+        mgr.close()
+        engine.stop()
+
+
+def test_host_routing_membership_and_refresh(tmp_path):
+    class StubClient:
+        def __init__(self):
+            self.stopped = False
+
+        def start_fetch(self, req, cb):
+            cb(StorageError("stub"))
+
+        def resume_ok(self, host=""):
+            return True
+
+        def generation(self, host=""):
+            return None
+
+        def stop(self):
+            self.stopped = True
+
+    made = []
+
+    def connect(host):
+        c = StubClient()
+        made.append((host, c))
+        return c
+
+    router = HostRoutingClient(connect=connect)
+    router._client_for("A")
+    assert len(made) == 1
+    j0 = _counter("elastic.joins")
+    router.notify_join("B")
+    router.notify_join("B")  # idempotent: counted once
+    assert _counter("elastic.joins") == j0 + 1
+    assert router.members() == ["B"]
+    # refresh drops the cached transport so the next fetch re-dials
+    router.refresh("A")
+    assert made[0][1].stopped
+    router._client_for("A")
+    assert len(made) == 2  # A re-dialed; join only refreshes, it
+    # never pre-dials the joiner
+    router.notify_drain("B")
+    assert router.members() == []
+    assert router.is_draining("B")
+    router.stop()
+
+
+def test_segment_add_host_widens_candidates():
+    seg = Segment(None, "j", "m1", 0, 1 << 20, host="A", hosts=["A"])
+    assert seg.add_host("B")
+    assert not seg.add_host("B")      # already known
+    assert not seg.add_host("")       # no empty hosts
+    assert seg.hosts == ["A", "B"]
+    seg._done.set()
+    assert not seg.add_host("C")      # done segments never widen
+
+
+def test_mid_job_join_rescues_failing_fetch(tmp_path):
+    """Integration: the primary supplier is missing one map's output;
+    a supplier holding it JOINS mid-job and the retry ladder's re-rank
+    elects the joiner — the fetch completes without fallback."""
+    job = "jobJN"
+    root_a = os.path.join(str(tmp_path), "A")
+    root_b = os.path.join(str(tmp_path), "B")
+    expected = make_mof_tree(root_a, job, 3, 1, 30, seed=17)
+    # map 2's output lives ONLY on the joiner B: move it over
+    import shutil
+    mid_missing = map_ids(job, 3)[2]
+    os.makedirs(os.path.join(root_b, job), exist_ok=True)
+    shutil.move(os.path.join(root_a, job, mid_missing),
+                os.path.join(root_b, job, mid_missing))
+    engines = {"A": DataEngine(DirIndexResolver(root_a)),
+               "B": DataEngine(DirIndexResolver(root_b))}
+    router = HostRoutingClient(
+        connect=lambda host: LocalFetchClient(engines[host]))
+    cfg = Config({"uda.tpu.fetch.retries": 30,
+                  "mapred.rdma.fetch.retry.backoff.ms": 40.0,
+                  "mapred.rdma.fetch.retry.backoff.max.ms": 80.0})
+    mm = MergeManager(router, KT, cfg)
+    joiner = threading.Timer(0.3, lambda: mm.notify_join("B"))
+    joiner.daemon = True
+    joiner.start()
+    try:
+        entries = [("A", m) for m in map_ids(job, 3)]
+        segs = mm.fetch_all(job, entries, 0)
+        got = sorted(sum((list(b.iter_records())
+                          for s in segs for b in s.batches), []))
+        assert got == sorted(expected[0])
+        rescued = [s for s in segs if s.map_id == mid_missing][0]
+        assert rescued.host == "B"  # the joiner served it
+        assert "B" in rescued.hosts
+        assert _counter("elastic.joins") > 0
+    finally:
+        joiner.cancel()
+        mm.stop()
+        for e in engines.values():
+            e.stop()
+
+
+def test_writer_add_supplier_root_joins_placement():
+    w = MOFWriter("/tmp/x", "j", supplier_roots=["/r/a", "/r/b"],
+                  supplier_index=0)
+    w.add_supplier_root("/r/c", domain="rack2")
+    w.add_supplier_root("/r/c")  # idempotent
+    assert w.supplier_roots == ["/r/a", "/r/b", "/r/c"]
+    assert w.domains["/r/c"] == "rack2"
+    w.add_supplier_root("/r/d", supplier_index=1)
+    assert w.supplier_index == 1
+
+
+def test_merge_manager_notify_drain_records_ledger(tmp_path):
+    engine = DataEngine(DirIndexResolver(str(tmp_path)))
+    router = HostRoutingClient(
+        connect=lambda host: LocalFetchClient(engine))
+    mm = MergeManager(router, KT, Config())
+    try:
+        mm.notify_drain("hostX")
+        assert router.is_draining("hostX")
+        snap = mm.ledger.snapshot()
+        assert "drain" in [e["kind"] for e in snap["events"]]
+    finally:
+        mm.stop()
+        engine.stop()

@@ -168,6 +168,15 @@ DEFAULT_PAIRS: Tuple[ObligationPair, ...] = (
         recv=r".*failpoints.*", transfer=("enter_context",),
         description="scoped failpoint arming must be entered "
                     "(utils/failpoints.py)"),
+    ObligationPair(
+        "store.fd", acquire=("acquire_fd",), release=("release_fd",),
+        description="MOF-store backend handles (mofserver/store.py "
+                    "MOFStore.acquire_fd/release_fd)"),
+    ObligationPair(
+        "gauge.store.migrate", kind="gauge",
+        gauge="store.migrate.bytes.on_air",
+        description="bytes mid-migration between store tiers "
+                    "(mofserver/store.py StoreManager.migrate)"),
 )
 
 

@@ -258,6 +258,46 @@ class MergeManager:
             self._budget_obj = MemoryBudget.from_config(self.cfg)
         return self._budget_obj
 
+    # -- elastic membership (ISSUE 18) --------------------------------------
+
+    def notify_join(self, host: str) -> int:
+        """A supplier joined mid-job: widen every in-flight segment's
+        candidate list so the joiner becomes eligible at the next
+        ledger-ranked decision point (retry re-pick, speculation
+        alternate, reconstruction anchor), and fold the host into the
+        routing client's membership ring (so its transport re-dials and
+        observes the joiner's CAP_ELASTIC banner). Returns the number
+        of segments widened. Already-completed segments and segments
+        that already know the host are untouched — join is advisory,
+        never a re-route of live attempts."""
+        notify = getattr(self.client, "notify_join", None)
+        if callable(notify):
+            notify(host)
+        else:
+            metrics.add("elastic.joins", supplier=host)
+        widened = 0
+        for seg in list(self._live_segments):
+            if seg is not None and seg.add_host(host):
+                widened += 1
+        self.ledger.record("join", supplier=host)
+        flightrec.record("elastic.join", supplier=host,
+                         widened=widened)
+        log.info(f"elastic: supplier {host!r} joined mid-job; "
+                 f"{widened} in-flight segment(s) widened")
+        return widened
+
+    def notify_drain(self, host: str) -> None:
+        """The symmetric departure: demote the host in routing (no new
+        placements; in-flight fetches against it complete normally —
+        its MOFs migrate to the blob tier via StoreManager.drain, so
+        fetch-after-departure resolves there, migrated not
+        reconstructed)."""
+        notify = getattr(self.client, "notify_drain", None)
+        if callable(notify):
+            notify(host)
+        self.ledger.record("drain", supplier=host)
+        flightrec.record("elastic.drain", supplier=host)
+
     # -- fetch phase --------------------------------------------------------
 
     def fetch_all(self, job_id: str, map_ids: Sequence,
@@ -593,6 +633,25 @@ class MergeManager:
             if wd is not None:
                 wd.stop()
                 self._watchdog = None
+
+    def _revalidate_spilled(self, job_id: str) -> None:
+        """Resume-side locator revalidation: reachable only when the
+        transport is in-process (a LocalFetchClient — possibly behind a
+        DecompressingClient — over an engine with an attached
+        StoreManager); remote suppliers run the same check on their own
+        resume path. Raises the store's typed error on damage."""
+        client = self.client
+        inner = getattr(client, "inner", None)
+        if inner is not None:
+            client = inner
+        engine = getattr(client, "engine", None)
+        store_mgr = getattr(engine, "store", None)
+        if store_mgr is None:
+            return
+        n = store_mgr.validate_spilled(job_id)
+        if n:
+            log.info(f"ckpt: revalidated {n} spilled blob object(s) of "
+                     f"job {job_id} before resume")
 
     # -- liveness -----------------------------------------------------------
 
@@ -933,6 +992,13 @@ class MergeManager:
         adopted_records = 0
         self._live_segments = []
         if manifest is not None:
+            # elastic-store interaction (ISSUE 18): partitions may have
+            # SPILLED to the blob tier while this task was down — before
+            # trusting the manifest's run files and offset ledgers,
+            # re-verify every spilled object's CRC so damage surfaces
+            # here as a typed StoreError, not later as a Segment CRC
+            # mismatch blamed on the wire
+            self._revalidate_spilled(job_id)
             adopted, preload, adopted_records = self._resume_from_manifest(
                 manifest, mids, store, om, ckpt)
             # snapshot #0: the loaded manifest was consumed-on-load

@@ -180,6 +180,13 @@ class HostRoutingClient(InputClient):
                          else self._socket_factory(config))
         self._clients: dict[str, InputClient] = {}
         self._stopped = False
+        # elastic membership (ISSUE 18): joiners announced mid-job via
+        # notify_join and leavers via notify_drain. Membership is
+        # ADVISORY routing state — fetches still address whatever host
+        # the entry names; the sets steer candidate ranking and let
+        # MergeManager.notify_join widen in-flight segments.
+        self._members: set[str] = set()
+        self._draining: set[str] = set()
         self._lock = TrackedLock("host_router")
 
     @staticmethod
@@ -264,6 +271,56 @@ class HostRoutingClient(InputClient):
         with self._lock:
             client = self._clients.get(host)
         return None if client is None else client.generation(host)
+
+    # -- elastic membership (ISSUE 18) ---------------------------------------
+
+    def notify_join(self, host: str) -> None:
+        """A supplier registered mid-job (its banner carries
+        CAP_ELASTIC): fold it into the membership ring and refresh any
+        stale cached transport so the next fetch re-dials and observes
+        the joiner's current generation."""
+        with self._lock:
+            already = host in self._members
+            self._members.add(host)
+            self._draining.discard(host)
+        if not already:
+            metrics.add("elastic.joins", supplier=host)
+        self.refresh(host)
+
+    def notify_drain(self, host: str) -> None:
+        """A supplier announced departure (CAP_DRAINING): keep its
+        transport — in-flight fetches complete against it — but mark it
+        so candidate ranking demotes it and no new placement lands
+        there."""
+        with self._lock:
+            self._members.discard(host)
+            self._draining.add(host)
+
+    def refresh(self, host: str) -> None:
+        """Drop the host's cached transport (stopping it) so the next
+        fetch re-dials; a no-op for unconnected hosts. Used after a
+        join/restart to pick up the fresh HELLO banner."""
+        with self._lock:
+            client = self._clients.pop(host, None)
+        if client is not None:
+            client.stop()
+
+    def members(self) -> list[str]:
+        """The advisory elastic membership (joiners announced via
+        notify_join, minus announced leavers), sorted for deterministic
+        placement."""
+        with self._lock:
+            return sorted(self._members)
+
+    def is_draining(self, host: str) -> bool:
+        """Has this host announced drain — either via notify_drain or
+        through a CAP_DRAINING banner its live transport observed?"""
+        with self._lock:
+            if host in self._draining:
+                return True
+            client = self._clients.get(host)
+        probe = getattr(client, "peer_draining", None)
+        return bool(probe(host)) if callable(probe) else False
 
     def estimate_partition_bytes(self, job_id: str, map_ids,
                                  reduce_id: int):
@@ -421,6 +478,21 @@ class Segment:
         """The metric/penalty label of the CURRENT source (host when
         routed per host, else the map id); tracks speculation wins."""
         return self.host or self.map_id
+
+    def add_host(self, host: str) -> bool:
+        """Mid-job joiner pickup (ISSUE 18): widen the candidate list
+        of an IN-FLIGHT segment so the existing ledger-ranked paths —
+        retry re-pick, speculation alternate, reconstruction anchors —
+        can elect the joiner. No attempt is re-routed eagerly; the
+        joiner only matters at the next decision point. Returns True
+        when the host was actually added (unknown and not done)."""
+        if not host:
+            return False
+        with self._lock:
+            if self._done.is_set() or host in self.hosts:
+                return False
+            self.hosts.append(host)
+        return True
 
     def _notify_done(self) -> None:
         span = self.trace_span
@@ -844,7 +916,17 @@ class Segment:
                         self._resume_check = True  # revalidate identity
                     offset = self._next_offset if resume else 0
                     attempt = self.policy.retries - self._retries_left
+                    cands = list(self.hosts)
                 self._notify_fault(result)
+                if retry and not resume and len(cands) > 1 \
+                        and self.ledger is not None:
+                    # restart-from-zero retries re-rank the candidate
+                    # list (which mid-job joiners may have WIDENED via
+                    # add_host): a punished primary falls behind a
+                    # healthy replica or joiner. Resumed retries must
+                    # stay put — the offset ledger is only valid
+                    # against the host that served it.
+                    self.host = self.ledger.rank(cands)[0]
                 if not retry:
                     if deadline_hit:
                         metrics.add("fetch.deadline_exceeded")

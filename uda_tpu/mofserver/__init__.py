@@ -6,7 +6,12 @@ from uda_tpu.mofserver.data_engine import (DataEngine, FdSlice, FetchResult,
 from uda_tpu.mofserver.index import (DirIndexResolver, IndexRecord,
                                      IndexResolver, read_index_file,
                                      write_index_file)
+from uda_tpu.mofserver.store import (BackendHealth, BlobStore, LocalFdStore,
+                                     MOFStore, StoreManager,
+                                     spill_watermark_bytes)
 
 __all__ = ["DataEngine", "FdSlice", "FetchResult", "ShuffleRequest",
            "DirIndexResolver", "IndexRecord", "IndexResolver",
-           "read_index_file", "write_index_file"]
+           "read_index_file", "write_index_file",
+           "BackendHealth", "BlobStore", "LocalFdStore", "MOFStore",
+           "StoreManager", "spill_watermark_bytes"]

@@ -529,6 +529,12 @@ class DataEngine:
         if spec:
             failpoints.arm_spec(spec)
         self.resolver = resolver
+        # the elastic disaggregated store (mofserver/store.py): when
+        # attached, reads of store-MANAGED partitions (blob primaries,
+        # twin-holding locals) route through its failover router;
+        # unmanaged partitions keep the classic fd path untouched —
+        # zero-copy FdSlice serve included
+        self.store = None
         self._pool = ThreadPoolExecutor(max_workers=threads,
                                         thread_name_prefix="uda-data-engine")
         self._fds = _FdCache()
@@ -668,6 +674,20 @@ class DataEngine:
             self._unadmit(want, req.tenant)
             metrics.gauge_add("supplier.reads.on_air", -1)
             raise
+
+    def attach_store(self, store) -> None:
+        """Attach a :class:`~uda_tpu.mofserver.store.StoreManager`:
+        the engine consults ``store.manages(path)`` per resolved record
+        and routes managed reads through the store's failover router
+        (``read``/``read_ranges``). Byte semantics are identical —
+        short-read checks, CRC stamping and the ``data_engine.pread``
+        failpoint all run on the routed bytes exactly as on the fd
+        path."""
+        self.store = store
+
+    def _store_managed(self, rec) -> bool:
+        store = self.store
+        return store is not None and store.manages(rec.path)
 
     def set_tenant_registry(self, registry) -> None:
         """Attach the multi-tenant registry: tenant-stamped requests
@@ -950,6 +970,9 @@ class DataEngine:
         for e in live:
             by_path.setdefault(e.rec.path, []).append(e)
         for path, group in by_path.items():
+            if self.store is not None and self.store.manages(path):
+                self._read_batch_store(path, group)
+                continue
             try:
                 fd = self._fds.acquire(path)
             except OSError as exc:
@@ -980,6 +1003,22 @@ class DataEngine:
                                 f"{exc}")
             finally:
                 self._fds.release(path)
+
+    def _read_batch_store(self, path: str,
+                          group: List[_BatchEntry]) -> None:
+        """One store-managed path group of a batch: the router's
+        vectored read (the blob tier rides the same ``plan_coalesced``
+        planner), per-request error isolation preserved — a failed
+        range fails ONE future, its batch-mates complete untouched."""
+        results = self.store.read_ranges(
+            path, [(e.file_off, e.want) for e in group],
+            keys=[f"{e.req.map_id}/{e.req.reduce_id}" for e in group])
+        for e, res in zip(group, results):
+            if isinstance(res, Exception):
+                e.err = res
+            else:
+                e.buf = bytearray(res)
+                e.got = len(res)
 
     def _read_run_preadv(self, fd: int, run: List[tuple]) -> None:
         """One coalesced run -> one vectored read: per-request
@@ -1033,6 +1072,12 @@ class DataEngine:
         jobs: List[tuple] = []
         try:
             for path, group in by_path.items():
+                if self.store is not None and self.store.manages(path):
+                    # store-managed groups keep the router semantics
+                    # (failpoints, health, failover) on every backend
+                    # rung — the ring never bypasses the store
+                    self._read_batch_store(path, group)
+                    continue
                 try:
                     # released by the pinned-list sweep in THIS
                     # function's finally (list-mediated hand-off the
@@ -1126,7 +1171,10 @@ class DataEngine:
         if resolve_cached is None:
             return None
         rec = resolve_cached(req.job_id, req.map_id, req.reduce_id)
-        if rec is None:
+        if rec is None or self._store_managed(rec):
+            # store-managed partitions (blob tier / failover twins)
+            # need the router's health/failover logic — no zero-copy
+            # slice can express a mid-read tier switch
             return None
         want_admit = req.chunk_size or self.chunk_size_default
         self._admit_bytes(want_admit, req.tenant)
@@ -1156,8 +1204,9 @@ class DataEngine:
                                  reduce=req.reduce_id, offset=req.offset):
                 if self._slice_eligible():
                     plan = self._plan_inner(req, admitted)
-                    sliced = True
-                    return plan
+                    if plan is not None:
+                        sliced = True
+                        return plan
                 return self._serve_inner(req)
         finally:
             if admitted and not sliced:
@@ -1171,8 +1220,11 @@ class DataEngine:
                 metrics.observe("supplier.read.latency_ms",
                                 (time.perf_counter() - t0) * 1e3)
 
-    def _plan_inner(self, req: ShuffleRequest, admitted: int) -> FdSlice:
+    def _plan_inner(self, req: ShuffleRequest,
+                    admitted: int) -> Optional[FdSlice]:
         rec = self.resolver.resolve(req.job_id, req.map_id, req.reduce_id)
+        if self._store_managed(rec):
+            return None  # the caller falls through to the byte serve
         return self._build_slice(rec, req, admitted)
 
     def _build_slice(self, rec, req: ShuffleRequest,
@@ -1263,15 +1315,25 @@ class DataEngine:
                     f"{served}) for {req.map_id}/{req.reduce_id}")
             want = min(req.chunk_size or self.chunk_size_default,
                        served - req.offset)
-            fd = self._fds.acquire(rec.path)
-            try:
-                if self._native is not None:
-                    data = self._native.read(fd, rec.start_offset + req.offset,
-                                             want)
-                else:
-                    data = os.pread(fd, want, rec.start_offset + req.offset)
-            finally:
-                self._fds.release(rec.path)
+            if self._store_managed(rec):
+                # the disaggregated-store router: tier health, the
+                # store.get failpoint site and twin failover live
+                # there; the bytes come back through the same CRC/
+                # failpoint/accounting tail as the fd path below
+                data = self.store.read(
+                    rec.path, rec.start_offset + req.offset, want,
+                    key=f"{req.map_id}/{req.reduce_id}")
+            else:
+                fd = self._fds.acquire(rec.path)
+                try:
+                    if self._native is not None:
+                        data = self._native.read(
+                            fd, rec.start_offset + req.offset, want)
+                    else:
+                        data = os.pread(fd, want,
+                                        rec.start_offset + req.offset)
+                finally:
+                    self._fds.release(rec.path)
             if len(data) != want:
                 raise StorageError(
                     f"short read {len(data)}/{want} at {rec.path}:"

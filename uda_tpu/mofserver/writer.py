@@ -192,7 +192,7 @@ class MOFWriter:
     def __init__(self, root: str, job_id: str, codec=None, scheme=None,
                  supplier_roots: Optional[Sequence[str]] = None,
                  supplier_index: int = 0,
-                 domains: Optional[dict] = None):
+                 domains: Optional[dict] = None, store=None):
         self.root = root
         self.job_id = job_id
         self.codec = codec
@@ -201,9 +201,30 @@ class MOFWriter:
         self.supplier_index = supplier_index
         self.domains = dict(domains or {})
         self.map_ids: list[str] = []
+        # the elastic store's spill ladder (mofserver/store.py): each
+        # write's on-disk bytes are accounted against the retention
+        # watermark so over-budget suppliers spill as they produce
+        self.store = store
 
     def map_dir(self, map_id: str) -> str:
         return os.path.join(self.root, self.job_id, map_id)
+
+    def add_supplier_root(self, root: str, domain: Optional[str] = None,
+                          supplier_index: Optional[int] = None) -> None:
+        """Mid-job joiner rebalance (the writer half of CAP_ELASTIC):
+        a supplier that registered after the job started joins the
+        stripe-placement universe for NOT-yet-written maps — already
+        written stripes keep their placement (their indexes are
+        immutable); only future ``write`` calls fan shards onto the
+        joiner. ``supplier_index`` re-anchors this writer's position
+        when the canonical (sorted) supplier order shifted."""
+        if root in self.supplier_roots:
+            return
+        self.supplier_roots.append(root)
+        if domain is not None:
+            self.domains[root] = domain
+        if supplier_index is not None:
+            self.supplier_index = supplier_index
 
     def write(self, map_id: str,
               partitions: Sequence[Iterable[Tuple[bytes, bytes]]]) -> None:
@@ -216,3 +237,14 @@ class MOFWriter:
             write_map_output(self.map_dir(map_id), partitions, self.codec,
                              self.scheme)
         self.map_ids.append(map_id)
+        if self.store is not None:
+            mof = os.path.join(self.map_dir(map_id), "file.out")
+            try:
+                nbytes = os.path.getsize(mof)
+            except OSError:
+                # striped writers may anchor the primary on a peer
+                # root; retention accounting only covers bytes THIS
+                # writer landed under its own root
+                nbytes = 0
+            if nbytes:
+                self.store.account_write(self.job_id, map_id, nbytes)

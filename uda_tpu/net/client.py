@@ -412,6 +412,21 @@ class EvLoopFetchClient(InputClient):
         with self._lock:
             return self._generation
 
+    def peer_caps(self, host: str = "") -> int:
+        """Last HELLO capability bits (0 until the first handshake —
+        also the correct conservative answer: no advertised cap means
+        no optional behavior)."""
+        with self._lock:
+            return self._peer_caps
+
+    def peer_draining(self, host: str = "") -> bool:
+        """Did the last banner carry CAP_DRAINING? A draining supplier
+        still serves (in-flight work completes) but the candidate
+        ranking demotes it so speculation/replica reads prefer staying
+        members (segment.py HostRoutingClient / merge_manager)."""
+        with self._lock:
+            return bool(self._peer_caps & wire.CAP_DRAINING)
+
     # -- connection management ----------------------------------------------
 
     def _ensure_connected(self) -> _ClientConn:

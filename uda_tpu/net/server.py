@@ -94,6 +94,7 @@ from uda_tpu.utils.config import Config
 from uda_tpu.utils.errors import (ProtocolError, StorageError, TenantError,
                                   TransportError, UdaError)
 from uda_tpu.utils.failpoints import failpoint
+from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
@@ -1334,6 +1335,11 @@ class EvLoopShuffleServer:
         self.handoff_path = str(cfg.get("uda.tpu.net.handoff.path"))
         self.generation = 0
         self.warm_restart = False
+        # elastic drain (ISSUE 18): once announce_drain() flips this,
+        # every subsequent HELLO banner carries CAP_DRAINING so reduce
+        # sides stop placing NEW work here while in-flight serves
+        # complete; the store layer migrates retained MOFs in parallel
+        self._draining = False
         self._marks: dict = {}  # "peer|job|map|reduce" -> served end
         self._marks_lock = threading.Lock()
 
@@ -1581,8 +1587,9 @@ class EvLoopShuffleServer:
             # frame on the connection (uncredited — it answers no
             # request); rides _enqueue so the net.frame failpoint can
             # tear it like any other frame
-            caps = wire.CAP_TRACE | wire.CAP_OBS \
-                | (wire.CAP_TENANT if self.tenancy else 0)
+            caps = wire.CAP_TRACE | wire.CAP_OBS | wire.CAP_ELASTIC \
+                | (wire.CAP_TENANT if self.tenancy else 0) \
+                | (wire.CAP_DRAINING if self._draining else 0)
             hello = wire.encode_hello(self.generation, self.warm_restart,
                                       caps=caps)
             conn._enqueue(_BufItem([hello], credited=False,
@@ -1645,6 +1652,30 @@ class EvLoopShuffleServer:
             self.zc_mode = "mmap"
             log.warn("net: sendfile refused by the fs/socket pairing; "
                      "switching the zero-copy serve mechanism to mmap")
+
+    def announce_drain(self, store=None, job_id: Optional[str] = None):
+        """Begin elastic departure (the symmetric half of mid-job join):
+        flip the banner to CAP_DRAINING — every connection accepted
+        from here on learns this supplier is leaving and demotes it in
+        candidate ranking (already-connected peers keep their credits;
+        in-flight serves complete normally) — and, when a StoreManager
+        is attached, migrate the retained MOF partitions to the blob
+        tier so the job can still fetch them AFTER this process exits
+        (migrated, not reconstructed). Idempotent; returns the list of
+        migration records (empty without a store). The caller follows
+        with ``stop(drain=True)`` once its producers are quiesced."""
+        first = not self._draining
+        self._draining = True
+        if first:
+            metrics.add("elastic.drains")
+            flightrec.record("elastic.drain", generation=self.generation)
+            log.info(f"net: drain announced (generation "
+                     f"{self.generation}); new banners carry "
+                     f"CAP_DRAINING")
+        moved = []
+        if store is not None:
+            moved = store.drain(job_id)
+        return moved
 
     def stop(self, drain: bool = True) -> None:
         """Stop serving. ``drain=True`` (the default) completes what the

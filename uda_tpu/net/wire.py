@@ -92,13 +92,14 @@ from typing import Optional, Sequence
 
 from uda_tpu.mofserver.data_engine import FetchResult, ShuffleRequest
 from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
-                                  ProtocolError, StorageError, TenantError,
-                                  TransportError, UdaError)
+                                  ProtocolError, StorageError, StoreError,
+                                  TenantError, TransportError, UdaError)
 
 __all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
            "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
            "MSG_HELLO", "MSG_STATS", "MSG_STATS_REPLY",
            "MSG_JOB", "MSG_JOB_OK", "CAP_TRACE", "CAP_TENANT", "CAP_OBS",
+           "CAP_ELASTIC", "CAP_DRAINING",
            "STATS_SEC_TS", "STATS_SEC_SLI", "STATS_SEC_ANOMALY",
            "STATS_SEC_ALL", "decode_stats_request",
            "encode_job", "decode_job", "encode_job_ok", "decode_job_ok",
@@ -181,6 +182,16 @@ CAP_OBS = 0x08      # peer runs the live-telemetry plane (ISSUE 17):
                     # blocks and the active-anomaly table. Send the
                     # tail ONLY to CAP_OBS peers — an older server
                     # treats trailing bytes as a torn frame
+CAP_ELASTIC = 0x10  # peer participates in elastic membership (ISSUE
+                    # 18): it may register mid-job (reduce sides fold
+                    # a fresh CAP_ELASTIC banner into the candidate
+                    # ring via HostRoutingClient.notify_join) and
+                    # understands the symmetric drain announcement
+CAP_DRAINING = 0x20  # peer is LEAVING: it has announced drain, is
+                     # migrating its retained MOFs to the blob tier
+                     # (StoreManager.drain) and will refuse no inflight
+                     # work but should receive no NEW placements; the
+                     # reduce side demotes it in candidate ranking
 
 # the optional MSG_STATS request tail: requested rollup-window seconds
 # + a section bitmask. Exactly 0 bytes (the PR 11 shape: plain
@@ -200,7 +211,7 @@ _FLAG_CRC = 0x02
 # supplier-admission backoff) see realistic types across the wire.
 _ERROR_CLASSES = {cls.__name__: cls for cls in
                   (UdaError, ConfigError, ProtocolError, TransportError,
-                   MergeError, StorageError, CompressionError,
+                   MergeError, StorageError, StoreError, CompressionError,
                    TenantError)}
 
 

@@ -562,6 +562,31 @@ _FLAG_LIST = [
          "torn newest manifest (kill mid-snapshot) falls back to the "
          "previous one, and consumed-on-load walks backward across "
          "crash-retry loops (min 1)"),
+    Flag("uda.tpu.store.blob.root", "", str,
+         "blob-tier root directory of the elastic disaggregated MOF "
+         "store (mofserver/store.py): non-empty arms the StoreManager "
+         "— spilled/migrated partitions live here and the path joins "
+         "the DirIndexResolver search roots. Empty = off (the seed "
+         "behavior: supplier-local storage only)"),
+    Flag("uda.tpu.store.spill.watermark.mb", 0, int,
+         "supplier local-retention watermark in MB: retained MOF "
+         "bytes above it migrate oldest-first to the blob tier "
+         "(CRC-verified, store.spilled.bytes ledgered). 0 = derive "
+         "from uda.tpu.store.spill.frac of the host memory budget"),
+    Flag("uda.tpu.store.spill.frac", 0.0, float,
+         "watermark as a fraction of the MemoryBudget host budget "
+         "when the explicit MB knob is 0 (0 = spill ladder off)"),
+    Flag("uda.tpu.store.shadow", False, bool,
+         "keep the local file.out as a failover twin after a spill "
+         "cut-over (blob primary, local shadow): a dying blob "
+         "backend then re-routes reads to the surviving local copy "
+         "instead of the k-of-n reconstruction rung"),
+    Flag("uda.tpu.store.health.threshold", 2, int,
+         "store-backend faults before the tier is penalty-boxed and "
+         "twin-holding reads proactively re-route (BackendHealth)"),
+    Flag("uda.tpu.store.health.penalty.ms", 1000.0, float,
+         "how long a boxed store backend stays deprioritized before "
+         "parole (one more fault re-boxes it)"),
 ]
 
 FLAGS: Dict[str, Flag] = {f.key: f for f in _FLAG_LIST}

@@ -24,6 +24,7 @@ __all__ = [
     "TransportError",
     "MergeError",
     "StorageError",
+    "StoreError",
     "CompressionError",
     "TenantError",
     "FallbackSignal",
@@ -86,6 +87,23 @@ class MergeError(UdaError):
 class StorageError(UdaError):
     """Segment IO failure (reference AIOHandler/DataEngine read errors,
     src/MOFServer/IndexInfo.cc:304-376)."""
+
+
+class StoreError(StorageError):
+    """Disaggregated MOF-store failure (uda_tpu/mofserver/store.py):
+    a backend tier (local fd / blob) errored, failed CRC verification,
+    or every tier a partition lives on is unhealthy. ``cause`` is the
+    STRUCTURED failure class (``get``/``put``/``migrate``/``crc``/
+    ``short_read``/``missing`` — compare these, never the message
+    text, per udalint UDA005) and ``backend`` the tier that produced
+    it, so the RecoveryLedger and the chaos gates can key the storage
+    rung without reason strings. Both default empty so the failpoint
+    runtime's one-positional-message construction stays legal."""
+
+    def __init__(self, message: str, cause: str = "", backend: str = ""):
+        super().__init__(message)
+        self.cause = cause
+        self.backend = backend
 
 
 class CompressionError(UdaError):

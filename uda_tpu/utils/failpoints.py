@@ -55,7 +55,10 @@ request of a batch, never its batch-mates). Crash-consistent
 checkpointing (merger/checkpoint.py) adds ``ckpt.save`` (the assembled
 manifest bytes, keyed by task — truncate writes a torn manifest the
 next load must skip) and ``ckpt.load`` (the manifest walk, keyed by
-task — error degrades to a fresh start).
+task — error degrades to a fresh start). The elastic disaggregated
+MOF store (mofserver/store.py) adds ``store.get`` / ``store.put`` /
+``store.migrate``, keyed ``<backend>:<key>`` so a spec's ``match:``
+trigger can kill exactly one tier (see _SITE_ERRORS below).
 """
 
 from __future__ import annotations
@@ -70,8 +73,8 @@ from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
 from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
-                                  ProtocolError, StorageError, TenantError,
-                                  TransportError, UdaError)
+                                  ProtocolError, StorageError, StoreError,
+                                  TenantError, TransportError, UdaError)
 from uda_tpu.utils.flightrec import flightrec
 from uda_tpu.utils.metrics import metrics
 
@@ -138,6 +141,21 @@ _SITE_ERRORS = {
     # checkpoint store, which degrades to a fresh start, never a crash)
     "ckpt.save": StorageError,
     "ckpt.load": StorageError,
+    # the elastic disaggregated MOF store (mofserver/store.py), every
+    # site keyed "<backend>:<partition key>" so chaos can target ONE
+    # tier (``match:blob`` kills blob reads while the local tier keeps
+    # serving — the degraded-backend failover rung): store.get fires
+    # per tier read attempt BEFORE the bytes are read (error = that
+    # tier is down for this read; the router must re-route to the
+    # surviving tier when the partition has a twin copy, typed
+    # StoreError otherwise), store.put per blob-tier object write
+    # (a failed or torn spill must leave the local copy authoritative
+    # — migration is all-or-nothing), store.migrate per whole-MOF
+    # tier migration before any byte moves (a spill/drain that fails
+    # here leaves the partition where it was, fully servable)
+    "store.get": StoreError,
+    "store.put": StoreError,
+    "store.migrate": StoreError,
 }
 
 # The registered-site inventory. udalint's UDA003 rule checks every

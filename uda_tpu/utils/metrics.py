@@ -523,6 +523,64 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                           "queued request, enqueue to "
                                           "grant (the queue-wait SLI) "
                                           "[labels: tenant]"),
+    # -- the elastic disaggregated MOF store (mofserver/store.py) --------
+    "store.read.bytes": ("counter", "bytes served through the store "
+                                    "router [labels: backend]"),
+    "store.blob.reads": ("counter", "blob-tier vectored read syscalls "
+                                    "(the PR 13 coalescer riding the "
+                                    "blob range-GET path)"),
+    "store.errors": ("counter", "store-tier read/put faults (typed "
+                                "StoreError; the failover router's "
+                                "input) [labels: backend]"),
+    "store.failover": ("counter", "reads served by the SURVIVING tier "
+                                  "after the partition's primary tier "
+                                  "faulted or was boxed [labels: "
+                                  "backend — the tier that served]"),
+    "store.rerouted": ("counter", "reads proactively routed around a "
+                                  "penalty-boxed tier (no failed "
+                                  "attempt burned) [labels: backend — "
+                                  "the boxed tier]"),
+    "store.penalties": ("counter", "store backends penalty-boxed after "
+                                   "repeated faults (BackendHealth) "
+                                   "[labels: backend]"),
+    "store.migrations": ("counter", "whole-partition tier migrations "
+                                    "completed [labels: reason="
+                                    "spill|drain|replicate]"),
+    "store.migrated.bytes": ("counter", "MOF bytes moved between tiers "
+                                        "(CRC-verified streamed "
+                                        "copies)"),
+    "store.spilled.bytes": ("counter", "migrated bytes attributed to "
+                                       "the retention-watermark spill "
+                                       "ladder (the bounded-RSS "
+                                       "contract's ledger)"),
+    "store.drained.partitions": ("counter", "partitions migrated off a "
+                                            "departing supplier by the "
+                                            "drain handoff"),
+    "store.revalidated": ("counter", "spilled blob objects CRC-"
+                                     "re-verified by the checkpoint-"
+                                     "resume locator revalidation"),
+    "elastic.joins": ("counter", "suppliers that joined mid-job "
+                                 "(CAP_ELASTIC HELLO; in-flight "
+                                 "segments adopt them as speculation/"
+                                 "replica candidates) [labels: "
+                                 "supplier]"),
+    "elastic.drains": ("counter", "suppliers that announced departure "
+                                  "(CAP_DRAINING HELLO / server "
+                                  "announce_drain)"),
+    "store.local.retained.bytes": ("gauge", "MOF bytes retained on the "
+                                           "local tier and counted "
+                                           "against the spill "
+                                           "watermark (absolute "
+                                           "level, not paired)"),
+    "store.migrate.bytes.on_air": ("gauge", "bytes mid-migration "
+                                           "between store tiers; "
+                                           "paired — every +N must "
+                                           "meet its -N at migration "
+                                           "settle (resledger "
+                                           "gauge.store.migrate)"),
+    "store.read.latency_ms": ("histogram", "store-router range-read "
+                                           "latency per tier attempt "
+                                           "[labels: backend]"),
 }
 
 # Dynamically-named families (f-string call sites): the static prefix

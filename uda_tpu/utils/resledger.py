@@ -82,6 +82,7 @@ PAIRED_GAUGES: Dict[str, str] = {
     "supplier.read.bytes.on_air": "gauge.read.bytes",
     "io.batch.inflight": "gauge.io.batch",
     "tenant.read.bytes.on_air": "gauge.tenant.read.bytes",
+    "store.migrate.bytes.on_air": "gauge.store.migrate",
 }
 
 
