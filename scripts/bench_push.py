@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Push-shuffle overlap bench: the ISSUE 19 acceptance numbers.
+
+One shape, two end-to-end runs over the REAL loopback data plane
+(ShuffleServer + HostRoutingClient), byte-compared:
+
+- **pull** — the fetch-wave baseline: a timed map phase writes every
+  MOF (vectorized TeraSort records, native-framed), then the reduce
+  starts cold and fetches everything. End-to-end wall is
+  ``map_wall + reduce_wall`` with zero overlap by construction.
+
+- **push** — the same map phase against a CAP_PUSH server with the
+  reduce's ``PushStaging`` armed BEFORE the first commit: every
+  ``notify_commit`` streams the new map's partition to the reduce side
+  while the map phase is still producing, so by the time the fetch
+  wave starts most bytes are already staged and adopted into the
+  Segment ledger. The reduce tail shrinks by the overlapped volume.
+
+Two regime knobs make the overlap observable on a loopback host, both
+applied to BOTH variants symmetrically:
+
+- ``--map-pace-ms`` sleeps after each map commit — the map-compute
+  time a real map task spends between spills, which is exactly the
+  window the push plane streams into (pull reducers idle through it).
+- ``--serve-delay-ms`` arms the ``data_engine.pread`` delay failpoint
+  for the whole bench — the storage/network-bound supplier regime the
+  fetch-wave barrier actually hurts in. RAM-speed loopback serving
+  makes the fetch wave nearly free and the overlap unmeasurable; the
+  delay restores the deployment-shaped read cost for pull fetches and
+  pushed reads alike (push pays it during the map phase, which is the
+  point).
+
+Gates: byte-identity (sha256 of the merged stream, pull is the
+oracle — exit 3 on divergence) and zero terminal FallbackSignals in
+both runs. Full mode additionally gates the overlap win: end-to-end
+push wall must beat pull by >= OVERLAP_GATE x (the 64x64 MB
+acceptance); quick mode records walls/speedup as perfwatch trend data
+only — shared CI hosts gate direction-of-change, not absolute seconds.
+
+Usage: python scripts/bench_push.py [--quick] [--maps 64] [--map-mb 64]
+       [--out BENCH_PUSH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+OVERLAP_GATE = 1.1  # full mode: push end-to-end must beat pull by 10%
+RECORD = 100        # 10B key + 90B value, the TeraSort record
+
+
+def _force_cpu() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _write_maps(root, job, num_maps, recs_per_map, on_commit=None,
+                pace_s=0.0):
+    """The map phase: vectorized sorted-record MOFs, native-framed
+    straight to disk (no per-record Python — the bench measures the
+    shuffle plane, not a Python map loop). ``on_commit`` fires after
+    each map's index lands, the MOFWriter commit-point contract."""
+    import numpy as np
+
+    from uda_tpu import native
+    from uda_tpu.mofserver.index import write_index_file
+    from uda_tpu.utils.ifile import RecordBatch
+
+    for m in range(num_maps):
+        rng = np.random.default_rng(4242 + m)
+        n = recs_per_map
+        keys = rng.integers(0, 256, (n, 10), dtype=np.uint8)
+        keys = keys[np.lexsort(tuple(keys[:, c]
+                                     for c in range(9, -1, -1)))]
+        vals = rng.integers(0, 256, (n, 90), dtype=np.uint8)
+        buf = np.concatenate([keys.reshape(-1), vals.reshape(-1)])
+        batch = RecordBatch(
+            buf,
+            np.arange(n, dtype=np.int64) * 10, np.full(n, 10, np.int64),
+            n * 10 + np.arange(n, dtype=np.int64) * 90,
+            np.full(n, 90, np.int64))
+        mid = f"attempt_{job}_m_{m:06d}_0"
+        d = os.path.join(root, job, mid)
+        os.makedirs(d, exist_ok=True)
+        mof = os.path.join(d, "file.out")
+        with open(mof, "wb") as f:
+            for piece in native.iter_framed_chunks(batch, write_eof=True):
+                f.write(piece)
+        size = os.path.getsize(mof)
+        write_index_file(mof + ".index", [(0, size, size)])
+        if on_commit is not None:
+            on_commit(job, mid)
+        if pace_s > 0:
+            time.sleep(pace_s)
+
+
+def _run_variant(tmp, job, num_maps, recs_per_map, push, quick,
+                 pace_s=0.0):
+    """One end-to-end run; returns (sha256, stats dict)."""
+    from uda_tpu.merger import HostRoutingClient, MergeManager
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.net import ShuffleServer
+    from uda_tpu.utils.comparators import get_key_type
+    from uda_tpu.utils.config import Config
+    from uda_tpu.utils.metrics import metrics
+
+    metrics.reset()
+    root = os.path.join(tmp, "push_root" if push else "pull_root")
+    total_mb = num_maps * recs_per_map * RECORD / 1048576
+    cfg = Config({
+        "uda.tpu.push.enable": push,
+        # stage the whole shuffle: a modest eager window in host RAM,
+        # the rest through the spill tier — the overlap win must not
+        # depend on holding the full map output resident
+        "uda.tpu.push.eager.mb": 256.0,
+        "uda.tpu.push.staged.mb": max(64.0, total_mb * 1.25),
+        "uda.tpu.spill.dirs": os.path.join(tmp, "spill"),
+        "mapred.rdma.wqe.per.conn": 8,
+        # take() withholds the staged LAST chunk (pull re-fetches the
+        # tail as the byte-identity oracle), so a map must span
+        # several chunks for adoption to have a prefix to keep — on
+        # the quick shape's 0.5 MB maps that needs a sub-MB chunk
+        "mapred.rdma.buf.size": 128 if quick else 1024,
+    })
+    engine = DataEngine(DirIndexResolver(root), cfg)
+    server = ShuffleServer(engine, cfg, host="127.0.0.1", port=0).start()
+    router = HostRoutingClient(config=cfg)
+    mm = MergeManager(router, get_key_type("uda.tpu.RawBytes"), cfg)
+    addr = f"127.0.0.1:{server.port}"
+    mids = [f"attempt_{job}_m_{m:06d}_0" for m in range(num_maps)]
+    sha = hashlib.sha256()
+    out_bytes = [0]
+
+    def sink(mv):
+        sha.update(mv)
+        out_bytes[0] += len(mv)
+
+    try:
+        t0 = time.monotonic()
+        staging = None
+        if push:
+            staging = mm.arm_push(job, 0, hosts={addr})
+        _write_maps(root, job, num_maps, recs_per_map,
+                    on_commit=server.notify_commit if push else None,
+                    pace_s=pace_s)
+        map_wall = time.monotonic() - t0
+        if push and staging is not None:
+            # deterministic engagement on tiny quick shapes: the first
+            # chunk must have landed before the fetch wave claims the
+            # maps (a no-op on full shapes — the long map phase stages
+            # most of the shuffle long before this point)
+            deadline = time.monotonic() + 10.0
+            while (time.monotonic() < deadline
+                   and staging.staged_bytes() <= 0):
+                time.sleep(0.005)
+        t1 = time.monotonic()
+        mm.run(job, [(addr, m) for m in mids], 0, sink)
+        reduce_wall = time.monotonic() - t1
+        total_wall = time.monotonic() - t0
+    finally:
+        router.stop()
+        server.stop()
+        engine.stop()
+    stats = {
+        "map_wall_s": round(map_wall, 3),
+        "reduce_wall_s": round(reduce_wall, 3),
+        "total_wall_s": round(total_wall, 3),
+        "MBps": round(total_mb / total_wall, 1) if total_wall else 0.0,
+        "out_mb": round(out_bytes[0] / 1048576, 3),
+        "fallbacks": int(metrics.get("fallback.signals") or 0),
+    }
+    if push:
+        stats.update({
+            "push_chunks": int(metrics.get("push.chunks") or 0),
+            "push_adopted": int(metrics.get("push.adopted") or 0),
+            "push_adopted_mb": round(
+                (metrics.get("push.adopted.bytes") or 0.0) / 1048576, 3),
+            "push_refused": int(sum(
+                v for k, v in metrics.snapshot().items()
+                if k.startswith("push.refused"))),
+            "push_errors": int(metrics.get("push.errors") or 0),
+        })
+    return sha.hexdigest(), stats
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--maps", type=int, default=64)
+    ap.add_argument("--map-mb", type=float, default=64.0)
+    ap.add_argument("--map-pace-ms", type=float, default=None,
+                    help="map-compute sleep after each commit "
+                    "(default: 250 full, 0 quick)")
+    ap.add_argument("--serve-delay-ms", type=float, default=None,
+                    help="per-pread delay on the supplier engine, the "
+                    "storage-bound regime (default: 10 full, 0 quick)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shape; identity + engagement gates "
+                    "only — walls and the speedup are trend data")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    _force_cpu()
+    from uda_tpu.utils.failpoints import failpoints
+
+    num_maps = 8 if args.quick else args.maps
+    map_mb = 0.5 if args.quick else args.map_mb
+    pace_ms = args.map_pace_ms if args.map_pace_ms is not None \
+        else (0.0 if args.quick else 250.0)
+    delay_ms = args.serve_delay_ms if args.serve_delay_ms is not None \
+        else (0.0 if args.quick else 10.0)
+    recs_per_map = max(64, int(map_mb * 1048576 / RECORD))
+    tmp = tempfile.mkdtemp(prefix="uda_push_")
+    spec = (f"data_engine.pread=delay:{int(delay_ms)}:prob:1"
+            if delay_ms > 0 else "")
+    try:
+        job = "pushbench"
+        # an empty spec arms nothing — scoped("") is the documented
+        # no-op, so the quick/undelayed path shares this block
+        with failpoints.scoped(spec):
+            pull_sha, pull = _run_variant(tmp, job, num_maps,
+                                          recs_per_map, push=False,
+                                          quick=args.quick,
+                                          pace_s=pace_ms / 1000.0)
+            push_sha, push = _run_variant(tmp, job, num_maps,
+                                          recs_per_map, push=True,
+                                          quick=args.quick,
+                                          pace_s=pace_ms / 1000.0)
+        speedup = (pull["total_wall_s"] / push["total_wall_s"]
+                   if push["total_wall_s"] else 0.0)
+        result = {
+            "bench": "push_overlap", "quick": bool(args.quick),
+            "maps": num_maps, "map_mb": map_mb,
+            "map_pace_ms": pace_ms, "serve_delay_ms": delay_ms,
+            "total_mb": round(num_maps * recs_per_map * RECORD
+                              / 1048576, 1),
+            "nproc": os.cpu_count(),
+            "pull": pull, "push": push,
+            "identity_push_eq_pull": bool(pull_sha == push_sha
+                                          and pull["out_mb"] > 0),
+            "push_engaged": bool(push.get("push_chunks", 0) > 0
+                                 and push.get("push_adopted_mb", 0) > 0),
+            "zero_fallbacks": bool(pull["fallbacks"] == 0
+                                   and push["fallbacks"] == 0),
+            "speedup_e2e": round(speedup, 3),
+            "overlap_margin_s": round(pull["total_wall_s"]
+                                      - push["total_wall_s"], 3),
+            "reduce_tail_shrinks": bool(push["reduce_wall_s"]
+                                        < pull["reduce_wall_s"]),
+        }
+        result["overlap_ok"] = bool(args.quick
+                                    or speedup >= OVERLAP_GATE)
+        print(json.dumps(result))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1)
+                f.write("\n")
+        if not result["identity_push_eq_pull"]:
+            print("FAIL: push output diverged from the pull oracle",
+                  file=sys.stderr)
+            return 3
+        if not result["push_engaged"]:
+            print("FAIL: push plane never engaged (no chunks adopted)",
+                  file=sys.stderr)
+            return 3
+        if not result["zero_fallbacks"]:
+            print("FAIL: terminal FallbackSignal during a bench run",
+                  file=sys.stderr)
+            return 3
+        if not result["overlap_ok"]:
+            print(f"FAIL: push e2e speedup {result['speedup_e2e']} < "
+                  f"{OVERLAP_GATE}", file=sys.stderr)
+            return 2
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,18 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python scripts/bench_elastic.py --quick \
   --out "$ART/bench_elastic.json" 2>&1 | tee -a "$ART/ci.log" | tail -2
 
+# Push-shuffle overlap bench, quick mode: supplier-initiated MSG_PUSH
+# vs the fetch-wave pull baseline over the real loopback plane — the
+# byte-identity gate (sha256 of the merged stream vs the pull oracle;
+# exit 3 on divergence) plus push-plane engagement (chunks sent AND
+# staged bytes adopted into the Segment ledger) and zero terminal
+# FallbackSignals; walls/speedup are perfwatch trend data (full runs
+# ride BENCH_PUSH_r*.json and gate the >= 1.1x overlap win there).
+echo "-- push-shuffle overlap bench (quick)" | tee -a "$ART/ci.log"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/bench_push.py --quick \
+  --out "$ART/bench_push.json" 2>&1 | tee -a "$ART/ci.log" | tail -2
+
 # Fleet observability gate: one tenanted, observability-armed daemon,
 # 8 equal-weight tenant drivers, scripts/udafleet.py --once --json
 # polled live against it — the CAP_OBS sections must round-trip and
@@ -189,6 +201,8 @@ python scripts/perfwatch.py --check "$ART/bench_tenant.json" \
 python scripts/perfwatch.py --check "$ART/exchange_bench.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 python scripts/perfwatch.py --check "$ART/bench_elastic.json" \
+  --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
+python scripts/perfwatch.py --check "$ART/bench_push.json" \
   --tolerance 0.6 2>&1 | tee -a "$ART/ci.log" | tail -3
 
 # CPU-only gates run with the accelerator-pool env stripped: the pool's

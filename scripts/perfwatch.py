@@ -284,6 +284,42 @@ def _extract_elastic(run: str, data: Dict, out: List[Dict]) -> None:
             _add(out, run, w, key, data[key], "info")
 
 
+def _extract_push(run: str, data: Dict, out: List[Dict]) -> None:
+    """scripts/bench_push.py output (bench "push_overlap", r19+):
+    supplier-initiated push vs the fetch-wave pull baseline, end to
+    end. The identity/engagement/zero-fallback booleans are hard gates
+    (tol 0 — a pushed run that drifts a byte from the pull oracle, or
+    one where the push plane silently never engaged, is a correctness
+    break); the e2e speedup and the reduce-tail shrink gate full runs
+    direction-of-change and trend quick runs (shared-host walls)."""
+    quick = bool(data.get("quick"))
+    w = "push_overlap_quick" if quick else "push_overlap"
+    for key in ("identity_push_eq_pull", "push_engaged",
+                "zero_fallbacks"):
+        if key in data:
+            _add(out, run, w, key, 1.0 if data[key] else 0.0, "up",
+                 tol=0.0)
+    if "speedup_e2e" in data:
+        _add(out, run, w, "speedup_e2e", data["speedup_e2e"],
+             "info" if quick else "up")
+    if "overlap_margin_s" in data:
+        _add(out, run, w, "overlap_margin_s", data["overlap_margin_s"],
+             "info")
+    for side in ("pull", "push"):
+        rec = data.get(side) or {}
+        if "MBps" in rec:
+            _add(out, run, w, f"{side}_MBps", rec["MBps"],
+                 "info" if quick else "up")
+        if "reduce_wall_s" in rec:
+            _add(out, run, w, f"{side}_reduce_wall_s",
+                 rec["reduce_wall_s"], "info" if quick else "down")
+    push = data.get("push") or {}
+    for key in ("push_chunks", "push_adopted_mb", "push_refused"):
+        if key in push:
+            # structural trend figures: the plane's traffic shape
+            _add(out, run, w, key, push[key], "info")
+
+
 def _extract_regression(run: str, data: Dict, out: List[Dict]) -> None:
     w = f"regression_{data.get('size', 'unknown')}"
     for rec in data.get("results", []):
@@ -359,6 +395,8 @@ def extract(run: str, data) -> List[Dict]:
         _extract_ckpt(run, data, out)
     elif data.get("bench") == "elastic":
         _extract_elastic(run, data, out)
+    elif data.get("bench") == "push_overlap":
+        _extract_push(run, data, out)
     elif "identity" in data and "speedup_sorted" in data:
         _extract_pipeline(run, data, out)
     elif isinstance(data.get("results"), list):

@@ -271,17 +271,22 @@ def wl_mesh_shuffle(size: str, work_dir: str) -> dict:
 
 
 def _make_terasort_mofs(root: str, job: str, num_maps: int,
-                        records_per_map: int, seed: int = 17) -> None:
+                        records_per_map: int, seed: int = 17,
+                        first_map: int = 0) -> None:
     """Vectorized TeraSort MOF generator: per-map sorted 10B-key/90B-value
     records, native-framed straight to disk (no per-record Python) —
-    the xlarge rungs measure the ENGINE, not a Python map phase."""
+    the xlarge rungs measure the ENGINE, not a Python map phase.
+    ``first_map`` writes a suffix of the map set (each map's records
+    derive from ``seed + m``, so a split generation is byte-identical
+    to a whole one — the push_streaming workload commits maps in two
+    waves)."""
     import numpy as np
 
     from uda_tpu import native
     from uda_tpu.mofserver.index import write_index_file
     from uda_tpu.utils.ifile import RecordBatch
 
-    for m in range(num_maps):
+    for m in range(first_map, first_map + num_maps):
         rng = np.random.default_rng(seed + m)
         n = records_per_map
         keys = rng.integers(0, 256, (n, 10), dtype=np.uint8)
@@ -505,6 +510,156 @@ def wl_resume_shuffle(size: str, work_dir: str) -> dict:
             "runs_adopted": int(adopted)}
 
 
+def _record_multiset_hash(rows) -> int:
+    """Order-independent hash of (n, 100) u8 record rows: each record's
+    position-weighted u64 digest, summed mod 2^64 — equal multisets of
+    records hash equal regardless of merge order."""
+    import numpy as np
+
+    weights = ((np.arange(100, dtype=np.uint64) + 1)
+               * np.uint64(0x9E3779B97F4A7C15))
+    return int(np.sum(rows.astype(np.uint64) @ weights,
+                      dtype=np.uint64))
+
+
+def _expected_multiset_hash(num_maps: int, per_map: int,
+                            seed: int = 17) -> int:
+    """Re-derive the multiset hash of everything _make_terasort_mofs
+    wrote (same seeds, same generation order)."""
+    import numpy as np
+
+    h = np.uint64(0)
+    for m in range(num_maps):
+        rng = np.random.default_rng(seed + m)
+        keys = rng.integers(0, 256, (per_map, 10), dtype=np.uint8)
+        keys = keys[np.lexsort(tuple(keys[:, c]
+                                     for c in range(9, -1, -1)))]
+        vals = rng.integers(0, 256, (per_map, 90), dtype=np.uint8)
+        h += np.uint64(_record_multiset_hash(
+            np.concatenate([keys, vals], axis=1)))
+    return int(h)
+
+
+def _output_multiset_hash(path: str) -> int:
+    """The emitted stream's record-multiset hash (streamed, bounded
+    memory like the sortedness gate)."""
+    import numpy as np
+
+    from uda_tpu.utils.ifile import crack_partial
+
+    h = np.uint64(0)
+    carry = b""
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(64 << 20)
+            if not chunk:
+                break
+            data = carry + chunk
+            batch, consumed, _ = crack_partial(data)
+            carry = data[consumed:]
+            n = batch.num_records
+            if n == 0:
+                continue
+            rows = np.empty((n, 100), np.uint8)
+            rows[:, :10] = batch.data[
+                batch.key_off[:, None] + np.arange(10)[None, :]]
+            rows[:, 10:] = batch.data[
+                batch.val_off[:, None] + np.arange(90)[None, :]]
+            h += np.uint64(_record_multiset_hash(rows))
+    return int(h)
+
+
+def wl_push_streaming(size: str, work_dir: str) -> dict:
+    # the push-shuffle regression (ISSUE 19): NEW map outputs commit —
+    # and stream over as MSG_PUSH — WHILE THE REDUCER IS ALREADY
+    # DRAINING. Half the maps exist before the reduce starts (their
+    # pushes ride the catch-up path); the other half commit from a
+    # background thread racing the fetch wave (their pushes ride the
+    # notify_commit fan-out and are adopted at segment start). Gates:
+    # the sortedness + record-count stream gate, the record-MULTISET
+    # hash against the generator (no record lost or duplicated across
+    # the push/pull seam), and at least one chunk actually pushed.
+    import threading as _threading
+
+    from uda_tpu.merger import HostRoutingClient, MergeManager
+    from uda_tpu.mofserver import DataEngine, DirIndexResolver
+    from uda_tpu.net import ShuffleServer
+    from uda_tpu.utils import comparators
+    from uda_tpu.utils.config import Config
+    from uda_tpu.utils.metrics import metrics
+
+    total = _size("shuffle_records", size)
+    num_maps = max(4, min(64, total // 160_000 or 4))
+    per_map = (total + num_maps - 1) // num_maps
+    job = "shufpush"
+    cfg = Config({
+        "uda.tpu.push.enable": True,
+        "uda.tpu.spill.dirs": os.path.join(work_dir, "spill"),
+        "mapred.rdma.wqe.per.conn": 8,
+        "uda.tpu.fetch.retries": 8,
+        # 64 KB push chunks: every map spans several chunks even at
+        # the small rung, so take()'s last-chunk trim still leaves a
+        # prefix to adopt (the path under test)
+        "mapred.rdma.buf.size": 64,
+    })
+    mids = [f"attempt_{job}_m_{m:06d}_0" for m in range(num_maps)]
+    half = max(1, num_maps // 2)
+    _make_terasort_mofs(work_dir, job, half, per_map)
+    engine = DataEngine(DirIndexResolver(work_dir), cfg)
+    server = ShuffleServer(engine, cfg, host="127.0.0.1", port=0).start()
+    addr = f"127.0.0.1:{server.port}"
+    kt = comparators.get_key_type("uda.tpu.RawBytes")
+    out_path = os.path.join(work_dir, "reduce.out")
+    router = HostRoutingClient(config=cfg)
+    mm = MergeManager(router, kt, cfg)
+    errs: list = []
+
+    def _late_maps():
+        try:
+            for m in range(half, num_maps):
+                _make_terasort_mofs(work_dir, job, 1, per_map,
+                                    first_map=m)
+                server.notify_commit(job, mids[m])
+        except Exception as e:  # noqa: BLE001 - reported via the gate
+            errs.append(e)
+
+    try:
+        staging = mm.arm_push(job, 0, hosts={addr})
+        assert staging is not None, "push plane did not arm"
+        for m in range(half):
+            server.notify_commit(job, mids[m])
+        # let the catch-up pushes land a first prefix before the
+        # reducer starts (deterministic adoption); the LATE half still
+        # races the fetch wave for real
+        deadline = time.monotonic() + 30
+        while staging.staged_bytes() == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert staging.staged_bytes() > 0, "catch-up pushes never landed"
+        late = _threading.Thread(target=_late_maps, daemon=True)
+        late.start()
+        with open(out_path, "wb") as out:
+            mm.run(job, [(addr, mid) for mid in mids], 0,
+                   lambda mv: out.write(mv))
+        late.join(60)
+        assert not errs, f"late map writer failed: {errs[0]}"
+    finally:
+        router.stop()
+        server.stop()
+        engine.stop()
+    _verify_sorted_stream(out_path, num_maps * per_map)
+    got = _output_multiset_hash(out_path)
+    want = _expected_multiset_hash(num_maps, per_map)
+    assert got == want, \
+        f"record multiset drifted across the push/pull seam " \
+        f"({got:#x} != {want:#x})"
+    snap = metrics.snapshot()
+    assert snap.get("push.chunks", 0) > 0, "no pushes flowed"
+    return {"maps": num_maps, "records": num_maps * per_map,
+            "push_chunks": int(snap.get("push.chunks", 0)),
+            "push_adopted_bytes": int(snap.get("push.adopted.bytes", 0)),
+            "push_refused": int(snap.get("push.refused", 0))}
+
+
 def wl_pi(size: str, work_dir: str) -> dict:
     from uda_tpu.models.pi import run_pi
 
@@ -538,6 +693,7 @@ WORKLOADS = {
     "terasort_shuffle_streaming": wl_terasort_shuffle_streaming,
     "terasort_shuffle_auto": wl_terasort_shuffle_auto,
     "resume_shuffle": wl_resume_shuffle,
+    "push_streaming": wl_push_streaming,
 }
 
 

@@ -29,7 +29,7 @@ COUNTERS="$(mktemp)"
 # its post-mortem defeats the recorder's purpose).
 FRROOT="$(mktemp -d)"
 export FRROOT  # the telemetry merge below reads the dumps from it
-for r in main pressure network exchange completion pipeline iobatch tenant resume anomaly elastic lockdep; do
+for r in main pressure network exchange completion pipeline iobatch tenant resume anomaly elastic push lockdep; do
   mkdir -p "${FRROOT}/${r}"
 done
 trap 'rm -f "${COUNTERS}"; rm -rf "${FRROOT}"' EXIT
@@ -298,6 +298,35 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
     -p no:cacheprovider \
     --continue-on-collection-errors "$@" || elrc=$?
 
+# Push rung: the push-shuffle pipeline contract (ISSUE 19) — the
+# faults-marked push tests (a seeded supplier KILL racing in-flight
+# pushes, torn MSG_PUSH frames, injected admission refusals) under a
+# seeded ambient push-plane schedule: torn push frames and admission
+# refusals by probability, plus a pread-delay storm that varies WHICH
+# pushes are on the wire when the kill lands. Every shape must end
+# byte-identical to the pull oracle with ZERO FallbackSignals — a
+# refused, torn or orphaned push converts that partition to ordinary
+# pull, it never loses a job — and lockdep + the resource ledger watch
+# the new push leaf locks (push.sched, push.staging) and paired gauges
+# (push.on_air, push.staged.bytes): a killed supplier or dropped
+# connection must strand neither.
+PUSHSPEC="net.push=truncate:prob:0.1:seed:${SEED},push.admit=error:prob:0.1:seed:$((SEED + 1)),data_engine.pread=delay:$((SEED % 8 + 1)):prob:0.2:seed:$((SEED + 2))"
+PUSHCOUNTERS="$(mktemp)"
+PUSHCYCLES="$(mktemp)"
+PUSHLEAKS="$(mktemp)"
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${ACOUNTERS}" "${ELJSON}" "${ELCOUNTERS}" "${ELCYCLES}" "${ELLEAKS}" "${PUSHCOUNTERS}" "${PUSHCYCLES}" "${PUSHLEAKS}"; rm -rf "${FRROOT}"' EXIT
+echo "push schedule:       ${PUSHSPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
+pushrc=0
+env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PUSHSPEC}" UDA_TPU_STATS=1 \
+    UDA_TPU_CHAOS_SEED="${SEED}" \
+    UDA_TPU_FLIGHTREC_DIR="${FRROOT}/push" \
+    UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${PUSHCYCLES}" \
+    UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${PUSHLEAKS}" \
+    UDA_TPU_CHAOS_TELEMETRY="${PUSHCOUNTERS}" \
+    python -m pytest tests/test_push.py -m faults -q \
+    -p no:cacheprovider \
+    --continue-on-collection-errors "$@" || pushrc=$?
+
 # Lockdep rung: the whole faults tier again with the runtime lock-order
 # validator armed (uda_tpu/utils/locks.py, UDA_TPU_LOCKDEP=1). Two
 # guarantees, both checked: the seeded AB/BA inversion fixture
@@ -308,7 +337,7 @@ env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 \
 # cycle report (UDA_TPU_LOCKDEP_JSON) folded into the telemetry below.
 LCOUNTERS="$(mktemp)"
 LCYCLES="$(mktemp)"
-trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${ACOUNTERS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
+trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${ACOUNTERS}" "${ELJSON}" "${ELCOUNTERS}" "${ELCYCLES}" "${ELLEAKS}" "${PUSHCOUNTERS}" "${PUSHCYCLES}" "${PUSHLEAKS}" "${LCOUNTERS}" "${LCYCLES}"; rm -rf "${FRROOT}"' EXIT
 echo "lockdep schedule:    ${SPEC} (UDA_TPU_LOCKDEP=1)"
 lrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${SPEC}" UDA_TPU_STATS=1 \
@@ -335,7 +364,9 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${RESLEAKS}" \
     "${ASPEC}" "${ACOUNTERS}" "${anrc}" \
     "${ELJSON}" "${ELCOUNTERS}" "${elrc}" "${ELCYCLES}" \
-    "${ELLEAKS}" <<'EOF' || mrc=$?
+    "${ELLEAKS}" \
+    "${PUSHSPEC}" "${PUSHCOUNTERS}" "${pushrc}" "${PUSHCYCLES}" \
+    "${PUSHLEAKS}" <<'EOF' || mrc=$?
 import glob, json, os, sys
 sys.path.insert(0, os.getcwd())
 from uda_tpu.utils.critpath import buckets_from_counters
@@ -350,8 +381,9 @@ from uda_tpu.utils.critpath import buckets_from_counters
  tenspec, tencounters, tenrc, tencycles, tenleaks_path,
  resspec, rescounters, resrc_, rescycles, resleaks_path,
  aspec, acounters, anrc,
- eljson, elcounters, elrc_, elcycles, elleaks_path) = \
-    sys.argv[1:52]
+ eljson, elcounters, elrc_, elcycles, elleaks_path,
+ pushspec, pushcounters, pushrc_, pushcycles, pushleaks_path) = \
+    sys.argv[1:57]
 frroot = os.environ.get("FRROOT", "")
 def flightrec_block(rung, exit_code):
     """Archive the rung's black-box dumps (cause + structured extra +
@@ -545,6 +577,41 @@ elastic_dead = (not int(elrc_)
                 and (not el_scenario.get("identical")
                      or not el_scenario.get("store_failover", 0)
                      or el_scenario.get("fallback_signals", 1)))
+push, push_reports = lockdep_block(pushspec, pushrc_, pushcounters,
+                                   pushcycles)
+push_leaks = resledger_block(push, pushleaks_path)
+# the push contract, surfaced: chunks pushed and acked, the typed
+# refusals (each one a partition converting to pull, zero bytes
+# lost), adopted prefixes, and the settlement guarantee — nothing
+# left on the push window or in staging after every kill/tear (the
+# per-test asserts enforce byte-identity against the pull oracle;
+# this block is the cross-round diffable record)
+pshc = push["telemetry"].get("counters", {})
+pshg = push["telemetry"].get("gauges", {})
+push["pushed"] = {
+    "commits": pshc.get("push.commits", 0),
+    "chunks": pshc.get("push.chunks", 0),
+    "acks": pshc.get("push.acks", 0),
+    "nacks": pshc.get("push.nacks", 0),
+    "refused": pshc.get("push.refused", 0),
+    "push_errors": pshc.get("push.errors", 0),
+    "adopted": pshc.get("push.adopted", 0),
+    "adopted_bytes": pshc.get("push.adopted.bytes", 0),
+    "fallback_signals": pshc.get("fallback.signals", 0),
+    "on_air_left": pshg.get("push.on_air", 0),
+    "staged_bytes_left": pshg.get("push.staged.bytes", 0),
+}
+# a passing push rung that pushed NOTHING, fell back, or stranded its
+# window/staging means the plane under test never engaged (or leaked)
+# — fail the tier like the elastic/anomaly dead-rung checks
+# absent counters/gauges read as 0 — a counter that never
+# incremented is simply missing from the export, which is the
+# HEALTHY case for fallback.signals and the settled gauges
+push_dead = (not int(pushrc_)
+             and (not pshc.get("push.chunks", 0)
+                  or pshc.get("fallback.signals", 0)
+                  or pshg.get("push.on_air", 0)
+                  or pshg.get("push.staged.bytes", 0)))
 anomaly_telem = load(acounters)
 # the proactive-capture contract, surfaced: detector firings, the
 # rate-limited black-box dumps, and the PROACTIVE guarantee — zero
@@ -563,7 +630,8 @@ anomaly = {"schedule": aspec, "pytest_exit": int(anrc),
                "fallback_signals": acc.get("fallback.signals", 0)}}
 lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 nleak = (len(n_leaks) + len(c_leaks) + len(pi_leaks) + len(io_leaks)
-         + len(ten_leaks) + len(res_leaks) + len(el_leaks))
+         + len(ten_leaks) + len(res_leaks) + len(el_leaks)
+         + len(push_leaks))
 # flight-recorder archive, one block per rung; a rung that failed
 # without a single black-box dump flags failed_without_dump
 fr = {"main": flightrec_block("main", rc),
@@ -577,6 +645,7 @@ fr = {"main": flightrec_block("main", rc),
       "resume": flightrec_block("resume", resrc_),
       "anomaly": flightrec_block("anomaly", anrc),
       "elastic": flightrec_block("elastic", elrc_),
+      "push": flightrec_block("push", pushrc_),
       "lockdep": flightrec_block("lockdep", lrc)}
 network["flightrec"] = fr["network"]
 exchange["flightrec"] = fr["exchange"]
@@ -587,6 +656,7 @@ tenant["flightrec"] = fr["tenant"]
 resume["flightrec"] = fr["resume"]
 anomaly["flightrec"] = fr["anomaly"]
 elastic["flightrec"] = fr["elastic"]
+push["flightrec"] = fr["push"]
 lockdep["flightrec"] = fr["lockdep"]
 # the anomaly rung's enforced guarantee (the flip side of
 # failed_without_dump): a PASSING anomaly rung that left no proactive
@@ -619,18 +689,20 @@ with open(out, "w") as f:
                "resume": resume,
                "anomaly": anomaly,
                "elastic": elastic,
+               "push": push,
                "lockdep": lockdep,
                "resledger": {"armed_rungs": ["network", "completion",
                                              "pipeline", "iobatch",
                                              "tenant", "resume",
-                                             "elastic"],
+                                             "elastic", "push"],
                              "leaks": nleak},
                "flightrec_missing_postmortem": no_postmortem},
               f, indent=1, sort_keys=True)
     f.write("\n")
 ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
         + len(pi_reports) + len(io_reports) + len(ten_reports)
-        + len(res_reports) + len(el_reports) + len(l_reports))
+        + len(res_reports) + len(el_reports) + len(push_reports)
+        + len(l_reports))
 ndumps = sum(b["dumps"] for b in fr.values())
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc}, "
       f"resledger leaks: {nleak}, flightrec dumps: {ndumps})")
@@ -648,13 +720,18 @@ if elastic_dead:
           "shows no engaged failover, a byte drift, or a fallback — "
           "the blob-kill/drain/join machinery never exercised, which "
           "defeats the rung's purpose", file=sys.stderr)
+if push_dead:
+    print("PUSH: the push rung passed but pushed no chunks, raised a "
+          "FallbackSignal, or left the push window/staging gauges "
+          "nonzero — the push plane never engaged or leaked, which "
+          "defeats the rung's purpose", file=sys.stderr)
 # the zero-cycles / zero-leaks / dump-on-failure / proactive-capture
 # guarantees are ENFORCED, not just printed: a detected inversion, a
 # leaked obligation, a failing rung with no post-mortem record, or an
 # anomaly rung with no proactive capture all fail the tier — that is
 # the entire point of lockdep, the ledger and the flight recorder
 sys.exit(3 if (ncyc or nleak or no_postmortem or no_proactive
-               or elastic_dead)
+               or elastic_dead or push_dead)
          else 0)
 EOF
 if [ "${prc}" -ne 0 ]; then rc="${prc}"; fi
@@ -667,6 +744,7 @@ if [ "${tenrc}" -ne 0 ]; then rc="${tenrc}"; fi
 if [ "${resrc}" -ne 0 ]; then rc="${resrc}"; fi
 if [ "${anrc}" -ne 0 ]; then rc="${anrc}"; fi
 if [ "${elrc}" -ne 0 ]; then rc="${elrc}"; fi
+if [ "${pushrc}" -ne 0 ]; then rc="${pushrc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
   echo "LOCKDEP/RESLEDGER/FLIGHTREC: cycle reports, leaked obligations" \

@@ -30,6 +30,18 @@ def test_harness_pass_produces_report(tmp_path):
     assert os.path.exists(os.path.join(tmp_path, "results.md"))
 
 
+def test_push_streaming_workload_passes(tmp_path):
+    # the ISSUE 19 rung: map outputs commit while the reducer drains,
+    # gated on sortedness + record-multiset across the push/pull seam
+    proc = _run(tmp_path, "push_streaming")
+    assert proc.returncode == 0, proc.stderr[-800:]
+    report = json.load(open(os.path.join(tmp_path, "results.json")))
+    assert report["failed"] == []
+    detail = report["results"][0]["detail"]
+    assert detail["push_chunks"] > 0
+    assert detail["push_adopted_bytes"] > 0
+
+
 def test_harness_unknown_workload_errors(tmp_path):
     proc = _run(tmp_path, "not_a_workload")
     assert proc.returncode == 2

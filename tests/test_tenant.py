@@ -349,14 +349,31 @@ def _fetch_sync(client, req, timeout=10.0):
     return box[0]
 
 
-def _fetch_job(client, job, num_maps, reduce_id=0):
-    """All of one reducer's records for a job over ``client``."""
+def _fetch_job(client, job, num_maps, reduce_id=0, retries=8):
+    """All of one reducer's records for a job over ``client``.
+
+    Bounded per-map retry: this raw helper has none of the merge
+    path's offset-ledger revalidation, so under an ambient chaos
+    schedule (UDA_FAILPOINTS arming data_engine.pread) a truncated or
+    errored pread surfaces here directly and must be absorbed by
+    re-requesting the map — the same absorb-and-refetch contract the
+    product path honors.  Without faults the first attempt always
+    succeeds.
+    """
     got = []
     for mid in map_ids(job, num_maps):
-        res = _fetch_sync(client, ShuffleRequest(job, mid, reduce_id, 0,
-                                                 1 << 20))
-        assert isinstance(res, FetchResult), f"fetch failed: {res!r}"
-        got += list(crack(res.data).iter_records())
+        for attempt in range(retries):
+            res = _fetch_sync(client, ShuffleRequest(job, mid, reduce_id, 0,
+                                                     1 << 20))
+            if not isinstance(res, FetchResult):
+                assert attempt < retries - 1, f"fetch failed: {res!r}"
+                continue
+            try:
+                got += list(crack(res.data).iter_records())
+                break
+            except StorageError:       # truncated pread served whole
+                if attempt == retries - 1:
+                    raise
     return got
 
 

@@ -177,6 +177,14 @@ DEFAULT_PAIRS: Tuple[ObligationPair, ...] = (
         gauge="store.migrate.bytes.on_air",
         description="bytes mid-migration between store tiers "
                     "(mofserver/store.py StoreManager.migrate)"),
+    ObligationPair(
+        "gauge.push.on_air", kind="gauge", gauge="push.on_air",
+        description="in-flight MSG_PUSH chunks awaiting ACK/NACK "
+                    "(net/push.py PushScheduler)"),
+    ObligationPair(
+        "gauge.push.staged", kind="gauge", gauge="push.staged.bytes",
+        description="pushed bytes staged reduce-side but not yet "
+                    "adopted or discarded (net/push.py PushStaging)"),
 )
 
 

@@ -252,6 +252,79 @@ class MergeManager:
         self._watchdog: Optional[StallWatchdog] = None
         self._stall_error: Optional[StallError] = None
         self._emit_progress = 0
+        # push plane (ISSUE 19): reduce-side staging, armed by
+        # arm_push() — ideally by the embedder the moment the reduce
+        # task is SCHEDULED (pushes then overlap the entire map phase);
+        # fetch_all arms it lazily otherwise
+        self._push_staging = None
+
+    def arm_push(self, job_id: str, reduce_id: int, hosts=None):
+        """Arm reduce-side push staging for this task and subscribe the
+        supplier fleet (``uda.tpu.push.enable``). Idempotent; returns
+        the staging or None when the plane stays pull-only: flag off,
+        a transport without a push plane (LocalFetchClient, custom
+        connects), or a byte-domain-transforming wrapper
+        (DecompressingClient — pushed bytes are the on-disk compressed
+        stream, the Segment ledger's domain is the decompressed one).
+
+        Call it BEFORE the map phase finishes to win overlap: pushes
+        land while maps are still running, and the fetch wave then
+        starts from the staged offsets instead of zero."""
+        if self._push_staging is not None:
+            return self._push_staging
+        if not bool(self.cfg.get("uda.tpu.push.enable")):
+            return None
+        if getattr(self.client, "inner", None) is not None:
+            return None
+        reg = getattr(self.client, "push_register", None)
+        if not callable(reg):
+            return None
+        from uda_tpu.net.push import PushStaging
+
+        staging = PushStaging(job_id, int(reduce_id), cfg=self.cfg,
+                              budget=self.budget())
+        reg(job_id, int(reduce_id), staging, hosts=hosts)
+        self._push_staging = staging
+        return staging
+
+    def _release_push(self) -> None:
+        """Unsubscribe and discard unclaimed staged bytes (idempotent;
+        run()'s finally). Late pushes after this draw
+        PUSH_NACK(UNKNOWN) and the supplier goes pull-only — no frame
+        is ever left unanswered."""
+        staging, self._push_staging = self._push_staging, None
+        if staging is None:
+            return
+        unreg = getattr(self.client, "push_unregister", None)
+        if callable(unreg):
+            unreg(staging.job_id, staging.reduce_id)
+        staging.close()
+
+    def _push_adopt(self, seg: Segment) -> None:
+        """Right before a segment starts: claim its map in staging and
+        arm the staged prefix as a resumed fetch (Segment.ckpt_preload
+        — the PUSHED bytes land in the offset ledger exactly like a
+        checkpoint's, so retry/speculation/reconstruction compose
+        unchanged). The claim stands even when nothing usable is
+        staged: from here the fetch is in flight, and later pushes for
+        this map are refused CLAIMED (dedup)."""
+        staging = self._push_staging
+        if staging is None:
+            return
+        kw = staging.take(seg.map_id)
+        if kw is None:
+            return
+        if seg._next_offset or seg.batches:
+            return  # a checkpoint ledger is further along; keep it
+        try:
+            seg.ckpt_preload(**kw)
+        except UdaError as e:
+            metrics.add("push.invalidated")
+            log.warn(f"pushed prefix of map {seg.map_id} rejected, "
+                     f"fetching from zero: {e}")
+            return
+        metrics.add("push.adopted")
+        metrics.add("push.adopted.bytes", int(kw["next_offset"]))
 
     def budget(self) -> MemoryBudget:
         if self._budget_obj is None:
@@ -347,6 +420,13 @@ class MergeManager:
             return hosts or [""], mid
 
         entries = [_norm(m) for m in map_ids]
+        # push plane: arm lazily if the embedder did not (no overlap
+        # won at this point — the map phase may already be over — but
+        # pushes still beat pulls for any map that commits during this
+        # fetch wave)
+        self.arm_push(job_id, reduce_id,
+                      hosts={h for hosts, _ in entries for h in hosts
+                             if h})
         stripe_ctx = None
         if self.coding_scheme is not None:
             from uda_tpu.coding.recovery import StripeContext
@@ -481,6 +561,10 @@ class MergeManager:
                 segs[i].on_done = on_done
                 segs[i].on_fault = on_fault
                 started.append(segs[i])
+                # adopt the staged push prefix AT START TIME, not at
+                # construction: maps that committed while earlier
+                # segments held the window get their pushed bytes in
+                self._push_adopt(segs[i])
                 segs[i].start()
             for s in segs:
                 if s is not None:
@@ -630,6 +714,7 @@ class MergeManager:
             raise FallbackSignal(e) from e
         finally:
             unregister_stats_provider(provider_name, _recovery_provider)
+            self._release_push()
             if wd is not None:
                 wd.stop()
                 self._watchdog = None
@@ -1048,4 +1133,5 @@ class MergeManager:
 
     def stop(self) -> None:
         self._stop.set()
+        self._release_push()
         self.client.stop()

@@ -187,6 +187,11 @@ class HostRoutingClient(InputClient):
         # MergeManager.notify_join widen in-flight segments.
         self._members: set[str] = set()
         self._draining: set[str] = set()
+        # push plane (ISSUE 19): (job, reduce) -> staging, applied to
+        # every transport the router builds — including transports
+        # created (or re-dialed after refresh()) AFTER registration,
+        # so a joiner/bounced supplier gets subscribed too
+        self._push_regs: dict = {}
         self._lock = TrackedLock("host_router")
 
     @staticmethod
@@ -245,7 +250,53 @@ class HostRoutingClient(InputClient):
             with self._lock:
                 if self._stopped:
                     raise MergeError("HostRoutingClient is stopped")
+                regs = list(self._push_regs.items())
+            self._apply_push_regs(client, regs)
         return client
+
+    @staticmethod
+    def _apply_push_regs(client: InputClient, regs) -> None:
+        """Subscribe an armed push registration on one transport.
+        Duck-typed: transports without a push plane (LocalFetchClient,
+        custom connects) simply stay pull-only."""
+        reg = getattr(client, "push_register", None)
+        if not callable(reg):
+            return
+        for (job_id, reduce_id), staging in regs:
+            reg(job_id, reduce_id, staging)
+
+    # -- push plane (ISSUE 19) -----------------------------------------------
+
+    def push_register(self, job_id: str, reduce_id: int, staging,
+                      hosts=None) -> None:
+        """Register reduce-side staging across the supplier fleet:
+        every cached transport subscribes now, every FUTURE transport
+        (lazy first-fetch dial, join, post-refresh re-dial) subscribes
+        at build time. ``hosts`` eagerly dials the named suppliers so
+        pushes can arrive before the first fetch exists; dial failures
+        are best-effort (those hosts stay pull-only until fetched)."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._push_regs[(job_id, int(reduce_id))] = staging
+            cached = list(self._clients.values())
+        regs = [((job_id, int(reduce_id)), staging)]
+        for client in cached:
+            self._apply_push_regs(client, regs)
+        for host in set(hosts or ()) | set(self.members()):
+            try:
+                self._client_for(host)  # _apply_push_regs rides the build
+            except Exception:  # noqa: BLE001 - eager dial is advisory
+                metrics.add("push.dial.failures", supplier=host)
+
+    def push_unregister(self, job_id: str, reduce_id: int) -> None:
+        with self._lock:
+            self._push_regs.pop((job_id, int(reduce_id)), None)
+            cached = list(self._clients.values())
+        for client in cached:
+            unreg = getattr(client, "push_unregister", None)
+            if callable(unreg):
+                unreg(job_id, reduce_id)
 
     def start_fetch(self, req: ShuffleRequest, on_complete) -> None:
         try:

@@ -192,7 +192,8 @@ class MOFWriter:
     def __init__(self, root: str, job_id: str, codec=None, scheme=None,
                  supplier_roots: Optional[Sequence[str]] = None,
                  supplier_index: int = 0,
-                 domains: Optional[dict] = None, store=None):
+                 domains: Optional[dict] = None, store=None,
+                 on_commit=None):
         self.root = root
         self.job_id = job_id
         self.codec = codec
@@ -205,6 +206,13 @@ class MOFWriter:
         # write's on-disk bytes are accounted against the retention
         # watermark so over-budget suppliers spill as they produce
         self.store = store
+        # the push plane's commit seam (ISSUE 19): called as
+        # ``on_commit(job_id, map_id)`` AFTER the map output is fully
+        # on disk and accounted — wire it to
+        # ``EvLoopShuffleServer.notify_commit`` and every subscribed
+        # reduce connection starts receiving the partitions as
+        # MSG_PUSH chunks while the map phase is still running
+        self.on_commit = on_commit
 
     def map_dir(self, map_id: str) -> str:
         return os.path.join(self.root, self.job_id, map_id)
@@ -248,3 +256,5 @@ class MOFWriter:
                 nbytes = 0
             if nbytes:
                 self.store.account_write(self.job_id, map_id, nbytes)
+        if self.on_commit is not None:
+            self.on_commit(self.job_id, map_id)

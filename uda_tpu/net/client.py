@@ -54,7 +54,7 @@ from uda_tpu.mofserver.data_engine import ShuffleRequest
 from uda_tpu.net import wire
 from uda_tpu.net.evloop import EventLoop, loop_callback, shared_client_loop
 from uda_tpu.utils.config import Config
-from uda_tpu.utils.errors import ProtocolError, TransportError
+from uda_tpu.utils.errors import ProtocolError, TransportError, UdaError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.locks import TrackedLock
 from uda_tpu.utils.logging import get_logger
@@ -105,6 +105,11 @@ class _ClientConn:
         self._payload: Optional[bytearray] = None
         self._pay_got = 0
         self._cur = (0, 0)
+        # (job, reduce) push subscriptions already SUB'd on THIS
+        # connection — per connection by construction, so a reconnect
+        # re-subscribes from scratch (the server's tables died with
+        # the old socket)
+        self.push_subbed: set = set()
 
     # -- registration --------------------------------------------------------
 
@@ -278,6 +283,13 @@ class _ClientConn:
             generation, warm, caps = wire.decode_hello_ex(bytes(payload))
             self.client._on_hello(generation, warm, caps)
             return
+        elif msg_type == wire.MSG_PUSH:
+            # supplier-initiated chunk (ISSUE 19): only arrives on
+            # connections that PUSH_SUB'd. Admission (budget route,
+            # possible spill write) blocks — dispatcher thread, never
+            # the loop
+            self.client._on_push(self, req_id, payload)
+            return
         else:
             raise TransportError(
                 f"unexpected frame type {msg_type} on the client side")
@@ -366,6 +378,13 @@ class EvLoopFetchClient(InputClient):
         # land unregistered (typed refusal under strict, a silent
         # default-tenant pass otherwise).
         self._bound_jobs: dict = {}
+        # push plane (ISSUE 19): (job, reduce) -> PushStaging. The
+        # registration OUTLIVES connections — every fresh banner that
+        # advertises CAP_PUSH gets the subscriptions re-sent (the
+        # per-conn sent-set lives on the connection object).
+        self._push_staging: dict = {}
+        self._push_window = max(1, int(cfg.get("uda.tpu.push.window")))
+        self._push_chunk = int(cfg.get("mapred.rdma.buf.size")) * 1024
 
     def _on_hello(self, generation: int, warm: bool,
                   caps: int = 0) -> None:
@@ -476,6 +495,10 @@ class EvLoopFetchClient(InputClient):
         # peer behind host:port may have been REPLACED since the last
         # banner (stale CAP_TRACE against an old decoder tears frames).
         self._hello_seen.wait(timeout=min(2.0, self.connect_timeout_s))
+        # re-subscribe the push plane on every fresh banner: the
+        # server-side tables died with the previous socket (a timed-out
+        # banner leaves caps=0 — no SUB, pull-only, always legal)
+        self._send_push_subs(conn)
         return conn
 
     def _trace_of(self, span) -> Optional[tuple]:
@@ -674,6 +697,88 @@ class EvLoopFetchClient(InputClient):
                     self._bound_jobs.pop(job_id, None)
             raise result
         return int(result)
+
+    # -- push plane (ISSUE 19) ----------------------------------------------
+
+    def push_register(self, job_id: str, reduce_id: int, staging,
+                      hosts=None) -> None:
+        """Arm reduce-side staging for (job, reduce) and subscribe the
+        supplier: committed partitions start arriving as MSG_PUSH
+        chunks. Dial is eager (pushes need a live connection before
+        the first fetch exists) but best-effort — a failed dial just
+        leaves the plane pull-only until the next fetch redials, and
+        a push-less peer (no CAP_PUSH in its banner) is never sent a
+        SUB at all."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._push_staging[(job_id, int(reduce_id))] = staging
+        try:
+            conn = self._ensure_connected()
+        except TransportError:
+            return
+        self._send_push_subs(conn)
+
+    def push_unregister(self, job_id: str, reduce_id: int) -> None:
+        """Drop the staging registration. No un-SUB frame exists (nor
+        needs to): a late push finds no staging, draws
+        PUSH_NACK(UNKNOWN), and the supplier marks the partition
+        pull-only — self-healing by the refusal path."""
+        with self._lock:
+            self._push_staging.pop((job_id, int(reduce_id)), None)
+
+    def _send_push_subs(self, conn: _ClientConn) -> None:
+        """Send MSG_PUSH_SUB for every registration not yet SUB'd on
+        this connection (idempotent per conn; any thread). Fire and
+        forget, the MSG_JOB discipline: a refusal would come back as a
+        typed ERR with no waiter — counted as an orphan, and the plane
+        simply stays pull-only."""
+        frames = []
+        with self._lock:
+            if self._conn is not conn or not self._push_staging \
+                    or not self._peer_caps & wire.CAP_PUSH:
+                return
+            for key in self._push_staging:
+                if key in conn.push_subbed:
+                    continue
+                conn.push_subbed.add(key)
+                self._next_id += 1
+                frames.append(wire.encode_push_sub(
+                    self._next_id, job_id=key[0], reduce_id=key[1],
+                    window=self._push_window,
+                    chunk_size=self._push_chunk))
+        for frame in frames:
+            self._post(conn, frame)
+
+    def _on_push(self, conn: _ClientConn, push_id: int,
+                 payload: bytearray) -> None:
+        """Loop thread: hand the pushed chunk to the dispatcher —
+        admission may write a spill file, and the verdict frame goes
+        back inline from there."""
+        conn.loop.dispatch(self._handle_push, conn, push_id, payload)
+
+    def _handle_push(self, conn: _ClientConn, push_id: int,
+                     payload: bytearray) -> None:
+        """Dispatcher thread: decode, run the staging admission
+        ladder, answer PUSH_ACK or PUSH_NACK."""
+        from uda_tpu.net.push import NACK_UNKNOWN
+        try:
+            (job_id, map_id, reduce_id, offset, raw_length, last,
+             data) = wire.decode_push_take(payload)
+        except UdaError as e:
+            conn.loop.call_soon(conn.die, e)
+            return
+        with self._lock:
+            staging = self._push_staging.get((job_id, int(reduce_id)))
+        if staging is None:
+            metrics.add("push.refused", reason="unknown")
+            verdict = NACK_UNKNOWN
+        else:
+            verdict = staging.offer(map_id, offset, raw_length, last,
+                                    data)
+        frame = (wire.encode_push_ack(push_id) if verdict == 0
+                 else wire.encode_push_nack(push_id, verdict))
+        self._post(conn, frame)
 
     def bind_job(self, job_id: str, timeout: float = 10.0) -> int:
         """Register (tenant, job, epoch) with the supplier and wait for

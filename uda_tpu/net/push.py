@@ -1,0 +1,561 @@
+"""Push-based pipelined shuffle: the supplier-initiated MSG_PUSH plane.
+
+The data plane was strictly pull: reducers discover finished MOFs, then
+fetch — so merge work cannot start until the first fetch wave returns
+and the map→shuffle→reduce phases serialize at that barrier. Exoshuffle
+(arXiv:2203.05072) shows that push-vs-pull belongs to the *shuffle
+library as a policy*: map tasks eagerly push partitions to reduce-side
+staging as they materialize, and the three phases fully overlap
+(Exoshuffle-CloudSort, arXiv:2301.03734, rides the same seam at
+production sort scale). This module is that policy for uda_tpu, built
+on seams the plane already owns:
+
+- **Negotiation**: the HELLO banner advertises :data:`wire.CAP_PUSH`;
+  a client that wants pushes subscribes a (job, reduce) with
+  MSG_PUSH_SUB. No subscription, no pushes — a push-less client on a
+  push server (or vice versa) degrades to pure pull byte-identically.
+- **Supplier side** (:class:`PushScheduler`, owned by the
+  ShuffleServer): ``MOFWriter`` commit notifications enqueue one push
+  task per subscribed connection; a per-connection window of un-ACKed
+  pushes (min of both peers' knobs — MSG_DATA's credit discipline,
+  receiver-paced) gates chunk reads off the same DataEngine that
+  serves fetches. A draining supplier (PR 18) stops initiating.
+- **Reduce side** (:class:`PushStaging`, owned by the MergeManager):
+  pushed chunks accumulate per map as the partition's contiguous
+  raw-byte prefix — exactly the coordinates of a resumed fetch. The
+  admission ladder decides per chunk: eager-accept in memory while
+  under the MemoryBudget-derived cap, spill the prefix to a staging
+  run file while under the staged cap, else PUSH_NACK(BUDGET) — the
+  supplier marks that partition pull-only and the prefix already
+  accepted stays usable, so refusal costs zero bytes.
+- **Adoption**: when the merge's fetch wave constructs a Segment, it
+  ``take()``s the staged prefix and arms it via ``Segment.
+  ckpt_preload`` — pushed bytes land in the offset ledger *as if they
+  were a resumed fetch*, so retry, speculation, k-of-n reconstruction,
+  warm-restart and checkpoint/resume compose unchanged. The LAST
+  staged chunk is always withheld: the pull path re-fetches the tail,
+  staying the byte-identity oracle on every partition (and satisfying
+  the engine's offset-past-EOF rejection).
+
+``take()`` claims the map: later pushes for it get PUSH_NACK(CLAIMED),
+which is the dedup against in-flight fetches.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from collections import OrderedDict, deque
+from typing import Optional
+
+from uda_tpu.utils.errors import UdaError
+from uda_tpu.utils.failpoints import failpoint
+from uda_tpu.utils.ifile import crack_partial
+from uda_tpu.utils.locks import TrackedLock
+from uda_tpu.utils.logging import get_logger
+from uda_tpu.utils.metrics import metrics
+
+log = get_logger("push")
+
+# PUSH_NACK reason codes (the wire carries the int; names are for
+# metrics labels and logs — branch on the CODE, never the name).
+NACK_BUDGET = 1    # staging caps exhausted; prefix kept, pull the rest
+NACK_UNKNOWN = 2   # no staging for (job, reduce) — e.g. unregistered
+NACK_CLAIMED = 3   # a Segment already took this map (in-flight fetch)
+NACK_DISABLED = 4  # push plane off on this peer
+NACK_GAP = 5       # offset is not the contiguous next byte (dup
+                   # supplier or reordered stream) — prefix kept
+
+NACK_REASONS = {
+    NACK_BUDGET: "budget",
+    NACK_UNKNOWN: "unknown",
+    NACK_CLAIMED: "claimed",
+    NACK_DISABLED: "disabled",
+    NACK_GAP: "gap",
+}
+
+
+def nack_reason_name(code: int) -> str:
+    return NACK_REASONS.get(code, f"code{code}")
+
+
+# -- reduce side -------------------------------------------------------------
+
+
+class _MapStage:
+    """One partition's staged contiguous prefix: raw on-disk bytes from
+    offset 0, split between an in-memory bytearray (the eager tier) and
+    an overflow run file (the spill tier, strictly after the memory
+    bytes)."""
+
+    __slots__ = ("mem", "spill_path", "spill_bytes", "chunk_lens",
+                 "next_off", "raw_length", "complete", "claimed")
+
+    def __init__(self):
+        self.mem = bytearray()
+        self.spill_path: Optional[str] = None
+        self.spill_bytes = 0
+        self.chunk_lens: list[int] = []
+        self.next_off = 0
+        self.raw_length: Optional[int] = None
+        self.complete = False
+        self.claimed = False
+
+    @property
+    def total(self) -> int:
+        return len(self.mem) + self.spill_bytes
+
+
+class PushStaging:
+    """Reduce-side staging for one (job, reduce): the landing zone of
+    MSG_PUSH chunks and the preload source of the merge's Segments.
+
+    Thread contract: ``offer`` runs on transport dispatcher threads
+    (one per connection is possible — multiple supplier hosts push
+    concurrently), ``take``/``close`` on the merge manager's thread;
+    one leaf lock serializes them.
+    """
+
+    def __init__(self, job_id: str, reduce_id: int, *, cfg,
+                 budget=None):
+        self.job_id = job_id
+        self.reduce_id = int(reduce_id)
+        eager_mb = float(cfg.get("uda.tpu.push.eager.mb"))
+        staged_mb = float(cfg.get("uda.tpu.push.staged.mb"))
+        if eager_mb > 0:
+            self.eager_cap = int(eager_mb * (1 << 20))
+        elif budget is not None:
+            # auto: an eighth of the host read budget — pushes must
+            # never crowd out the fetch pipeline's own admission
+            self.eager_cap = max(1 << 20, budget.host_budget_bytes // 8)
+        else:
+            self.eager_cap = 8 << 20
+        self.staged_cap = (int(staged_mb * (1 << 20)) if staged_mb > 0
+                           else 4 * self.eager_cap)
+        self.spill_ok = bool(cfg.get("uda.tpu.push.spill"))
+        from uda_tpu.merger.streaming import spill_dirs
+        self._spill_dir = spill_dirs(cfg)[0]
+        self._lock = TrackedLock("push.staging")
+        self._maps: "OrderedDict[str, _MapStage]" = OrderedDict()
+        self._closed = False
+
+    # -- admission ladder (one verdict per pushed chunk) --
+
+    def offer(self, map_id: str, offset: int, raw_length: int,
+              last: bool, data) -> int:
+        """Admit one pushed chunk. Returns 0 (ACK) or a NACK reason
+        code. The contiguous prefix accepted so far survives every
+        refusal — a NACK converts the REMAINDER to ordinary pull."""
+        n = len(data)
+        with self._lock:
+            if self._closed:
+                return self._refused(NACK_UNKNOWN)
+            st = self._maps.get(map_id)
+            if st is None:
+                st = self._maps[map_id] = _MapStage()
+            if st.claimed:
+                return self._refused(NACK_CLAIMED)
+            if offset != st.next_off:
+                return self._refused(NACK_GAP)
+            try:
+                failpoint("push.admit", key=f"{self.job_id}:{map_id}")
+            except UdaError:
+                return self._refused(NACK_BUDGET)
+            total = sum(s.total for s in self._maps.values())
+            if total + n > self.staged_cap:
+                return self._refused(NACK_BUDGET)
+            mem = sum(len(s.mem) for s in self._maps.values())
+            if st.spill_path is None and mem + n <= self.eager_cap:
+                st.mem += data
+                tier = "eager"
+            elif self.spill_ok:
+                try:
+                    self._spill(st, data)
+                except OSError as e:
+                    log.warn(f"push: staging spill failed ({e}); "
+                             f"refusing chunk")
+                    return self._refused(NACK_BUDGET)
+                tier = "spill"
+            else:
+                return self._refused(NACK_BUDGET)
+            st.chunk_lens.append(n)
+            st.next_off = offset + n
+            st.raw_length = int(raw_length)
+            st.complete = bool(last)
+            metrics.add("push.accepted", tier=tier)
+            metrics.add("push.accepted.bytes", n)
+            metrics.gauge_add("push.staged.bytes", n)  # udalint: disable=UDA101 - released by take()/close()
+            return 0
+
+    @staticmethod
+    def _refused(reason: int) -> int:
+        metrics.add("push.refused", reason=nack_reason_name(reason))
+        return reason
+
+    def _spill(self, st: _MapStage, data) -> None:
+        """Append ``data`` to the map's staging run file (the spill
+        tier keeps strict byte order after the memory prefix)."""
+        if st.spill_path is None:
+            fd, st.spill_path = tempfile.mkstemp(
+                prefix=f"uda-push-{self.reduce_id}-", suffix=".stage",
+                dir=self._spill_dir)
+            os.close(fd)
+        with open(st.spill_path, "ab") as f:
+            f.write(data)
+        st.spill_bytes += len(data)
+        metrics.add("push.spilled.bytes", len(data))
+
+    # -- adoption --
+
+    def take(self, map_id: str) -> Optional[dict]:
+        """Claim ``map_id`` and return ``Segment.ckpt_preload`` kwargs
+        for its staged prefix, or None when nothing usable is staged.
+        Claiming is unconditional — from here on pushes for this map
+        are NACK_CLAIMED (the dedup against the now in-flight fetch).
+
+        The last staged chunk is withheld so ``next_offset`` stays
+        strictly inside the partition: the pull path always re-fetches
+        a tail chunk, remaining the byte-identity oracle (and the
+        engine's offset-past-EOF rejection is never tripped)."""
+        with self._lock:
+            st = self._maps.get(map_id)
+            if st is None:
+                st = self._maps[map_id] = _MapStage()
+                st.claimed = True
+                return None
+            if st.claimed:
+                return None
+            st.claimed = True
+            total = st.total
+            if total:
+                metrics.gauge_add("push.staged.bytes", -total)
+            if not st.chunk_lens:
+                return None
+            drop = st.chunk_lens[-1]
+            usable = total - drop
+            if usable <= 0:
+                self._free(st)
+                return None
+            data = bytes(st.mem)
+            if st.spill_bytes:
+                with open(st.spill_path, "rb") as f:
+                    data += f.read()
+            raw_length = st.raw_length
+            self._free(st)
+        data = data[:usable]
+        try:
+            batch, consumed, _ = crack_partial(data, expect_eof=False)
+        except UdaError:
+            metrics.add("push.invalidated")
+            return None
+        return dict(data=data, carry_len=len(data) - consumed,
+                    next_offset=usable, raw_length=raw_length,
+                    num_records=batch.num_records)
+
+    @staticmethod
+    def _free(st: _MapStage) -> None:
+        """Lock held: drop a claimed map's staged bytes (the gauge was
+        already settled by the claim)."""
+        st.mem = bytearray()
+        st.chunk_lens = []
+        if st.spill_path is not None:
+            try:
+                os.unlink(st.spill_path)
+            except OSError:
+                pass
+            st.spill_path = None
+        st.spill_bytes = 0
+
+    def staged_bytes(self) -> int:
+        with self._lock:
+            return sum(s.total for s in self._maps.values()
+                       if not s.claimed)
+
+    def close(self) -> None:
+        """Discard everything unclaimed and settle the staged gauge
+        (idempotent; the MergeManager calls this when the run ends)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for st in self._maps.values():
+                if not st.claimed and st.total:
+                    metrics.gauge_add("push.staged.bytes", -st.total)
+                st.claimed = True
+                self._free(st)
+            self._maps.clear()
+
+
+# -- supplier side -----------------------------------------------------------
+
+
+class _PushTask:
+    """One (subscription, map) pair being pushed: chunks go out
+    sequentially (ONE outstanding chunk per task — ordering by
+    construction; the window parallelizes across tasks)."""
+
+    __slots__ = ("job_id", "map_id", "reduce_id", "offset", "inflight",
+                 "dead")
+
+    def __init__(self, job_id: str, map_id: str, reduce_id: int):
+        self.job_id = job_id
+        self.map_id = map_id
+        self.reduce_id = reduce_id
+        self.offset = 0
+        self.inflight = False
+        self.dead = False
+
+
+class _ConnSub:
+    """Per-connection push state: the subscriptions this peer asked
+    for, the task queue feeding it and the un-ACKed window."""
+
+    __slots__ = ("conn", "subs", "tasks", "window", "chunk", "on_air",
+                 "pull_only")
+
+    def __init__(self, conn, window: int, chunk: int):
+        self.conn = conn
+        self.subs: set = set()        # {(job_id, reduce_id)}
+        self.tasks: deque = deque()
+        self.window = window
+        self.chunk = chunk
+        self.on_air = 0
+        self.pull_only: set = set()   # {(job_id, reduce_id, map_id)}
+
+
+class PushScheduler:
+    """Supplier-side push pump, owned by the event-loop ShuffleServer.
+
+    Entry points and their threads: ``subscribe``/``on_ack``/
+    ``on_nack`` arrive from the loop thread (frame dispatch),
+    ``notify_commit`` from whatever thread runs the MOFWriter,
+    ``drop_conn`` from the loop (connection close), chunk completions
+    from the engine's pool threads. One leaf lock guards the tables;
+    the lock is NEVER held across an engine submit or a connection
+    enqueue (both can run arbitrary downstream work)."""
+
+    def __init__(self, server, engine, cfg):
+        self.server = server
+        self.engine = engine
+        self.window = max(1, int(cfg.get("uda.tpu.push.window")))
+        self.chunk = int(cfg.get("mapred.rdma.buf.size")) * 1024
+        self._lock = TrackedLock("push.sched")
+        self._subs: dict = {}        # id(conn) -> _ConnSub
+        self._commits: dict = {}     # job_id -> OrderedDict[map_id]
+        self._inflight: dict = {}    # push_id -> (_ConnSub, _PushTask)
+        self._next_id = 1
+        self._stopped = False
+
+    # -- control-plane entry points --
+
+    def subscribe(self, conn, job_id: str, reduce_id: int,
+                  window: int, chunk: int) -> None:
+        """MSG_PUSH_SUB: remember the subscription and catch up on
+        maps that committed before it arrived."""
+        metrics.add("push.subs")
+        with self._lock:
+            if self._stopped:
+                return
+            cs = self._subs.get(id(conn))
+            if cs is None:
+                cs = self._subs[id(conn)] = _ConnSub(
+                    conn,
+                    window=max(1, min(self.window, int(window) or 1)),
+                    chunk=max(4096, min(self.chunk, int(chunk)
+                                        or self.chunk)))
+            key = (job_id, int(reduce_id))
+            if key in cs.subs:
+                return
+            cs.subs.add(key)
+            for map_id in self._commits.get(job_id, ()):
+                cs.tasks.append(_PushTask(job_id, map_id,
+                                          int(reduce_id)))
+        self._pump(conn)
+
+    def notify_commit(self, job_id: str, map_id: str) -> None:
+        """A MOFWriter committed ``map_id``: fan one push task out to
+        every subscribed connection (any thread)."""
+        metrics.add("push.commits")
+        conns = []
+        with self._lock:
+            if self._stopped:
+                return
+            self._commits.setdefault(job_id, OrderedDict())[map_id] = \
+                None
+            for cs in self._subs.values():
+                for (job, reduce_id) in cs.subs:
+                    if job == job_id:
+                        cs.tasks.append(_PushTask(job_id, map_id,
+                                                  reduce_id))
+                        conns.append(cs.conn)
+        for conn in conns:
+            self._pump(conn)
+
+    def on_ack(self, conn, push_id: int) -> None:
+        metrics.add("push.acks")
+        with self._lock:
+            entry = self._inflight.pop(push_id, None)
+            if entry is not None:
+                self._settle_locked(entry[0])
+        if entry is not None:
+            self._pump(conn)
+
+    def on_nack(self, conn, push_id: int, reason: int) -> None:
+        """The receiver refused a chunk: the partition goes pull-only
+        on this connection — its ACKed prefix stays valid over there,
+        the pull path serves the remainder."""
+        metrics.add("push.nacks", reason=nack_reason_name(reason))
+        with self._lock:
+            entry = self._inflight.pop(push_id, None)
+            if entry is not None:
+                cs, task = entry
+                self._settle_locked(cs)
+                task.dead = True
+                cs.pull_only.add((task.job_id, task.reduce_id,
+                                  task.map_id))
+        if entry is not None:
+            self._pump(conn)
+
+    def drop_conn(self, conn) -> None:
+        """Connection closed: settle its whole window (resledger — a
+        dead peer must not strand push.on_air)."""
+        with self._lock:
+            cs = self._subs.pop(id(conn), None)
+            if cs is None:
+                return
+            dead = [pid for pid, (owner, _t) in self._inflight.items()
+                    if owner is cs]
+            for pid in dead:
+                del self._inflight[pid]
+            if cs.on_air:
+                metrics.gauge_add("push.on_air", -cs.on_air)
+            cs.on_air = 0
+            cs.tasks.clear()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            for cs in self._subs.values():
+                if cs.on_air:
+                    metrics.gauge_add("push.on_air", -cs.on_air)
+                cs.on_air = 0
+                cs.tasks.clear()
+            self._subs.clear()
+            self._inflight.clear()
+
+    @staticmethod
+    def _settle_locked(cs: _ConnSub) -> None:
+        if cs.on_air > 0:
+            cs.on_air -= 1
+            metrics.gauge_add("push.on_air", -1)
+
+    # -- the pump --
+
+    def _pump(self, conn) -> None:
+        """Issue engine chunk reads for ``conn`` until its window is
+        full. Lock discipline: plan under the lock, submit outside."""
+        issues = []
+        with self._lock:
+            if self._stopped or self.server._draining:
+                return
+            cs = self._subs.get(id(conn))
+            if cs is None:
+                return
+            while cs.on_air < cs.window:
+                task = self._next_task_locked(cs)
+                if task is None:
+                    break
+                push_id = self._next_id
+                self._next_id += 1
+                task.inflight = True
+                cs.on_air += 1
+                metrics.gauge_add("push.on_air", 1)  # udalint: disable=UDA101 - released on ACK/NACK/error/drop_conn
+                self._inflight[push_id] = (cs, task)
+                issues.append((push_id, cs, task, task.offset))
+        from uda_tpu.mofserver.data_engine import ShuffleRequest
+        for push_id, cs, task, offset in issues:
+            req = ShuffleRequest(job_id=task.job_id, map_id=task.map_id,
+                                 reduce_id=task.reduce_id, offset=offset,
+                                 chunk_size=cs.chunk)
+            try:
+                fut = self.engine.submit(req)
+            except Exception as e:  # noqa: BLE001 - sync rejection
+                self._push_failed(push_id, e)
+                continue
+            fut.add_done_callback(
+                lambda f, pid=push_id: self._chunk_done(pid, f))
+
+    def _next_task_locked(self, cs: _ConnSub) -> Optional[_PushTask]:
+        while cs.tasks and cs.tasks[0].dead:
+            cs.tasks.popleft()
+        for task in cs.tasks:
+            if task.dead or task.inflight:
+                continue
+            key = (task.job_id, task.reduce_id, task.map_id)
+            if key in cs.pull_only:
+                task.dead = True
+                continue
+            return task
+        return None
+
+    def _chunk_done(self, push_id: int, fut) -> None:
+        """Engine completion (pool thread): frame the chunk, run the
+        net.push failpoint, hand the frame to the connection's
+        outbound queue — the same inline-write path DATA rides."""
+        try:
+            res = fut.result()
+        except Exception as e:  # noqa: BLE001 - missing MOF, stopped
+            # engine, injected fault: this partition goes pull-only
+            self._push_failed(push_id, e)
+            return
+        with self._lock:
+            entry = self._inflight.get(push_id)
+            if entry is None:  # conn dropped while the read ran
+                return
+            cs, task = entry
+            conn = cs.conn
+        from uda_tpu.net import wire
+        frame = wire.encode_push(
+            push_id, job_id=task.job_id, map_id=task.map_id,
+            reduce_id=task.reduce_id, offset=res.offset,
+            raw_length=res.raw_length, last=res.last, data=res.data)
+        try:
+            out = failpoint("net.push", data=frame,
+                            key=getattr(conn, "peer", ""))
+        except Exception as e:  # noqa: BLE001 - injected push failure
+            self._push_failed(push_id, e)
+            return
+        torn = len(out) != len(frame)
+        with self._lock:
+            if self._inflight.get(push_id) is None:
+                return
+            task.inflight = False
+            if torn or res.last:
+                # last chunk SENT (or the stream is about to tear):
+                # the task is done; the window slot stays charged
+                # until the ACK comes back
+                task.dead = True
+            else:
+                task.offset = res.offset + len(res.data)
+        metrics.add("push.chunks")
+        metrics.add("push.bytes", len(res.data))
+        conn.push_frame(out, close_after=torn)
+        if not torn:
+            self._pump(conn)
+
+    def _push_failed(self, push_id: int, err: Exception) -> None:
+        metrics.add("push.errors")
+        with self._lock:
+            entry = self._inflight.pop(push_id, None)
+            if entry is None:
+                return
+            cs, task = entry
+            task.inflight = False
+            task.dead = True
+            cs.pull_only.add((task.job_id, task.reduce_id,
+                              task.map_id))
+            self._settle_locked(cs)
+            conn = cs.conn
+        log.debug(f"push: {task.job_id}/{task.map_id} -> pull-only "
+                  f"({err})")
+        self._pump(conn)

@@ -454,6 +454,37 @@ class _EvConn:
             # risk is the chaos-only tenant.register failpoint, the
             # same deliberate stall shape as net.accept's.
             self._on_job(req_id, payload)
+        elif msg_type == wire.MSG_PUSH_SUB \
+                and self.server.push is not None:
+            # push subscription, uncredited like MSG_JOB (and inline
+            # for the same TCP-ordering reason: a SUB must be recorded
+            # before any REQ behind it is admitted, or the catch-up
+            # pushes could race the first fetch's claim). A push-less
+            # server falls through to the typed-ERR refusal below —
+            # the forward-compat contract doubles as the capability
+            # refusal, and the client just stays pull-only.
+            try:
+                job_id, reduce_id, window, chunk = \
+                    wire.decode_push_sub(payload)
+            except UdaError as e:
+                self._drop(e)
+                return
+            self.server.push.subscribe(self, job_id, reduce_id,
+                                       window, chunk)
+        elif msg_type == wire.MSG_PUSH_ACK \
+                and self.server.push is not None:
+            if len(payload):
+                self._drop(TransportError("malformed PUSH_ACK frame"))
+                return
+            self.server.push.on_ack(self, req_id)
+        elif msg_type == wire.MSG_PUSH_NACK \
+                and self.server.push is not None:
+            try:
+                reason = wire.decode_push_nack(payload)
+            except UdaError as e:
+                self._drop(e)
+                return
+            self.server.push.on_nack(self, req_id, reason)
         else:
             # in-range but unknown/unexpected type: a NEWER peer
             # probing an optional message. Refuse it with a typed ERR
@@ -1015,6 +1046,16 @@ class _EvConn:
 
     # -- outbound (any thread; _wlock serializes writers) --------------------
 
+    def push_frame(self, frame: bytes, close_after: bool = False) -> None:
+        """Queue one supplier-initiated frame (MSG_PUSH), any thread.
+        Uncredited — the push plane runs its OWN window (PUSH_ACK
+        settles it), so pushes never consume the fetch pipeline's
+        credits; ordering and inline writes ride the normal outbound
+        path."""
+        self._enqueue(_BufItem([frame], credited=False,
+                               t0=time.perf_counter(),
+                               close_after=close_after), frame)
+
     def _enqueue(self, item, head: bytes) -> None:
         """Queue one response and opportunistically write it NOW on the
         calling thread. The net.frame failpoint fires here, once per
@@ -1238,6 +1279,10 @@ class _EvConn:
             span.end(error="closed")
             self._settle(True, getattr(req, "tenant", ""))
         self._drop_parked()
+        if self.server.push is not None:
+            # settle the push window (resledger: a dead peer must not
+            # strand push.on_air) and forget its subscriptions
+            self.server.push.drop_conn(self)
         self.server._forget(self)
         metrics.gauge_add("net.server.connections", -1)
         self.server._sweep()  # freed tenant credits flow to neighbors
@@ -1342,6 +1387,15 @@ class EvLoopShuffleServer:
         self._draining = False
         self._marks: dict = {}  # "peer|job|map|reduce" -> served end
         self._marks_lock = threading.Lock()
+        # push plane (ISSUE 19, uda.tpu.push.enable): supplier-
+        # initiated MSG_PUSH of committed partitions to subscribed
+        # reduce connections. Off = the pull-only plane, bit for bit
+        # (no CAP_PUSH in the banner, MSG_PUSH_SUB answered with the
+        # typed-ERR refusal every unknown frame gets).
+        self.push = None
+        if bool(cfg.get("uda.tpu.push.enable")):
+            from uda_tpu.net.push import PushScheduler
+            self.push = PushScheduler(self, engine, cfg)
 
     # -- warm-restart handoff -----------------------------------------------
 
@@ -1589,7 +1643,9 @@ class EvLoopShuffleServer:
             # tear it like any other frame
             caps = wire.CAP_TRACE | wire.CAP_OBS | wire.CAP_ELASTIC \
                 | (wire.CAP_TENANT if self.tenancy else 0) \
-                | (wire.CAP_DRAINING if self._draining else 0)
+                | (wire.CAP_DRAINING if self._draining else 0) \
+                | (wire.CAP_PUSH if self.push is not None
+                   and not self._draining else 0)
             hello = wire.encode_hello(self.generation, self.warm_restart,
                                       caps=caps)
             conn._enqueue(_BufItem([hello], credited=False,
@@ -1598,6 +1654,15 @@ class EvLoopShuffleServer:
     def _forget(self, conn: _EvConn) -> None:
         with self._lock:
             self._conns.discard(conn)
+
+    def notify_commit(self, job_id: str, map_id: str) -> None:
+        """The MOFWriter commit seam: a map output just became
+        fetchable — push it to every subscribed reduce connection
+        (wire a writer with ``on_commit=server.notify_commit``). A
+        no-op on a pull-only or draining server, so embedders can
+        call it unconditionally."""
+        if self.push is not None and not self._draining:
+            self.push.notify_commit(job_id, map_id)
 
     def _stats_snapshot(self) -> dict:
         """The introspection provider: generation, bound port, loop
@@ -1687,6 +1752,8 @@ class EvLoopShuffleServer:
         if self._loop is None:
             return
         self._stopping.set()
+        if self.push is not None:
+            self.push.stop()
         from uda_tpu.utils.stats import unregister_stats_provider
         unregister_stats_provider("net.server", self._stats_snapshot)
         if self.tenancy and self._sched is not None:

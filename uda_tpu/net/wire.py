@@ -57,6 +57,35 @@ Frame types::
     JOB_OK     registration granted       (echoes the epoch; refusals
                                            are typed TenantError ERR
                                            frames on the same req id)
+    PUSH       supplier-initiated chunk   (one partition chunk pushed
+                                           from the supplier's commit
+                                           point into reduce-side
+                                           staging; req id is a
+                                           server-minted push id the
+                                           receiver echoes in PUSH_ACK/
+                                           PUSH_NACK — sent ONLY on
+                                           connections that subscribed
+                                           via PUSH_SUB, so a push-less
+                                           client never sees one)
+    PUSH_SUB   push subscription          (client -> server: push this
+                                           (job, reduce)'s partitions
+                                           as they commit; carries the
+                                           receiver's window and chunk
+                                           preferences. Send only to
+                                           CAP_PUSH peers)
+    PUSH_ACK   push accepted              (empty payload; the push id
+                                           correlates — releases one
+                                           slot of the supplier's push
+                                           window, the DATA credit
+                                           discipline mirrored)
+    PUSH_NACK  push refused               (reason code; the supplier
+                                           marks the partition
+                                           pull-only and the bytes
+                                           already accepted stay
+                                           usable as a resume prefix —
+                                           over-budget/unknown pushes
+                                           convert to ordinary pull
+                                           with no bytes lost)
 
 **Wire trace context** (versioned by LENGTH, the v2-UDIX back-compat
 discipline): REQ and SIZE_REQ payloads may carry an optional trailing
@@ -98,8 +127,13 @@ from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
 __all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
            "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
            "MSG_HELLO", "MSG_STATS", "MSG_STATS_REPLY",
-           "MSG_JOB", "MSG_JOB_OK", "CAP_TRACE", "CAP_TENANT", "CAP_OBS",
-           "CAP_ELASTIC", "CAP_DRAINING",
+           "MSG_JOB", "MSG_JOB_OK",
+           "MSG_PUSH", "MSG_PUSH_SUB", "MSG_PUSH_ACK", "MSG_PUSH_NACK",
+           "CAP_TRACE", "CAP_TENANT", "CAP_OBS",
+           "CAP_ELASTIC", "CAP_DRAINING", "CAP_PUSH",
+           "encode_push", "decode_push_take",
+           "encode_push_sub", "decode_push_sub",
+           "encode_push_ack", "encode_push_nack", "decode_push_nack",
            "STATS_SEC_TS", "STATS_SEC_SLI", "STATS_SEC_ANOMALY",
            "STATS_SEC_ALL", "decode_stats_request",
            "encode_job", "decode_job", "encode_job_ok", "decode_job_ok",
@@ -140,9 +174,28 @@ MSG_JOB = 9          # tenant handshake: bind this connection to
 MSG_JOB_OK = 10      # MSG_JOB accepted: echoes the granted epoch.
                      # Refusals ride a typed ERR (TenantError) on the
                      # MSG_JOB's req id instead.
+MSG_PUSH = 11        # supplier-initiated partition chunk (server ->
+                     # client). Sent ONLY on connections that
+                     # subscribed with MSG_PUSH_SUB, so push-less
+                     # clients never see one. The req id is a
+                     # server-minted push id echoed by PUSH_ACK/NACK.
+MSG_PUSH_SUB = 12    # client -> server: push me (job, reduce) chunks
+                     # as maps commit. Uncredited like MSG_JOB. Send
+                     # only to CAP_PUSH peers — an older server answers
+                     # a typed ERR (forward-compat contract) and the
+                     # client just stays pull-only.
+MSG_PUSH_ACK = 13    # push accepted into reduce-side staging (empty
+                     # payload). Releases one slot of the supplier's
+                     # push window — MSG_DATA's credit discipline,
+                     # receiver-paced.
+MSG_PUSH_NACK = 14   # push refused: reason code. The supplier marks
+                     # the partition pull-only on this connection; the
+                     # contiguous prefix already ACKed stays usable as
+                     # a resume preload, so refusal costs zero bytes.
 
 _TYPES = (MSG_REQ, MSG_DATA, MSG_ERR, MSG_SIZE_REQ, MSG_SIZE, MSG_HELLO,
-          MSG_STATS, MSG_STATS_REPLY, MSG_JOB, MSG_JOB_OK)
+          MSG_STATS, MSG_STATS_REPLY, MSG_JOB, MSG_JOB_OK,
+          MSG_PUSH, MSG_PUSH_SUB, MSG_PUSH_ACK, MSG_PUSH_NACK)
 # the header accepts any type in this reserved range; semantically
 # unknown ones get a typed ERR from the server, never a teardown (the
 # forward-compat contract — see the module docstring). Anything past
@@ -159,6 +212,9 @@ _TRACE = struct.Struct("!QQ")     # trace_id, parent_span_id (optional
                                   # REQ/SIZE_REQ tail — see docstring)
 _JOB = struct.Struct("!IBH")      # epoch, flags (retire bit), weight
 _JOB_OK = struct.Struct("!I")     # granted epoch echo
+_PUSH = struct.Struct("!IQQB")    # reduce_id, offset, raw_length, flags
+_PUSH_SUB = struct.Struct("!III")  # reduce_id, window, chunk bytes
+_PUSH_NACK = struct.Struct("!B")  # reason code (uda_tpu.net.push)
 
 _JOB_RETIRE = 0x01  # MSG_JOB flags: this is a retire, not a register
 
@@ -192,6 +248,12 @@ CAP_DRAINING = 0x20  # peer is LEAVING: it has announced drain, is
                      # (StoreManager.drain) and will refuse no inflight
                      # work but should receive no NEW placements; the
                      # reduce side demotes it in candidate ranking
+CAP_PUSH = 0x40     # peer runs the push plane (ISSUE 19): it accepts
+                    # MSG_PUSH_SUB subscriptions and will push
+                    # committed partitions as MSG_PUSH frames. A
+                    # draining supplier stops advertising it so new
+                    # conns stay pull-only; clients subscribe ONLY
+                    # when the banner carries this bit.
 
 # the optional MSG_STATS request tail: requested rollup-window seconds
 # + a section bitmask. Exactly 0 bytes (the PR 11 shape: plain
@@ -436,6 +498,83 @@ def decode_stats_reply(payload) -> dict:
         return json.loads(bytes(payload).decode("utf-8"))
     except (ValueError, UnicodeDecodeError) as e:
         raise TransportError(f"malformed STATS_REPLY frame: {e}") from e
+
+
+def encode_push(push_id: int, *, job_id: str, map_id: str, reduce_id: int,
+                offset: int, raw_length: int, last: bool,
+                data: bytes) -> bytes:
+    """MSG_PUSH: one supplier-initiated partition chunk. ``offset`` is
+    the chunk's position in the partition's raw on-disk byte stream and
+    ``raw_length`` its total — the same coordinates a resumed fetch
+    would use, which is what lets the receiver ledger pushed bytes as
+    if they were fetched. ``last`` marks the partition's final chunk.
+
+    ``push_id`` is minted by the supplier; PUSH_ACK/PUSH_NACK echo it."""
+    payload = (_PUSH.pack(reduce_id & 0xFFFFFFFF, offset, raw_length,
+                          _FLAG_LAST if last else 0)
+               + _pack_str(job_id) + _pack_str(map_id) + bytes(data))
+    return encode_frame(MSG_PUSH, push_id, payload)
+
+
+def decode_push_take(payload: bytearray) -> tuple:
+    """-> ``(job_id, map_id, reduce_id, offset, raw_length, last,
+    data)``. Buffer-donating like :func:`decode_result_take`: the chunk
+    bytes are carved out of ``payload`` without a second copy of the
+    metadata prefix."""
+    if len(payload) < _PUSH.size:
+        raise TransportError("truncated PUSH frame")
+    reduce_id, offset, raw_length, flags = _PUSH.unpack_from(
+        bytes(payload[:_PUSH.size]))
+    job_id, off = _unpack_str(payload, _PUSH.size, "job id")
+    map_id, off = _unpack_str(payload, off, "map id")
+    del payload[:off]
+    return (job_id, map_id, reduce_id, offset, raw_length,
+            bool(flags & _FLAG_LAST), payload)
+
+
+def encode_push_sub(req_id: int, *, job_id: str, reduce_id: int,
+                    window: int, chunk_size: int) -> bytes:
+    """MSG_PUSH_SUB: subscribe this connection to (job, reduce) pushes.
+    ``window`` is the receiver's un-ACKed-push ceiling and
+    ``chunk_size`` its preferred chunk bytes; the supplier takes the
+    min with its own knobs. Send only to :data:`CAP_PUSH` peers."""
+    payload = (_PUSH_SUB.pack(reduce_id & 0xFFFFFFFF,
+                              window & 0xFFFFFFFF,
+                              chunk_size & 0xFFFFFFFF)
+               + _pack_str(job_id))
+    return encode_frame(MSG_PUSH_SUB, req_id, payload)
+
+
+def decode_push_sub(payload) -> tuple:
+    """-> ``(job_id, reduce_id, window, chunk_size)``."""
+    if len(payload) < _PUSH_SUB.size:
+        raise TransportError("truncated PUSH_SUB frame")
+    reduce_id, window, chunk_size = _PUSH_SUB.unpack(
+        bytes(payload[:_PUSH_SUB.size]))
+    job_id, off = _unpack_str(payload, _PUSH_SUB.size, "job id")
+    _done(payload, off, "PUSH_SUB frame")
+    return job_id, reduce_id, window, chunk_size
+
+
+def encode_push_ack(push_id: int) -> bytes:
+    """MSG_PUSH_ACK: the chunk landed in staging. Empty payload — the
+    push id says it all. Releases one push-window slot."""
+    return encode_frame(MSG_PUSH_ACK, push_id, b"")
+
+
+def encode_push_nack(push_id: int, reason: int) -> bytes:
+    """MSG_PUSH_NACK: the chunk was refused (reason codes live in
+    ``uda_tpu.net.push``). The supplier marks the partition pull-only;
+    the ACKed prefix stays valid."""
+    return encode_frame(MSG_PUSH_NACK, push_id,
+                        _PUSH_NACK.pack(reason & 0xFF))
+
+
+def decode_push_nack(payload) -> int:
+    """-> reason code."""
+    if len(payload) != _PUSH_NACK.size:
+        raise TransportError("malformed PUSH_NACK frame")
+    return _PUSH_NACK.unpack(bytes(payload))[0]
 
 
 # -- decode ------------------------------------------------------------------

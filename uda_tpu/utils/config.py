@@ -587,6 +587,29 @@ _FLAG_LIST = [
     Flag("uda.tpu.store.health.penalty.ms", 1000.0, float,
          "how long a boxed store backend stays deprioritized before "
          "parole (one more fault re-boxes it)"),
+    Flag("uda.tpu.push.enable", False, bool,
+         "push-based pipelined shuffle (uda_tpu/net/push.py): the "
+         "server advertises CAP_PUSH and pushes committed partitions "
+         "to subscribed reduce connections; the MergeManager arms "
+         "reduce-side staging and adopts pushed prefixes as resumed "
+         "fetches. Off = the pull-only plane, frame for frame"),
+    Flag("uda.tpu.push.window", 8, int,
+         "per-connection cap of un-ACKed MSG_PUSH chunks (the push "
+         "plane's credit discipline — receivers pace suppliers via "
+         "PUSH_ACK; the effective window is the min of both peers')"),
+    Flag("uda.tpu.push.eager.mb", 0.0, float,
+         "reduce-side staging bytes held IN MEMORY before pushes "
+         "spill to a staging run file (0 = an eighth of the "
+         "MemoryBudget host budget — pushes must not crowd out the "
+         "fetch pipeline's own admission)"),
+    Flag("uda.tpu.push.staged.mb", 0.0, float,
+         "total reduce-side staged bytes (memory + spill) per task "
+         "before further pushes draw PUSH_NACK(BUDGET) and convert "
+         "to ordinary pull (0 = 4x the eager cap)"),
+    Flag("uda.tpu.push.spill", True, bool,
+         "allow the staging spill tier (uda.tpu.spill.dirs): pushes "
+         "over the eager cap land in a run file instead of being "
+         "refused; off = memory-only staging, earlier NACKs"),
 ]
 
 FLAGS: Dict[str, Flag] = {f.key: f for f in _FLAG_LIST}

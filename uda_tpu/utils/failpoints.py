@@ -156,6 +156,17 @@ _SITE_ERRORS = {
     "store.get": StoreError,
     "store.put": StoreError,
     "store.migrate": StoreError,
+    # the push plane (ISSUE 19, net/push.py), both keyed so chaos can
+    # target one supplier or one map: net.push fires on every outbound
+    # MSG_PUSH frame (keyed by peer; truncate = a torn push frame —
+    # the supplier closes the conn after sending the torn bytes, the
+    # reducer's staging discards the partial map and the pull path
+    # re-fetches byte-identically); push.admit fires inside the
+    # reduce-side admission ladder (keyed "<job>:<map>"; an injected
+    # error converts the push to a typed PUSH_NACK(budget) — the
+    # supplier falls back to serving that map over pull, no bytes lost)
+    "net.push": TransportError,
+    "push.admit": StorageError,
 }
 
 # The registered-site inventory. udalint's UDA003 rule checks every

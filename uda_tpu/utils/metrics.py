@@ -581,6 +581,42 @@ METRICS_REGISTRY: Dict[str, tuple] = {
     "store.read.latency_ms": ("histogram", "store-router range-read "
                                            "latency per tier attempt "
                                            "[labels: backend]"),
+    # -- push plane (ISSUE 19, uda_tpu/net/push.py) ----------------------
+    "push.commits": ("counter", "map commits announced to the push "
+                                "scheduler (MOFWriter on_commit)"),
+    "push.subs": ("counter", "MSG_PUSH_SUB subscriptions accepted"),
+    "push.chunks": ("counter", "MSG_PUSH chunks sent (supplier side)"),
+    "push.bytes": ("counter", "MSG_PUSH payload bytes sent"),
+    "push.acks": ("counter", "pushes the receiver accepted (PUSH_ACK)"),
+    "push.nacks": ("counter", "pushes the receiver refused "
+                              "[labels: reason]"),
+    "push.errors": ("counter", "push chunk reads/encodes that failed "
+                               "supplier-side (partition -> pull-only)"),
+    "push.accepted": ("counter", "pushed chunks admitted into staging "
+                                 "[labels: tier]"),
+    "push.accepted.bytes": ("counter", "pushed bytes admitted into "
+                                       "staging"),
+    "push.refused": ("counter", "pushed chunks refused by the staging "
+                                "admission ladder [labels: reason]"),
+    "push.spilled.bytes": ("counter", "staged push bytes diverted to "
+                                      "the spill tier"),
+    "push.adopted": ("counter", "segments that started from a staged "
+                                "push prefix (ckpt_preload adoption)"),
+    "push.adopted.bytes": ("counter", "staged bytes adopted into "
+                                      "segment offset ledgers"),
+    "push.invalidated": ("counter", "staged push prefixes that failed "
+                                    "re-crack/preload validation "
+                                    "(degraded to a fresh fetch)"),
+    "push.dial.failures": ("counter", "eager push-subscription dials "
+                                      "that failed [labels: supplier]"),
+    "push.on_air": ("gauge", "un-ACKed MSG_PUSH chunks in flight; "
+                             "paired — every +1 must meet its -1 at "
+                             "ACK/NACK/error/conn-drop (resledger "
+                             "gauge.push.on_air)"),
+    "push.staged.bytes": ("gauge", "bytes staged reduce-side awaiting "
+                                   "adoption; paired — every +N must "
+                                   "meet its -N at take()/close() "
+                                   "(resledger gauge.push.staged)"),
 }
 
 # Dynamically-named families (f-string call sites): the static prefix

@@ -83,6 +83,8 @@ PAIRED_GAUGES: Dict[str, str] = {
     "io.batch.inflight": "gauge.io.batch",
     "tenant.read.bytes.on_air": "gauge.tenant.read.bytes",
     "store.migrate.bytes.on_air": "gauge.store.migrate",
+    "push.on_air": "gauge.push.on_air",
+    "push.staged.bytes": "gauge.push.staged",
 }
 
 
