@@ -75,13 +75,22 @@ NSPEC="$(python -c "from uda_tpu.utils.failpoints import net_chaos_spec; print(n
 NCOUNTERS="$(mktemp)"
 NCYCLES="$(mktemp)"
 NLEAKS="$(mktemp)"
+# runtime race detector (udarace's Eraser machine, utils/locks.py):
+# armed on the rungs whose instrumented hot classes actually churn
+# cross-thread — push scheduler/staging (push rung), the migration log
+# (completion rung), the tenant books under the net plane (here). The
+# race JSONLs live under FRROOT (the trap's rm -rf collects them) and
+# fold into the telemetry merge below, where ANY real-code race fails
+# the tier exactly like a lockdep cycle or a leaked obligation.
+NRACES="${FRROOT}/races_network.jsonl"
 trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}"; rm -rf "${FRROOT}"' EXIT
-echo "network schedule:    ${NSPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
+echo "network schedule:    ${NSPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1, UDA_TPU_RACEDET=1)"
 nrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${NSPEC}" UDA_TPU_STATS=1 \
     UDA_TPU_FLIGHTREC_DIR="${FRROOT}/network" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${NCYCLES}" \
     UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${NLEAKS}" \
+    UDA_TPU_RACEDET=1 UDA_TPU_RACEDET_JSON="${NRACES}" \
     UDA_TPU_CHAOS_TELEMETRY="${NCOUNTERS}" \
     python -m pytest tests/ -m faults -q -p no:cacheprovider \
     -k "net" \
@@ -125,13 +134,15 @@ env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${ESPEC}" UDA_TPU_STATS=1 \
 CCOUNTERS="$(mktemp)"
 CCYCLES="$(mktemp)"
 CLEAKS="$(mktemp)"
+CRACES="${FRROOT}/races_completion.jsonl"
 trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}"; rm -rf "${FRROOT}"' EXIT
-echo "completion rung:     seeded supplier kill + warm restart (seed ${SEED}, UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
+echo "completion rung:     seeded supplier kill + warm restart (seed ${SEED}, UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1, UDA_TPU_RACEDET=1)"
 crc=0
 env JAX_PLATFORMS=cpu UDA_TPU_STATS=1 UDA_TPU_CHAOS_SEED="${SEED}" \
     UDA_TPU_FLIGHTREC_DIR="${FRROOT}/completion" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${CCYCLES}" \
     UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${CLEAKS}" \
+    UDA_TPU_RACEDET=1 UDA_TPU_RACEDET_JSON="${CRACES}" \
     UDA_TPU_CHAOS_TELEMETRY="${CCOUNTERS}" \
     python -m pytest tests/test_coding.py -m faults -q -p no:cacheprovider \
     --continue-on-collection-errors "$@" || crc=$?
@@ -314,14 +325,16 @@ PUSHSPEC="net.push=truncate:prob:0.1:seed:${SEED},push.admit=error:prob:0.1:seed
 PUSHCOUNTERS="$(mktemp)"
 PUSHCYCLES="$(mktemp)"
 PUSHLEAKS="$(mktemp)"
+PUSHRACES="${FRROOT}/races_push.jsonl"
 trap 'rm -f "${COUNTERS}" "${PCOUNTERS}" "${NCOUNTERS}" "${NCYCLES}" "${NLEAKS}" "${ECOUNTERS}" "${ECYCLES}" "${CCOUNTERS}" "${CCYCLES}" "${CLEAKS}" "${PICOUNTERS}" "${PICYCLES}" "${PILEAKS}" "${IOCOUNTERS}" "${IOCYCLES}" "${IOLEAKS}" "${TENCOUNTERS}" "${TENCYCLES}" "${TENLEAKS}" "${RESCOUNTERS}" "${RESCYCLES}" "${RESLEAKS}" "${ACOUNTERS}" "${ELJSON}" "${ELCOUNTERS}" "${ELCYCLES}" "${ELLEAKS}" "${PUSHCOUNTERS}" "${PUSHCYCLES}" "${PUSHLEAKS}"; rm -rf "${FRROOT}"' EXIT
-echo "push schedule:       ${PUSHSPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1)"
+echo "push schedule:       ${PUSHSPEC} (UDA_TPU_LOCKDEP=1, UDA_TPU_RESLEDGER=1, UDA_TPU_RACEDET=1)"
 pushrc=0
 env JAX_PLATFORMS=cpu UDA_FAILPOINTS="${PUSHSPEC}" UDA_TPU_STATS=1 \
     UDA_TPU_CHAOS_SEED="${SEED}" \
     UDA_TPU_FLIGHTREC_DIR="${FRROOT}/push" \
     UDA_TPU_LOCKDEP=1 UDA_TPU_LOCKDEP_JSON="${PUSHCYCLES}" \
     UDA_TPU_RESLEDGER=1 UDA_TPU_RESLEDGER_JSON="${PUSHLEAKS}" \
+    UDA_TPU_RACEDET=1 UDA_TPU_RACEDET_JSON="${PUSHRACES}" \
     UDA_TPU_CHAOS_TELEMETRY="${PUSHCOUNTERS}" \
     python -m pytest tests/test_push.py -m faults -q \
     -p no:cacheprovider \
@@ -366,7 +379,8 @@ python - "${SEED}" "${SPEC}" "${COUNTERS}" "${OUT}" "${rc}" \
     "${ELJSON}" "${ELCOUNTERS}" "${elrc}" "${ELCYCLES}" \
     "${ELLEAKS}" \
     "${PUSHSPEC}" "${PUSHCOUNTERS}" "${pushrc}" "${PUSHCYCLES}" \
-    "${PUSHLEAKS}" <<'EOF' || mrc=$?
+    "${PUSHLEAKS}" \
+    "${NRACES}" "${CRACES}" "${PUSHRACES}" <<'EOF' || mrc=$?
 import glob, json, os, sys
 sys.path.insert(0, os.getcwd())
 from uda_tpu.utils.critpath import buckets_from_counters
@@ -382,8 +396,8 @@ from uda_tpu.utils.critpath import buckets_from_counters
  resspec, rescounters, resrc_, rescycles, resleaks_path,
  aspec, acounters, anrc,
  eljson, elcounters, elrc_, elcycles, elleaks_path,
- pushspec, pushcounters, pushrc_, pushcycles, pushleaks_path) = \
-    sys.argv[1:57]
+ pushspec, pushcounters, pushrc_, pushcycles, pushleaks_path,
+ nraces_path, craces_path, pushraces_path) = sys.argv[1:60]
 frroot = os.environ.get("FRROOT", "")
 def flightrec_block(rung, exit_code):
     """Archive the rung's black-box dumps (cause + structured extra +
@@ -435,6 +449,15 @@ def timeacct_block(telem):
     StatsReporter final records and flightrec dumps instead). Diffable
     across rounds like every other telemetry block."""
     return buckets_from_counters(telem.get("counters", {}))
+def racedet_block(block, races_path):
+    """Fold the rung's data-race reports (UDA_TPU_RACEDET_JSON lines
+    from the runtime Eraser machine) into its telemetry block; returns
+    the reports so the zero-races guarantee is ENFORCED below, like
+    lockdep cycles and resledger leaks."""
+    reports = load_cycles(races_path)
+    block["racedet"] = {"armed": True, "races": len(reports),
+                        "race_reports": reports}
+    return reports
 def resledger_block(block, leaks_path):
     """Fold the rung's leaked-obligation reports (UDA_TPU_RESLEDGER_
     JSON lines) into its telemetry block; returns the reports so the
@@ -445,6 +468,7 @@ def resledger_block(block, leaks_path):
     return reports
 network, n_reports = lockdep_block(nspec, nrc, ncounters, ncycles)
 n_leaks = resledger_block(network, nleaks_path)
+n_races = racedet_block(network, nraces_path)
 exchange, e_reports = lockdep_block(
     "seeded exchange.decode + scoped exchange.round (per-test)",
     erc, ecounters, ecycles)
@@ -463,6 +487,7 @@ completion, c_reports = lockdep_block(
     f"seeded supplier kill + warm restart (seed {seed})",
     crc_, ccounters, ccycles)
 c_leaks = resledger_block(completion, cleaks_path)
+c_races = racedet_block(completion, craces_path)
 # the completion guarantee, surfaced in the telemetry: reconstructed
 # partitions and resumed fetches with ZERO fallbacks (the per-test
 # asserts enforce it; this block is the cross-round diffable record)
@@ -580,6 +605,7 @@ elastic_dead = (not int(elrc_)
 push, push_reports = lockdep_block(pushspec, pushrc_, pushcounters,
                                    pushcycles)
 push_leaks = resledger_block(push, pushleaks_path)
+push_races = racedet_block(push, pushraces_path)
 # the push contract, surfaced: chunks pushed and acked, the typed
 # refusals (each one a partition converting to pull, zero bytes
 # lost), adopted prefixes, and the settlement guarantee — nothing
@@ -632,6 +658,7 @@ lockdep, l_reports = lockdep_block(spec, lrc, lcounters, lcycles)
 nleak = (len(n_leaks) + len(c_leaks) + len(pi_leaks) + len(io_leaks)
          + len(ten_leaks) + len(res_leaks) + len(el_leaks)
          + len(push_leaks))
+nrace = len(n_races) + len(c_races) + len(push_races)
 # flight-recorder archive, one block per rung; a rung that failed
 # without a single black-box dump flags failed_without_dump
 fr = {"main": flightrec_block("main", rc),
@@ -696,6 +723,9 @@ with open(out, "w") as f:
                                              "tenant", "resume",
                                              "elastic", "push"],
                              "leaks": nleak},
+               "racedet": {"armed_rungs": ["network", "completion",
+                                           "push"],
+                           "races": nrace},
                "flightrec_missing_postmortem": no_postmortem},
               f, indent=1, sort_keys=True)
     f.write("\n")
@@ -705,7 +735,13 @@ ncyc = (len(n_reports) + len(e_reports) + len(c_reports)
         + len(l_reports))
 ndumps = sum(b["dumps"] for b in fr.values())
 print(f"chaos telemetry:     {out} (lockdep cycles on real code: {ncyc}, "
-      f"resledger leaks: {nleak}, flightrec dumps: {ndumps})")
+      f"resledger leaks: {nleak}, racedet races: {nrace}, "
+      f"flightrec dumps: {ndumps})")
+if nrace:
+    print(f"RACEDET: {nrace} data race(s) on real code under chaos — "
+          f"a shared-modified field ended with an empty candidate "
+          f"lockset (see the racedet blocks in {out})",
+          file=sys.stderr)
 if no_postmortem:
     print(f"FLIGHTREC: rung(s) failed with NO black-box dump: "
           f"{', '.join(no_postmortem)} — the post-mortem record is "
@@ -725,12 +761,13 @@ if push_dead:
           "FallbackSignal, or left the push window/staging gauges "
           "nonzero — the push plane never engaged or leaked, which "
           "defeats the rung's purpose", file=sys.stderr)
-# the zero-cycles / zero-leaks / dump-on-failure / proactive-capture
-# guarantees are ENFORCED, not just printed: a detected inversion, a
-# leaked obligation, a failing rung with no post-mortem record, or an
-# anomaly rung with no proactive capture all fail the tier — that is
-# the entire point of lockdep, the ledger and the flight recorder
-sys.exit(3 if (ncyc or nleak or no_postmortem or no_proactive
+# the zero-cycles / zero-leaks / zero-races / dump-on-failure /
+# proactive-capture guarantees are ENFORCED, not just printed: a
+# detected inversion, a leaked obligation, a data race on real code, a
+# failing rung with no post-mortem record, or an anomaly rung with no
+# proactive capture all fail the tier — that is the entire point of
+# lockdep, the ledger, the race detector and the flight recorder
+sys.exit(3 if (ncyc or nleak or nrace or no_postmortem or no_proactive
                or elastic_dead or push_dead)
          else 0)
 EOF
@@ -747,9 +784,9 @@ if [ "${elrc}" -ne 0 ]; then rc="${elrc}"; fi
 if [ "${pushrc}" -ne 0 ]; then rc="${pushrc}"; fi
 if [ "${lrc}" -ne 0 ]; then rc="${lrc}"; fi
 if [ "${mrc}" -ne 0 ]; then
-  echo "LOCKDEP/RESLEDGER/FLIGHTREC: cycle reports, leaked obligations" \
-       "or a failing rung without its black-box dump (see" \
-       "CHAOS_TELEMETRY.json)" >&2
+  echo "LOCKDEP/RESLEDGER/RACEDET/FLIGHTREC: cycle reports, leaked" \
+       "obligations, data races or a failing rung without its" \
+       "black-box dump (see CHAOS_TELEMETRY.json)" >&2
   rc="${mrc}"
 fi
 exit "${rc}"

@@ -2,10 +2,11 @@
 """udalint CLI: the shuffle stack's AST invariant linter.
 
 Runs the uda_tpu.analysis rule suite — the syntactic tier (UDA001-
-UDA008) and the udaflow CFG/dataflow tier (UDA101-UDA103), see
-``--list-rules`` — over the given files/directories and prints findings
-as ``file:line:col: RULE message [fix: hint]``. Exit 1 when any
-non-suppressed finding exists, 0 on a clean tree.
+UDA008), the udaflow CFG/dataflow tier (UDA101-UDA103) and the udarace
+lockset tier (UDA201-UDA204), see ``--list-rules`` — over the given
+files/directories and prints findings as ``file:line:col: RULE message
+[fix: hint]``. Exit 1 when any non-suppressed finding exists, 0 on a
+clean tree.
 
 Usage::
 
@@ -13,6 +14,8 @@ Usage::
     python scripts/udalint.py --list-rules
     python scripts/udalint.py --rule UDA004 uda_tpu/net
     python scripts/udalint.py --json uda_tpu    # machine-readable
+    python scripts/udalint.py --changed         # git-diff files only
+    python scripts/udalint.py --cache           # content-hash cache
 
 ``--json`` prints one JSON object to stdout — ``{"files": N,
 "findings": [{file, line, col, rule, message, hint, data}, ...]}`` —
@@ -20,21 +23,125 @@ so the CI and chaos gates consume findings structurally instead of
 grepping human output (the check_metrics_names.py wrapper contract).
 Exit codes are identical to the human mode.
 
+``--changed`` lints only the files ``git diff --name-only HEAD`` (plus
+untracked files) reports, running the per-file rules only — tree-wide
+rules (lock order, lockset inference, wire exhaustiveness) need the
+whole tree and are skipped with a printed note. Same exit contract.
+
+``--cache`` keeps a findings cache at ``.udalint_cache.json`` keyed on
+content hashes (and on the analysis package's own sources, so editing
+a rule invalidates everything). A full-tree re-run over an unchanged
+tree — e.g. ci.sh's human-then-JSON double invocation — re-parses
+nothing; per-file entries also let partially-changed runs skip the
+per-file rule work for untouched files.
+
 Suppression: append ``# udalint: disable=<RULE>[,<RULE>...]`` (or
-``disable=all``) to the offending line. ``scripts/build/ci.sh`` runs
-this gate before the test tiers; ``tests/test_udalint.py`` keeps the
-whole tree clean in tier-1.
+``disable=all``) to the offending line; lockset waivers use
+``# udarace: lockfree=<attr>[,<attr>] - <why>``. ``scripts/build/ci.sh``
+runs this gate before the test tiers; ``tests/test_udalint.py`` keeps
+the whole tree clean in tier-1.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+CACHE_PATH = os.path.join(REPO, ".udalint_cache.json")
+# bump when the cache schema (not the rules — those self-invalidate
+# through the analysis-source hash) changes shape
+CACHE_SCHEMA = 1
+
+_F_FIELDS = ("file", "line", "col", "rule", "message", "hint", "data")
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _file_sha(path: str) -> str:
+    with open(path, "rb") as f:
+        return _sha(f.read())
+
+
+def _ruleset_key(rules) -> str:
+    """Cache key covering WHICH rules run and WHAT they mean: the rule
+    ids plus a hash of every source file in uda_tpu/analysis — editing
+    any rule, the engine or the thread-root registry invalidates the
+    whole cache (stale findings are worse than a cold run)."""
+    h = hashlib.sha256()
+    h.update(",".join(sorted(r.rule_id for r in rules)).encode())
+    adir = os.path.join(REPO, "uda_tpu", "analysis")
+    for dirpath, dirnames, filenames in os.walk(adir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                h.update(fn.encode())
+                with open(os.path.join(dirpath, fn), "rb") as f:
+                    h.update(f.read())
+    return h.hexdigest()
+
+
+def _load_cache(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            cache = json.load(f)
+        if cache.get("schema") == CACHE_SCHEMA:
+            return cache
+    except (OSError, ValueError):
+        pass
+    return {"schema": CACHE_SCHEMA, "per_file": {}, "tree": {}}
+
+
+def _save_cache(path: str, cache: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cache, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"udalint: cannot write cache {path}: {e}",
+              file=sys.stderr)
+
+
+def _ser(findings) -> list:
+    return [[getattr(f, k) for k in _F_FIELDS] for f in findings]
+
+
+def _deser(rows) -> list:
+    from uda_tpu.analysis.core import Finding
+    return [Finding(*row) for row in rows]
+
+
+def _changed_files() -> list:
+    """Repo-relative .py files git considers changed (vs HEAD) or
+    untracked; missing git degrades to the full default paths."""
+    out = []
+    for cmd in (["git", "diff", "--name-only", "HEAD", "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            r = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if r.returncode != 0:
+            return []
+        out.extend(line.strip() for line in r.stdout.splitlines())
+    seen = set()
+    files = []
+    for rel in out:
+        if (rel.endswith(".py") and rel not in seen
+                and os.path.exists(os.path.join(REPO, rel))):
+            seen.add(rel)
+            files.append(os.path.join(REPO, rel))
+    return sorted(files)
 
 
 def main(argv=None) -> int:
@@ -52,9 +159,16 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit machine-readable findings on stdout "
                          "(file/line/col/rule/message/hint/data)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only git-changed/untracked .py files "
+                         "(per-file rules only; tree-wide rules need "
+                         "the whole tree and are skipped)")
+    ap.add_argument("--cache", action="store_true",
+                    help=f"use the content-hash findings cache "
+                         f"({os.path.relpath(CACHE_PATH, REPO)})")
     args = ap.parse_args(argv)
 
-    from uda_tpu.analysis.core import Engine, iter_py_files
+    from uda_tpu.analysis.core import Engine, Rule, iter_py_files
     from uda_tpu.analysis.rules import ALL_RULES
 
     if args.list_rules:
@@ -63,13 +177,35 @@ def main(argv=None) -> int:
         return 0
 
     wanted = {r.upper() for r in args.rule} if args.rule else None
-    rules = [cls() for cls in ALL_RULES
-             if wanted is None or cls.rule_id in wanted]
-    if wanted and not rules:
+    rule_classes = [cls for cls in ALL_RULES
+                    if wanted is None or cls.rule_id in wanted]
+    if wanted and not rule_classes:
         print(f"udalint: no such rule(s): {', '.join(sorted(wanted))}",
               file=sys.stderr)
         return 2
 
+    if args.changed:
+        # incremental mode: only per-file rules are sound on a partial
+        # file set (a tree-wide rule fed 3 files would "prove" absence
+        # of things that exist in the other 100)
+        tree_ids = [cls.rule_id for cls in rule_classes
+                    if cls.finalize is not Rule.finalize]
+        rule_classes = [cls for cls in rule_classes
+                        if cls.finalize is Rule.finalize]
+        files = _changed_files()
+        if tree_ids:
+            print(f"udalint: --changed: tree-wide rule(s) skipped: "
+                  f"{', '.join(tree_ids)} (run without --changed for "
+                  f"the full gate)", file=sys.stderr)
+        if not files:
+            print("udalint: --changed: no changed .py files")
+            return 0
+        rules = [cls() for cls in rule_classes]
+        engine = Engine(rules, root=REPO)
+        findings = engine.lint_paths(files)
+        return _emit(args, findings, len(files), rules)
+
+    rules = [cls() for cls in rule_classes]
     paths = [os.path.join(REPO, p) if not os.path.isabs(p) else p
              for p in (args.paths or ["uda_tpu", "scripts"])]
     for p in paths:
@@ -77,9 +213,61 @@ def main(argv=None) -> int:
             print(f"udalint: no such path: {p}", file=sys.stderr)
             return 2
 
-    engine = Engine(rules, root=REPO)
-    findings = engine.lint_paths(paths)
-    nfiles = len(iter_py_files(paths))
+    if not args.cache:
+        engine = Engine(rules, root=REPO)
+        findings = engine.lint_paths(paths)
+        return _emit(args, findings, len(iter_py_files(paths)), rules)
+
+    # -- cached run ----------------------------------------------------------
+    files = iter_py_files(paths)
+    shas = {os.path.relpath(p, REPO): _file_sha(p) for p in files}
+    rkey = _ruleset_key(rules)
+    fingerprint = _sha(json.dumps(
+        [rkey, sorted(shas.items())]).encode())
+    cache = _load_cache(CACHE_PATH)
+    tree = cache.get("tree", {})
+    if tree.get("fingerprint") == fingerprint:
+        # unchanged tree + unchanged rules: the whole run is cached —
+        # nothing is parsed (the ci.sh human-then-JSON double pass)
+        return _emit(args, _deser(tree.get("findings", [])),
+                     len(files), rules)
+
+    per_file_rules = [r for r in rules
+                      if type(r).finalize is Rule.finalize]
+    tree_rules = [r for r in rules
+                  if type(r).finalize is not Rule.finalize]
+    pf_ids = {r.rule_id for r in per_file_rules}
+    pf_engine = Engine(per_file_rules, root=REPO)
+    tree_engine = Engine(tree_rules, root=REPO)
+    per_cache = cache.get("per_file", {})
+    new_per: dict = {}
+    findings = []
+    for path in files:
+        rel = os.path.relpath(path, REPO)
+        ent = per_cache.get(rel)
+        if ent and ent.get("sha") == shas[rel] \
+                and ent.get("rkey") == rkey:
+            pf_findings = _deser(ent["findings"])
+        else:
+            pf_findings = [f for f in pf_engine.lint_file(path)
+                           if f.rule in pf_ids or f.rule == "UDA000"]
+        new_per[rel] = {"sha": shas[rel], "rkey": rkey,
+                        "findings": _ser(pf_findings)}
+        findings.extend(pf_findings)
+        # tree-wide rules always see every file (their verdicts are
+        # global); this is the parse the fingerprint hit avoids
+        if tree_rules:
+            findings.extend(tree_engine.lint_file(path))
+    findings.extend(tree_engine.finish())
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    cache["per_file"] = new_per
+    cache["tree"] = {"fingerprint": fingerprint,
+                     "findings": _ser(findings)}
+    _save_cache(CACHE_PATH, cache)
+    return _emit(args, findings, len(files), rules)
+
+
+def _emit(args, findings, nfiles: int, rules) -> int:
     if args.json:
         print(json.dumps(
             {"files": nfiles, "rules": [r.rule_id for r in rules],

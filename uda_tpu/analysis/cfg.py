@@ -65,6 +65,9 @@ __all__ = ["CFG", "CFGNode", "build_cfg"]
 # we model (the graph drops the propagate edge past it)
 _BROAD = {"Exception", "BaseException"}
 
+# 3.12 `type X = ...` statements (absent on 3.10/3.11 — gate, don't touch)
+_TYPE_ALIAS = getattr(ast, "TypeAlias", None)
+
 
 @dataclasses.dataclass
 class CFGNode:
@@ -275,6 +278,14 @@ class _Builder:
             node.add(nxt)
             node.add(ctx.exc, exc=True)
             return idx
+        if isinstance(stmt, ast.Match):
+            return self._build_match(stmt, nxt, ctx)
+        if _TYPE_ALIAS is not None and isinstance(stmt, _TYPE_ALIAS):
+            # 3.12 `type X = ...`: the value is lazily evaluated, so
+            # the statement itself cannot raise — a plain no-effect node
+            idx = cfg._new("stmt", stmt, ())
+            cfg.node(idx).add(nxt)
+            return idx
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             # nested defs are opaque: their bodies run later (or never),
@@ -312,6 +323,26 @@ class _Builder:
         if _can_raise(exprs):
             node.add(ctx.exc, exc=True)
         return header
+
+    def _build_match(self, stmt: "ast.Match", nxt: int, ctx: _Ctx) -> int:
+        """3.10+ ``match``: one header node evaluates the subject and
+        every case guard; each case body is its own subgraph (so a
+        ``return``/``raise`` inside a case is a real exit, not a
+        swallowed side effect of one opaque mega-node). The header
+        keeps a fall-through edge to ``nxt`` — the statement is not
+        required to be exhaustive — which over-approximates only in
+        the sound direction (paths that may not exist, never fewer)."""
+        cfg = self.cfg
+        exprs: Tuple[ast.AST, ...] = (stmt.subject,) + tuple(
+            c.guard for c in stmt.cases if c.guard is not None)
+        idx = cfg._new("match", stmt, exprs)
+        node = cfg.node(idx)
+        for case in stmt.cases:
+            node.add(self.build_block(case.body, nxt, ctx))
+        node.add(nxt)  # no case matched
+        if _can_raise(exprs):
+            node.add(ctx.exc, exc=True)
+        return idx
 
     def _build_with(self, stmt, nxt: int, ctx: _Ctx) -> int:
         cfg = self.cfg

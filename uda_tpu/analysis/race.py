@@ -1,0 +1,717 @@
+"""udarace — Eraser-style lockset inference over the udaflow CFG.
+
+The third static-analysis tier. udalint (UDA001-009) checks single
+nodes, udaflow (UDA101-103) checks paths *within* one function; this
+module checks the property neither can see: that shared state is
+touched with the right lock held *at all*. The bug class is the one
+behind the PR 10 "gauge stuck at -1" double-settle and the PR 6
+parked-request recursion — a ``self.<attr>`` mutated from two threads
+where one access path skips the lock — which runtime gates only catch
+when the unlucky interleaving actually happens in CI.
+
+The analysis, per Eraser (Savage et al.) adapted to lexical Python:
+
+1. **Thread roots** (uda_tpu/analysis/threads.py): every declared
+   thread entry point, plus auto-detected ones — ``Thread(target=f)``
+   spawn sites, ``@loop_callback`` bodies (the event-loop thread),
+   ``call_soon(f)`` marshalling (also the loop thread), ``submit(f)`` /
+   ``add_done_callback(f)`` (pool workers). A call-graph walk (name-
+   keyed like UDA102, but ``self.m()`` calls resolve within the class)
+   marks every function with the set of roots that reach it.
+
+2. **Locksets**: for every ``self.<attr>`` access in a root-reachable
+   method, the set of locks held — the lexical ``with <lock>:``
+   ancestors (sound: ``with`` release is the finally-copy discipline
+   made syntax) plus a CFG must-hold dataflow over explicit
+   ``.acquire()``/``.release()`` pairs (finally copies from
+   :mod:`uda_tpu.analysis.cfg` make a release-in-finally kill the
+   obligation on BOTH continuations).
+
+3. **Verdicts**, per (class, attribute) with accesses from >= 2
+   distinct roots and at least one write:
+
+   - every access lockset empty -> **UDA201** (unguarded shared
+     attribute) unless waived by ``# udarace: lockfree=<attr>[,...]``
+     with a justification;
+   - a consistent lock exists but some access skips it -> **UDA202**
+     (the check-then-act escape), anchored on the unguarded write;
+   - every access holds SOME lock but no lock is common -> **UDA203**
+     (mixed guards: two locks protect nothing).
+
+   Findings carry one witness access per conflicting thread root, so
+   the report reads like a runtime race report with line numbers
+   instead of stacks.
+
+Single-threaded state needs no annotation: a method no declared or
+detected root reaches is owner-thread-confined (construction, main
+test thread) and never convicts an attribute. That makes the
+loop-thread-confined idioms (CreditScheduler, the evloop's parked
+table) clean BY MODEL rather than by waiver — only genuinely
+multi-root lock-free idioms (GIL-atomic deques, bool flags) need the
+``# udarace: lockfree=`` comment, and each one must say why::
+
+    # udarace: lockfree=_closed - bool flip, GIL-atomic, racing
+    #     readers see the old value for at most one extra iteration
+
+UDA204 (``WireExhaustivenessRule``) rides in the same module: the
+``MSG_*`` inventory of net/wire.py must be total — every frame type
+carries a ``WIRE_CODECS`` entry naming its encoder + strict decoder
+(``None`` only with an on-line justification comment) and a dispatch
+arm in net/server.py or net/client.py — so the next PR-19-style frame
+family cannot land half-wired.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from uda_tpu.analysis.cfg import build_cfg
+from uda_tpu.analysis.core import FileContext, Finding, Rule
+from uda_tpu.analysis.flow import _LOCK_RE, _last_segment
+from uda_tpu.analysis.threads import (LOOP_ROOT, POOL_ROOT,
+                                      RUNTIME_INSTRUMENTED, declared_root)
+
+# class names declared shared at runtime (threads.py) participate in
+# the static tier even when they hold no lock (the loop-confined
+# no-lock-by-design classes the runtime machine watches)
+_DECLARED_SHARED = {key.rsplit(".", 1)[1] for key in RUNTIME_INSTRUMENTED}
+
+__all__ = ["RaceLocksetRule", "WireExhaustivenessRule"]
+
+# `# udarace: lockfree=_a,_b - why` — the waiver for deliberate
+# GIL-atomic idioms. The justification after the dash is REQUIRED; a
+# bare waiver is itself a finding (suppressions must carry their why).
+_LOCKFREE_RE = re.compile(
+    r"#\s*udarace:\s*lockfree=([A-Za-z0-9_,\s]*[A-Za-z0-9_])"
+    r"(?:\s*[-–—]\s*(\S.*))?")
+
+# container-mutating method calls: `self._tab.append(x)` is a WRITE of
+# the shared table, not a read of the attribute binding
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "add",
+             "insert", "remove", "discard", "pop", "popleft", "popitem",
+             "clear", "update", "setdefault", "sort", "reverse",
+             "put", "put_nowait"}
+
+# dunders + teardown: pre-publication / owner-finalized, never
+# contribute accesses (Eraser's virgin state, decided lexically)
+_CONFINED_METHODS = {"__init__", "__new__", "__del__", "__repr__"}
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    write: bool
+    line: int
+    col: int
+    locks: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class _Def:
+    file: str
+    cls: str                      # enclosing class name, "" at module level
+    name: str                     # function name
+    line: int
+    accesses: List[_Access]
+    calls: List[Tuple[str, str]]  # ("self", m) -> same class; ("", m) -> any
+    roots: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    file: str
+    name: str
+    line: int
+    end_line: int
+    # attr -> (waiver line, justification or None)
+    lockfree: Dict[str, Tuple[int, Optional[str]]] = \
+        dataclasses.field(default_factory=dict)
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Dotted source form of a lock reference ('self._lock', 'mu'), or
+    None when it is not a plain name/attribute chain."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# Broader than flow.py's _LOCK_RE: the lockset tier must also accept
+# suffixed names (`_inflight_cv`, `_state_lock`, `_forest_lock`) —
+# missing one turns a correctly guarded access into a false UDA201.
+_LOCK_SUFFIX_RE = re.compile(
+    r"[a-z0-9_]*(?:lock|cv|cond(?:ition)?|mu(?:tex)?|sem(?:aphore)?)")
+
+
+def _is_lock_ref(node: ast.AST) -> Optional[str]:
+    """The lock key when ``node`` looks like a lock reference (its last
+    segment matches the shared lock-name shape), else None."""
+    seg = _last_segment(node)
+    if seg is not None and (_LOCK_RE.fullmatch(seg)
+                            or _LOCK_SUFFIX_RE.fullmatch(seg)):
+        return _expr_key(node)
+    return None
+
+
+class RaceLocksetRule(Rule):
+    """UDA201/202/203: guarded-field lockset analysis (see the module
+    docstring). One collector emits all three verdicts — they are one
+    analysis with three failure shapes, like UDA101's leak kinds."""
+
+    rule_id = "UDA201"
+    description = ("udarace lockset tier: shared attributes reachable "
+                   "from >= 2 thread roots must hold one consistent "
+                   "TrackedLock on every access (UDA201 unguarded / "
+                   "UDA202 lock-skipping access / UDA203 mixed locks)")
+    hint = ("guard every access with the class's lock, or — for a "
+            "deliberate GIL-atomic idiom — waive the attribute with "
+            "`# udarace: lockfree=<attr> - <why>` inside the class")
+    node_types = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                  ast.Assign)
+
+    def __init__(self) -> None:
+        self._defs: List[_Def] = []
+        self._classes: Dict[Tuple[str, str], _ClassInfo] = {}
+        # seed roots: (root id, callee ref, enclosing class) resolved
+        # like call edges
+        self._spawned: List[Tuple[str, Tuple[str, str], str]] = []
+        # classes that DECLARE lock discipline (own a TrackedLock /
+        # TrackedCondition attr): the static tier's conviction scope.
+        # Function-level reachability cannot see instance confinement,
+        # so lock-less helper classes (per-request cursors, histogram
+        # cells) must not convict — a class enters the tier by holding
+        # a lock or by being declared shared in analysis/threads.py.
+        self._locked_classes: Set[str] = set()
+        # variable/attr name -> ctor class names seen assigned to it
+        # (`self.store = StoreManager(...)`): receiver-informed call
+        # resolution, the UDA103 lock-var-table idiom
+        self._ctor_vars: Dict[str, Set[str]] = {}
+
+    # -- collection ----------------------------------------------------------
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._lines = ctx.source.splitlines()
+
+    def visit(self, node, ctx: FileContext) -> Iterable[Finding]:
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Call):
+                ctor = _last_segment(node.value.func)
+                if ctor is not None and ctor[:1].isupper():
+                    for tgt in node.targets:
+                        name = _last_segment(tgt)
+                        if name:
+                            self._ctor_vars.setdefault(
+                                name, set()).add(ctor)
+            return ()
+        if isinstance(node, ast.ClassDef):
+            info = _ClassInfo(ctx.rel, node.name, node.lineno,
+                              getattr(node, "end_lineno", node.lineno))
+            for lno in range(info.line, info.end_line + 1):
+                if lno > len(self._lines):
+                    break
+                m = _LOCKFREE_RE.search(self._lines[lno - 1])
+                if m:
+                    just = m.group(2)
+                    for attr in m.group(1).split(","):
+                        attr = attr.strip()
+                        if attr:
+                            info.lockfree[attr] = (lno, just)
+            self._classes[(ctx.rel, node.name)] = info
+            return ()
+        # FunctionDef / AsyncFunctionDef: one def record; nested defs
+        # get their own visit (and their accesses stay out of ours)
+        cls = self._enclosing_class(node)
+        d = _Def(ctx.rel, cls, node.name, node.lineno, [], [])
+        if self._is_loop_callback(node):
+            d.roots.add(LOOP_ROOT)
+        tr = declared_root(ctx.rel.replace("\\", "/"), node.name)
+        if tr is not None:
+            d.roots.add(tr.root)
+        self._scan(node, d, ctx)
+        self._defs.append(d)
+        return ()
+
+    @staticmethod
+    def _enclosing_class(node: ast.AST) -> str:
+        cur = getattr(node, "parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return ""  # a def nested in a method is not a method
+            cur = getattr(cur, "parent", None)
+        return ""
+
+    @staticmethod
+    def _is_loop_callback(node) -> bool:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _last_segment(target) == "loop_callback":
+                return True
+        return False
+
+    @staticmethod
+    def _callee_ref(func_expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """A call-edge reference: ('self', m) for self.m,
+        ('recv:<name>', m) for <something>.<name>.m — the receiver name
+        feeds the ctor-var table — and ('', m) for bare names."""
+        if isinstance(func_expr, ast.Attribute):
+            if isinstance(func_expr.value, ast.Name) \
+                    and func_expr.value.id == "self":
+                return ("self", func_expr.attr)
+            recv = _last_segment(func_expr.value)
+            if recv is not None and recv != "self":
+                return (f"recv:{recv}", func_expr.attr)
+            return ("", func_expr.attr)
+        if isinstance(func_expr, ast.Name):
+            return ("", func_expr.id)
+        return None
+
+    def _scan(self, func, d: _Def, ctx: FileContext) -> None:
+        """One pass over the method body: attribute accesses with their
+        lexical lock context, call edges, and spawn/marshal sites."""
+        must_hold = _cfg_must_hold(func)
+        stack: List[ast.AST] = list(func.body)
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue  # deferred code: its own def record / opaque
+            if isinstance(cur, ast.Call):
+                self._scan_call(cur, d)
+            elif isinstance(cur, ast.Attribute) \
+                    and isinstance(cur.value, ast.Name) \
+                    and cur.value.id == "self" \
+                    and not _LOCK_RE.fullmatch(cur.attr) \
+                    and not _LOCK_SUFFIX_RE.fullmatch(cur.attr):
+                write = self._is_write(cur)
+                if write is not None:
+                    locks = self._held_at(cur, func, must_hold)
+                    d.accesses.append(_Access(
+                        cur.attr, write, cur.lineno, cur.col_offset,
+                        locks))
+            stack.extend(ast.iter_child_nodes(cur))
+
+    def _scan_call(self, call: ast.Call, d: _Def) -> None:
+        seg = _last_segment(call.func)
+        if seg is None:
+            return
+        if seg in ("TrackedLock", "TrackedCondition"):
+            parent = getattr(call, "parent", None)
+            if isinstance(parent, ast.Assign) and d.cls:
+                self._locked_classes.add(d.cls)
+            return
+        if seg == "Thread":
+            # spawns in driver/benchmark scripts are not data-plane
+            # roots: a script thread exercises one private instance,
+            # and counting it would manufacture multi-rootness for
+            # whatever pipeline the benchmark drives
+            if "uda_tpu" not in d.file.replace("\\", "/"):
+                return
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    ref = self._callee_ref(kw.value)
+                    if ref is not None:
+                        tr = declared_root(d.file.replace("\\", "/"),
+                                           ref[1])
+                        root = tr.root if tr is not None else \
+                            f"thread:{d.file}:{call.lineno}"
+                        self._spawned.append((root, ref, d.cls))
+            return
+        marshal = {"call_soon": LOOP_ROOT, "submit": POOL_ROOT,
+                   "add_done_callback": POOL_ROOT}.get(seg)
+        if marshal is not None and call.args:
+            ref = self._callee_ref(call.args[0])
+            if ref is not None:
+                self._spawned.append((marshal, ref, d.cls))
+        ref = self._callee_ref(call.func)
+        if ref is not None:
+            d.calls.append(ref)
+
+    @staticmethod
+    def _is_write(attr: ast.Attribute) -> Optional[bool]:
+        """True write / False read / None not-an-access (the attribute
+        is itself a method being called: self.m() is a call edge)."""
+        if isinstance(attr.ctx, (ast.Store, ast.Del)):
+            return True
+        parent = getattr(attr, "parent", None)
+        if isinstance(parent, ast.Call) and parent.func is attr:
+            return None  # self.m(...): the call edge covers it
+        if isinstance(parent, ast.Attribute) \
+                and parent.attr in _MUTATORS:
+            grand = getattr(parent, "parent", None)
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                return True  # self._tab.append(...): container write
+        if isinstance(parent, ast.Subscript) \
+                and isinstance(parent.ctx, (ast.Store, ast.Del)) \
+                and parent.value is attr:
+            return True      # self._tab[k] = ...: container write
+        if isinstance(parent, ast.AugAssign) and parent.target is attr:
+            return True
+        return False
+
+    @staticmethod
+    def _held_at(node: ast.AST, func, must_hold) -> FrozenSet[str]:
+        """Locks held at ``node``: lexical `with <lock>:` ancestors
+        inside ``func`` + the CFG must-hold set of the enclosing
+        statement (explicit acquire/release pairs)."""
+        held: Set[str] = set()
+        stmt = None
+        cur = getattr(node, "parent", None)
+        prev: ast.AST = node
+        while cur is not None and cur is not func:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                # held only when we came from the BODY (the header's
+                # context expressions evaluate before __enter__)
+                if prev in cur.body:
+                    for item in cur.items:
+                        key = _is_lock_ref(item.context_expr)
+                        if key is not None:
+                            held.add(key)
+            if isinstance(cur, ast.stmt):
+                stmt = cur
+            prev = cur
+            cur = getattr(cur, "parent", None)
+        if stmt is not None:
+            held |= must_hold.get(id(stmt), frozenset())
+        return frozenset(held)
+
+    # -- the verdicts --------------------------------------------------------
+
+    def _resolve(self, ref: Tuple[str, str], cls: str) -> List[int]:
+        """Call-edge resolution: indexes of the defs a reference can
+        mean. ``self.m`` binds strictly within the class; a bare name
+        binds ONLY when the tree defines it exactly once — resolving a
+        generic name (`set`, `close`, `run`) to every same-named def
+        would smear thread roots across unrelated subsystems (the
+        UDA102 generic-name problem, solved here by abstention: a
+        missed edge costs a missed finding, never a false one)."""
+        kind, name = ref
+        hits = [i for i, d in enumerate(self._defs) if d.name == name]
+        if kind == "self":
+            return [i for i in hits if self._defs[i].cls == cls]
+        if kind.startswith("recv:"):
+            # receiver-informed: `self.store.drain()` resolves into the
+            # one class ever constructed into a var/attr named `store`
+            classes = {c for c in self._ctor_vars.get(kind[5:], ())
+                       if any(self._defs[i].cls == c for i in hits)}
+            if len(classes) == 1:
+                tgt = next(iter(classes))
+                return [i for i in hits if self._defs[i].cls == tgt]
+        return hits if len(hits) == 1 else []
+
+    def _propagate_roots(self) -> None:
+        for root, ref, cls in self._spawned:
+            for i in self._resolve(ref, cls):
+                self._defs[i].roots.add(root)
+        work = [i for i, d in enumerate(self._defs) if d.roots]
+        while work:
+            i = work.pop()
+            d = self._defs[i]
+            for ref in d.calls:
+                for j in self._resolve(ref, d.cls):
+                    tgt = self._defs[j]
+                    if not d.roots <= tgt.roots:
+                        tgt.roots |= d.roots
+                        work.append(j)
+
+    def finalize(self) -> Iterable[Finding]:
+        self._propagate_roots()
+        in_scope = self._locked_classes | _DECLARED_SHARED
+        by_class: Dict[Tuple[str, str], List[Tuple[_Def, _Access]]] = {}
+        for d in self._defs:
+            if not d.cls or d.cls not in in_scope or not d.roots \
+                    or d.name in _CONFINED_METHODS:
+                continue
+            for a in d.accesses:
+                by_class.setdefault((d.file, d.cls), []).append((d, a))
+        findings: List[Finding] = []
+        for (file, cls), pairs in sorted(by_class.items()):
+            info = self._classes.get((file, cls))
+            by_attr: Dict[str, List[Tuple[_Def, _Access]]] = {}
+            for d, a in pairs:
+                by_attr.setdefault(a.attr, []).append((d, a))
+            for attr, acc in sorted(by_attr.items()):
+                findings.extend(self._judge(file, cls, attr, acc, info))
+        # bare waivers: a lockfree= with no justification is itself a
+        # finding — every suppression carries its why
+        for (file, cls), info in sorted(self._classes.items()):
+            for attr, (lno, just) in sorted(info.lockfree.items()):
+                if just is None or not just.strip():
+                    findings.append(Finding(
+                        file, lno, 0, "UDA201",
+                        f"lockfree waiver for {cls}.{attr} carries no "
+                        f"justification",
+                        "append ` - <why this is GIL-atomic/confined>` "
+                        "to the waiver comment"))
+        findings.sort(key=lambda f: (f.file, f.line, f.rule))
+        return findings
+
+    def _judge(self, file: str, cls: str, attr: str,
+               acc: List[Tuple[_Def, _Access]],
+               info: Optional[_ClassInfo]) -> Iterable[Finding]:
+        roots: Set[str] = set()
+        for d, _ in acc:
+            roots |= d.roots
+        writes = [(d, a) for d, a in acc if a.write]
+        if len(roots) < 2 or not writes:
+            return ()
+        if info is not None and attr in info.lockfree:
+            return ()  # waived (bare waivers are reported separately)
+        common = frozenset.intersection(*[a.locks for _, a in acc])
+        if common:
+            return ()  # consistently guarded
+        witnesses = {}
+        for root in sorted(roots):
+            for d, a in acc:
+                if root in d.roots:
+                    witnesses[root] = (f"{d.file}:{a.line} "
+                                       f"({'write' if a.write else 'read'}"
+                                       f" in {cls}.{d.name}, locks="
+                                       f"{sorted(a.locks) or '[]'})")
+                    break
+        data = {"class": cls, "attr": attr,
+                "roots": sorted(roots), "witnesses": witnesses}
+        held_sets = {a.locks for _, a in acc}
+        d0, a0 = writes[0]
+        if all(not s for s in held_sets):
+            return (Finding(
+                file, a0.line, a0.col, "UDA201",
+                f"{cls}.{attr} is written with NO lock held but is "
+                f"reachable from {len(roots)} thread roots "
+                f"({', '.join(sorted(roots))}); witnesses: "
+                f"{'; '.join(f'{r}: {w}' for r, w in witnesses.items())}",
+                self.hint, data),)
+        # some accesses hold a lock: either an escape (empty lockset
+        # somewhere) or mixed guards (all non-empty, no intersection)
+        bare = [(d, a) for d, a in acc if not a.locks]
+        if bare:
+            tally: Dict[str, int] = {}
+            for _, a in acc:
+                for lk in a.locks:
+                    tally[lk] = tally.get(lk, 0) + 1
+            inferred = max(tally, key=lambda k: tally[k])
+            d1, a1 = next(((d, a) for d, a in bare if a.write), bare[0])
+            return (Finding(
+                file, a1.line, a1.col, "UDA202",
+                f"{cls}.{attr} is guarded by {inferred!r} elsewhere but "
+                f"this {'write' if a1.write else 'read'} "
+                f"(in {d1.name}) holds no lock — the check-then-act "
+                f"escape; witnesses: "
+                f"{'; '.join(f'{r}: {w}' for r, w in witnesses.items())}",
+                f"move the access under `with {inferred}:` (or waive "
+                f"with `# udarace: lockfree={attr} - <why>`)", data),)
+        locksets = sorted({tuple(sorted(s)) for s in held_sets})
+        return (Finding(
+            file, a0.line, a0.col, "UDA203",
+            f"{cls}.{attr} is guarded by DIFFERENT locks on different "
+            f"paths ({' vs '.join(str(list(s)) for s in locksets)}) — "
+            f"no common lock, mutual exclusion protects nothing; "
+            f"witnesses: "
+            f"{'; '.join(f'{r}: {w}' for r, w in witnesses.items())}",
+            "pick ONE lock for this attribute and use it on every "
+            "access", data),)
+
+
+def _cfg_must_hold(func) -> Dict[int, FrozenSet[str]]:
+    """Forward must-hold dataflow over explicit ``X.acquire()`` /
+    ``X.release()`` pairs: id(stmt ast) -> locks held ON ENTRY to that
+    statement on EVERY path. `with` blocks are handled lexically by the
+    caller (the CFG has no with-exit node); this pass exists for the
+    manual-pair shape, where the finally-copy discipline of
+    :func:`build_cfg` is what makes `release()` in a finally kill the
+    obligation on both the normal and exceptional continuation."""
+    acquires: Set[str] = set()
+    for sub in ast.walk(func):
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in ("acquire", "release"):
+            key = _expr_key(sub.func.value)
+            if key is not None and not key.startswith("self.__"):
+                acquires.add(key)
+    if not acquires:
+        return {}
+    try:
+        cfg = build_cfg(func)
+    except RecursionError:
+        return {}
+    universe = frozenset(acquires)
+
+    def transfer(node, state: FrozenSet[str]) -> FrozenSet[str]:
+        out = set(state)
+        for e in node.exprs:
+            for sub in ast.walk(e):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute):
+                    key = _expr_key(sub.func.value)
+                    if key is None:
+                        continue
+                    if sub.func.attr == "acquire":
+                        out.add(key)
+                    elif sub.func.attr == "release":
+                        out.discard(key)
+        return frozenset(out)
+
+    n = len(cfg.nodes)
+    in_state: List[FrozenSet[str]] = [universe] * n
+    in_state[cfg.entry] = frozenset()
+    work = [cfg.entry]
+    while work:
+        i = work.pop()
+        node = cfg.nodes[i]
+        out_norm = transfer(node, in_state[i])
+        out_exc = in_state[i]  # an acquire that raised did not acquire
+        for succs, out in ((node.norm_succs, out_norm),
+                           (node.exc_succs, out_exc)):
+            for s in succs:
+                met = in_state[s] & out
+                if met != in_state[s]:
+                    in_state[s] = met
+                    work.append(s)
+    result: Dict[int, FrozenSet[str]] = {}
+    for node in cfg.nodes:
+        if node.stmt is None:
+            continue
+        key = id(node.stmt)
+        # finally copies: the same stmt can appear on several nodes —
+        # must-hold means the intersection over every copy
+        result[key] = result.get(key, universe) & in_state[node.index]
+    return result
+
+
+# -- UDA204 ------------------------------------------------------------------
+
+class WireExhaustivenessRule(Rule):
+    """UDA204: the MSG_* frame inventory must be total (see the module
+    docstring). Tree-wide: wire.py declares the constants and the
+    ``WIRE_CODECS`` encoder/decoder table; server.py/client.py provide
+    the dispatch arms; finalize() joins the three."""
+
+    rule_id = "UDA204"
+    description = ("every MSG_* frame type carries a WIRE_CODECS "
+                   "encoder/decoder entry and a dispatch arm in "
+                   "net/server.py or net/client.py")
+    hint = ("add the WIRE_CODECS entry (decoder None needs an on-line "
+            "justification comment) and wire the dispatch arm, or "
+            "remove the dead constant")
+    node_types = (ast.Assign, ast.FunctionDef, ast.Compare)
+
+    def __init__(self) -> None:
+        # constant name -> (file, line)
+        self._consts: Dict[str, Tuple[str, int]] = {}
+        # constant name -> (encoder, decoder-or-None, line, has_comment)
+        self._codecs: Dict[str, Tuple[Optional[str], Optional[str],
+                                      int, bool]] = {}
+        self._codecs_file: Optional[str] = None
+        self._wire_funcs: Set[str] = set()
+        self._dispatched: Set[str] = set()
+        self._saw_dispatch_file = False
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._in_wire = ctx.basename == "wire.py" and ctx.in_net
+        self._in_dispatch = (ctx.basename in ("server.py", "client.py")
+                             and ctx.in_net)
+        if self._in_dispatch:
+            self._saw_dispatch_file = True
+
+    def visit(self, node, ctx: FileContext) -> Iterable[Finding]:
+        if isinstance(node, ast.Compare):
+            if self._in_dispatch:
+                for sub in ast.walk(node):
+                    seg = _last_segment(sub) \
+                        if isinstance(sub, (ast.Name, ast.Attribute)) \
+                        else None
+                    if seg and seg.startswith("MSG_"):
+                        self._dispatched.add(seg)
+            return ()
+        if not self._in_wire:
+            return ()
+        if isinstance(node, ast.FunctionDef):
+            self._wire_funcs.add(node.name)
+            return ()
+        # ast.Assign in wire.py
+        for tgt in node.targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if tgt.id.startswith("MSG_") \
+                    and isinstance(node.value, ast.Constant):
+                self._consts[tgt.id] = (ctx.rel, node.lineno)
+            elif tgt.id == "WIRE_CODECS" \
+                    and isinstance(node.value, ast.Dict):
+                self._codecs_file = ctx.rel
+                self._take_codecs(node.value, ctx)
+        return ()
+
+    def _take_codecs(self, d: ast.Dict, ctx: FileContext) -> None:
+        lines = ctx.source.splitlines()
+        for key, val in zip(d.keys, d.values):
+            seg = _last_segment(key) if key is not None else None
+            if seg is None or not seg.startswith("MSG_"):
+                continue
+            enc = dec = None
+            if isinstance(val, (ast.Tuple, ast.List)) \
+                    and len(val.elts) == 2:
+                e0, e1 = val.elts
+                if isinstance(e0, ast.Constant) \
+                        and isinstance(e0.value, str):
+                    enc = e0.value
+                if isinstance(e1, ast.Constant) \
+                        and isinstance(e1.value, str):
+                    dec = e1.value
+            line = getattr(val, "lineno", d.lineno)
+            end = getattr(val, "end_lineno", line)
+            has_comment = any("#" in lines[ln - 1]
+                              for ln in range(line, end + 1)
+                              if ln <= len(lines))
+            self._codecs[seg] = (enc, dec, line, has_comment)
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._consts:
+            return ()
+        findings: List[Finding] = []
+        for const, (file, line) in sorted(self._consts.items()):
+            entry = self._codecs.get(const)
+            if entry is None:
+                findings.append(Finding(
+                    file, line, 0, self.rule_id,
+                    f"{const} has no WIRE_CODECS entry — the frame "
+                    f"family is half-wired (no declared encoder/strict "
+                    f"decoder)", self.hint))
+                continue
+            enc, dec, eline, has_comment = entry
+            efile = self._codecs_file or file
+            if enc is None or enc not in self._wire_funcs:
+                findings.append(Finding(
+                    efile, eline, 0, self.rule_id,
+                    f"{const}: declared encoder "
+                    f"{enc!r} is not defined in wire.py", self.hint))
+            if dec is None:
+                if not has_comment:
+                    findings.append(Finding(
+                        efile, eline, 0, self.rule_id,
+                        f"{const}: decoder is None without an on-line "
+                        f"justification comment (empty-payload / "
+                        f"reserved frames must say so)", self.hint))
+            elif dec not in self._wire_funcs:
+                findings.append(Finding(
+                    efile, eline, 0, self.rule_id,
+                    f"{const}: declared decoder "
+                    f"{dec!r} is not defined in wire.py", self.hint))
+            if self._saw_dispatch_file \
+                    and const not in self._dispatched:
+                findings.append(Finding(
+                    file, line, 0, self.rule_id,
+                    f"{const} has no dispatch arm in net/server.py or "
+                    f"net/client.py — a peer sending it gets silence "
+                    f"or a generic unsupported-frame error",
+                    self.hint))
+        return findings

@@ -55,6 +55,7 @@ from typing import Iterable, List, Optional, Set, Tuple
 from uda_tpu.analysis.core import FileContext, Finding, Rule
 from uda_tpu.analysis.flow import (ResourceBalanceRule, StaticLockOrderRule,
                                    TransitiveBlockingRule)
+from uda_tpu.analysis.race import RaceLocksetRule, WireExhaustivenessRule
 
 __all__ = ["ALL_RULES", "default_engine",
            "ConfigKeyRule", "MetricsNameRule", "FailpointSiteRule",
@@ -643,7 +644,10 @@ ALL_RULES = (ConfigKeyRule, MetricsNameRule, FailpointSiteRule,
              EventLoopBlockingRule, SpanNameRule,
              # the udaflow dataflow tier (uda_tpu/analysis/flow.py)
              ResourceBalanceRule, TransitiveBlockingRule,
-             StaticLockOrderRule)
+             StaticLockOrderRule,
+             # the udarace lockset tier (uda_tpu/analysis/race.py):
+             # UDA201/202/203 from the one collector + UDA204
+             RaceLocksetRule, WireExhaustivenessRule)
 
 
 def default_engine(root: Optional[str] = None):

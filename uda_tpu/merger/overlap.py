@@ -221,12 +221,18 @@ class OverlappedMerger:
         # reduce-task root) so their pack/stage/merge timers land in the
         # right trace subtree
         self._parent_span = metrics.current_span()
+        # udarace: lockfree=_q,_staged_q - queue.Queue is internally
+        # locked; cross-thread put/get rides the Queue's own mutex
         self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
+        # udarace: lockfree=_aborted,_overflow - one-way bool latches
+        # (GIL-atomic store; readers may lag one item, by design)
         self._aborted = False
         self._forest: dict[int, _Run] = {}   # capacity -> run
         self._forest_lock = threading.Lock()
         self._state_lock = threading.Lock()  # counters/overflow flag
         self._overflow = False
+        # udarace: lockfree=_error - first-error latch: a lagging racer
+        # overwrites with its own exception, either surfaces at finish()
         self._error: Optional[Exception] = None
         self._merges = 0
         self._staged = 0
@@ -863,10 +869,15 @@ class OverlappedMerger:
         preserved) — capacities stay powers of two, so kernel shapes
         stay in the O(log) compiled set. Returns None when nothing was
         staged."""
-        if not self._forest:
-            return None
-        runs = [self._forest[c] for c in sorted(self._forest)]
-        self._forest = {}  # release device-resident runs when done
+        # UDA202 (udarace): _insert writes the forest under
+        # _forest_lock; take it here too — the leftover merge runs
+        # after the stage pool quiesces, but "after join" is an
+        # ordering argument the lock makes unnecessary (uncontended)
+        with self._forest_lock:
+            if not self._forest:
+                return None
+            runs = [self._forest[c] for c in sorted(self._forest)]
+            self._forest = {}  # release device-resident runs when done
         acc = runs[0]
         for nxt in runs[1:]:
             if self.engine == "pallas" and acc.capacity < nxt.capacity:

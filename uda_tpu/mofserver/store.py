@@ -69,7 +69,7 @@ from uda_tpu.mofserver.index import (DirIndexResolver, read_index_file,
 from uda_tpu.utils.errors import StorageError, StoreError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.flightrec import flightrec
-from uda_tpu.utils.locks import TrackedLock
+from uda_tpu.utils.locks import TrackedLock, race_instrument
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 from uda_tpu.utils.resledger import resledger
@@ -359,6 +359,7 @@ class BackendHealth:
                               if t > now]}
 
 
+@race_instrument("_migrations")
 class StoreManager:
     """Placement policy + spill ladder + failover router over the two
     tiers. Attach to a DataEngine with ``engine.attach_store(mgr)``:
@@ -662,7 +663,11 @@ class StoreManager:
         entry = {"job": job_id, "map": map_id, "reason": reason,
                  "src": src_mof, "dst": dst_mof, "bytes": copied,
                  "crc": crc, "shadow": shadow, "cutover": cutover}
-        self._migrations.append(entry)
+        # UDA201 (udarace): the migration log is appended on the
+        # producer/drain thread and iterated by resume revalidation on
+        # the merge thread — every touch goes through self._lock
+        with self._lock:
+            self._migrations.append(entry)
         flightrec.record("store.migrate", key=key, reason=reason,
                          bytes=copied, shadow=shadow)
         log.info(f"store: migrated {key} -> blob tier ({copied} bytes, "
@@ -704,7 +709,9 @@ class StoreManager:
         resume, not as a late Segment CRC mismatch blamed on the
         wire."""
         n = 0
-        for entry in list(self._migrations):
+        with self._lock:
+            entries = list(self._migrations)
+        for entry in entries:
             if job_id is not None and entry["job"] != job_id:
                 continue
             dst = entry["dst"]

@@ -51,7 +51,7 @@ from typing import Optional
 from uda_tpu.utils.errors import UdaError
 from uda_tpu.utils.failpoints import failpoint
 from uda_tpu.utils.ifile import crack_partial
-from uda_tpu.utils.locks import TrackedLock
+from uda_tpu.utils.locks import TrackedLock, race_instrument
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -106,6 +106,7 @@ class _MapStage:
         return len(self.mem) + self.spill_bytes
 
 
+@race_instrument("_maps")
 class PushStaging:
     """Reduce-side staging for one (job, reduce): the landing zone of
     MSG_PUSH chunks and the preload source of the merge's Segments.
@@ -323,6 +324,7 @@ class _ConnSub:
         self.pull_only: set = set()   # {(job_id, reduce_id, map_id)}
 
 
+@race_instrument("_subs", "_commits", "_inflight")
 class PushScheduler:
     """Supplier-side push pump, owned by the event-loop ShuffleServer.
 

@@ -1384,6 +1384,9 @@ class EvLoopShuffleServer:
         # every subsequent HELLO banner carries CAP_DRAINING so reduce
         # sides stop placing NEW work here while in-flight serves
         # complete; the store layer migrates retained MOFs in parallel
+        # udarace: lockfree=_draining - one-way bool latch flipped by
+        # the control thread; the loop reading it one accept late just
+        # sends one more non-draining banner (harmless, self-corrects)
         self._draining = False
         self._marks: dict = {}  # "peer|job|map|reduce" -> served end
         self._marks_lock = threading.Lock()

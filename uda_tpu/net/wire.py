@@ -124,7 +124,7 @@ from uda_tpu.utils.errors import (CompressionError, ConfigError, MergeError,
                                   ProtocolError, StorageError, StoreError,
                                   TenantError, TransportError, UdaError)
 
-__all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER",
+__all__ = ["MAGIC", "WIRE_VERSION", "MAX_FRAME", "HEADER", "WIRE_CODECS",
            "MSG_REQ", "MSG_DATA", "MSG_ERR", "MSG_SIZE_REQ", "MSG_SIZE",
            "MSG_HELLO", "MSG_STATS", "MSG_STATS_REPLY",
            "MSG_JOB", "MSG_JOB_OK",
@@ -196,6 +196,31 @@ MSG_PUSH_NACK = 14   # push refused: reason code. The supplier marks
 _TYPES = (MSG_REQ, MSG_DATA, MSG_ERR, MSG_SIZE_REQ, MSG_SIZE, MSG_HELLO,
           MSG_STATS, MSG_STATS_REPLY, MSG_JOB, MSG_JOB_OK,
           MSG_PUSH, MSG_PUSH_SUB, MSG_PUSH_ACK, MSG_PUSH_NACK)
+
+# The frame-family exhaustiveness table (udalint UDA204): every MSG_*
+# constant maps to its (encoder, strict decoder) by NAME, and the lint
+# verifies the named functions exist here and that a dispatch arm in
+# net/server.py or net/client.py handles the type. A decoder of None is
+# legal ONLY for header-only frames and must carry its reason on the
+# same line — this is how the next PR-19-style frame family is forced
+# to land fully wired (encoder + decoder + dispatch) or not at all.
+WIRE_CODECS = {
+    MSG_REQ: ("encode_request", "decode_request"),
+    MSG_DATA: ("encode_result", "decode_result"),
+    MSG_ERR: ("encode_error", "decode_error"),
+    MSG_SIZE_REQ: ("encode_size_request", "decode_size_request"),
+    MSG_SIZE: ("encode_size", "decode_size"),
+    MSG_HELLO: ("encode_hello", "decode_hello"),
+    MSG_STATS: ("encode_stats_request", "decode_stats_request"),
+    MSG_STATS_REPLY: ("encode_stats_reply", "decode_stats_reply"),
+    MSG_JOB: ("encode_job", "decode_job"),
+    MSG_JOB_OK: ("encode_job_ok", "decode_job_ok"),
+    MSG_PUSH: ("encode_push", "decode_push_take"),
+    MSG_PUSH_SUB: ("encode_push_sub", "decode_push_sub"),
+    MSG_PUSH_ACK: ("encode_push_ack",
+                   None),  # header-only: the echoed push id IS the ack
+    MSG_PUSH_NACK: ("encode_push_nack", "decode_push_nack"),
+}
 # the header accepts any type in this reserved range; semantically
 # unknown ones get a typed ERR from the server, never a teardown (the
 # forward-compat contract — see the module docstring). Anything past

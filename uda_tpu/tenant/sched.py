@@ -60,6 +60,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from uda_tpu.utils.locks import race_instrument
 from uda_tpu.utils.logging import get_logger
 from uda_tpu.utils.metrics import metrics
 
@@ -81,6 +82,7 @@ class _TenantQ:
         # grant (cost/weight units) — the force-serve pick's clock
 
 
+@race_instrument("_tenants")
 class CreditScheduler:
     """``total`` credits shared across tenants; ``weight_of(tenant)``
     supplies the live weights (the registry's view, consulted at each

@@ -47,14 +47,26 @@ import traceback
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["LockDep", "TrackedLock", "TrackedCondition", "lockdep",
-           "lockdep_enabled_from_env"]
+           "lockdep_enabled_from_env", "RaceDetector", "racedet",
+           "race_instrument", "racedet_enabled_from_env",
+           "RACE_INSTRUMENTED"]
+
+
+def _env_flag(var: str) -> bool:
+    return os.environ.get(var, "").strip().lower() in (
+        "1", "true", "yes", "on")
 
 
 def lockdep_enabled_from_env() -> bool:
     """UDA_TPU_LOCKDEP=1 (or true/yes/on) arms the validator for the
     whole process."""
-    return os.environ.get("UDA_TPU_LOCKDEP", "").strip().lower() in (
-        "1", "true", "yes", "on")
+    return _env_flag("UDA_TPU_LOCKDEP")
+
+
+def racedet_enabled_from_env() -> bool:
+    """UDA_TPU_RACEDET=1 (or true/yes/on) arms the Eraser state machine
+    for the whole process."""
+    return _env_flag("UDA_TPU_RACEDET")
 
 
 class LockDep:
@@ -275,12 +287,16 @@ class TrackedLock:
         got = self._lock.acquire(blocking, timeout)
         if got and dep.enabled:
             dep.note_acquire(self)
+        if got and _race_tracking.on:
+            _race_tracking.note_acquire(self)
         return got
 
     def release(self) -> None:
         self._lock.release()
         if self._dep.enabled:
             self._dep.note_release(self)
+        if _race_tracking.on:
+            _race_tracking.note_release(self)
 
     def locked(self) -> bool:
         return self._lock.locked()
@@ -328,21 +344,29 @@ class TrackedCondition:
         dep = self._tlock._dep
         if dep.enabled:
             dep.note_release(self._tlock)
+        if _race_tracking.on:
+            _race_tracking.note_release(self._tlock)
         try:
             return self._cond.wait(timeout)
         finally:
             if dep.enabled:
                 dep.note_acquire(self._tlock)
+            if _race_tracking.on:
+                _race_tracking.note_acquire(self._tlock)
 
     def wait_for(self, predicate, timeout: Optional[float] = None):
         dep = self._tlock._dep
         if dep.enabled:
             dep.note_release(self._tlock)
+        if _race_tracking.on:
+            _race_tracking.note_release(self._tlock)
         try:
             return self._cond.wait_for(predicate, timeout)
         finally:
             if dep.enabled:
                 dep.note_acquire(self._tlock)
+            if _race_tracking.on:
+                _race_tracking.note_acquire(self._tlock)
 
     def notify(self, n: int = 1) -> None:
         self._cond.notify(n)
@@ -352,3 +376,267 @@ class TrackedCondition:
 
     def __repr__(self) -> str:
         return f"TrackedCondition({self._tlock.name!r})"
+
+
+# ---------------------------------------------------------------------------
+# The runtime race detector (udarace, the dynamic half of UDA201-203):
+# a sampling Eraser lockset state machine over the attributes
+# race_instrument() hooks. Per (object, attr) the machine walks
+# virgin -> exclusive -> shared -> shared-modified exactly like Eraser
+# (Savage et al.): the first thread owns the field without lockset
+# constraints (init-then-publish is legal); the moment a SECOND thread
+# touches it, the candidate lockset starts as the locks that thread
+# holds and every later access intersects it; an empty candidate set on
+# a shared-modified field is a data race, reported once per
+# (class, attr) with BOTH stacks — the current access and the most
+# recent access from the other thread — like lockdep's cycle reports.
+# ---------------------------------------------------------------------------
+
+_EXCLUSIVE, _SHARED, _SHARED_MOD = 0, 1, 2
+
+
+class _RaceState:
+    """Per-(object, attr) machine state. ``lockset`` is None while the
+    field is still thread-exclusive (the Eraser 'universe' — no
+    constraint yet) and a set of lock ids once shared."""
+
+    __slots__ = ("state", "owner", "lockset", "prev", "prev_cross")
+
+    def __init__(self, owner: int):
+        self.state = _EXCLUSIVE
+        self.owner = owner
+        self.lockset: Optional[frozenset] = None
+        # (thread ident, thread name, op, stack) of the last sampled
+        # access, and of the last one from a DIFFERENT thread than the
+        # current accessor — the "other side" of a race report
+        self.prev: Optional[Tuple[int, str, str, str]] = None
+        self.prev_cross: Optional[Tuple[int, str, str, str]] = None
+
+
+class _RaceTracking:
+    """Shared held-lock bookkeeping: per-thread held sets are a fact
+    about THREADS, not about any one detector, so every RaceDetector
+    (the global one and the private test instances) reads the same
+    table. TrackedLock feeds it whenever any enabled detector exists —
+    one attribute check (``_race_tracking.on``) on the disabled path."""
+
+    def __init__(self):
+        self.on = False
+        self._tls = threading.local()
+
+    def held(self) -> Dict[int, str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = {}
+        return held
+
+    def note_acquire(self, lock: "TrackedLock") -> None:
+        self.held()[id(lock)] = lock.name
+
+    def note_release(self, lock: "TrackedLock") -> None:
+        self.held().pop(id(lock), None)
+
+
+_race_tracking = _RaceTracking()
+
+
+class RaceDetector:
+    """The Eraser machine. One global instance (:data:`racedet`) serves
+    every race_instrument() hook; tests that SEED races use private
+    instances so fixture races never pollute the real code's zero-race
+    invariant (mirroring LockDep's private-instance discipline). All
+    instances share the per-thread held-lock table TrackedLock feeds
+    (:class:`_RaceTracking`)."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 emit_metrics: bool = False,
+                 sample: Optional[int] = None):
+        self.enabled = (racedet_enabled_from_env() if enabled is None
+                        else bool(enabled))
+        self.emit_metrics = emit_metrics
+        if sample is None:
+            sample = int(os.environ.get("UDA_TPU_RACEDET_SAMPLE", "1")
+                         or "1")
+        self.sample = max(1, sample)
+        self._mu = threading.Lock()   # raw: must not validate itself
+        self._tls = threading.local()
+        self._state: Dict[Tuple[int, str], _RaceState] = {}
+        self._reported: set = set()
+        self.races: List[dict] = []
+        if self.enabled:
+            _race_tracking.on = True
+
+    # -- per-thread held-lock set (fed by TrackedLock) -----------------------
+
+    def _held(self) -> Dict[int, str]:
+        return _race_tracking.held()
+
+    def note_acquire(self, lock: "TrackedLock") -> None:
+        _race_tracking.note_acquire(lock)
+
+    def note_release(self, lock: "TrackedLock") -> None:
+        _race_tracking.note_release(lock)
+
+    # -- the machine ---------------------------------------------------------
+
+    def access(self, obj, attr: str, is_write: bool) -> None:
+        """One sampled access to an instrumented attribute. The caller
+        (the race_instrument property) already checked ``enabled``."""
+        if getattr(self._tls, "busy", False):
+            return  # a report in progress touches instrumented state
+        if self.sample > 1:
+            n = getattr(self._tls, "n", 0) + 1
+            self._tls.n = n
+            if n % self.sample:
+                return
+        tid = threading.get_ident()
+        held = frozenset(self._held())
+        key = (id(obj), attr)
+        race_note = None
+        with self._mu:
+            st = self._state.get(key)
+            if st is None:
+                st = self._state[key] = _RaceState(tid)
+                # record the birth access: it is the "other side" of
+                # the first cross-thread race report (usually the
+                # init-then-publish write in __init__)
+                st.prev = (tid, threading.current_thread().name,
+                           "write" if is_write else "read",
+                           "".join(traceback.format_stack()[:-2]))
+                return
+            op = "write" if is_write else "read"
+            stack = "".join(traceback.format_stack()[:-2])
+            rec = (tid, threading.current_thread().name, op, stack)
+            if st.prev is not None and st.prev[0] != tid:
+                st.prev_cross = st.prev
+            if st.state == _EXCLUSIVE and tid == st.owner:
+                # still single-threaded: no lockset constraint, but
+                # remember the stack — it is the "other side" the first
+                # cross-thread race report needs
+                st.prev = rec
+                return
+            # second thread (or already shared): intersect candidates
+            st.lockset = (held if st.lockset is None
+                          else st.lockset & held)
+            if is_write or st.state == _SHARED_MOD:
+                st.state = _SHARED_MOD
+            else:
+                st.state = _SHARED
+            st.prev = rec
+            if st.state == _SHARED_MOD and not st.lockset:
+                race_note = (type(obj).__name__, rec, st.prev_cross)
+        if race_note is not None:
+            cls_name, rec, cross = race_note
+            stacks = {f"{rec[2]} on {rec[1]} (now)": rec[3]}
+            if cross is not None:
+                stacks[f"{cross[2]} on {cross[1]}"] = cross[3]
+            self._report(cls_name, attr, stacks)
+
+    # -- reporting -----------------------------------------------------------
+
+    def _report(self, cls_name: str, attr: str,
+                stacks: Dict[str, str]) -> None:
+        key = (cls_name, attr)
+        with self._mu:
+            if key in self._reported:
+                return
+            self._reported.add(key)
+            note = (f"{cls_name}.{attr} is written from multiple "
+                    f"threads with no consistently held lock")
+            rep = {"class": cls_name, "attr": attr, "note": note,
+                   "stacks": stacks}
+            self.races.append(rep)
+        self._tls.busy = True
+        try:
+            lines = [f"RACEDET: data race: {note}"]
+            for label, stk in stacks.items():
+                if stk:
+                    lines.append(f"-- {label} --\n{stk}")
+            text = "\n".join(lines)
+            try:
+                from uda_tpu.utils.logging import get_logger
+                get_logger().error(text)
+            except Exception:  # noqa: BLE001 - the report must survive
+                print(text)    # a half-imported logging module
+            if self.emit_metrics:
+                try:
+                    from uda_tpu.utils.metrics import metrics
+                    metrics.add("racedet.races")
+                except Exception as e:  # noqa: BLE001
+                    print(f"racedet: metrics unavailable: {e}")
+                out = os.environ.get("UDA_TPU_RACEDET_JSON")
+                if out:
+                    try:
+                        # one compact line per race: the chaos ladder
+                        # greps these; stacks stay in the log/report
+                        with open(out, "a") as f:
+                            f.write(json.dumps(
+                                {"class": cls_name, "attr": attr,
+                                 "note": note}) + "\n")
+                    except OSError as e:
+                        print(f"racedet: cannot append {out}: {e}")
+        finally:
+            self._tls.busy = False
+
+    def reset(self) -> None:
+        """Forget machine state, reports and dedup keys (tests). Held
+        sets are per-thread reality and survive."""
+        with self._mu:
+            self._state.clear()
+            self._reported.clear()
+            self.races.clear()
+
+
+racedet = RaceDetector(emit_metrics=True)
+
+
+# module qualname -> instrumented attrs: ALWAYS recorded (armed or
+# not) so the static<->runtime lockstep test can compare this registry
+# against analysis/threads.py RUNTIME_INSTRUMENTED without re-importing
+# the world under UDA_TPU_RACEDET=1
+RACE_INSTRUMENTED: Dict[str, Tuple[str, ...]] = {}
+
+
+def race_instrument(*attrs: str, det: Optional[RaceDetector] = None):
+    """Class decorator hooking ``attrs`` into the race detector.
+
+    Zero-overhead-when-off contract, stricter than lockdep's: with
+    ``UDA_TPU_RACEDET`` unset the class is returned UNTOUCHED — plain
+    attributes, no descriptor in the lookup path — so the hot tables
+    (conn maps, staging ladders, credit ledgers) pay nothing. Armed,
+    each attr becomes a property whose fast path is one ``enabled``
+    check before the instance-dict access; every read/write feeds
+    :meth:`RaceDetector.access`. Incompatible with ``__slots__`` on
+    the decorated class (the hooks store through the instance dict)."""
+
+    def deco(cls):
+        d = det if det is not None else racedet
+        RACE_INSTRUMENTED[f"{cls.__module__}.{cls.__qualname__}"] = attrs
+        if not d.enabled:
+            return cls
+        if "__slots__" in cls.__dict__:
+            raise TypeError(
+                f"race_instrument: {cls.__name__} declares __slots__; "
+                f"the hooks need an instance dict")
+        for name in attrs:
+            def _mk(name=name):
+                def _get(self):
+                    if d.enabled:
+                        d.access(self, name, False)
+                    return self.__dict__[name]
+
+                def _set(self, value):
+                    if d.enabled:
+                        d.access(self, name, True)
+                    self.__dict__[name] = value
+
+                def _del(self):
+                    if d.enabled:
+                        d.access(self, name, True)
+                    del self.__dict__[name]
+
+                return property(_get, _set, _del)
+            setattr(cls, name, _mk())
+        return cls
+
+    return deco

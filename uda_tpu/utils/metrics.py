@@ -158,6 +158,11 @@ METRICS_REGISTRY: Dict[str, tuple] = {
                                   "deadlocks) detected by the runtime "
                                   "validator (utils/locks.py, "
                                   "UDA_TPU_LOCKDEP=1)"),
+    "racedet.races": ("counter", "data races (shared-modified field "
+                                 "with an empty candidate lockset) "
+                                 "detected by the runtime Eraser "
+                                 "machine (utils/locks.py, "
+                                 "UDA_TPU_RACEDET=1)"),
     "resledger.leaks": ("counter", "obligations (leases, fd pins, "
                                    "admission charges, paired-gauge "
                                    "increments) still open at a drain "
